@@ -1,0 +1,14 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+transition-era PaddlePaddle (v2 + Fluid).
+
+Structure:
+  paddle_tpu.fluid     program IR + layers + lowering executor (the core)
+  paddle_tpu.parallel  device meshes, SPMD sharding, distributed init
+  paddle_tpu.models    the "book" model zoo (fit_a_line ... transformer)
+  paddle_tpu.ops       Pallas TPU kernels for ops XLA fusion can't cover
+  paddle_tpu.utils     profiler, flags, misc runtime utilities
+"""
+
+from . import fluid  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""paddle_tpu.fluid — the Fluid-style front end of the TPU-native framework.
+
+API mirror of python/paddle/v2/fluid/__init__.py: programs of blocks of ops
+built by ``layers.*``, differentiated by ``append_backward``/Optimizer,
+executed by an Executor that lowers whole blocks to XLA (instead of
+dispatching per-op kernels), with save/load, initializers, regularizers,
+clipping, and profiler."""
+
+from . import ops as _ops  # registers all op emitters  # noqa: F401
+from . import (clip, initializer, io, layers, nets, optimizer, regularizer,
+               unique_name)
+from .backward import append_backward, calc_gradient
+from .core.lod import SeqArray, make_seq
+from .core.registry import registered_ops
+from .data_feeder import DataFeeder
+from .executor import (CPUPlace, Executor, Scope, TPUPlace, global_scope,
+                       scope_guard)
+from .framework import (Block, Operator, Parameter, Program, Variable,
+                        default_main_program, default_startup_program,
+                        program_guard, switch_main_program,
+                        switch_startup_program)
+from .param_attr import ParamAttr
+
+__all__ = [
+    "layers", "optimizer", "initializer", "regularizer", "clip", "io",
+    "nets", "unique_name",
+    "append_backward", "calc_gradient",
+    "Executor", "Scope", "global_scope", "scope_guard",
+    "TPUPlace", "CPUPlace",
+    "Program", "Block", "Operator", "Variable", "Parameter", "ParamAttr",
+    "default_main_program", "default_startup_program", "program_guard",
+    "switch_main_program", "switch_startup_program",
+    "SeqArray", "make_seq", "registered_ops", "DataFeeder",
+]

@@ -1,0 +1,145 @@
+"""Gradient / error clipping — analog of python/paddle/v2/fluid/clip.py
+(ErrorClipByValue:40, GradientClipByValue:101, GradientClipByNorm:122,
+GradientClipByGlobalNorm).  Clip ops are appended to the program between
+backward and the optimizer ops, so they fuse into the same XLA step."""
+
+from __future__ import annotations
+
+__all__ = ["ErrorClipByValue", "GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "append_gradient_clip_ops",
+           "error_clip_callback", "set_gradient_clip"]
+
+
+class BaseErrorClipAttr:
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        if min is None:
+            min = -max
+        self.max, self.min = float(max), float(min)
+
+    def append_clip_op(self, block, grad_name):
+        gv = block.vars[grad_name]
+        block.append_op("clip", {"X": gv}, {"Out": gv},
+                        {"min": self.min, "max": self.max})
+
+
+def error_clip_callback(block, op):
+    for name in op.output_names:
+        try:
+            var = block.var(name)
+        except KeyError:
+            continue
+        clip = getattr(var, "error_clip", None)
+        if clip is not None:
+            clip.append_clip_op(block, name)
+
+
+class BaseGradientClipAttr:
+    def process_context(self, context, param, grad):
+        pass
+
+    def create_operators(self, param, grad, helper):
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        if min is None:
+            min = -max
+        self.max, self.min = float(max), float(min)
+
+    def create_operators(self, param, grad, helper):
+        out = helper.create_tmp_variable(grad.dtype)
+        helper.append_op("clip", {"X": grad}, {"Out": out},
+                         {"min": self.min, "max": self.max})
+        return param, out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def create_operators(self, param, grad, helper):
+        out = helper.create_tmp_variable(grad.dtype)
+        helper.append_op("clip_by_norm", {"X": grad}, {"Out": out},
+                         {"max_norm": self.clip_norm})
+        return param, out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Two-pass: accumulate squared norms across params, then scale each grad
+    by clip_norm / max(global_norm, clip_norm) (reference clip.py)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def process_context(self, context, param, grad):
+        context.setdefault("sum_squares", []).append(grad)
+
+    def create_operators(self, param, grad, helper, scale_var=None):
+        out = helper.create_tmp_variable(grad.dtype)
+        helper.append_op("elementwise_mul", {"X": grad, "Y": scale_var},
+                         {"Out": out})
+        return param, out
+
+
+_default_clip = None
+
+
+def set_gradient_clip(clip):
+    global _default_clip
+    _default_clip = clip
+
+
+def append_gradient_clip_ops(param_grads, main_program=None):
+    from .layer_helper import LayerHelper
+
+    helper = LayerHelper("gradient_clip", main_program=main_program)
+    context = {}
+    attrs = []
+    for p, g in param_grads:
+        clip = getattr(p, "gradient_clip_attr", None) or _default_clip
+        attrs.append(clip)
+        if clip is not None:
+            clip.process_context(context, p, g)
+
+    scale_var = None
+    if any(isinstance(c, GradientClipByGlobalNorm) for c in attrs):
+        squares = []
+        for g in context.get("sum_squares", []):
+            sq = helper.create_tmp_variable(g.dtype)
+            helper.append_op("squared_l2_norm", {"X": g}, {"Out": sq})
+            squares.append(sq)
+        total = helper.create_tmp_variable("float32")
+        helper.append_op("sum", {"X": squares}, {"Out": total})
+        gnorm = helper.create_tmp_variable("float32")
+        helper.append_op("sqrt", {"X": total}, {"Out": gnorm})
+        clip_norm = next(c.clip_norm for c in attrs
+                         if isinstance(c, GradientClipByGlobalNorm))
+        maxed = helper.create_tmp_variable("float32")
+        helper.append_op("clip", {"X": gnorm}, {"Out": maxed},
+                         {"min": clip_norm, "max": 3.4e38})
+        scale_var = helper.create_tmp_variable("float32")
+        helper.append_op("elementwise_div", {"X": _const(helper, clip_norm),
+                                             "Y": maxed}, {"Out": scale_var})
+
+    out = []
+    for (p, g), clip in zip(param_grads, attrs):
+        if g is None or clip is None:
+            out.append((p, g))
+        elif isinstance(clip, GradientClipByGlobalNorm):
+            out.append(clip.create_operators(p, g, helper, scale_var))
+        else:
+            out.append(clip.create_operators(p, g, helper))
+    return out
+
+
+def _const(helper, value):
+    v = helper.create_tmp_variable("float32")
+    helper.append_op("fill_constant", {}, {"Out": v},
+                     {"shape": [], "value": float(value), "dtype": "float32"})
+    return v

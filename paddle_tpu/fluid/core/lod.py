@@ -1,0 +1,100 @@
+"""Sequence tensors: the TPU-native answer to LoDTensor.
+
+The reference packs variable-length sequences unpadded, carrying level-of-
+detail offsets alongside the data (paddle/framework/lod_tensor.h:109, lod_ at
+:154), and every sequence op walks the offsets.  That representation is hostile
+to XLA (dynamic shapes, gather-heavy), so on TPU we keep the *capability* —
+batches of variable-length sequences with no user-visible padding bookkeeping —
+via a dense padded layout plus per-sequence lengths:
+
+    SeqArray.data     [batch, max_len, *feature_dims]   (padded, static shape)
+    SeqArray.lengths  [batch] int32                     (valid prefix lengths)
+
+Masking replaces offset walking; ``lod_level=1`` semantics (sequence_pool,
+dynamic_lstm, sequence_softmax, ...) are implemented with masks and
+``lax.scan``.  SeqArray is a registered pytree, so it flows through jit/vjp and
+shows up in compiled XLA computations as two ordinary arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["SeqArray", "make_seq", "seq_mask"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SeqArray:
+    """A batch of variable-length sequences: padded data + lengths."""
+
+    __slots__ = ("data", "lengths")
+
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = lengths
+
+    # pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # conveniences ------------------------------------------------------
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def batch_size(self):
+        return self.data.shape[0]
+
+    @property
+    def max_len(self):
+        return self.data.shape[1]
+
+    def mask(self, dtype=None):
+        """[batch, max_len] validity mask (1 inside each sequence)."""
+        m = seq_mask(self.lengths, self.max_len)
+        return m if dtype is None else m.astype(dtype)
+
+    def with_data(self, data):
+        return SeqArray(data, self.lengths)
+
+    def __repr__(self):
+        return f"SeqArray(data={self.data.shape}, lengths={self.lengths.shape})"
+
+
+def seq_mask(lengths, max_len):
+    """[batch, max_len] bool mask from lengths — analog of sequence_mask /
+    the implicit masking the reference gets from LoD offsets."""
+    import jax.numpy as jnp
+
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    return pos < lengths[:, None].astype(jnp.int32)
+
+
+def make_seq(seqs, dtype=None, max_len=None, bucket=None):
+    """Host-side packing: list of per-sequence arrays -> SeqArray (numpy).
+
+    The analog of LoDTensor construction from nested lists (reference
+    pybind/tensor_py.h + fluid data_feeder.py).  ``bucket`` rounds max_len up
+    to a multiple, bounding XLA recompilation across batches (the TPU answer
+    to the reference's pad-free LoD efficiency claim).
+    """
+    seqs = [np.asarray(s, dtype=dtype) for s in seqs]
+    lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    ml = int(max_len if max_len is not None else (lengths.max() if len(seqs) else 0))
+    if bucket:
+        ml = int(np.ceil(max(ml, 1) / bucket) * bucket)
+    feat = seqs[0].shape[1:] if seqs else ()
+    data = np.zeros((len(seqs), ml) + feat, dtype=seqs[0].dtype if seqs else dtype)
+    for i, s in enumerate(seqs):
+        data[i, : len(s)] = s
+    return SeqArray(data, lengths)

@@ -1,0 +1,78 @@
+"""Type vocabulary for the IR.
+
+TPU-native analog of the enums in the reference's ``paddle/framework/framework.proto``
+(VarDesc.VarType at framework.proto:119, DataType at framework.proto:91). We keep
+the same *capability* — typed variables over a small closed set of dtypes and
+var kinds — but store dtypes as canonical numpy/JAX dtype strings so the IR maps
+1:1 onto XLA types (bf16 is first-class: it is the MXU-native dtype on TPU).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class VarType:
+    """Kinds of variables a Block can declare.
+
+    Mirrors the capability set of VarDesc.VarType (framework.proto:119):
+    LOD_TENSOR, SELECTED_ROWS, FEED_MINIBATCH, FETCH_LIST, STEP_SCOPES,
+    LOD_RANK_TABLE, LOD_TENSOR_ARRAY, PLACE_LIST, READER...  On TPU, dense
+    tensors and sequence tensors (padded + lengths) cover the data plane;
+    SELECTED_ROWS survives as the sparse-row gradient container for
+    embeddings (lowered to gather/segment_sum).
+    """
+
+    DENSE_TENSOR = "dense_tensor"      # reference: LOD_TENSOR with empty lod
+    LOD_TENSOR = "lod_tensor"          # sequence tensor: padded data + lengths
+    SELECTED_ROWS = "selected_rows"    # sparse row-slices (embedding grads)
+    TENSOR_ARRAY = "tensor_array"      # reference: LOD_TENSOR_ARRAY
+    RNG_STATE = "rng_state"            # explicit: JAX threads RNG functionally
+    RAW = "raw"
+
+
+# Canonical dtype strings.  (Reference DataType enum: BOOL/INT16/INT32/INT64/
+# FP16/FP32/FP64; we add bfloat16 because it is the TPU-native training dtype.)
+FP32 = "float32"
+FP64 = "float64"
+FP16 = "float16"
+BF16 = "bfloat16"
+INT8 = "int8"
+INT16 = "int16"
+INT32 = "int32"
+INT64 = "int64"
+BOOL = "bool"
+
+_ALL_DTYPES = {FP32, FP64, FP16, BF16, INT8, INT16, INT32, INT64, BOOL, "uint8"}
+
+
+def canonical_dtype(dtype) -> str:
+    """Normalise any dtype spelling (np dtype, jnp dtype, str, VarDesc int) to a
+    canonical string."""
+    if dtype is None:
+        return FP32
+    if isinstance(dtype, str):
+        name = dtype
+    else:
+        try:
+            name = np.dtype(dtype).name
+        except TypeError:
+            name = getattr(dtype, "name", None) or str(dtype)
+    if name == "bfloat16" or name == "bf16":
+        return BF16
+    if name not in _ALL_DTYPES:
+        raise ValueError(f"unsupported dtype: {dtype!r} -> {name}")
+    return name
+
+
+def np_dtype(name: str):
+    """Canonical string -> numpy dtype (bfloat16 via ml_dtypes)."""
+    if name == BF16:
+        import ml_dtypes  # shipped with jax
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def is_float_dtype(name: str) -> bool:
+    return name in (FP32, FP64, FP16, BF16)

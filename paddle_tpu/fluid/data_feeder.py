@@ -1,0 +1,42 @@
+"""DataFeeder — analog of python/paddle/v2/fluid/data_feeder.py: converts
+python minibatch rows into the executor's feed dict (dense arrays or
+SeqArrays for lod_level>0 slots)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core.lod import SeqArray, make_seq
+from .framework import Variable
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence[Variable], place=None,
+                 program=None, seq_bucket: int = 16):
+        self.feed_vars = list(feed_list)
+        self.seq_bucket = seq_bucket  # pad max_len up to multiples: bounds
+        #                               XLA recompiles across batches
+
+    def feed(self, data: Sequence[Sequence]) -> Dict[str, object]:
+        """`data` is a list of rows, each row one value per feed var."""
+        cols = list(zip(*data))
+        out: Dict[str, object] = {}
+        for var, col in zip(self.feed_vars, cols):
+            dtype = np.int32 if var.dtype in ("int64", "int32") else np.float32
+            if var.lod_level > 0:
+                seqs = [np.asarray(c, dtype=dtype) for c in col]
+                shape = [d for d in var.shape[1:] if d != -1]
+                seqs = [s.reshape(-1, *shape) if shape else s for s in seqs]
+                out[var.name] = make_seq(seqs, dtype=dtype,
+                                         bucket=self.seq_bucket)
+            else:
+                arr = np.asarray(col, dtype=dtype)
+                shape = [d for d in (var.shape or []) if d != -1]
+                if shape and list(arr.shape[1:]) != shape:
+                    arr = arr.reshape(arr.shape[0], *shape)
+                out[var.name] = arr
+        return out

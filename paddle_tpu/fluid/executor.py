@@ -1,0 +1,284 @@
+"""Scope and Executor.
+
+Analog of the reference's Scope (paddle/framework/scope.h:38), C++ Executor
+(paddle/framework/executor.cc:77,230) and its Python wrapper
+(python/paddle/v2/fluid/executor.py:149,204) — re-architected for XLA:
+
+* ``Executor.run`` does NOT walk ops per step.  It compiles the whole block
+  into one jitted step function (see lowering.py) keyed by (program version,
+  feed signature, fetch list, state signature) and replays the executable —
+  the reference pays per-op dispatch + Python->C++ crossing per run
+  (executor.py:204 clones the program per call!); we pay once per signature.
+* Feed = jitted-arg transfer (device_put under the hood), fetch = executable
+  results; the reference's feed/fetch ops and FeedFetchList
+  (feed_fetch_method.cc) become markers.
+* Persistables live in the Scope as device arrays and are threaded
+  functionally; XLA buffer donation turns parameter updates into in-place
+  HBM writes (the analog of ParamOut aliasing in sgd_op.cc).
+* ``save``/``load`` ops (operators/save_op.cc, load_op.cc) are executed
+  host-side, streaming tensors to disk in a sidecar-JSON + raw-bytes format.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from .core.lod import SeqArray
+from .core.types import np_dtype
+from .framework import Program, Variable, default_main_program
+from .lowering import HOST_OPS, build_step_fn
+
+__all__ = ["Scope", "global_scope", "scope_guard", "Executor",
+           "TPUPlace", "CPUPlace"]
+
+
+class TPUPlace:
+    """Device tag — analog of platform::CUDAPlace (paddle/platform/place.h),
+    pointing at a TPU chip."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+class CPUPlace:
+    def __init__(self):
+        self.device_id = 0
+
+    def __repr__(self):
+        return "CPUPlace()"
+
+
+class Scope:
+    """name -> value map with parent chaining (scope.h:38).  Values are JAX
+    arrays, SeqArrays, or host objects."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+        self._rng_seed: Optional[int] = None
+        self._rng_step: int = 0
+
+    def var(self, name: str) -> str:
+        self.vars.setdefault(name, None)
+        return name
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value) -> None:
+        self.vars[name] = value
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+    def next_rng_bits(self, seed: Optional[int]) -> np.ndarray:
+        """int32[2] (seed, step) — the step RNG key is derived from these
+        inside the compiled computation (see lowering.build_step_fn)."""
+        if self._rng_seed is None or (seed is not None and seed != self._rng_seed):
+            self._rng_seed = (seed if seed is not None
+                              else (time.time_ns() & 0x7FFFFFFF))
+        self._rng_step += 1
+        return np.array([self._rng_seed, self._rng_step], dtype=np.int32)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+def _as_feed_value(v):
+    """Normalise one feed entry to a device-ready value (int64/f64 narrowed to
+    JAX defaults)."""
+    if isinstance(v, SeqArray):
+        return SeqArray(_as_feed_value(v.data), np.asarray(v.lengths, np.int32))
+    a = np.asarray(v)
+    if a.dtype == np.int64:
+        a = a.astype(np.int32)
+    elif a.dtype == np.float64:
+        a = a.astype(np.float32)
+    return a
+
+
+def _sig_of(v):
+    if isinstance(v, SeqArray):
+        return ("seq",) + tuple(v.data.shape) + (str(np.asarray(v.data).dtype),)
+    a = np.asarray(v)
+    return tuple(a.shape) + (str(a.dtype),)
+
+
+class Executor:
+    """Compiling executor.  API mirrors fluid.Executor (executor.py:149):
+    ``run(program, feed, fetch_list, scope)`` -> list of numpy arrays."""
+
+    def __init__(self, place: Union[TPUPlace, CPUPlace, None] = None):
+        self.place = place or TPUPlace(0)
+        self._cache: Dict[tuple, Any] = {}
+
+    # -- host-side IO ops ---------------------------------------------------
+    def _run_host_op(self, op, scope: Scope) -> None:
+        from . import io as fluid_io
+
+        if op.type in ("save", "save_combine"):
+            names = op.input("X")
+            path = op.attr("file_path")
+            if op.type == "save":
+                fluid_io.save_tensor(scope.find_var(names[0]), path)
+            else:
+                fluid_io.save_tensors({n: scope.find_var(n) for n in names}, path)
+        elif op.type in ("load", "load_combine"):
+            names = op.output("Out")
+            path = op.attr("file_path")
+            if op.type == "load":
+                scope.set_var(names[0], fluid_io.load_tensor(path))
+            else:
+                loaded = fluid_io.load_tensors(path)
+                for n in names:
+                    scope.set_var(n, loaded[n])
+
+    # -- main entry ---------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+            scope: Optional[Scope] = None, return_numpy: bool = True,
+            mode: str = "train") -> List[Any]:
+        program = program or default_main_program()
+        feed = {k: _as_feed_value(v) for k, v in (feed or {}).items()}
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        scope = scope or global_scope()
+        desc = program.desc
+        block = desc.global_block()
+
+        # host IO ops (save/load) execute in block order relative to the
+        # compiled segment: a `load` prologue before, a `save` epilogue after
+        # (the reference executor runs them inline; an IO op sandwiched
+        # between compute ops would need segment splitting — reject it).
+        traced_ops = [op for op in block.ops if op.type not in HOST_OPS]
+        pre_host, post_host = [], []
+        seen_traced = False
+        for op in block.ops:
+            if op.type in HOST_OPS:
+                (post_host if seen_traced else pre_host).append(op)
+            else:
+                seen_traced = True
+        for op in post_host:
+            idx = block.ops.index(op)
+            if any(o.type not in HOST_OPS for o in block.ops[idx:]):
+                raise NotImplementedError(
+                    "save/load ops interleaved between compute ops are not "
+                    "supported; put IO ops at the block boundary or in their "
+                    "own program")
+        for op in pre_host:
+            self._run_host_op(op, scope)
+        if not traced_ops and not fetch_names:
+            for op in post_host:
+                self._run_host_op(op, scope)
+            return []
+
+        # classify vars: feeds come from the feed dict; every other var that
+        # is read before written (or fetched but never written) must come from
+        # the scope as state.
+        written: set = set()
+        state_in: List[str] = []
+        seen_state: set = set()
+        for op in traced_ops:
+            for n in op.input_names():
+                if n and n not in written and n not in feed and n not in seen_state:
+                    seen_state.add(n)
+                    state_in.append(n)
+            for n in op.output_names():
+                if n:
+                    written.add(n)
+        persistable = {n for n, vd in block.vars.items() if vd.persistable}
+        state_out = [n for n in written
+                     if n in persistable or n.startswith("@STATE@")]
+        for n in fetch_names:
+            if n not in written and n not in feed and n not in seen_state:
+                seen_state.add(n)
+                state_in.append(n)
+
+        state_vals = {}
+        for n in state_in:
+            v = scope.find_var(n)
+            if v is None:
+                if n in fetch_names and not any(
+                        n in op.input_names() for op in traced_ops):
+                    raise RuntimeError(
+                        f"Executor: fetch target {n!r} is not produced by "
+                        f"the program and not present in the scope")
+                raise RuntimeError(
+                    f"Executor: variable {n!r} is read by the program but "
+                    f"absent from the scope — did you run the startup "
+                    f"program? (reference executor raises the same way)")
+            state_vals[n] = v
+
+        key = (id(program), program.version, mode,
+               tuple((n, _sig_of(v)) for n, v in sorted(feed.items())),
+               tuple(fetch_names),
+               tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            step = build_step_fn(desc, 0, list(feed), state_in, state_out,
+                                 fetch_names, mode)
+            compiled = jax.jit(step, donate_argnums=(1,))
+            self._cache[key] = compiled
+
+        fetches, new_state = compiled(feed, state_vals,
+                                      scope.next_rng_bits(program.random_seed))
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        for op in post_host:
+            self._run_host_op(op, scope)
+
+        if return_numpy:
+            return [_to_numpy(f) for f in fetches]
+        return list(fetches)
+
+    def close(self):
+        self._cache.clear()
+
+
+def _is_cpu(place) -> bool:
+    return isinstance(place, CPUPlace)
+
+
+def _to_numpy(v):
+    if isinstance(v, SeqArray):
+        return SeqArray(np.asarray(v.data), np.asarray(v.lengths))
+    return np.asarray(v)

@@ -1,0 +1,236 @@
+"""Checkpoint / inference-model IO.
+
+Analog of python/paddle/v2/fluid/io.py (save_vars:66, save_params:129,
+save_persistables:142, load_*:156-232, save_inference_model:297,
+load_inference_model:370) and the C++ stream serialization in
+operators/save_op.cc / load_op.cc (version + dims + dtype + lod + raw bytes).
+
+Tensor wire format: a JSON header line {dtype, shape, lod} followed by raw
+little-endian bytes (lengths bytes appended for SeqArray).  Combine files
+stack entries with a manifest.  Device arrays are fetched through the PJRT
+runtime (np.asarray) and restored with device_put on next use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .core.lod import SeqArray
+from .executor import Executor, Scope, global_scope
+from .framework import (Parameter, Program, Variable, default_main_program,
+                        default_startup_program)
+
+__all__ = ["save_tensor", "load_tensor", "save_tensors", "load_tensors",
+           "save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "get_inference_program"]
+
+_MAGIC = b"PDTPU\x01"
+
+
+def _tensor_bytes(value) -> bytes:
+    if isinstance(value, SeqArray):
+        data = np.asarray(value.data)
+        lengths = np.asarray(value.lengths, np.int32)
+        header = {"dtype": data.dtype.name, "shape": list(data.shape),
+                  "lod": True, "batch": int(lengths.shape[0])}
+        hb = json.dumps(header).encode()
+        return (struct.pack("<I", len(hb)) + hb + data.tobytes()
+                + lengths.tobytes())
+    data = np.asarray(value)
+    header = {"dtype": data.dtype.name, "shape": list(data.shape),
+              "lod": False}
+    hb = json.dumps(header).encode()
+    return struct.pack("<I", len(hb)) + hb + data.tobytes()
+
+
+def _tensor_from(buf: bytes, offset: int = 0):
+    (hlen,) = struct.unpack_from("<I", buf, offset)
+    offset += 4
+    header = json.loads(buf[offset: offset + hlen].decode())
+    offset += hlen
+    import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+
+    dt = np.dtype(header["dtype"]) if header["dtype"] != "bfloat16" else \
+        np.dtype(__import__("ml_dtypes").bfloat16)
+    n = int(np.prod(header["shape"])) * dt.itemsize
+    data = np.frombuffer(buf[offset: offset + n], dtype=dt).reshape(
+        header["shape"]).copy()
+    offset += n
+    if header.get("lod"):
+        ln = header["batch"] * 4
+        lengths = np.frombuffer(buf[offset: offset + ln],
+                                dtype=np.int32).copy()
+        offset += ln
+        return SeqArray(data, lengths), offset
+    return data, offset
+
+
+def save_tensor(value, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(_tensor_bytes(value))
+
+
+def load_tensor(path: str):
+    with open(path, "rb") as f:
+        buf = f.read()
+    assert buf[: len(_MAGIC)] == _MAGIC, f"bad tensor file {path}"
+    value, _ = _tensor_from(buf, len(_MAGIC))
+    return value
+
+
+def save_tensors(named: Dict[str, object], path: str) -> None:
+    """Combine-file variant (save_combine_op.cc)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        names = sorted(named)
+        manifest = json.dumps(names).encode()
+        f.write(struct.pack("<I", len(manifest)))
+        f.write(manifest)
+        for n in names:
+            f.write(_tensor_bytes(named[n]))
+
+
+def load_tensors(path: str) -> Dict[str, object]:
+    with open(path, "rb") as f:
+        buf = f.read()
+    assert buf[: len(_MAGIC)] == _MAGIC, f"bad tensor file {path}"
+    off = len(_MAGIC)
+    (mlen,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    names = json.loads(buf[off: off + mlen].decode())
+    off += mlen
+    out = {}
+    for n in names:
+        out[n], off = _tensor_from(buf, off)
+    return out
+
+
+# -- program-level save/load (reference io.py:66-232) -----------------------
+
+def _default_predicate(var: Variable) -> bool:
+    return var.persistable
+
+
+def save_vars(executor: Executor, dirname: str,
+              main_program: Optional[Program] = None, vars=None,
+              predicate=None, scope: Optional[Scope] = None) -> None:
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate or _default_predicate)(v)]
+    os.makedirs(dirname, exist_ok=True)
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        save_tensor(val, os.path.join(dirname, v.name))
+
+
+def save_params(executor, dirname, main_program=None, **kw):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter), **kw)
+
+
+def save_persistables(executor, dirname, main_program=None, **kw):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_default_predicate, **kw)
+
+
+def load_vars(executor: Executor, dirname: str,
+              main_program: Optional[Program] = None, vars=None,
+              predicate=None, scope: Optional[Scope] = None) -> None:
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate or _default_predicate)(v)]
+    for v in vars:
+        path = os.path.join(dirname, v.name)
+        if os.path.exists(path):
+            scope.set_var(v.name, load_tensor(path))
+
+
+def load_params(executor, dirname, main_program=None, **kw):
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter), **kw)
+
+
+def load_persistables(executor, dirname, main_program=None, **kw):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_default_predicate, **kw)
+
+
+# -- inference packaging (reference io.py:297,370) --------------------------
+
+def prune_program(program: Program, targets: List[Variable]) -> Program:
+    """Backward-slice the global block to the ops needed for `targets` —
+    analog of the reference's Program.prune (framework.py:893 + prune.cc)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = {t.name if isinstance(t, Variable) else str(t) for t in targets}
+    keep = []
+    for op in reversed(block.ops):
+        outs = set(op.output_names)
+        if outs & needed:
+            keep.append(op)
+            needed |= {n for n in op.input_names if n}
+    keep_set = {id(op.desc) for op in keep}
+    block.ops = [op for op in block.ops if id(op.desc) in keep_set]
+    block.desc.ops = [od for od in block.desc.ops if id(od) in keep_set]
+    pruned._bump_version()
+    return pruned
+
+
+def save_inference_model(dirname: str, feeded_var_names: List[str],
+                         target_vars: List[Variable], executor: Executor,
+                         main_program: Optional[Program] = None,
+                         scope: Optional[Scope] = None) -> None:
+    """reference io.py:297: prune to the inference slice, record feed/fetch
+    ops, persist program + params."""
+    program = main_program or default_main_program()
+    pruned = prune_program(program, target_vars)
+    block = pruned.global_block()
+    for i, name in enumerate(feeded_var_names):
+        block.desc.prepend_op(__import__(
+            "paddle_tpu.fluid.core.desc", fromlist=["OpDesc"]).OpDesc(
+            "feed", {"X": [name]}, {"Out": [name]}, {"col": i}))
+    for i, v in enumerate(target_vars):
+        block.desc.append_op(__import__(
+            "paddle_tpu.fluid.core.desc", fromlist=["OpDesc"]).OpDesc(
+            "fetch", {"X": [v.name]}, {"Out": [v.name]}, {"col": i}))
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(pruned.serialize_to_string())
+    save_persistables(executor, dirname, program, scope=scope)
+
+
+def load_inference_model(dirname: str, executor: Executor,
+                         scope: Optional[Scope] = None):
+    """reference io.py:370 -> (program, feed_names, fetch_targets)."""
+    with open(os.path.join(dirname, "__model__"), "rb") as f:
+        program = Program.parse_from_string(f.read())
+    block = program.global_block()
+    feed_names = [op.input("X")[0] for op in block.desc.ops
+                  if op.type == "feed"]
+    fetch_names = [op.output("Out")[0] for op in block.desc.ops
+                   if op.type == "fetch"]
+    load_persistables(executor, dirname, program, scope=scope)
+    fetch_vars = [block.vars[n] for n in fetch_names]
+    return program, feed_names, fetch_vars
+
+
+def get_inference_program(target_vars, main_program=None):
+    program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    return prune_program(program, target_vars)

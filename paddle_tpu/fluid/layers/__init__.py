@@ -1,0 +1,8 @@
+"""fluid.layers — analog of python/paddle/v2/fluid/layers/__init__.py."""
+
+from . import io, nn, ops, sequence, tensor  # noqa: F401
+from .sequence import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403

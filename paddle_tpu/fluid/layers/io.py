@@ -1,0 +1,27 @@
+"""Data layers — analog of python/paddle/v2/fluid/layers/io.py (``data``)."""
+
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True, main_program=None, startup_program=None,
+         type=None):
+    """Declare an input variable (reference layers/io.py data:24).
+
+    With ``append_batch_size`` (default, matching the reference) the leading
+    batch dim is dynamic (-1).  For ``lod_level>0`` the runtime value is a
+    SeqArray (padded [batch, time, *shape] + lengths) — see core/lod.py.
+    """
+    helper = LayerHelper("data", name=name, main_program=main_program,
+                         startup_program=startup_program)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.block.create_var(name=name, shape=shape, dtype=dtype,
+                                   lod_level=lod_level,
+                                   stop_gradient=stop_gradient)

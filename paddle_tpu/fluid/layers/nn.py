@@ -1,0 +1,448 @@
+"""Rich neural-net layers — analog of python/paddle/v2/fluid/layers/nn.py
+(fc:71, embedding:192, conv2d:1135, pool2d:1424, batch_norm:1473,
+dropout, cross_entropy, accuracy, topk, reduce_*:1953+, matmul:2278, ...).
+
+Each layer appends ops to the current block via LayerHelper, exactly like the
+reference; the ops themselves lower to XLA (see ops/)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fc", "embedding", "dropout", "cross_entropy", "square_error_cost",
+    "accuracy", "auc", "topk", "conv2d", "conv2d_transpose", "pool2d",
+    "batch_norm", "layer_norm", "reduce_sum", "reduce_mean", "reduce_max",
+    "reduce_min", "reduce_prod", "reshape", "transpose", "matmul", "one_hot",
+    "softmax_with_cross_entropy", "smooth_l1", "l2_normalize", "split",
+    "nce", "im2sequence",
+]
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None, main_program=None, startup_program=None,
+       use_mkldnn=False):
+    """Fully connected — reference layers/nn.py fc:71.  Multiple inputs each
+    get their own weight (mul op); partial sums are added; bias + activation
+    follow.  The mul ops map straight onto the MXU."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = helper.input_dtype()
+    mul_results = []
+    for input_var in helper.multiple_input():
+        input_shape = input_var.shape
+        if input_var.lod_level > 0:
+            # padded seq input [b, t, f...]: weight covers feature dims
+            flat = input_shape[1:]
+            num_flat = num_flatten_dims + 1
+        else:
+            flat = input_shape[num_flatten_dims:]
+            num_flat = num_flatten_dims
+        import numpy as np
+
+        in_features = int(np.prod(flat))
+        w = helper.create_parameter(helper.param_attr,
+                                    shape=[in_features, size], dtype=dtype)
+        tmp = helper.create_tmp_variable(dtype,
+                                         lod_level=input_var.lod_level)
+        helper.append_op("mul", {"X": input_var, "Y": w}, {"Out": tmp},
+                         {"x_num_col_dims": num_flat, "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(dtype)
+        helper.append_op("sum", {"X": mul_results}, {"Out": pre_bias})
+    lod = pre_bias.lod_level
+    # bias is always [size]; for sequence inputs the runtime data is
+    # [b, t, size], so the broadcast axis shifts by the time dim
+    pre_act = helper.append_bias_op(pre_bias,
+                                    dim_start=1 + (1 if lod else 0),
+                                    bias_shape=[size])
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None,
+              main_program=None, startup_program=None):
+    """Embedding lookup — reference layers/nn.py embedding:192.  is_sparse
+    selected SelectedRows grads in the reference; on TPU the backward is an
+    XLA scatter-add either way, so the flag is accepted and ignored."""
+    helper = LayerHelper("embedding", param_attr=param_attr, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    w = helper.create_parameter(helper.param_attr, shape=list(size),
+                                dtype=dtype)
+    out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    attrs = {}
+    if padding_idx is not None:
+        attrs["padding_idx"] = int(padding_idx)
+    helper.append_op("lookup_table", {"W": w, "Ids": input}, {"Out": out},
+                     attrs)
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+    helper.append_op("dropout", {"X": x}, {"Out": out},
+                     {"dropout_prob": float(dropout_prob),
+                      "is_test": is_test})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, name=None):
+    helper = LayerHelper("cross_entropy", name=name)
+    out = helper.create_tmp_variable(input.dtype,
+                                     lod_level=input.lod_level)
+    helper.append_op("cross_entropy", {"X": input, "Label": label},
+                     {"Out": out}, {"soft_label": soft_label})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax = helper.create_tmp_variable(logits.dtype)
+    loss = helper.create_tmp_variable(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     {"Logits": logits, "Label": label},
+                     {"Softmax": softmax, "Loss": loss},
+                     {"soft_label": soft_label})
+    return loss
+
+
+def square_error_cost(input, label, name=None):
+    helper = LayerHelper("square_error_cost", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("square_error_cost", {"X": input, "Y": label},
+                     {"Out": out})
+    return out
+
+
+def smooth_l1(x, y, sigma=1.0):
+    helper = LayerHelper("smooth_l1")
+    diff = helper.create_tmp_variable(x.dtype)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("smooth_l1_loss", {"X": x, "Y": y},
+                     {"Diff": diff, "Out": out}, {"sigma": sigma})
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None, **kw):
+    """reference layers/nn.py accuracy — top-k accuracy via top_k op."""
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    topk_indices = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("top_k", {"X": input},
+                     {"Out": topk_out, "Indices": topk_indices}, {"k": k})
+    acc_out = helper.create_tmp_variable("float32", stop_gradient=True)
+    correct = correct or helper.create_tmp_variable("int32",
+                                                    stop_gradient=True)
+    total = total or helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("accuracy",
+                     {"Out": topk_out, "Indices": topk_indices,
+                      "Label": label},
+                     {"Accuracy": acc_out, "Correct": correct,
+                      "Total": total})
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
+    helper = LayerHelper("auc")
+    topk_out = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    topk_indices = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("top_k", {"X": input},
+                     {"Out": topk_out, "Indices": topk_indices}, {"k": topk})
+    out = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op("auc", {"Out": input, "Indices": topk_indices,
+                             "Label": label}, {"AUC": out},
+                     {"curve": curve, "num_thresholds": num_thresholds})
+    return out
+
+
+def topk(input, k=1):
+    helper = LayerHelper("top_k")
+    vals = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    idx = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("top_k", {"X": input}, {"Out": vals, "Indices": idx},
+                     {"k": k})
+    return vals, idx
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("one_hot", {"X": input}, {"Out": out}, {"depth": depth})
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, groups=1,
+           dilation=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None, main_program=None,
+           startup_program=None):
+    """2-D convolution (NCHW) — reference layers/nn.py conv2d:1135 /
+    conv_op.cc; lowers to lax.conv_general_dilated which XLA tiles onto the
+    MXU (the reference needed im2col+gemm or cuDNN)."""
+    helper = LayerHelper("conv2d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = input.dtype
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    fsize = _pair(filter_size)
+    num_channels = input.shape[1]
+    filter_shape = [num_filters, num_channels // groups] + list(fsize)
+
+    import numpy as np
+
+    from ..initializer import NormalInitializer
+
+    std = (2.0 / (fsize[0] * fsize[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape,
+                                dtype=dtype,
+                                default_initializer=NormalInitializer(0.0, std))
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op("conv2d", {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": dilation, "groups": groups})
+    pre_act = _append_channel_bias(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """reference conv2d_transpose:1574 / conv_transpose_op.cc."""
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    stride = _pair(stride)
+    padding = _pair(padding)
+    fsize = _pair(filter_size)
+    in_channels = input.shape[1]
+    filter_shape = [in_channels, num_filters] + list(fsize)
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op("conv2d_transpose", {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": _pair(dilation)})
+    pre_act = _append_channel_bias(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, main_program=None,
+           startup_program=None):
+    """reference pool2d:1424 / pool_op.cc."""
+    helper = LayerHelper("pool2d", name=name, main_program=main_program,
+                         startup_program=startup_program)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("pool2d", {"X": input}, {"Out": out},
+                     {"pooling_type": pool_type,
+                      "ksize": _pair(pool_size),
+                      "strides": _pair(pool_stride),
+                      "paddings": _pair(pool_padding),
+                      "global_pooling": global_pooling,
+                      "ceil_mode": ceil_mode})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               main_program=None, startup_program=None):
+    """reference batch_norm:1473 / batch_norm_op.cc.  Moving stats are
+    persistable state vars updated functionally by the op."""
+    from ..initializer import ConstantInitializer
+
+    helper = LayerHelper("batch_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = input.dtype
+    channels = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    pshape = [channels]
+    scale = helper.create_parameter(
+        helper.param_attr, shape=pshape, dtype=dtype,
+        default_initializer=ConstantInitializer(1.0), suffix="scale")
+    bias = helper.create_parameter(helper.bias_attr or helper.param_attr,
+                                   shape=pshape, dtype=dtype, is_bias=True,
+                                   suffix="offset")
+    mean = helper.create_global_variable(
+        shape=pshape, dtype=dtype, persistable=True,
+        name=moving_mean_name)
+    helper.set_variable_initializer(mean, ConstantInitializer(0.0))
+    variance = helper.create_global_variable(
+        shape=pshape, dtype=dtype, persistable=True,
+        name=moving_variance_name)
+    helper.set_variable_initializer(variance, ConstantInitializer(1.0))
+
+    saved_mean = helper.create_tmp_variable(dtype, stop_gradient=True)
+    saved_var = helper.create_tmp_variable(dtype, stop_gradient=True)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        "batch_norm",
+        {"X": input, "Scale": scale, "Bias": bias, "Mean": mean,
+         "Variance": variance},
+        {"Y": out, "MeanOut": mean, "VarianceOut": variance,
+         "SavedMean": saved_mean, "SavedVariance": saved_var},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference layer_norm_op.cc."""
+    from ..initializer import ConstantInitializer
+    import numpy as np
+
+    helper = LayerHelper("layer_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": input}
+    if scale:
+        inputs["Scale"] = helper.create_parameter(
+            helper.param_attr, shape=norm_shape, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0), suffix="scale")
+    if shift:
+        inputs["Bias"] = helper.create_parameter(
+            helper.bias_attr or helper.param_attr, shape=norm_shape,
+            dtype=dtype, is_bias=True)
+    out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    mean = helper.create_tmp_variable(dtype, stop_gradient=True)
+    var = helper.create_tmp_variable(dtype, stop_gradient=True)
+    helper.append_op("layer_norm", inputs,
+                     {"Y": out, "Mean": mean, "Variance": var},
+                     {"epsilon": epsilon, "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def _make_reduce(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(input.dtype)
+        attrs = {"keep_dim": keep_dim, "reduce_all": dim is None}
+        if dim is not None:
+            attrs["dim"] = dim if isinstance(dim, (list, tuple)) else [dim]
+        helper.append_op(op_type, {"X": input}, {"Out": out}, attrs)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _make_reduce("reduce_sum")
+reduce_mean = _make_reduce("reduce_mean")
+reduce_max = _make_reduce("reduce_max")
+reduce_min = _make_reduce("reduce_min")
+reduce_prod = _make_reduce("reduce_prod")
+
+
+def reshape(x, shape, act=None, name=None):
+    helper = LayerHelper("reshape", name=name, act=act)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("reshape", {"X": x}, {"Out": out},
+                     {"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("transpose", {"X": x}, {"Out": out},
+                     {"axis": list(perm)})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("matmul", {"X": x, "Y": y}, {"Out": out},
+                     {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                      "alpha": float(alpha)})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        attrs = {"num": num, "axis": dim}
+    else:
+        num = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_tmp_variable(input.dtype) for _ in range(num)]
+    helper.append_op("split", {"X": input}, {"Out": outs}, attrs)
+    return outs
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("l2_normalize", {"X": x}, {"Out": out},
+                     {"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None):
+    """Noise-contrastive estimation — reference nce_op.cc.  Samples negatives
+    inside the op with the executor-threaded RNG."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr or helper.param_attr,
+                                shape=[num_total_classes], dtype=input.dtype,
+                                is_bias=True)
+    cost = helper.create_tmp_variable(input.dtype)
+    helper.append_op("nce", {"Input": input, "Label": label,
+                             "Weight": w, "Bias": b}, {"Cost": cost},
+                     {"num_total_classes": num_total_classes,
+                      "num_neg_samples": num_neg_samples or 10})
+    return cost
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("im2sequence", {"X": input}, {"Out": out},
+                     {"kernels": _pair(filter_size),
+                      "strides": _pair(stride), "paddings": _pair(padding)})
+    return out
+
+
+def _pair(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x, x]
+
+
+def _append_channel_bias(helper, pre_bias):
+    bias_attr = helper.bias_attr
+    if bias_attr is None:
+        return pre_bias
+    channels = pre_bias.shape[1]
+    b = helper.create_parameter(bias_attr, shape=[channels],
+                                dtype=pre_bias.dtype, is_bias=True)
+    out = helper.create_tmp_variable(pre_bias.dtype)
+    helper.append_op("elementwise_add", {"X": pre_bias, "Y": b},
+                     {"Out": out}, {"axis": 1})
+    return out

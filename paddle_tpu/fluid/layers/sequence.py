@@ -1,0 +1,103 @@
+"""Sequence layers — the lod-aware subset of the reference's layers/nn.py
+(sequence_conv, sequence_pool, sequence_first_step, sequence_last_step,
+sequence_expand, sequence_softmax...)."""
+
+from __future__ import annotations
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = ["sequence_conv", "sequence_pool", "sequence_first_step",
+           "sequence_last_step", "sequence_expand", "sequence_concat",
+           "sequence_reshape", "sequence_slice", "sequence_erase",
+           "sequence_mask"]
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None, main_program=None, startup_program=None):
+    """reference layers/nn.py sequence_conv — context-window projection."""
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = input.dtype
+    feat = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[filter_size * feat, num_filters],
+                                dtype=dtype)
+    out = helper.create_tmp_variable(dtype, lod_level=1)
+    helper.append_op("sequence_conv", {"X": input, "Filter": w},
+                     {"Out": out},
+                     {"context_length": filter_size,
+                      "context_start": -((filter_size - 1) // 2),
+                      "context_stride": filter_stride})
+    out = helper.append_bias_op(out, dim_start=2,
+                                bias_shape=[num_filters])
+    return helper.append_activation(out)
+
+
+def sequence_pool(input, pool_type, name=None):
+    helper = LayerHelper("sequence_pool", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    max_index = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("sequence_pool", {"X": input},
+                     {"Out": out, "MaxIndex": max_index},
+                     {"pooltype": pool_type})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_expand(x, y, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    helper.append_op("sequence_expand", {"X": x, "Y": y}, {"Out": out})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_tmp_variable(helper.input_dtype() if isinstance(
+        input, (list, tuple)) else input.dtype, lod_level=1)
+    helper.append_op("sequence_concat", {"X": input}, {"Out": out})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("sequence_reshape", {"X": input}, {"Out": out},
+                     {"new_dim": new_dim})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("sequence_slice",
+                     {"X": input, "Offset": offset, "Length": length},
+                     {"Out": out})
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("sequence_erase", {"X": input}, {"Out": out},
+                     {"tokens": list(tokens)})
+    return out
+
+
+def sequence_mask(x, maxlen, dtype="float32"):
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_tmp_variable(dtype, stop_gradient=True)
+    helper.append_op("sequence_mask_op", {"X": x}, {"Out": out},
+                     {"maxlen": maxlen, "out_dtype": dtype})
+    return out

@@ -1,0 +1,77 @@
+"""Tensor-creation layers — analog of python/paddle/v2/fluid/layers/tensor.py."""
+
+from __future__ import annotations
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = ["create_tensor", "create_global_var", "fill_constant", "zeros",
+           "ones", "concat", "sums", "assign", "cast", "argmax"]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.block.create_var(name=helper.name, dtype=dtype,
+                                   persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False, name=None):
+    from ..initializer import ConstantInitializer
+
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(shape=shape, dtype=dtype,
+                                        persistable=persistable, name=name)
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def fill_constant(shape, dtype, value, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    out = out or helper.create_tmp_variable(dtype)
+    helper.append_op("fill_constant", {}, {"Out": out},
+                     {"shape": list(shape), "dtype": dtype,
+                      "value": float(value)})
+    return out
+
+
+def zeros(shape, dtype, name=None):
+    return fill_constant(shape, dtype, 0.0, name=name)
+
+
+def ones(shape, dtype, name=None):
+    return fill_constant(shape, dtype, 1.0, name=name)
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_tmp_variable(helper.input_dtype())
+    helper.append_op("concat", {"X": input}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sums")
+    out = out or helper.create_tmp_variable(helper.input_dtype())
+    helper.append_op("sum", {"X": input}, {"Out": out})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    output = output or helper.create_tmp_variable(input.dtype,
+                                                  lod_level=input.lod_level)
+    helper.append_op("assign", {"X": input}, {"Out": output})
+    return output
+
+
+def cast(x, dtype):
+    from .ops import cast as _cast
+
+    return _cast(x, dtype)
+
+
+def argmax(x, axis=-1):
+    helper = LayerHelper("argmax")
+    out = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("argmax", {"X": x}, {"Out": out}, {"axis": axis})
+    return out

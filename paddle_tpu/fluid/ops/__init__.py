@@ -1,0 +1,16 @@
+"""Op corpus: importing this package registers every op emitter.
+
+The analog of linking paddle/operators/*.cc into the binary — the reference's
+USE_OP machinery (op_registry.h) becomes Python imports.
+"""
+
+from . import (  # noqa: F401
+    activation_ops,
+    io_ops,
+    loss_ops,
+    math_ops,
+    nn_ops,
+    optimizer_ops,
+    sequence_ops,
+    tensor_ops,
+)

@@ -1,0 +1,80 @@
+"""Activation ops — reference paddle/operators/activation_op.cc (~20 kernels,
+each with hand-written functor + grad functor in operators/math/detail/).
+Here each is one jnp call; the VJP-derived grad op reproduces the math and XLA
+fuses both into adjacent matmuls (what the reference's fused LSTM kernels did
+by hand)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import primitive
+
+
+def _act(name, fn):
+    @primitive(name, seq_transparent=True)
+    def _op(ctx, x, _fn=fn):
+        return _fn(ctx, x)
+    _op.__name__ = name
+    return _op
+
+
+_act("sigmoid", lambda c, x: jax.nn.sigmoid(x))
+_act("logsigmoid", lambda c, x: jax.nn.log_sigmoid(x))
+_act("exp", lambda c, x: jnp.exp(x))
+_act("relu", lambda c, x: jax.nn.relu(x))
+_act("relu6", lambda c, x: jnp.clip(x, 0.0, c.attr("threshold", 6.0)))
+_act("tanh", lambda c, x: jnp.tanh(x))
+_act("tanh_shrink", lambda c, x: x - jnp.tanh(x))
+_act("sqrt", lambda c, x: jnp.sqrt(x))
+_act("rsqrt", lambda c, x: jax.lax.rsqrt(x))
+_act("abs", lambda c, x: jnp.abs(x))
+_act("ceil", lambda c, x: jnp.ceil(x))
+_act("floor", lambda c, x: jnp.floor(x))
+_act("round", lambda c, x: jnp.round(x))
+_act("reciprocal", lambda c, x: 1.0 / x)
+_act("log", lambda c, x: jnp.log(x))
+_act("softplus", lambda c, x: jax.nn.softplus(x))
+_act("softsign", lambda c, x: jax.nn.soft_sign(x))
+_act("softshrink", lambda c, x: jnp.where(
+    x > c.attr("lambda", 0.5), x - c.attr("lambda", 0.5),
+    jnp.where(x < -c.attr("lambda", 0.5), x + c.attr("lambda", 0.5), 0.0)))
+_act("hard_shrink", lambda c, x: jnp.where(
+    jnp.abs(x) > c.attr("threshold", 0.5), x, 0.0))
+_act("hard_sigmoid", lambda c, x: jnp.clip(
+    c.attr("slope", 0.2) * x + c.attr("offset", 0.5), 0.0, 1.0))
+_act("thresholded_relu", lambda c, x: jnp.where(
+    x > c.attr("threshold", 1.0), x, 0.0))
+_act("elu", lambda c, x: jax.nn.elu(x, alpha=c.attr("alpha", 1.0)))
+_act("pow", lambda c, x: jnp.power(x, c.attr("factor", 1.0)))
+_act("stanh", lambda c, x: c.attr("scale_b", 1.7159) * jnp.tanh(
+    c.attr("scale_a", 2.0 / 3.0) * x))
+_act("square_act", lambda c, x: x * x)
+_act("swish", lambda c, x: x * jax.nn.sigmoid(c.attr("beta", 1.0) * x))
+_act("gelu", lambda c, x: jax.nn.gelu(x))
+
+
+@primitive("leaky_relu", seq_transparent=True)
+def leaky_relu(ctx, x):
+    return jax.nn.leaky_relu(x, negative_slope=ctx.attr("alpha", 0.02))
+
+
+@primitive("brelu", seq_transparent=True)
+def brelu(ctx, x):
+    return jnp.clip(x, ctx.attr("t_min", 0.0), ctx.attr("t_max", 24.0))
+
+
+@primitive("prelu", inputs=["X", "Alpha"], seq_transparent=True)
+def prelu(ctx, x, alpha):
+    """reference prelu_op.cc — learnable slope."""
+    return jnp.where(x > 0, x, alpha * x)
+
+
+@primitive("maxout")
+def maxout(ctx, x):
+    """reference maxout_op.cc (operators/math/maxouting.cc): NCHW channel
+    groups reduced by max."""
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    return x.reshape(n, c // groups, groups, h, w).max(axis=2)

@@ -1,0 +1,44 @@
+"""Feed/fetch and checkpoint IO ops.
+
+The reference moves data through special FEED_MINIBATCH/FETCH_LIST variables
+(paddle/framework/feed_fetch_method.cc, operators/feed_op.cc, fetch_op.cc) and
+checkpoints by *running save/load ops* (operators/save_op.cc, load_op.cc,
+save_combine_op.cc, load_combine_op.cc).  On TPU, feed = device_put into the
+compiled computation's arguments and fetch = returning outputs, so feed/fetch
+ops are lowered as markers by the executor; they exist so inference programs
+serialized by save_inference_model keep the reference's shape.  save/load ops
+are executed host-side by the executor (they are IO, not math).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import OpInfo, register
+
+
+def _identity_emit(ctx, ins):
+    xs = ins.get("X", [])
+    return {"Out": list(xs)}
+
+
+# feed/fetch behave as identity when traced (the executor wires the actual
+# arguments/results); save/load are intercepted before tracing.
+register(OpInfo("feed", _identity_emit, no_grad=True))
+register(OpInfo("fetch", _identity_emit, no_grad=True))
+register(OpInfo("save", lambda ctx, ins: {}, no_grad=True))
+register(OpInfo("load", lambda ctx, ins: {}, no_grad=True))
+register(OpInfo("save_combine", lambda ctx, ins: {}, no_grad=True))
+register(OpInfo("load_combine", lambda ctx, ins: {}, no_grad=True))
+
+
+def _print_emit(ctx, ins):
+    """reference print_op.cc — debug print; jax.debug.print keeps it working
+    under jit."""
+    import jax
+
+    x = ins["X"][0]
+    msg = ctx.attr("message", "")
+    jax.debug.print(msg + " {x}", x=getattr(x, "data", x))
+    return {"Out": [x]}
+
+
+register(OpInfo("print", _print_emit, no_grad=True))

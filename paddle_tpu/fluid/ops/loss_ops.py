@@ -1,0 +1,152 @@
+"""Softmax / losses / metrics.
+
+Replaces reference softmax_op.cc, softmax_with_cross_entropy_op.cc,
+cross_entropy_op.cc (operators/math/cross_entropy.cu), accuracy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, squared_l2_norm_op.cc,
+smooth_l1_loss_op.cc, huber_loss_op.cc, hinge_loss_op.cc, auc_op.cc.
+Stable log-softmax forms throughout (the reference's CUDA kernels do the same
+max-subtraction dance by hand).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import SeqArray
+from ..core.registry import primitive
+
+
+@primitive("softmax", seq_transparent=True)
+def softmax(ctx, x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@primitive("log_softmax", seq_transparent=True)
+def log_softmax(ctx, x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def _label_ce(logp, label, num_classes, soft_label):
+    """Cross-entropy core shared by the CE ops (reference
+    operators/math/cross_entropy.cc)."""
+    if soft_label:
+        return -(label * logp).sum(axis=-1, keepdims=True)
+    ids = label
+    if ids.ndim == logp.ndim and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    picked = jnp.take_along_axis(logp, ids.astype(jnp.int32)[..., None],
+                                 axis=-1)
+    return -picked
+
+
+@primitive("cross_entropy", inputs=["X", "Label"], stop_grad_slots=("Label",),
+           seq_transparent=True)
+def cross_entropy(ctx, x, label):
+    """X is a probability distribution (post-softmax) — reference
+    cross_entropy_op.cc."""
+    logp = jnp.log(jnp.clip(x, 1e-8, None))
+    return _label_ce(logp, label, x.shape[-1], ctx.attr("soft_label", False))
+
+
+@primitive("softmax_with_cross_entropy", inputs=["Logits", "Label"],
+           outputs=["Softmax", "Loss"], stop_grad_slots=("Label",))
+def softmax_with_cross_entropy(ctx, logits, label):
+    """Fused, numerically-stable variant — reference
+    softmax_with_cross_entropy_op.cc."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = _label_ce(logp, label, logits.shape[-1],
+                     ctx.attr("soft_label", False))
+    return jnp.exp(logp), loss
+
+
+@primitive("sigmoid_cross_entropy_with_logits", inputs=["X", "Label"],
+           stop_grad_slots=("Label",), seq_transparent=True)
+def sigmoid_ce_logits(ctx, x, label):
+    return jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@primitive("square_error_cost", inputs=["X", "Y"], seq_transparent=True)
+def square_error_cost(ctx, x, y):
+    d = x - y
+    return d * d
+
+
+@primitive("smooth_l1_loss", inputs=["X", "Y"], outputs=["Diff", "Out"])
+def smooth_l1_loss(ctx, x, y):
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * s2 * d * d, a - 0.5 / s2)
+    return d, loss.sum(axis=-1, keepdims=True)
+
+
+@primitive("huber_loss", inputs=["X", "Y"], outputs=["Residual", "Out"])
+def huber_loss(ctx, x, y):
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return r, loss
+
+
+@primitive("hinge_loss", inputs=["Logits", "Labels"],
+           stop_grad_slots=("Labels",))
+def hinge_loss(ctx, logits, labels):
+    return jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+
+
+@primitive("squared_l2_norm")
+def squared_l2_norm(ctx, x):
+    return (x * x).sum()
+
+
+@primitive("squared_l2_distance", inputs=["X", "Y"],
+           outputs=["sub_result", "Out"])
+def squared_l2_distance(ctx, x, y):
+    d = x - y.reshape(y.shape[0], -1) if x.shape != y.shape else x - y
+    return d, (d * d).sum(axis=-1, keepdims=True)
+
+
+@primitive("accuracy", inputs=["Out", "Indices", "Label"],
+           outputs=["Accuracy", "Correct", "Total"], no_grad=True)
+def accuracy(ctx, out, indices, label):
+    """reference accuracy_op.cc: consumes top_k output; correct if label is in
+    the top-k indices for the row."""
+    if isinstance(indices, SeqArray):
+        indices, label = indices.data, label.data
+    lbl = label.reshape(label.shape[0], -1)[:, :1].astype(jnp.int32)
+    hit = (indices.astype(jnp.int32) == lbl).any(axis=-1)
+    total = jnp.asarray(hit.shape[0], jnp.int32)
+    correct = hit.sum().astype(jnp.int32)
+    return correct.astype(jnp.float32) / total.astype(jnp.float32), correct, total
+
+
+@primitive("auc", inputs=["Out", "Indices", "Label"], outputs=["AUC"],
+           no_grad=True)
+def auc(ctx, out, indices, label):
+    """reference auc_op.cc — rank-based AUC on positive-class scores."""
+    score = out[:, 1] if out.ndim == 2 and out.shape[1] == 2 else out.reshape(-1)
+    lbl = label.reshape(-1).astype(jnp.float32)
+    order = jnp.argsort(score)
+    ranks = jnp.empty_like(order).at[order].set(jnp.arange(score.shape[0])) + 1
+    npos = lbl.sum()
+    nneg = lbl.shape[0] - npos
+    pos_rank_sum = (ranks * lbl).sum()
+    return (pos_rank_sum - npos * (npos + 1) / 2.0) / jnp.maximum(npos * nneg, 1.0)
+
+
+@primitive("precision_recall", inputs=["MaxProbs", "Indices", "Labels"],
+           outputs=["BatchMetrics"], no_grad=True)
+def precision_recall(ctx, probs, indices, labels):
+    """Simplified batch macro metrics (reference precision_recall_op.cc)."""
+    ncls = ctx.attr("class_number")
+    pred = indices.reshape(-1).astype(jnp.int32)
+    lbl = labels.reshape(-1).astype(jnp.int32)
+    cm = jnp.zeros((ncls, ncls)).at[lbl, pred].add(1.0)
+    tp = jnp.diag(cm)
+    prec = tp / jnp.maximum(cm.sum(axis=0), 1.0)
+    rec = tp / jnp.maximum(cm.sum(axis=1), 1.0)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    return jnp.stack([prec.mean(), rec.mean(), f1.mean()])

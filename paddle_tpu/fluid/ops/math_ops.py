@@ -1,0 +1,165 @@
+"""Math ops: matmul family, elementwise family, reductions, scaling.
+
+TPU-native replacements for the reference's hand-written kernels in
+paddle/operators/ (mul_op.cc, matmul_op.cc, elementwise_*_op.cc, mean_op.cc,
+sum_op.cc, scale_op.cc, reduce_op.cc) and paddle/operators/math/
+math_function.cc (gemm via cuBLAS/CBLAS).  Each op is one jnp expression; XLA
+maps the matmuls onto the MXU and fuses the elementwise ops into neighbors —
+the fusion the reference implements manually per-kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import primitive
+
+
+def _flatten_2d(x, num_col_dims: int):
+    """Flatten leading num_col_dims dims into rows, trailing into cols —
+    semantics of the reference mul_op (paddle/operators/mul_op.cc:30)."""
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims else 1
+    return x.reshape(lead, -1)
+
+
+@primitive("mul", inputs=["X", "Y"], seq_transparent=True)
+def mul(ctx, x, y):
+    """Projection matmul (reference mul_op.cc): flattens X/Y to 2-D per
+    x_num_col_dims / y_num_col_dims, multiplies, restores leading dims."""
+    xd = ctx.attr("x_num_col_dims", 1)
+    yd = ctx.attr("y_num_col_dims", 1)
+    x2 = _flatten_2d(x, xd)
+    y2 = _flatten_2d(y, yd)
+    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    return out.reshape(*x.shape[:xd], *y.shape[yd:])
+
+
+@primitive("matmul", inputs=["X", "Y"], seq_transparent=True)
+def matmul(ctx, x, y):
+    """General (batched) matmul with optional transposes — reference
+    matmul_op.cc.  1-D operands follow numpy vector rules."""
+    if ctx.attr("transpose_X", False) and x.ndim >= 2:
+        x = jnp.swapaxes(x, -1, -2)
+    if ctx.attr("transpose_Y", False) and y.ndim >= 2:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return out.astype(x.dtype)
+
+
+def _bcast_to_x(x, y, axis: int):
+    """Reference elementwise broadcast rule (elementwise_op_function.h): Y's
+    dims align with X starting at `axis` (default: trailing alignment)."""
+    if x.shape == y.shape or axis in (-1, None):
+        return y
+    pad_right = x.ndim - axis - y.ndim
+    return y.reshape((1,) * axis + y.shape + (1,) * pad_right)
+
+
+def _elementwise(name, fn):
+    @primitive(name, inputs=["X", "Y"], seq_transparent=True)
+    def _op(ctx, x, y, _fn=fn):
+        y = _bcast_to_x(x, y, ctx.attr("axis", -1))
+        return _fn(x, y)
+    _op.__name__ = name
+    return _op
+
+
+_elementwise("elementwise_add", lambda x, y: x + y)
+_elementwise("elementwise_sub", lambda x, y: x - y)
+_elementwise("elementwise_mul", lambda x, y: x * y)
+_elementwise("elementwise_div", lambda x, y: x / y)
+_elementwise("elementwise_max", jnp.maximum)
+_elementwise("elementwise_min", jnp.minimum)
+_elementwise("elementwise_pow", jnp.power)
+
+
+@primitive("mean")
+def mean(ctx, x):
+    """reference mean_op.cc — full reduction to scalar (kept 0-d)."""
+    return jnp.mean(x)
+
+
+@primitive("sum", inputs=["X*"], seq_transparent=True)
+def sum_op(ctx, xs):
+    """Variadic add — reference sum_op.cc (also the grad fan-in accumulator
+    inserted by backward, reference backward.py:134)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@primitive("scale", seq_transparent=True)
+def scale(ctx, x):
+    """reference scale_op.cc: out = scale * (x + bias_after? ... ) (bias ext)."""
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        return x * s + b
+    return (x + b) * s
+
+
+@primitive("square", seq_transparent=True)
+def square(ctx, x):
+    return x * x
+
+
+@primitive("clip", seq_transparent=True)
+def clip(ctx, x):
+    """reference clip_op.cc."""
+    return jnp.clip(x, ctx.attr("min"), ctx.attr("max"))
+
+
+@primitive("sign", seq_transparent=True)
+def sign(ctx, x):
+    return jnp.sign(x)
+
+
+@primitive("clip_by_norm")
+def clip_by_norm(ctx, x):
+    """reference clip_by_norm_op.cc: scale down if l2 norm exceeds max_norm."""
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt((x * x).sum())
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+@primitive("norm")
+def norm_op(ctx, x):
+    return jnp.sqrt((x * x).sum())
+
+
+@primitive("cos_sim", inputs=["X", "Y"], outputs=["Out", "XNorm", "YNorm"])
+def cos_sim(ctx, x, y):
+    """reference cos_sim_op.cc."""
+    xn = jnp.sqrt((x * x).sum(axis=-1, keepdims=True))
+    yn = jnp.sqrt((y * y).sum(axis=-1, keepdims=True))
+    out = (x * y).sum(axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return out, xn, yn
+
+
+def _reduce(name, fn):
+    @primitive(name)
+    def _op(ctx, x, _fn=fn):
+        """reference reduce_op.cc family: dim attr (list or int), keep_dim,
+        reduce_all."""
+        dim = ctx.attr("dim", [0])
+        if ctx.attr("reduce_all", False):
+            dim = None
+        elif isinstance(dim, int):
+            dim = (dim,)
+        else:
+            dim = tuple(dim)
+        return _fn(x, axis=dim, keepdims=ctx.attr("keep_dim", False))
+    _op.__name__ = name
+    return _op
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)

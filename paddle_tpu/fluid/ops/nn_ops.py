@@ -1,0 +1,208 @@
+"""Convolution / pooling / normalization / dropout ops.
+
+Replaces the reference's conv_op.cc (+conv_cudnn_op.cu.cc), pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, nce_op.cc and the
+im2col/vol2col/pooling helpers in paddle/operators/math/.  Convs lower to
+lax.conv_general_dilated — XLA tiles them onto the MXU directly, where the
+reference needed im2col+GEMM or cuDNN algorithm selection.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import primitive
+
+
+@primitive("conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def conv2d(ctx, x, w):
+    """NCHW conv — reference conv_op.cc.  Filter layout OIHW (out, in/groups,
+    h, w), matching the reference."""
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0])
+    dil = tuple(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@primitive("depthwise_conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def depthwise_conv2d(ctx, x, w):
+    """reference conv_op.cc depthwise variant (function/DepthwiseConvOp)."""
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0])
+    c = x.shape[1]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        feature_group_count=c,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@primitive("conv2d_transpose", inputs=["Input", "Filter"], outputs=["Output"])
+def conv2d_transpose(ctx, x, w):
+    """reference conv_transpose_op.cc — implemented as the standard
+    lhs-dilated conv with a flipped, transposed kernel (filter layout IOHW).
+    Output spatial = (in-1)*stride + filter - 2*pad."""
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    wf = jnp.flip(w, axis=(2, 3)).transpose(1, 0, 2, 3)  # IOHW -> OIHW
+    fh, fw = w.shape[2], w.shape[3]
+    return jax.lax.conv_general_dilated(
+        x, wf, window_strides=(1, 1),
+        padding=[(fh - 1 - p[0], fh - 1 - p[0]),
+                 (fw - 1 - p[1], fw - 1 - p[1])],
+        lhs_dilation=tuple(s),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@primitive("pool2d")
+def pool2d(ctx, x):
+    """reference pool_op.cc (operators/math/pooling.cc).  Average pooling
+    uses exclusive counts (padding excluded), matching the reference."""
+    ptype = ctx.attr("pooling_type", "max")
+    if ctx.attr("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        strides = ksize
+        pads = [0, 0]
+    else:
+        ksize = ctx.attr("ksize", [2, 2])
+        strides = ctx.attr("strides", [2, 2])
+        pads = ctx.attr("paddings", [0, 0])
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
+                                     padding)
+    total = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4,
+                                  padding)
+    if pads[0] == 0 and pads[1] == 0:
+        return total / (ksize[0] * ksize[1])
+    ones = jnp.ones_like(x)
+    count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4,
+                                  padding)
+    return total / count
+
+
+@primitive("batch_norm",
+           inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+           outputs=["Y", "MeanOut", "VarianceOut", "SavedMean",
+                    "SavedVariance"],
+           stop_grad_slots=("Mean", "Variance"))
+def batch_norm(ctx, x, scale, bias, mean, variance):
+    """reference batch_norm_op.cc.  Train: batch statistics + moving-average
+    update (MeanOut/VarianceOut write back onto the same persistable vars).
+    Test (is_test attr, set by Program.clone(for_test=True)): moving stats."""
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False) or ctx.mode == "infer"
+    layout = ctx.attr("data_layout", "NCHW")
+    axes = (0, 2, 3) if (x.ndim == 4 and layout == "NCHW") else \
+        tuple(i for i in range(x.ndim) if i != x.ndim - 1) if x.ndim > 1 else (0,)
+    shape = [1] * x.ndim
+    c_axis = 1 if (x.ndim == 4 and layout == "NCHW") else x.ndim - 1
+    shape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        bm, bv = mean, variance
+        new_mean, new_var = mean, variance
+    else:
+        xf = x.astype(jnp.float32)
+        bm = xf.mean(axis=axes)
+        bv = xf.var(axis=axes)
+        new_mean = momentum * mean + (1 - momentum) * bm
+        new_var = momentum * variance + (1 - momentum) * bv
+    inv = jax.lax.rsqrt(bv.astype(jnp.float32) + eps)
+    y = (x.astype(jnp.float32) - bm.reshape(shape)) * inv.reshape(shape)
+    y = y * scale.reshape(shape) + bias.reshape(shape)
+    return (y.astype(x.dtype),
+            jax.lax.stop_gradient(new_mean),
+            jax.lax.stop_gradient(new_var),
+            jax.lax.stop_gradient(bm),
+            jax.lax.stop_gradient(inv))
+
+
+@primitive("layer_norm", inputs=["X", "Scale?", "Bias?"],
+           outputs=["Y", "Mean", "Variance"])
+def layer_norm(ctx, x, scale, bias):
+    """reference layer_norm_op.cc: normalize over dims [begin_norm_axis:)."""
+    eps = ctx.attr("epsilon", 1e-5)
+    axis = ctx.attr("begin_norm_axis", 1)
+    lead = x.shape[:axis]
+    x2 = x.reshape(*lead, -1).astype(jnp.float32)
+    mu = x2.mean(axis=-1, keepdims=True)
+    var = x2.var(axis=-1, keepdims=True)
+    y = (x2 - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.reshape(-1)
+    if bias is not None:
+        y = y + bias.reshape(-1)
+    return (y.reshape(x.shape).astype(x.dtype),
+            jax.lax.stop_gradient(mu.reshape(lead)),
+            jax.lax.stop_gradient(var.reshape(lead)))
+
+
+@primitive("dropout", outputs=["Out", "Mask"], seq_transparent=True)
+def dropout(ctx, x):
+    """reference dropout_op.cc.  The mask is derived from the op's salted RNG
+    key; the vjp-recomputed backward regenerates the identical mask (see
+    lowering.py) — no mask tensor needs saving."""
+    p = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False) or ctx.mode == "infer" or p == 0.0:
+        return x, jnp.ones_like(x)
+    keep = jax.random.bernoulli(ctx.rng, 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    return x * mask / (1.0 - p), jax.lax.stop_gradient(mask)
+
+
+@primitive("l2_normalize")
+def l2_normalize(ctx, x):
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-12)
+    norm = jnp.sqrt((x * x).sum(axis=axis, keepdims=True) + eps)
+    return x / norm
+
+
+@primitive("nce", inputs=["Input", "Label", "Weight", "Bias"],
+           outputs=["Cost"], stop_grad_slots=("Label",))
+def nce(ctx, x, label, w, b):
+    """Noise-contrastive estimation — reference nce_op.cc.  Uniform negative
+    sampling from the op RNG; per-row BCE over 1 positive + k negatives."""
+    k = ctx.attr("num_neg_samples", 10)
+    n_classes = ctx.attr("num_total_classes")
+    batch = x.shape[0]
+    neg = jax.random.randint(ctx.rng, (batch, k), 0, n_classes)
+    pos = label.reshape(batch, 1).astype(jnp.int32)
+    ids = jnp.concatenate([pos, neg], axis=1)          # [b, 1+k]
+    wj = jnp.take(w, ids, axis=0)                      # [b, 1+k, d]
+    bj = jnp.take(b, ids, axis=0)                      # [b, 1+k]
+    logits = jnp.einsum("bd,bkd->bk", x, wj) + bj
+    labels = jnp.concatenate(
+        [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
+    loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    return loss.sum(axis=1, keepdims=True)
+
+
+@primitive("im2sequence")
+def im2sequence(ctx, x):
+    """reference im2sequence_op.cc: image patches -> [b, n_patches, c*kh*kw]."""
+    k = ctx.attr("kernels", [1, 1])
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(k), window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    b, f, oh, ow = patches.shape
+    return patches.reshape(b, f, oh * ow).transpose(0, 2, 1)

@@ -1,0 +1,139 @@
+"""Optimizer update ops.
+
+Replaces the reference's per-optimizer CUDA kernels (paddle/operators/
+sgd_op.cc, momentum_op.cc, adam_op.cc, adamax_op.cc, adagrad_op.cc,
+adadelta_op.cc, rmsprop_op.cc, ftrl_op.cc, decayed_adagrad_op.cc, and the
+standalone paddle/optimizer/ C library used by the Go pserver).  Updates are
+functional: the op's output var name equals its input param var name, and the
+executor's state-threading makes that an in-place HBM update after XLA's
+buffer donation — the TPU analog of the reference's in-place ParamOut.
+
+All update math runs in fp32 even if params are bf16 (master-weight pattern;
+accumulators are created fp32 by the Optimizer front end).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import primitive
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+@primitive("sgd", inputs=["Param", "Grad", "LearningRate"],
+           outputs=["ParamOut"], no_grad=True)
+def sgd(ctx, p, g, lr):
+    return (_f32(p) - lr * _f32(g)).astype(p.dtype)
+
+
+@primitive("momentum", inputs=["Param", "Grad", "Velocity", "LearningRate"],
+           outputs=["ParamOut", "VelocityOut"], no_grad=True)
+def momentum(ctx, p, g, v, lr):
+    mu = ctx.attr("mu", 0.9)
+    g = _f32(g)
+    v_out = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_out = _f32(p) - (g + mu * v_out) * lr
+    else:
+        p_out = _f32(p) - lr * v_out
+    return p_out.astype(p.dtype), v_out
+
+
+@primitive("adam",
+           inputs=["Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                   "Beta1Pow", "Beta2Pow"],
+           outputs=["ParamOut", "Moment1Out", "Moment2Out",
+                    "Beta1PowOut", "Beta2PowOut"], no_grad=True)
+def adam(ctx, p, g, lr, m1, m2, b1p, b2p):
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    g = _f32(g)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    po = _f32(p) - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return po.astype(p.dtype), m1o, m2o, b1p * b1, b2p * b2
+
+
+@primitive("adamax",
+           inputs=["Param", "Grad", "LearningRate", "Moment", "InfNorm",
+                   "Beta1Pow"],
+           outputs=["ParamOut", "MomentOut", "InfNormOut"], no_grad=True)
+def adamax(ctx, p, g, lr, m, u, b1p):
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    g = _f32(g)
+    mo = b1 * m + (1 - b1) * g
+    uo = jnp.maximum(b2 * u, jnp.abs(g))
+    po = _f32(p) - (lr / (1 - b1p)) * mo / (uo + eps)
+    return po.astype(p.dtype), mo, uo
+
+
+@primitive("adagrad", inputs=["Param", "Grad", "Moment", "LearningRate"],
+           outputs=["ParamOut", "MomentOut"], no_grad=True)
+def adagrad(ctx, p, g, m, lr):
+    eps = ctx.attr("epsilon", 1e-6)
+    g = _f32(g)
+    mo = m + g * g
+    return (_f32(p) - lr * g / (jnp.sqrt(mo) + eps)).astype(p.dtype), mo
+
+
+@primitive("decayed_adagrad", inputs=["Param", "Grad", "Moment", "LearningRate"],
+           outputs=["ParamOut", "MomentOut"], no_grad=True)
+def decayed_adagrad(ctx, p, g, m, lr):
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    g = _f32(g)
+    mo = decay * m + (1 - decay) * g * g
+    return (_f32(p) - lr * g / (jnp.sqrt(mo) + eps)).astype(p.dtype), mo
+
+
+@primitive("adadelta", inputs=["Param", "Grad", "AvgSquaredGrad",
+                               "AvgSquaredUpdate"],
+           outputs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+           no_grad=True)
+def adadelta(ctx, p, g, ag, au):
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    g = _f32(g)
+    ago = rho * ag + (1 - rho) * g * g
+    upd = jnp.sqrt(au + eps) / jnp.sqrt(ago + eps) * g
+    auo = rho * au + (1 - rho) * upd * upd
+    return (_f32(p) - upd).astype(p.dtype), ago, auo
+
+
+@primitive("rmsprop", inputs=["Param", "Grad", "Moment", "MeanSquare",
+                              "LearningRate"],
+           outputs=["ParamOut", "MomentOut", "MeanSquareOut"], no_grad=True)
+def rmsprop(ctx, p, g, m, ms, lr):
+    rho = ctx.attr("decay", 0.9)
+    eps = ctx.attr("epsilon", 1e-10)
+    mom = ctx.attr("momentum", 0.0)
+    g = _f32(g)
+    mso = rho * ms + (1 - rho) * g * g
+    mo = mom * m + lr * g / jnp.sqrt(mso + eps)
+    return (_f32(p) - mo).astype(p.dtype), mo, mso
+
+
+@primitive("ftrl", inputs=["Param", "Grad", "SquaredAccumulator",
+                           "LinearAccumulator", "LearningRate"],
+           outputs=["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+           no_grad=True)
+def ftrl(ctx, p, g, sq, lin, lr):
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    power = ctx.attr("lr_power", -0.5)
+    g = _f32(g)
+    new_sq = sq + g * g
+    sigma = (new_sq ** -power - sq ** -power) / lr
+    lin_out = lin + g - sigma * _f32(p)
+    pre = jnp.where(jnp.abs(lin_out) > l1,
+                    (jnp.sign(lin_out) * l1 - lin_out), 0.0)
+    denom = new_sq ** -power / lr + 2 * l2
+    po = pre / denom
+    return po.astype(p.dtype), new_sq, lin_out

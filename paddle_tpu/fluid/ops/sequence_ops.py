@@ -1,0 +1,182 @@
+"""Sequence ops over SeqArray (padded data + lengths).
+
+TPU-native replacements for the reference's LoD-walking kernels:
+sequence_pool_op.cc, sequence_softmax_op.cc, sequence_conv_op.cc
+(operators/math/context_project.h), sequence_expand_op.cc,
+sequence_concat_op.cc, sequence_slice_op.cc, sequence_erase_op.cc,
+sequence_reshape_op.cc, and the im2col-style ContextProjection in
+paddle/function/ContextProjectionOp.cpp.  Offset walking becomes masking:
+every op is a dense computation over [batch, max_len, ...] with validity
+masks, which XLA vectorizes across the batch (the reference iterated
+sequences serially on CPU / one block per sequence on GPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import SeqArray, seq_mask
+from ..core.registry import primitive
+
+
+def _mask(x: SeqArray):
+    m = seq_mask(x.lengths, x.max_len)
+    return m.reshape(m.shape + (1,) * (x.data.ndim - 2))
+
+
+@primitive("sequence_pool", inputs=["X"], outputs=["Out", "MaxIndex"])
+def sequence_pool(ctx, x):
+    """reference sequence_pool_op.cc: pooltype in {sum, average, sqrt, max,
+    last, first}; reduces the time axis -> dense [batch, ...]."""
+    assert isinstance(x, SeqArray), "sequence_pool expects a sequence input"
+    ptype = ctx.attr("pooltype", "sum").lower()
+    m = _mask(x)
+    data = x.data
+    if ptype == "max":
+        neg = jnp.where(m, data.astype(jnp.float32), -jnp.inf)
+        out = neg.max(axis=1).astype(data.dtype)
+        idx = jnp.argmax(neg, axis=1).astype(jnp.int32)
+        return out, idx
+    if ptype in ("sum", "average", "sqrt"):
+        s = (data * m.astype(data.dtype)).sum(axis=1)
+        n = x.lengths.astype(data.dtype).reshape(
+            (-1,) + (1,) * (data.ndim - 2))
+        if ptype == "average":
+            s = s / jnp.maximum(n, 1)
+        elif ptype == "sqrt":
+            s = s / jnp.sqrt(jnp.maximum(n, 1))
+        return s, jnp.zeros(s.shape, jnp.int32)
+    if ptype == "last":
+        idx = jnp.maximum(x.lengths.astype(jnp.int32) - 1, 0)
+        out = jnp.take_along_axis(
+            data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+        ).squeeze(1)
+        return out, jnp.broadcast_to(
+            idx.reshape((-1,) + (1,) * (data.ndim - 2)), out.shape
+        ).astype(jnp.int32)
+    if ptype == "first":
+        return data[:, 0], jnp.zeros(data[:, 0].shape, jnp.int32)
+    raise ValueError(f"unknown pooltype {ptype}")
+
+
+@primitive("sequence_softmax")
+def sequence_softmax(ctx, x):
+    """reference sequence_softmax_op.cc: softmax over each sequence's valid
+    positions (time axis), padding excluded."""
+    assert isinstance(x, SeqArray)
+    m = _mask(x)
+    logits = jnp.where(m, x.data.astype(jnp.float32), -jnp.inf)
+    out = jax.nn.softmax(logits, axis=1)
+    out = jnp.where(m, out, 0.0).astype(x.data.dtype)
+    return SeqArray(out, x.lengths)
+
+
+@primitive("sequence_conv", inputs=["X", "Filter"])
+def sequence_conv(ctx, x, w):
+    """reference sequence_conv_op.cc / ContextProjection: gather a
+    [context_length] window around each step (zero-padded outside the
+    sequence), flatten, project.  Window gathering is an XLA
+    conv_general_dilated_patches over time."""
+    assert isinstance(x, SeqArray)
+    ctx_len = ctx.attr("context_length", 3)
+    ctx_start = ctx.attr("context_start", -((ctx_len - 1) // 2))
+    data = x.data * _mask(x).astype(x.data.dtype)   # zero out padding
+    b, t, d = data.shape
+    # window positions: for output step i, inputs i+ctx_start .. +ctx_len-1
+    cols = []
+    for off in range(ctx_start, ctx_start + ctx_len):
+        shifted = jnp.roll(data, -off, axis=1)
+        pos = jnp.arange(t) + off
+        valid = ((pos >= 0) & (pos < t)).reshape(1, t, 1)
+        cols.append(jnp.where(valid, shifted, 0.0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)         # [b, t, ctx_len*d]
+    out = jnp.matmul(ctx_mat, w, preferred_element_type=jnp.float32
+                     ).astype(data.dtype)
+    out = out * _mask(x).astype(out.dtype)
+    return SeqArray(out, x.lengths)
+
+
+@primitive("sequence_expand", inputs=["X", "Y"])
+def sequence_expand(ctx, x, y):
+    """reference sequence_expand_op.cc: broadcast each batch row of X across
+    the time steps of the corresponding sequence in Y."""
+    assert isinstance(y, SeqArray)
+    xd = x.data if isinstance(x, SeqArray) else x
+    if xd.ndim == y.data.ndim:          # [b, 1, d] -> expand time
+        xd = xd[:, 0]
+    expanded = jnp.broadcast_to(
+        xd[:, None], (xd.shape[0], y.max_len) + xd.shape[1:])
+    return SeqArray(expanded * _mask(y).astype(xd.dtype), y.lengths)
+
+
+@primitive("sequence_concat", inputs=["X*"])
+def sequence_concat(ctx, xs):
+    """reference sequence_concat_op.cc with axis=1 semantics (feature
+    concat of aligned sequences)."""
+    assert all(isinstance(v, SeqArray) for v in xs)
+    data = jnp.concatenate([v.data for v in xs], axis=-1)
+    return SeqArray(data, xs[0].lengths)
+
+
+@primitive("sequence_reshape")
+def sequence_reshape(ctx, x):
+    """reference sequence_reshape_op.cc: change feature dim, time expands or
+    contracts proportionally.  Static max_len must divide evenly."""
+    assert isinstance(x, SeqArray)
+    new_dim = ctx.attr("new_dim")
+    b, t, d = x.data.shape
+    factor = d // new_dim if d >= new_dim else -(new_dim // d)
+    if factor > 0:
+        data = x.data.reshape(b, t * factor, new_dim)
+        lengths = x.lengths * factor
+    else:
+        data = x.data.reshape(b, t // (-factor), new_dim)
+        lengths = x.lengths // (-factor)
+    return SeqArray(data, lengths)
+
+
+@primitive("sequence_slice", inputs=["X", "Offset", "Length"],
+           stop_grad_slots=("Offset", "Length"))
+def sequence_slice(ctx, x, offset, length):
+    """reference sequence_slice_op.cc: per-sequence [offset, offset+length)
+    windows (static max window = max_len)."""
+    assert isinstance(x, SeqArray)
+    off = offset.reshape(-1).astype(jnp.int32)
+    ln = length.reshape(-1).astype(jnp.int32)
+    b, t = x.data.shape[:2]
+    idx = jnp.clip(off[:, None] + jnp.arange(t)[None, :], 0, t - 1)
+    gathered = jnp.take_along_axis(
+        x.data, idx.reshape(b, t, *(1,) * (x.data.ndim - 2)), axis=1)
+    return SeqArray(gathered, jnp.minimum(ln, x.lengths - off))
+
+
+@primitive("sequence_erase", no_grad=True)
+def sequence_erase(ctx, x):
+    """reference sequence_erase_op.cc: drop tokens in the kill-list,
+    compacting each sequence (stable order)."""
+    assert isinstance(x, SeqArray)
+    tokens = ctx.attr("tokens", [])
+    data = x.data
+    b, t = data.shape[:2]
+    keep = jnp.ones((b, t), bool)
+    flat = data.reshape(b, t, -1)[:, :, 0]
+    for tok in tokens:
+        keep &= flat != tok
+    keep &= seq_mask(x.lengths, t)
+    # stable compaction: sort by (~keep, position)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(t)[None, :], t + 1),
+                        axis=1)
+    compacted = jnp.take_along_axis(
+        data, order.reshape(b, t, *(1,) * (data.ndim - 2)), axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    mask = seq_mask(new_len, t).reshape(b, t, *(1,) * (data.ndim - 2))
+    return SeqArray(compacted * mask.astype(data.dtype), new_len)
+
+
+@primitive("sequence_mask_op", inputs=["X"], no_grad=True)
+def sequence_mask_op(ctx, lengths):
+    maxlen = ctx.attr("maxlen")
+    return seq_mask(lengths.reshape(-1), maxlen).astype(
+        ctx.attr("out_dtype", "float32"))

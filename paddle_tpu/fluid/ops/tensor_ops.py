@@ -1,0 +1,190 @@
+"""Tensor creation / manipulation ops.
+
+Replaces the reference's fill_constant_op.cc, gaussian_random_op.cc,
+uniform_random_op.cc, cast_op.cc, concat_op.cc, split_op.cc, reshape_op.cc,
+transpose_op.cc, assign_op.cc, one_hot_op.cc, top_k_op.cc (hl_top_k.cu),
+lookup_table_op.cc.  Random ops draw from the ctx RNG key that the executor
+threads functionally through the block — the XLA-friendly replacement for the
+reference's stateful per-device curand generators.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import SeqArray
+from ..core.registry import primitive
+from ..core.types import canonical_dtype
+
+
+def _rt_dtype(name):
+    """Runtime numpy dtype for a declared dtype (x64 disabled under JAX)."""
+    name = canonical_dtype(name)
+    return {"int64": jnp.int32, "float64": jnp.float32}.get(name, name)
+
+
+@primitive("fill_constant", inputs=[], no_grad=True)
+def fill_constant(ctx, *_):
+    return jnp.full(tuple(ctx.attr("shape")), ctx.attr("value", 0.0),
+                    dtype=_rt_dtype(ctx.attr("dtype", "float32")))
+
+
+@primitive("fill_zeros_like", no_grad=True)
+def fill_zeros_like(ctx, x):
+    return jnp.zeros_like(x)
+
+
+@primitive("uniform_random", inputs=[], no_grad=True)
+def uniform_random(ctx, *_):
+    return jax.random.uniform(
+        ctx.rng, tuple(ctx.attr("shape")),
+        dtype=_rt_dtype(ctx.attr("dtype", "float32")),
+        minval=ctx.attr("min", -1.0), maxval=ctx.attr("max", 1.0))
+
+
+@primitive("gaussian_random", inputs=[], no_grad=True)
+def gaussian_random(ctx, *_):
+    dt = _rt_dtype(ctx.attr("dtype", "float32"))
+    z = jax.random.normal(ctx.rng, tuple(ctx.attr("shape")), dtype=jnp.float32)
+    return (z * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0)).astype(dt)
+
+
+@primitive("truncated_gaussian_random", inputs=[], no_grad=True)
+def truncated_gaussian_random(ctx, *_):
+    dt = _rt_dtype(ctx.attr("dtype", "float32"))
+    z = jax.random.truncated_normal(ctx.rng, -2.0, 2.0,
+                                    tuple(ctx.attr("shape")), dtype=jnp.float32)
+    return (z * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0)).astype(dt)
+
+
+@primitive("cast", seq_transparent=True)
+def cast(ctx, x):
+    return x.astype(_rt_dtype(ctx.attr("out_dtype", "float32")))
+
+
+@primitive("assign", seq_transparent=True)
+def assign(ctx, x):
+    return x
+
+
+@primitive("concat", inputs=["X*"])
+def concat(ctx, xs):
+    return jnp.concatenate(xs, axis=ctx.attr("axis", 0))
+
+
+@primitive("split", inputs=["X"], outputs=["Out"])
+def split(ctx, x):
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", None)
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        return list(jnp.split(x, idx, axis=axis))
+    return list(jnp.split(x, num, axis=axis))
+
+
+@primitive("reshape", seq_transparent=True)
+def reshape(ctx, x):
+    shape = list(ctx.attr("shape"))
+    return x.reshape([x.shape[i] if d == 0 else d for i, d in enumerate(shape)])
+
+
+@primitive("squeeze")
+def squeeze(ctx, x):
+    axes = ctx.attr("axes", None)
+    return jnp.squeeze(x, axis=tuple(axes) if axes else None)
+
+
+@primitive("unsqueeze")
+def unsqueeze(ctx, x):
+    out = x
+    for ax in sorted(ctx.attr("axes")):
+        out = jnp.expand_dims(out, ax)
+    return out
+
+
+@primitive("transpose")
+def transpose(ctx, x):
+    return jnp.transpose(x, ctx.attr("axis"))
+
+
+@primitive("slice")
+def slice_op(ctx, x):
+    """reference slice_op.cc: axes/starts/ends with negative-index clamping."""
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(ctx.attr("axes"), ctx.attr("starts"), ctx.attr("ends")):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+@primitive("expand")
+def expand(ctx, x):
+    times = ctx.attr("expand_times")
+    return jnp.tile(x, times)
+
+
+@primitive("one_hot", no_grad=True)
+def one_hot(ctx, x):
+    depth = ctx.attr("depth")
+    ids = x.squeeze(-1) if x.ndim > 1 and x.shape[-1] == 1 else x
+    return jax.nn.one_hot(ids.astype(jnp.int32), depth, dtype=jnp.float32)
+
+
+@primitive("top_k", inputs=["X"], outputs=["Out", "Indices"], no_grad=True)
+def top_k(ctx, x):
+    """reference top_k_op.cc / hl_top_k.cu — jax.lax.top_k hits the XLA sort
+    unit directly."""
+    vals, idx = jax.lax.top_k(x, ctx.attr("k", 1))
+    return vals, idx.astype(jnp.int32)
+
+
+@primitive("argmax", no_grad=True)
+def argmax(ctx, x):
+    return jnp.argmax(x, axis=ctx.attr("axis", -1)).astype(jnp.int32)
+
+
+@primitive("lookup_table", inputs=["W", "Ids"], stop_grad_slots=("Ids",))
+def lookup_table(ctx, w, ids):
+    """Embedding gather — reference lookup_table_op.cc.  The backward becomes
+    an XLA scatter-add; the SelectedRows sparse-rows container (reference
+    selected_rows.h) is unnecessary on TPU because scatter-add into HBM is
+    native.  padding_idx rows emit zeros (reference attr)."""
+    seq = isinstance(ids, SeqArray)
+    lengths = ids.lengths if seq else None
+    idv = ids.data if seq else ids
+    if idv.ndim > 1 and idv.shape[-1] == 1:
+        idv = idv.squeeze(-1)
+    idv = idv.astype(jnp.int32)
+    out = jnp.take(w, idv, axis=0)
+    pad = ctx.attr("padding_idx", None)
+    if pad is not None:
+        out = jnp.where((idv == pad)[..., None], 0.0, out)
+    return SeqArray(out, lengths) if seq else out
+
+
+@primitive("multiplex", inputs=["Ids", "X*"], stop_grad_slots=("Ids",))
+def multiplex(ctx, ids, xs):
+    """reference multiplex_op.cc: per-row select among candidate tensors."""
+    stacked = jnp.stack(xs, axis=0)              # [n, batch, ...]
+    rows = ids.reshape(-1).astype(jnp.int32)     # [batch]
+    return stacked[rows, jnp.arange(stacked.shape[1])]
+
+
+@primitive("gather", inputs=["X", "Index"], stop_grad_slots=("Index",))
+def gather(ctx, x, index):
+    return jnp.take(x, index.reshape(-1).astype(jnp.int32), axis=0)
+
+
+@primitive("scatter", inputs=["X", "Ids", "Updates"], stop_grad_slots=("Ids",))
+def scatter(ctx, x, ids, updates):
+    ids = ids.reshape(-1).astype(jnp.int32)
+    if ctx.attr("overwrite", True):
+        return x.at[ids].set(updates)
+    return x.at[ids].add(updates)
+
+
+@primitive("shape", no_grad=True)
+def shape_op(ctx, x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)

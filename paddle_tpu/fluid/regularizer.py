@@ -1,0 +1,61 @@
+"""Weight-decay regularizers — analog of python/paddle/v2/fluid/regularizer.py:
+decay terms are appended to gradients as real ops before the optimizer ops."""
+
+from __future__ import annotations
+
+__all__ = ["append_regularization_ops", "L1Decay", "L2Decay",
+           "L1DecayRegularizer", "L2DecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def append_regularization_op(self, param, grad, helper):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, helper):
+        decay = helper.create_tmp_variable(param.dtype)
+        helper.append_op("scale", {"X": param}, {"Out": decay},
+                         {"scale": self._coeff})
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, helper):
+        sign = helper.create_tmp_variable(param.dtype)
+        helper.append_op("sign", {"X": param}, {"Out": sign})
+        decay = helper.create_tmp_variable(param.dtype)
+        helper.append_op("scale", {"X": sign}, {"Out": decay},
+                         {"scale": self._coeff})
+        return decay
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None,
+                              main_program=None):
+    """reference regularizer.py:15 — param-level regularizer wins over the
+    optimizer-level default."""
+    from .layer_helper import LayerHelper
+
+    out = []
+    for param, grad in parameters_and_grads:
+        regularizer = getattr(param, "regularizer", None) or regularization
+        if grad is None or regularizer is None:
+            out.append((param, grad))
+            continue
+        helper = LayerHelper("regularization", main_program=main_program)
+        decay = regularizer.append_regularization_op(param, grad, helper)
+        new_grad = helper.create_tmp_variable(grad.dtype)
+        helper.append_op("elementwise_add", {"X": grad, "Y": decay},
+                         {"Out": new_grad})
+        out.append((param, new_grad))
+    return out
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

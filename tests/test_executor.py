@@ -1,0 +1,147 @@
+"""Executor + backward end-to-end tests — the analog of the reference's
+executor tests plus book/test_fit_a_line.py (the capability contract's first
+chapter)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+
+
+def test_startup_and_simple_run(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w = [p for p in main.global_block().all_parameters()
+         if tuple(p.shape) == (4, 3)][0]
+    assert scope.find_var(w.name) is not None
+    out, = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[y])
+    assert out.shape == (2, 3)
+    wv = np.asarray(scope.find_var(w.name))
+    bv = np.asarray(scope.find_var(
+        [p for p in main.global_block().all_parameters()
+         if tuple(p.shape) == (3,)][0].name))
+    np.testing.assert_allclose(out, np.ones((2, 4)) @ wv + bv, rtol=1e-5)
+
+
+def test_append_backward_matches_numeric(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    x.stop_gradient = False
+    h = fluid.layers.fc(input=x, size=2, act="tanh")
+    loss = fluid.layers.mean(h)
+    fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    xv = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    (gx,) = exe.run(main, feed={"x": xv}, fetch_list=[x.grad_name])
+    # numeric check
+    eps = 1e-3
+    num = np.zeros_like(xv)
+    for i in range(xv.size):
+        for sgn, tgt in ((1, None), (-1, None)):
+            pass
+    for idx in np.ndindex(*xv.shape):
+        xp = xv.copy(); xp[idx] += eps
+        xm = xv.copy(); xm[idx] -= eps
+        lp, = exe.run(main, feed={"x": xp}, fetch_list=[loss])
+        lm, = exe.run(main, feed={"x": xm}, fetch_list=[loss])
+        num[idx] = (lp - lm) / (2 * eps)
+    np.testing.assert_allclose(gx, num, atol=1e-2, rtol=1e-2)
+
+
+def test_grad_fan_in_accumulation(fresh_programs):
+    """A var consumed by two ops must get summed gradients (backward.py
+    fan-in machinery)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    x.stop_gradient = False
+    a = fluid.layers.scale(x, scale=2.0)
+    b = fluid.layers.scale(x, scale=3.0)
+    s = fluid.layers.elementwise_add(a, b)
+    loss = fluid.layers.mean(s)
+    fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((2, 3), np.float32)
+    (gx,) = exe.run(main, feed={"x": xv}, fetch_list=[x.grad_name])
+    np.testing.assert_allclose(gx, np.full((2, 3), 5.0 / 6.0), rtol=1e-5)
+
+
+def test_fit_a_line_trains(fresh_programs):
+    """Linear regression converges — mirror of
+    fluid/tests/book/test_fit_a_line.py."""
+    main, startup, scope = fresh_programs
+    np_rng = np.random.RandomState(42)
+    true_w = np_rng.randn(13, 1).astype(np.float32)
+    true_b = 0.5
+
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    sgd = fluid.optimizer.SGD(learning_rate=0.01)
+    sgd.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    first = None
+    for step in range(100):
+        xv = np_rng.randn(32, 13).astype(np.float32)
+        yv = xv @ true_w + true_b + 0.01 * np_rng.randn(32, 1).astype(np.float32)
+        loss, = exe.run(main, feed={"x": xv, "y": yv},
+                        fetch_list=[avg_cost])
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.1, (first, float(loss))
+    assert float(loss) < 1.0
+
+
+def test_adam_trains(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu")
+    p = fluid.layers.fc(input=h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    np_rng = np.random.RandomState(1)
+    losses = []
+    for _ in range(60):
+        xv = np_rng.randn(16, 8).astype(np.float32)
+        yv = (xv.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+def test_random_ops_vary_across_steps(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[100], dtype="float32")
+    d = fluid.layers.dropout(x, dropout_prob=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 100), np.float32)
+    a, = exe.run(main, feed={"x": xv}, fetch_list=[d])
+    b, = exe.run(main, feed={"x": xv}, fetch_list=[d])
+    assert not np.array_equal(a, b)
+    assert set(np.unique(a)).issubset({0.0, 2.0})
+
+
+def test_fetch_parameter_directly(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    h = fluid.layers.fc(input=x, size=2, bias_attr=False)
+    w = main.global_block().all_parameters()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    wv, = exe.run(main, feed={"x": np.zeros((1, 2), np.float32)},
+                  fetch_list=[w.name])
+    assert wv.shape == (2, 2)

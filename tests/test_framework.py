@@ -1,0 +1,87 @@
+"""IR + framework tests — mirror of the reference's framework unit tests
+(paddle/framework/program_desc_test.cc, op_desc tests, python
+test_program.py / test_operator_desc.py / test_variable.py)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.core.desc import OpDesc, ProgramDesc, VarDesc
+
+
+def test_desc_roundtrip():
+    p = ProgramDesc()
+    b = p.global_block()
+    b.add_var(VarDesc("x", shape=[-1, 4], dtype="float32"))
+    b.add_var(VarDesc("w", shape=[4, 3], persistable=True))
+    b.add_var(VarDesc("y", shape=[-1, 3]))
+    b.append_op(OpDesc("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["y"]},
+                       {"x_num_col_dims": 1}))
+    sub = p.append_block(0)
+    sub.append_op(OpDesc("relu", {"X": ["y"]}, {"Out": ["y2"]}))
+    op = b.ops[0]
+    op.set_block_attr("sub_block", sub.idx)
+
+    data = p.serialize_to_string()
+    q = ProgramDesc.parse_from_string(data)
+    assert q.serialize_to_string() == data
+    assert q.fingerprint() == p.fingerprint()
+    assert q.global_block().var("w").persistable
+    assert q.global_block().ops[0].block_attr("sub_block") == 1
+    assert q.global_block().ops[0].input("X") == ["x"]
+
+
+def test_program_build_and_shape_inference(fresh_programs):
+    main, startup, _ = fresh_programs
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.fc(input=x, size=7, act="relu")
+    assert x.shape == (-1, 13)
+    assert y.shape == (-1, 7)
+    loss = fluid.layers.mean(y)
+    assert loss.shape == ()
+    # parameters were created in both programs with initializer ops
+    params = main.global_block().all_parameters()
+    assert {tuple(p.shape) for p in params} == {(13, 7), (7,)}
+    assert len(startup.global_block().ops) == 2
+
+
+def test_program_clone_preserves_params(fresh_programs):
+    main, startup, _ = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=3)
+    h = fluid.layers.dropout(h, dropout_prob=0.5)
+    clone = main.clone(for_test=True)
+    drop_ops = [op for op in clone.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops and drop_ops[0].attr("is_test") is True
+    # original untouched
+    orig = [op for op in main.global_block().ops if op.type == "dropout"]
+    assert not orig[0].attr("is_test", False)
+    assert clone.global_block().all_parameters()
+
+
+def test_variable_lookup_parent_block(fresh_programs):
+    main, _, _ = fresh_programs
+    g = main.global_block()
+    v = g.create_var(name="gvar", shape=[2], dtype="float32")
+    sub = main.create_block()
+    assert sub.var("gvar") is v
+    main.rollback()
+    assert main.current_block() is g
+
+
+def test_registry_rejects_duplicate():
+    from paddle_tpu.fluid.core.registry import OpInfo, register
+
+    with pytest.raises(ValueError):
+        register(OpInfo("relu", lambda ctx, ins: ins))
+
+
+def test_op_attrs_and_unique_names(fresh_programs):
+    main, _, _ = fresh_programs
+    a = fluid.layers.data(name="a", shape=[4], dtype="float32")
+    s1 = fluid.layers.scale(a, scale=3.0)
+    s2 = fluid.layers.scale(a, scale=4.0)
+    assert s1.name != s2.name
+    ops = [op for op in main.global_block().ops if op.type == "scale"]
+    assert ops[0].attr("scale") == 3.0 and ops[1].attr("scale") == 4.0

@@ -1,0 +1,115 @@
+"""Sequence stack tests: SeqArray feeding, sequence ops, lod-aware fc/
+embedding, and RNG-salt determinism of recomputed grads."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import make_seq
+
+
+def test_make_seq_and_mask():
+    s = make_seq([[1, 2, 3], [4]], dtype=np.int32, bucket=4)
+    assert s.data.shape == (2, 4)
+    np.testing.assert_array_equal(s.lengths, [3, 1])
+    np.testing.assert_array_equal(np.asarray(s.mask(np.int32)),
+                                  [[1, 1, 1, 0], [1, 0, 0, 0]])
+
+
+def test_sequence_pool_types(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32", lod_level=1)
+    pools = {pt: fluid.layers.sequence_pool(x, pt)
+             for pt in ["sum", "average", "max", "last", "first"]}
+    exe = fluid.Executor(fluid.CPUPlace())
+    seqs = [np.array([[1., 2.], [3., 4.], [5., 6.]]),
+            np.array([[7., 8.]])]
+    feed = {"x": make_seq(seqs, dtype=np.float32)}
+    outs = exe.run(main, feed=feed, fetch_list=list(pools.values()))
+    res = dict(zip(pools, outs))
+    np.testing.assert_allclose(res["sum"], [[9, 12], [7, 8]])
+    np.testing.assert_allclose(res["average"], [[3, 4], [7, 8]])
+    np.testing.assert_allclose(res["max"], [[5, 6], [7, 8]])
+    np.testing.assert_allclose(res["last"], [[5, 6], [7, 8]])
+    np.testing.assert_allclose(res["first"], [[1, 2], [7, 8]])
+
+
+def test_sequence_softmax_masks_padding(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32", lod_level=1)
+    sm = fluid.layers.sequence_softmax(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": make_seq([np.zeros((3, 1)), np.zeros((1, 1))],
+                          dtype=np.float32)}
+    out, = exe.run(main, feed=feed, fetch_list=[sm], return_numpy=False)
+    data = np.asarray(out.data).squeeze(-1)
+    np.testing.assert_allclose(data[0, :3], [1 / 3] * 3, rtol=1e-5)
+    assert data[0, 3:].sum() == 0         # padding got zero probability
+    np.testing.assert_allclose(data[1, 0], 1.0, rtol=1e-5)
+
+
+def test_embedding_seq_pipeline_trains(fresh_programs):
+    """word2vec-style slice: embedding -> sequence_pool -> fc -> CE loss."""
+    main, startup, scope = fresh_programs
+    words = fluid.layers.data(name="w", shape=[1], dtype="int64", lod_level=1)
+    label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(input=words, size=[50, 8])
+    pooled = fluid.layers.sequence_pool(emb, "average")
+    logits = fluid.layers.fc(input=pooled, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(40):
+        seqs = [rng.randint(0, 50, size=(rng.randint(2, 7), 1))
+                for _ in range(8)]
+        lbl = np.array([[s.sum() % 4] for s in seqs], dtype=np.int64)
+        feed = {"w": make_seq(seqs, dtype=np.int32, bucket=8), "y": lbl}
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.mean(losses[-8:]) < np.mean(losses[:8])
+
+
+def test_fc_on_sequence_has_full_bias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32", lod_level=1)
+    h = fluid.layers.fc(input=x, size=5)
+    biases = [p for p in main.global_block().all_parameters()
+              if tuple(p.shape) == (5,)]
+    assert len(biases) == 1  # bias must be [size], not a 0-d scalar
+    params = {tuple(p.shape) for p in main.global_block().all_parameters()}
+    assert (3, 5) in params and (5,) in params
+
+
+def test_dropout_grad_mask_determinism(fresh_programs):
+    """The vjp-recomputed dropout in the grad op must regenerate the same
+    mask (RNG salt contract, lowering.py)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+    x.stop_gradient = False
+    d = fluid.layers.dropout(x, dropout_prob=0.5)
+    loss = fluid.layers.reduce_sum(d)
+    fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((4, 64), np.float32)
+    out, gx = exe.run(main, feed={"x": xv}, fetch_list=[d, x.grad_name])
+    # gradient of sum(dropout(x)) wrt x is exactly the scaled keep-mask;
+    # if the grad op's RNG disagreed with the forward, these would differ
+    np.testing.assert_allclose(gx, out, rtol=1e-6)
+    assert set(np.unique(out)) == {0.0, 2.0}
+
+
+def test_sequence_conv_shapes(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32", lod_level=1)
+    c = fluid.layers.sequence_conv(x, num_filters=6, filter_size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": make_seq([np.ones((5, 4)), np.ones((2, 4))],
+                          dtype=np.float32)}
+    out, = exe.run(main, feed=feed, fetch_list=[c], return_numpy=False)
+    assert np.asarray(out.data).shape == (2, 5, 6)
+    # padding rows must stay zero
+    assert np.abs(np.asarray(out.data)[1, 2:]).sum() == 0
