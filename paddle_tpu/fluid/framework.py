@@ -294,10 +294,16 @@ class Block:
         info = get_op_info(desc.type)
         abstract_ins = {}
         batch_dyn = False
-        for slot, vs in in_vars.items():
-            abstract_ins[slot] = [v.abstract_value() for v in vs]
-            batch_dyn = batch_dyn or any(
-                v.shape and v.shape[0] == -1 for v in vs)
+        try:
+            for slot, vs in in_vars.items():
+                abstract_ins[slot] = [v.abstract_value() for v in vs]
+                batch_dyn = batch_dyn or any(
+                    v.shape and v.shape[0] == -1 for v in vs)
+        except ValueError as e:
+            if _STRICT_INFER:
+                raise RuntimeError(
+                    f"shape inference failed for op {desc.type}: {e}") from e
+            return
 
         def f(ins):
             ctx = EmitCtx(desc, rng=jax.random.key(0))

@@ -78,6 +78,15 @@ class LayerHelper:
         init = (attr.initializer or default_initializer
                 or attr.default_initializer(is_bias))
         main_block = self.main_program.global_block()
+        if name in main_block.vars:
+            # named parameter sharing (the reference's shared_w pattern in
+            # book/test_word2vec.py): reuse, don't re-create/re-init
+            existing = main_block.vars[name]
+            if list(existing.shape) != list(shape):
+                raise ValueError(
+                    f"shared parameter {name!r} shape mismatch: "
+                    f"{existing.shape} vs {shape}")
+            return existing
         param = main_block.create_parameter(
             name=name, shape=list(shape), dtype=dtype,
             trainable=attr.trainable,

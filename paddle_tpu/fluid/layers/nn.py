@@ -11,6 +11,7 @@ from typing import List, Optional, Sequence, Union
 
 from ..framework import Variable
 from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
 
 __all__ = [
     "fc", "embedding", "dropout", "cross_entropy", "square_error_cost",
@@ -276,7 +277,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     scale = helper.create_parameter(
         helper.param_attr, shape=pshape, dtype=dtype,
         default_initializer=ConstantInitializer(1.0), suffix="scale")
-    bias = helper.create_parameter(helper.bias_attr or helper.param_attr,
+    bias = helper.create_parameter(helper.bias_attr or ParamAttr(),
                                    shape=pshape, dtype=dtype, is_bias=True,
                                    suffix="offset")
     mean = helper.create_global_variable(
@@ -320,7 +321,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
             default_initializer=ConstantInitializer(1.0), suffix="scale")
     if shift:
         inputs["Bias"] = helper.create_parameter(
-            helper.bias_attr or helper.param_attr, shape=norm_shape,
+            helper.bias_attr or ParamAttr(), shape=norm_shape,
             dtype=dtype, is_bias=True)
     out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
     mean = helper.create_tmp_variable(dtype, stop_gradient=True)
@@ -409,7 +410,7 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     w = helper.create_parameter(helper.param_attr,
                                 shape=[num_total_classes, dim],
                                 dtype=input.dtype)
-    b = helper.create_parameter(helper.bias_attr or helper.param_attr,
+    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
                                 shape=[num_total_classes], dtype=input.dtype,
                                 is_bias=True)
     cost = helper.create_tmp_variable(input.dtype)

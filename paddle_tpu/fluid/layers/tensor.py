@@ -43,14 +43,14 @@ def ones(shape, dtype, name=None):
 
 
 def concat(input, axis=0, name=None):
-    helper = LayerHelper("concat", name=name)
+    helper = LayerHelper("concat", name=name, input=input)
     out = helper.create_tmp_variable(helper.input_dtype())
     helper.append_op("concat", {"X": input}, {"Out": out}, {"axis": axis})
     return out
 
 
 def sums(input, out=None):
-    helper = LayerHelper("sums")
+    helper = LayerHelper("sums", input=input)
     out = out or helper.create_tmp_variable(helper.input_dtype())
     helper.append_op("sum", {"X": input}, {"Out": out})
     return out

@@ -145,7 +145,19 @@ def _reduce(name, fn):
     @primitive(name)
     def _op(ctx, x, _fn=fn):
         """reference reduce_op.cc family: dim attr (list or int), keep_dim,
-        reduce_all."""
+        reduce_all.  A SeqArray input reduces over valid positions only
+        (padding masked out) — the analog of reducing an unpadded LoD
+        tensor."""
+        from ..core.lod import SeqArray
+
+        if isinstance(x, SeqArray):
+            if name != "reduce_sum" or not ctx.attr("reduce_all", False):
+                raise NotImplementedError(
+                    f"{name} with explicit dims on a sequence input is "
+                    f"ill-defined in the padded layout; pool the sequence "
+                    f"axis first (sequence_pool)")
+            m = x.mask().reshape(x.data.shape[:2] + (1,) * (x.data.ndim - 2))
+            x = x.data * m.astype(x.data.dtype)
         dim = ctx.attr("dim", [0])
         if ctx.attr("reduce_all", False):
             dim = None

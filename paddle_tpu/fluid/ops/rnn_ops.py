@@ -1,0 +1,161 @@
+"""Recurrent ops: dynamic LSTM / GRU over sequences.
+
+TPU-native replacement for the reference's fused recurrent kernels:
+lstm_op.cc + operators/math/lstm_compute.h (+ detail/lstm_kernel.h),
+gru_op.cc + math/gru_compute.h, and the legacy hand-fused hl_cuda_lstm.cu.
+The reference batches time steps via LoD reordering (math/sequence2batch.h);
+here the time loop is a lax.scan over the padded time axis with carry
+masking — XLA unrolls the gate math into fused MXU matmuls per step, and the
+scan keeps compile time constant in sequence length.
+
+Layout contract (matches the reference):
+  * Input is the PRE-PROJECTED sequence [batch, time, 4*size] (the x@W_x is
+    done by the preceding fc layer, exactly like lstm_op.cc's Input).
+  * Weight is the recurrence [size, 4*size]; gate order c~, i, f, o —
+    the reference packing (operators/math/detail/lstm_cpu_kernel.h:44-47
+    loads value_in (candidate) first, then input/forget/output gates).
+  * Bias [4*size], or [7*size] with use_peepholes (W_ic, W_fc, W_oc tails).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import SeqArray
+from ..core.registry import primitive
+
+_ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+         "relu": jax.nn.relu, "identity": lambda x: x}
+
+
+def _scan_seq(x: SeqArray, step, init_carry, reverse: bool):
+    """Run `step` over the time axis with carry masking; returns stacked
+    per-step outputs [batch, time, ...]."""
+    data = jnp.swapaxes(x.data, 0, 1)            # [T, B, ...]
+    mask = jnp.swapaxes(x.mask(data.dtype), 0, 1)  # [T, B]
+    if reverse:
+        data = data[::-1]
+        mask = mask[::-1]
+
+    def wrapped(carry, tm):
+        xt, mt = tm
+        new_carry, out = step(carry, xt)
+        mt = mt[:, None]
+        merged = tuple(mt * n + (1 - mt) * o
+                       for n, o in zip(new_carry, carry))
+        return merged, out * mt
+
+    _, outs = jax.lax.scan(wrapped, init_carry, (data, mask))
+    if reverse:
+        outs = outs[::-1]
+    return jnp.swapaxes(outs, 0, 1)
+
+
+@primitive("dynamic_lstm", inputs=["Input", "Weight", "Bias", "H0?", "C0?"],
+           outputs=["Hidden", "Cell"])
+def dynamic_lstm(ctx, x, w, b, h0, c0):
+    """reference lstm_op.cc — outputs the full hidden and cell sequences."""
+    assert isinstance(x, SeqArray), "dynamic_lstm expects a sequence input"
+    size = w.shape[0]
+    gate_act = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACTS[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACTS[ctx.attr("candidate_activation", "tanh")]
+    use_peepholes = ctx.attr("use_peepholes", True)
+    batch = x.data.shape[0]
+
+    bias = b.reshape(-1)
+    gate_bias = bias[: 4 * size]
+    if use_peepholes:
+        w_ic = bias[4 * size: 5 * size]
+        w_fc = bias[5 * size: 6 * size]
+        w_oc = bias[6 * size: 7 * size]
+
+    h_init = h0 if h0 is not None else jnp.zeros((batch, size), x.data.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((batch, size), x.data.dtype)
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + jnp.matmul(h, w, preferred_element_type=jnp.float32
+                                ).astype(xt.dtype) + gate_bias
+        # reference gate packing: candidate first (lstm_cpu_kernel.h:44-47)
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + w_ic * c
+            gf = gf + w_fc * c
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if use_peepholes:
+            go = go + w_oc * c_new
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        return (h_new, c_new), jnp.concatenate([h_new, c_new], axis=-1)
+
+    hc = _scan_seq(x, step, (h_init, c_init), ctx.attr("is_reverse", False))
+    return (SeqArray(hc[..., :size], x.lengths),
+            SeqArray(hc[..., size:], x.lengths))
+
+
+@primitive("dynamic_gru", inputs=["Input", "Weight", "Bias?", "H0?"],
+           outputs=["Hidden"])
+def dynamic_gru(ctx, x, w, b, h0):
+    """reference gru_op.cc: Input [b,t,3*size] pre-projected; Weight packs
+    the update/reset recurrence [size, 2*size] and candidate recurrence
+    [size, size] side by side (gru_compute.h layout)."""
+    assert isinstance(x, SeqArray)
+    size = w.shape[0]
+    gate_act = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    cand_act = _ACTS[ctx.attr("activation", "tanh")]
+    batch = x.data.shape[0]
+    w_ur = w[:, : 2 * size]
+    w_c = w[:, 2 * size:]
+    bias = b.reshape(-1) if b is not None else jnp.zeros(3 * size, x.data.dtype)
+
+    h_init = h0 if h0 is not None else jnp.zeros((batch, size), x.data.dtype)
+
+    def step(carry, xt):
+        (h,) = carry
+        x_ur, x_c = xt[..., : 2 * size], xt[..., 2 * size:]
+        ur = gate_act(x_ur + jnp.matmul(h, w_ur) + bias[: 2 * size])
+        u, r = jnp.split(ur, 2, axis=-1)
+        c = cand_act(x_c + jnp.matmul(r * h, w_c) + bias[2 * size:])
+        # reference gru_kernel.h:62: out = prev - u*prev + u*candidate
+        h_new = (1 - u) * h + u * c
+        return (h_new,), h_new
+
+    out = _scan_seq(x, step, (h_init,), ctx.attr("is_reverse", False))
+    return SeqArray(out, x.lengths)
+
+
+@primitive("lstm_unit", inputs=["X", "C_prev"], outputs=["C", "H"])
+def lstm_unit(ctx, x, c_prev):
+    """Single LSTM step (reference lstm_unit_op.cc) — building block for
+    StaticRNN-composed nets; x = [b, 4*size] pre-projected gates."""
+    forget_bias = ctx.attr("forget_bias", 0.0)
+    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return c, h
+
+
+@primitive("gru_unit", inputs=["Input", "HiddenPrev", "Weight", "Bias?"],
+           outputs=["Gate", "ResetHiddenPrev", "Hidden"])
+def gru_unit(ctx, x, h_prev, w, b):
+    """Single GRU step — reference gru_unit_op.cc."""
+    size = h_prev.shape[-1]
+    gate_act = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    cand_act = _ACTS[ctx.attr("activation", "tanh")]
+    bias = b.reshape(-1) if b is not None else jnp.zeros(3 * size, x.dtype)
+    w_ur = w[:, : 2 * size]
+    w_c = w[:, 2 * size:]
+    x_ur, x_c = x[..., : 2 * size], x[..., 2 * size:]
+    ur = gate_act(x_ur + jnp.matmul(h_prev, w_ur) + bias[: 2 * size])
+    u, r = jnp.split(ur, 2, axis=-1)
+    rh = r * h_prev
+    c = cand_act(x_c + jnp.matmul(rh, w_c) + bias[2 * size:])
+    h = (1 - u) * h_prev + u * c   # gru_kernel.h:62 convention
+    gate = jnp.concatenate([u, r, c], axis=-1)
+    return gate, rh, h

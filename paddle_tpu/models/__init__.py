@@ -1,0 +1,16 @@
+"""Model zoo — the capability contract of the reference's Fluid "book"
+(python/paddle/v2/fluid/tests/book/): fit_a_line, recognize_digits,
+image_classification (VGG/ResNet), word2vec, understand_sentiment,
+recommender, label_semantic_roles, machine_translation + Transformer.
+
+Each module exposes builder functions that append layers to the current
+program, mirroring how the book chapters build nets, so user scripts look
+identical to the reference's."""
+
+from . import (  # noqa: F401
+    fit_a_line,
+    image_classification,
+    recognize_digits,
+    sentiment,
+    word2vec,
+)

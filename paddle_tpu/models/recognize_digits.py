@@ -1,0 +1,31 @@
+"""MNIST digit recognition — book ch.02
+(fluid/tests/book/test_recognize_digits_conv.py / _mlp.py)."""
+
+from __future__ import annotations
+
+from ..fluid import layers, nets
+
+
+def conv_net(img, label):
+    """The reference chapter's conv-pool x2 topology."""
+    conv_pool_1 = nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act="relu")
+    prediction = layers.fc(input=conv_pool_2, size=10, act="softmax")
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def mlp(img, label):
+    hidden = layers.fc(input=img, size=128, act="relu")
+    hidden = layers.fc(input=hidden, size=64, act="relu")
+    prediction = layers.fc(input=hidden, size=10, act="softmax")
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc

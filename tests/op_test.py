@@ -1,0 +1,200 @@
+"""OpTest harness — the analog of the reference's
+python/paddle/v2/fluid/tests/op_test.py (OpTest:212,
+check_output_with_place:251, check_grad:361, get_numeric_gradient:97).
+
+The contract is the same: build a one-op program, run it through the real
+executor, compare outputs against a numpy golden, and compare the analytic
+gradient (desc-level *_grad ops produced by append_backward) against a
+central finite-difference numeric gradient, element by element.  Where the
+reference checks CPU vs CUDA kernels, we check the XLA lowering (CPU backend
+in CI, identical HLO on TPU) against pure numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import SeqArray
+from paddle_tpu.fluid.core.types import is_float_dtype
+
+
+def _is_float(arr) -> bool:
+    a = arr.data if isinstance(arr, SeqArray) else arr
+    return np.issubdtype(np.asarray(a).dtype, np.floating)
+
+
+class OpTestCase:
+    """One op-under-test configuration."""
+
+    def __init__(self, op_type: str,
+                 inputs: Dict[str, Union[np.ndarray, SeqArray, list]],
+                 attrs: Optional[dict] = None,
+                 n_outputs: Optional[Dict[str, int]] = None):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.attrs = attrs or {}
+        # output slot -> arity; default discovered by a probe run
+        self.n_outputs = n_outputs
+
+    # -- program construction ------------------------------------------------
+    def _build(self, out_slots: Dict[str, int]):
+        main = fluid.Program()
+        startup = fluid.Program()
+        scope = fluid.Scope()
+        in_vars: Dict[str, list] = {}
+        feed = {}
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            block = main.global_block()
+            for slot, vals in self.inputs.items():
+                if not isinstance(vals, list):
+                    vals = [vals]
+                in_vars[slot] = []
+                for i, arr in enumerate(vals):
+                    name = f"in_{slot}_{i}"
+                    if isinstance(arr, SeqArray):
+                        v = block.create_var(
+                            name=name, shape=[-1] + list(arr.data.shape[2:]),
+                            dtype=str(np.asarray(arr.data).dtype),
+                            lod_level=1, stop_gradient=not _is_float(arr))
+                    else:
+                        arr = np.asarray(arr)
+                        v = block.create_var(
+                            name=name, shape=list(arr.shape),
+                            dtype=_canon_dt(arr.dtype),
+                            stop_gradient=not _is_float(arr))
+                    in_vars[slot].append(v)
+                    feed[name] = arr
+            out_vars = {}
+            for slot, n in out_slots.items():
+                out_vars[slot] = [
+                    block.create_var(name=f"out_{slot}_{i}")
+                    for i in range(n)]
+            block.append_op(self.op_type, in_vars, out_vars, self.attrs,
+                            infer_shape=False)
+        return main, startup, scope, feed, in_vars, out_vars
+
+    def _discover_outputs(self) -> Dict[str, int]:
+        if self.n_outputs is not None:
+            return self.n_outputs
+        from paddle_tpu.fluid.core.registry import get_op_info
+
+        # probe: emit with real values to see which output slots appear
+        from paddle_tpu.fluid.core.desc import OpDesc
+        from paddle_tpu.fluid.core.registry import EmitCtx
+        import jax
+
+        ins = {}
+        for slot, vals in self.inputs.items():
+            if not isinstance(vals, list):
+                vals = [vals]
+            ins[slot] = [v if isinstance(v, SeqArray) else np.asarray(v)
+                         for v in vals]
+        op = OpDesc(self.op_type, {}, {}, dict(self.attrs))
+        ctx = EmitCtx(op, rng=jax.random.key(0))
+        outs = get_op_info(self.op_type).emit(ctx, ins)
+        return {slot: len(vals) for slot, vals in outs.items()}
+
+    # -- checks --------------------------------------------------------------
+    def check_output(self, expect: Dict[str, Union[np.ndarray, list]],
+                     atol: float = 1e-5, rtol: float = 1e-4):
+        out_slots = self._discover_outputs()
+        main, startup, scope, feed, _, out_vars = self._build(out_slots)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            fetch = [v for slot in expect for v in out_vars[slot]]
+            results = exe.run(main, feed=feed, fetch_list=fetch,
+                              return_numpy=False)
+        i = 0
+        for slot, exp in expect.items():
+            exps = exp if isinstance(exp, list) else [exp]
+            for e in exps:
+                got = results[i]
+                i += 1
+                g = np.asarray(got.data) if isinstance(got, SeqArray) \
+                    else np.asarray(got)
+                e_arr = e.data if isinstance(e, SeqArray) else e
+                np.testing.assert_allclose(
+                    g.astype(np.float64), np.asarray(e_arr, np.float64),
+                    atol=atol, rtol=rtol,
+                    err_msg=f"{self.op_type} output {slot}")
+
+    def check_grad(self, inputs_to_check: Sequence[str],
+                   output_slots: Optional[Sequence[str]] = None,
+                   max_relative_error: float = 5e-3, delta: float = 5e-3,
+                   atol: float = 1e-4):
+        """Compare analytic (append_backward) vs numeric grads of
+        loss = sum of requested outputs."""
+        out_slots = self._discover_outputs()
+        main, startup, scope, feed, in_vars, out_vars = self._build(out_slots)
+        with fluid.program_guard(main), fluid.unique_name.guard():
+            # loss = sum over (float) outputs of all requested slots
+            sel = output_slots or [s for s in out_slots]
+            parts = []
+            for slot in sel:
+                for v in out_vars[slot]:
+                    parts.append(fluid.layers.reduce_sum(v))
+            loss = parts[0] if len(parts) == 1 else fluid.layers.sums(parts)
+            grad_targets = []
+            for slot in inputs_to_check:
+                for v in in_vars[slot]:
+                    v.stop_gradient = False
+                    grad_targets.append(v)
+            fluid.append_backward(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        def run_loss(feed_override):
+            with fluid.scope_guard(scope):
+                out, = exe.run(main, feed=feed_override, fetch_list=[loss])
+            return float(np.asarray(out))
+
+        with fluid.scope_guard(scope):
+            analytic = exe.run(
+                main, feed=feed,
+                fetch_list=[v.grad_name for v in grad_targets],
+                return_numpy=False)
+
+        for v, ga in zip(grad_targets, analytic):
+            base = feed[v.name]
+            is_seq = isinstance(base, SeqArray)
+            data = np.asarray(base.data if is_seq else base, np.float64)
+            ga_arr = np.asarray(ga.data if isinstance(ga, SeqArray) else ga,
+                                np.float64)
+            num = np.zeros_like(data)
+            it = np.nditer(data, flags=["multi_index"])
+            while not it.finished:
+                idx = it.multi_index
+                if is_seq and idx[1] >= int(base.lengths[idx[0]]):
+                    it.iternext()
+                    continue  # padding positions carry no signal
+                dp = data.copy(); dp[idx] += delta
+                dm = data.copy(); dm[idx] -= delta
+                fp = dict(feed); fm = dict(feed)
+                if is_seq:
+                    fp[v.name] = SeqArray(dp.astype(np.float32), base.lengths)
+                    fm[v.name] = SeqArray(dm.astype(np.float32), base.lengths)
+                else:
+                    fp[v.name] = dp.astype(data.dtype if data.dtype != np.float64 else np.float32)
+                    fm[v.name] = dm.astype(fp[v.name].dtype)
+                num[idx] = (run_loss(fp) - run_loss(fm)) / (2 * delta)
+                it.iternext()
+            if is_seq:
+                mask = np.asarray(base.mask(np.float64))
+                mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+                ga_arr = ga_arr * mask
+                num = num * mask
+            abs_err = np.abs(ga_arr - num)
+            rel = abs_err / np.maximum(np.abs(num), 1.0)
+            assert (rel.max() <= max_relative_error) or \
+                   (abs_err.max() <= atol), (
+                f"{self.op_type} grad wrt {v.name}: max rel err "
+                f"{rel.max():.2e}, max abs err {abs_err.max():.2e}\n"
+                f"analytic:\n{ga_arr}\nnumeric:\n{num}")
+
+
+def _canon_dt(dt) -> str:
+    name = np.dtype(dt).name
+    return {"int64": "int64", "float64": "float32"}.get(name, name)

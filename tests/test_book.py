@@ -1,0 +1,161 @@
+"""End-to-end "book" tests — mirror of fluid/tests/book/: full training
+loops asserting the loss decreases.  Synthetic data (zero-egress CI), tiny
+shapes, CPU mesh; the same model builders run full-size on TPU via bench.py.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import make_seq
+from paddle_tpu.models import (image_classification, recognize_digits,
+                               sentiment, word2vec)
+
+
+def _train(main, startup, scope, feeder, loss_var, steps=25, acc_var=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(steps):
+            fetch = [loss_var] + ([acc_var] if acc_var is not None else [])
+            out = exe.run(main, feed=feeder(i), fetch_list=fetch)
+            losses.append(float(out[0]))
+    return losses
+
+
+def test_recognize_digits_conv(fresh_programs):
+    main, startup, scope = fresh_programs
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    _, avg_cost, acc = recognize_digits.conv_net(img, label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    rng = np.random.RandomState(0)
+    # synthetic "digits": class k = bright kth row-band
+    def feeder(i):
+        lbl = rng.randint(0, 10, (16, 1)).astype(np.int64)
+        img_v = rng.rand(16, 1, 28, 28).astype(np.float32) * 0.1
+        for b, k in enumerate(lbl[:, 0]):
+            img_v[b, 0, k * 2: k * 2 + 3, :] += 1.0
+        return {"img": img_v, "label": lbl}
+
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=30)
+    assert losses[-1] < losses[0] * 0.6, losses[::6]
+
+
+def test_word2vec_ngram(fresh_programs):
+    main, startup, scope = fresh_programs
+    dict_size = 30
+    words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+             for i in range(5)]
+    avg_cost, _ = word2vec.ngram_model(words, dict_size, embed_size=8,
+                                       hidden_size=32)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    rng = np.random.RandomState(1)
+
+    def feeder(i):
+        ctx = rng.randint(0, dict_size, (32, 4))
+        nxt = (ctx.sum(axis=1) % dict_size).reshape(-1, 1)
+        feed = {f"w{k}": ctx[:, k:k + 1].astype(np.int64) for k in range(4)}
+        feed["w4"] = nxt.astype(np.int64)
+        return feed
+
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=40)
+    assert losses[-1] < losses[0]
+
+
+def test_image_classification_resnet_small(fresh_programs):
+    main, startup, scope = fresh_programs
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    # depth 8 = smallest valid CIFAR resnet ((8-2)%6==0); 32px input is
+    # what the builder's final 8x8 avg pool assumes
+    predict = image_classification.resnet_cifar10(img, depth=8, class_num=4)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+        avg_cost)
+
+    rng = np.random.RandomState(2)
+
+    def feeder(i):
+        lbl = rng.randint(0, 4, (8, 1)).astype(np.int64)
+        img_v = rng.rand(8, 3, 32, 32).astype(np.float32) * 0.2
+        for b, k in enumerate(lbl[:, 0]):
+            img_v[b, k % 3, :, :] += 0.8  # class -> dominant channel
+        return {"img": img_v, "label": lbl}
+
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=25)
+    assert losses[-1] < losses[0], losses[::5]
+
+
+def test_vgg_builds_and_steps(fresh_programs):
+    main, startup, scope = fresh_programs
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    predict = image_classification.vgg16_bn_drop(img, class_num=10)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    rng = np.random.RandomState(3)
+
+    def feeder(i):
+        return {"img": rng.rand(2, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=2)
+    assert np.isfinite(losses).all()
+
+
+def test_sentiment_conv_net(fresh_programs):
+    main, startup, scope = fresh_programs
+    data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    avg_cost, acc, _ = sentiment.convolution_net(data, label, input_dim=40,
+                                                 class_dim=2, emb_dim=8,
+                                                 hid_dim=8)
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+    rng = np.random.RandomState(4)
+
+    def feeder(i):
+        seqs, lbls = [], []
+        for _ in range(8):
+            n = rng.randint(3, 9)
+            pos = rng.randint(0, 2)
+            lo, hi = (0, 20) if pos == 0 else (20, 40)
+            seqs.append(rng.randint(lo, hi, (n, 1)))
+            lbls.append([pos])
+        return {"words": make_seq(seqs, dtype=np.int32, bucket=10),
+                "label": np.array(lbls, np.int64)}
+
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=30)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_sentiment_stacked_lstm(fresh_programs):
+    main, startup, scope = fresh_programs
+    data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    avg_cost, acc, _ = sentiment.stacked_lstm_net(
+        data, label, input_dim=30, class_dim=2, emb_dim=8, hid_dim=8,
+        stacked_num=3)
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+    rng = np.random.RandomState(5)
+
+    def feeder(i):
+        seqs, lbls = [], []
+        for _ in range(6):
+            n = rng.randint(2, 7)
+            pos = rng.randint(0, 2)
+            lo, hi = (0, 15) if pos == 0 else (15, 30)
+            seqs.append(rng.randint(lo, hi, (n, 1)))
+            lbls.append([pos])
+        return {"words": make_seq(seqs, dtype=np.int32, bucket=8),
+                "label": np.array(lbls, np.int64)}
+
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=20)
+    assert losses[-1] < losses[0]

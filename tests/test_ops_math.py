@@ -1,0 +1,260 @@
+"""Op corpus tests, wave 1: math / elementwise / reductions / losses —
+mirror of the reference's test_*_op.py files (test_mul_op.py,
+test_elementwise_add_op.py, test_softmax_op.py, ...), built on the OpTest
+harness's output + numeric-gradient checks."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestCase
+
+R = np.random.RandomState(7)
+
+
+def _r(*shape):
+    return R.uniform(0.1, 1.0, shape).astype(np.float32)
+
+
+class TestMulOp:
+    def test_output_and_grad(self):
+        x, y = _r(4, 5), _r(5, 3)
+        t = OpTestCase("mul", {"X": x, "Y": y})
+        t.check_output({"Out": x @ y})
+        t.check_grad(["X", "Y"])
+
+    def test_flatten_dims(self):
+        x, y = _r(2, 3, 4), _r(4, 6)
+        t = OpTestCase("mul", {"X": x, "Y": y},
+                       {"x_num_col_dims": 2, "y_num_col_dims": 1})
+        t.check_output({"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 6)})
+        t.check_grad(["X", "Y"])
+
+
+class TestMatmulOp:
+    @pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                       (False, True), (True, True)])
+    def test_transposes(self, tx, ty):
+        a = _r(3, 4) if not tx else _r(4, 3)
+        b = _r(4, 5) if not ty else _r(5, 4)
+        t = OpTestCase("matmul", {"X": a, "Y": b},
+                       {"transpose_X": tx, "transpose_Y": ty})
+        ax = a.T if tx else a
+        bx = b.T if ty else b
+        t.check_output({"Out": ax @ bx})
+        t.check_grad(["X", "Y"])
+
+    def test_batched(self):
+        a, b = _r(2, 3, 4), _r(2, 4, 5)
+        t = OpTestCase("matmul", {"X": a, "Y": b})
+        t.check_output({"Out": a @ b})
+        t.check_grad(["X", "Y"])
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,fn", [
+        ("elementwise_add", np.add), ("elementwise_sub", np.subtract),
+        ("elementwise_mul", np.multiply), ("elementwise_div", np.divide),
+        ("elementwise_max", np.maximum), ("elementwise_min", np.minimum),
+    ])
+    def test_same_shape(self, op, fn):
+        x, y = _r(3, 4), _r(3, 4) + 0.5
+        t = OpTestCase(op, {"X": x, "Y": y})
+        t.check_output({"Out": fn(x, y)})
+        t.check_grad(["X", "Y"])
+
+    def test_broadcast_axis(self):
+        x, y = _r(2, 3, 4), _r(3)
+        t = OpTestCase("elementwise_add", {"X": x, "Y": y}, {"axis": 1})
+        t.check_output({"Out": x + y.reshape(1, 3, 1)})
+        t.check_grad(["X", "Y"])
+
+    def test_trailing_broadcast(self):
+        x, y = _r(2, 3, 4), _r(4)
+        t = OpTestCase("elementwise_mul", {"X": x, "Y": y})
+        t.check_output({"Out": x * y})
+        t.check_grad(["X", "Y"])
+
+
+class TestSumMeanScale:
+    def test_sum_variadic(self):
+        xs = [_r(3, 4) for _ in range(3)]
+        t = OpTestCase("sum", {"X": xs})
+        t.check_output({"Out": xs[0] + xs[1] + xs[2]})
+        t.check_grad(["X"])
+
+    def test_mean(self):
+        x = _r(5, 6)
+        t = OpTestCase("mean", {"X": x})
+        t.check_output({"Out": x.mean()})
+        t.check_grad(["X"])
+
+    def test_scale(self):
+        x = _r(4, 4)
+        t = OpTestCase("scale", {"X": x}, {"scale": 2.5, "bias": 0.3})
+        t.check_output({"Out": 2.5 * x + 0.3})
+        t.check_grad(["X"])
+
+
+class TestReduceOps:
+    @pytest.mark.parametrize("op,fn", [
+        ("reduce_sum", np.sum), ("reduce_mean", np.mean),
+        ("reduce_max", np.max),
+    ])
+    def test_dim(self, op, fn):
+        x = _r(3, 4, 5)
+        t = OpTestCase(op, {"X": x}, {"dim": [1]})
+        t.check_output({"Out": fn(x, axis=1)})
+        if op != "reduce_max":
+            t.check_grad(["X"])
+
+    def test_keepdim_all(self):
+        x = _r(3, 4)
+        t = OpTestCase("reduce_sum", {"X": x},
+                       {"reduce_all": True, "keep_dim": True})
+        t.check_output({"Out": x.sum(keepdims=True).reshape(1, 1)})
+        t.check_grad(["X"])
+
+
+class TestActivations:
+    @pytest.mark.parametrize("op,fn", [
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("exp", np.exp),
+        ("log", np.log),
+        ("sqrt", np.sqrt),
+        ("abs", np.abs),
+        ("square", np.square),
+        ("reciprocal", lambda x: 1 / x),
+        ("softplus", lambda x: np.log1p(np.exp(x))),
+        ("softsign", lambda x: x / (1 + np.abs(x))),
+    ])
+    def test_fwd_and_grad(self, op, fn):
+        x = _r(4, 5) + 0.5  # positive domain for log/sqrt
+        t = OpTestCase(op, {"X": x})
+        t.check_output({"Out": fn(x)})
+        t.check_grad(["X"])
+
+    def test_leaky_relu(self):
+        x = R.randn(4, 5).astype(np.float32)
+        t = OpTestCase("leaky_relu", {"X": x}, {"alpha": 0.1})
+        t.check_output({"Out": np.where(x > 0, x, 0.1 * x)})
+        t.check_grad(["X"])
+
+
+class TestSoftmaxAndLosses:
+    def test_softmax(self):
+        x = R.randn(5, 7).astype(np.float32)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        t = OpTestCase("softmax", {"X": x})
+        t.check_output({"Out": e / e.sum(-1, keepdims=True)})
+        t.check_grad(["X"])
+
+    def test_cross_entropy_hard(self):
+        probs = _r(6, 4)
+        probs /= probs.sum(-1, keepdims=True)
+        label = R.randint(0, 4, (6, 1)).astype(np.int64)
+        t = OpTestCase("cross_entropy", {"X": probs, "Label": label})
+        exp = -np.log(np.take_along_axis(probs, label.astype(int), 1))
+        t.check_output({"Out": exp})
+        t.check_grad(["X"], max_relative_error=1e-2)
+
+    def test_softmax_with_cross_entropy(self):
+        logits = R.randn(6, 5).astype(np.float32)
+        label = R.randint(0, 5, (6, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(np.take_along_axis(sm, label.astype(int), 1))
+        t = OpTestCase("softmax_with_cross_entropy",
+                       {"Logits": logits, "Label": label})
+        t.check_output({"Softmax": sm, "Loss": loss})
+        t.check_grad(["Logits"], output_slots=["Loss"])
+
+    def test_sigmoid_ce_logits(self):
+        x = R.randn(4, 3).astype(np.float32)
+        lbl = R.randint(0, 2, (4, 3)).astype(np.float32)
+        exp = np.maximum(x, 0) - x * lbl + np.log1p(np.exp(-np.abs(x)))
+        t = OpTestCase("sigmoid_cross_entropy_with_logits",
+                       {"X": x, "Label": lbl})
+        t.check_output({"Out": exp})
+        t.check_grad(["X"])
+
+    def test_square_error_cost(self):
+        x, y = _r(5, 3), _r(5, 3)
+        t = OpTestCase("square_error_cost", {"X": x, "Y": y})
+        t.check_output({"Out": (x - y) ** 2})
+        t.check_grad(["X", "Y"])
+
+    def test_huber_loss(self):
+        x, y = _r(6, 1), _r(6, 1) * 3
+        d = 1.0
+        r = y - x
+        exp = np.where(np.abs(r) <= d, 0.5 * r * r, d * (np.abs(r) - 0.5 * d))
+        t = OpTestCase("huber_loss", {"X": x, "Y": y}, {"delta": d})
+        t.check_output({"Out": exp})
+        t.check_grad(["X"], output_slots=["Out"])
+
+
+class TestTensorOps:
+    def test_concat(self):
+        xs = [_r(2, 3), _r(2, 4)]
+        t = OpTestCase("concat", {"X": xs}, {"axis": 1})
+        t.check_output({"Out": np.concatenate(xs, axis=1)})
+        t.check_grad(["X"])
+
+    def test_split(self):
+        x = _r(2, 6)
+        t = OpTestCase("split", {"X": x}, {"num": 3, "axis": 1},
+                       n_outputs={"Out": 3})
+        t.check_output({"Out": list(np.split(x, 3, axis=1))})
+        t.check_grad(["X"])
+
+    def test_transpose(self):
+        x = _r(2, 3, 4)
+        t = OpTestCase("transpose", {"X": x}, {"axis": [2, 0, 1]})
+        t.check_output({"Out": x.transpose(2, 0, 1)})
+        t.check_grad(["X"])
+
+    def test_reshape(self):
+        x = _r(2, 6)
+        t = OpTestCase("reshape", {"X": x}, {"shape": [3, 4]})
+        t.check_output({"Out": x.reshape(3, 4)})
+        t.check_grad(["X"])
+
+    def test_cast(self):
+        x = _r(3, 3)
+        t = OpTestCase("cast", {"X": x}, {"out_dtype": "int32"})
+        t.check_output({"Out": x.astype(np.int32)})
+
+    def test_lookup_table(self):
+        w = _r(10, 4)
+        ids = R.randint(0, 10, (5, 1)).astype(np.int64)
+        t = OpTestCase("lookup_table", {"W": w, "Ids": ids})
+        t.check_output({"Out": w[ids.squeeze(-1)]})
+        t.check_grad(["W"])
+
+    def test_top_k(self):
+        x = R.randn(4, 9).astype(np.float32)
+        t = OpTestCase("top_k", {"X": x}, {"k": 3})
+        idx = np.argsort(-x, axis=1)[:, :3]
+        vals = np.take_along_axis(x, idx, 1)
+        t.check_output({"Out": vals, "Indices": idx.astype(np.int32)})
+
+    def test_one_hot(self):
+        ids = R.randint(0, 6, (5, 1)).astype(np.int64)
+        t = OpTestCase("one_hot", {"X": ids}, {"depth": 6})
+        exp = np.eye(6, dtype=np.float32)[ids.squeeze(-1)]
+        t.check_output({"Out": exp})
+
+    def test_gather(self):
+        x = _r(8, 3)
+        idx = np.array([0, 3, 7], np.int64)
+        t = OpTestCase("gather", {"X": x, "Index": idx})
+        t.check_output({"Out": x[[0, 3, 7]]})
+        t.check_grad(["X"])
+
+    def test_clip(self):
+        x = R.randn(4, 4).astype(np.float32)
+        t = OpTestCase("clip", {"X": x}, {"min": -0.3, "max": 0.4})
+        t.check_output({"Out": np.clip(x, -0.3, 0.4)})
+        t.check_grad(["X"])

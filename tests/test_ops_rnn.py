@@ -1,0 +1,99 @@
+"""Numeric-gradient OpTest coverage for the recurrent ops (reference
+test_lstm_op.py / test_gru_op.py / test_gru_unit_op.py pattern)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTestCase
+from paddle_tpu.fluid import make_seq
+
+R = np.random.RandomState(13)
+
+
+def _seq(batch_lens, feat):
+    return make_seq([R.uniform(-0.5, 0.5, (n, feat)).astype(np.float32)
+                     for n in batch_lens])
+
+
+def _r(*shape):
+    return R.uniform(-0.5, 0.5, shape).astype(np.float32)
+
+
+class TestDynamicLSTM:
+    def _case(self, use_peepholes, is_reverse=False):
+        hid = 2
+        x = _seq([3, 1], 4 * hid)
+        w = _r(hid, 4 * hid)
+        b = _r(7 * hid if use_peepholes else 4 * hid)
+        return OpTestCase("dynamic_lstm",
+                          {"Input": x, "Weight": w, "Bias": b},
+                          {"use_peepholes": use_peepholes,
+                           "is_reverse": is_reverse})
+
+    @pytest.mark.parametrize("peep", [False, True])
+    def test_grad(self, peep):
+        t = self._case(peep)
+        t.check_grad(["Input", "Weight", "Bias"], output_slots=["Hidden"],
+                     max_relative_error=3e-2)
+
+    def test_reverse_grad(self):
+        t = self._case(False, is_reverse=True)
+        t.check_grad(["Input", "Weight"], output_slots=["Hidden"],
+                     max_relative_error=3e-2)
+
+    def test_forward_manual(self):
+        """One-step sequence against hand-computed gates (c~,i,f,o order)."""
+        hid = 2
+        x = make_seq([R.uniform(-0.5, 0.5, (1, 4 * hid)).astype(np.float32)])
+        w = _r(hid, 4 * hid)
+        b = np.zeros(4 * hid, np.float32)
+        t = OpTestCase("dynamic_lstm", {"Input": x, "Weight": w, "Bias": b},
+                       {"use_peepholes": False})
+        g = np.asarray(x.data)[0, 0]
+        gc, gi, gf, go = np.split(g, 4)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        c = sig(gi) * np.tanh(gc)          # h0=c0=0 → forget term drops
+        h = sig(go) * np.tanh(c)
+        exp_h = h[None, None, :]
+        t.check_output({"Hidden": make_seq([exp_h[0]]),
+                        "Cell": make_seq([c[None, :]])}, atol=1e-5)
+
+
+class TestDynamicGRU:
+    def test_grad(self):
+        hid = 3
+        x = _seq([3, 2], 3 * hid)
+        w = _r(hid, 3 * hid)
+        b = _r(3 * hid)
+        t = OpTestCase("dynamic_gru", {"Input": x, "Weight": w, "Bias": b})
+        t.check_grad(["Input", "Weight", "Bias"], max_relative_error=3e-2)
+
+    def test_update_gate_convention(self):
+        """u→1 must follow the CANDIDATE (reference gru_kernel.h:62)."""
+        hid = 1
+        xv = np.zeros((1, 1, 3 * hid), np.float32)
+        xv[0, 0, 0] = 100.0   # update gate saturates to 1
+        xv[0, 0, 2] = 5.0     # candidate ~ tanh(5) ~ 1
+        x = make_seq([xv[0]])
+        w = np.zeros((hid, 3 * hid), np.float32)
+        t = OpTestCase("dynamic_gru", {"Input": x, "Weight": w})
+        exp = np.tanh(5.0) * np.ones((1, 1, 1), np.float32)
+        t.check_output({"Hidden": make_seq([exp[0]])}, atol=1e-5)
+
+
+class TestUnits:
+    def test_lstm_unit_grad(self):
+        x, c = _r(4, 8), _r(4, 2)
+        t = OpTestCase("lstm_unit", {"X": x, "C_prev": c},
+                       {"forget_bias": 1.0})
+        t.check_grad(["X", "C_prev"], output_slots=["H"],
+                     max_relative_error=2e-2)
+
+    def test_gru_unit_grad(self):
+        hid = 3
+        x, h = _r(4, 3 * hid), _r(4, hid)
+        w, b = _r(hid, 3 * hid), _r(3 * hid)
+        t = OpTestCase("gru_unit",
+                       {"Input": x, "HiddenPrev": h, "Weight": w, "Bias": b})
+        t.check_grad(["Input", "HiddenPrev", "Weight"],
+                     output_slots=["Hidden"], max_relative_error=2e-2)
