@@ -1,0 +1,81 @@
+"""Benchmark driver — mirrors the reference's benchmark/paddle/image/run.sh
+ResNet-50 training-throughput measurement, on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's best published ResNet-50 training number,
+84.08 images/sec (Xeon 6148 + MKL-DNN, bs=256 — BASELINE.md; its K40m GPU
+numbers cover AlexNet/GoogLeNet only, so ResNet-50 CPU is the recorded
+reference point for this metric).
+
+Matmul/conv precision is set to bfloat16 (the MXU-native dtype) with fp32
+parameters/accumulation — the TPU analog of the reference's MKL-DNN
+lower-precision compute path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    image_px = int(os.environ.get("BENCH_PX", "224"))
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import image_classification
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        img = fluid.layers.data("img", [3, image_px, image_px], "float32")
+        label = fluid.layers.data("label", [1], "int64")
+        predict = image_classification.resnet_imagenet(img, class_num=1000,
+                                                       depth=50)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            avg_cost)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    rng = np.random.RandomState(0)
+    img_v = rng.rand(batch, 3, image_px, image_px).astype(np.float32)
+    lbl_v = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
+    feed = {"img": img_v, "label": lbl_v}
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # warmup: compile + 2 steady steps
+        for _ in range(3):
+            loss = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                           return_numpy=False)[0]
+        np.asarray(loss)
+        t0 = time.time()
+        for _ in range(steps):
+            loss = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                           return_numpy=False)[0]
+        final = float(np.asarray(loss))  # blocks on the last step
+        dt = time.time() - t0
+
+    assert np.isfinite(final), f"diverged: {final}"
+    ips = batch * steps / dt
+    baseline = 84.08  # BASELINE.md ResNet-50 train bs=256 MKL-DNN img/s
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

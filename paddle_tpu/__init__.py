@@ -10,5 +10,7 @@ Structure:
 """
 
 from . import fluid  # noqa: F401
+from . import parallel  # noqa: F401
+from . import utils  # noqa: F401
 
 __version__ = "0.1.0"

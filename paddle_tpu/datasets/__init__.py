@@ -1,0 +1,12 @@
+"""Dataset modules — API analog of python/paddle/v2/dataset/ (mnist, cifar,
+imdb, imikolov, uci_housing, movielens, conll05, wmt14...).
+
+The reference modules download+parse+cache public datasets
+(dataset/common.py).  This build runs zero-egress, so each module serves a
+deterministic SYNTHETIC dataset with the same sample schema, sizes scaled
+down, behind the same reader-creator API (`train()` / `test()` returning
+sample generators).  Drop-in local data: set PADDLE_TPU_DATA_HOME to a
+directory containing real files and modules will prefer them when present.
+"""
+
+from . import cifar, imdb, imikolov, mnist, uci_housing  # noqa: F401

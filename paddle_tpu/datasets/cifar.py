@@ -1,0 +1,38 @@
+"""CIFAR-10/100 — API analog of python/paddle/v2/dataset/cifar.py.
+Synthetic class-conditional color/texture patterns; samples are
+(image[3*32*32] float32 in [0,1], label int)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRAIN_N = 4096
+TEST_N = 512
+
+
+def _reader(n, n_classes, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, n_classes))
+            img = rng.rand(3, 32, 32).astype(np.float32) * 0.3
+            img[label % 3] += 0.5
+            img[:, (label * 3) % 28: (label * 3) % 28 + 4, :] += 0.3
+            yield np.clip(img, 0, 1).reshape(-1), label
+    return r
+
+
+def train10():
+    return _reader(TRAIN_N, 10, seed=3)
+
+
+def test10():
+    return _reader(TEST_N, 10, seed=4)
+
+
+def train100():
+    return _reader(TRAIN_N, 100, seed=5)
+
+
+def test100():
+    return _reader(TEST_N, 100, seed=6)

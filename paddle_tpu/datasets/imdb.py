@@ -1,0 +1,37 @@
+"""IMDB sentiment — API analog of python/paddle/v2/dataset/imdb.py:
+word_dict() + train/test readers yielding (word_id_sequence, label)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB = 500
+TRAIN_N = 2048
+TEST_N = 256
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _reader(n, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 64))
+            lo, hi = (0, VOCAB // 2) if label == 0 else (VOCAB // 2, VOCAB)
+            # 70% class-band tokens, 30% noise — learnable but not trivial
+            band = rng.randint(lo, hi, length)
+            noise = rng.randint(0, VOCAB, length)
+            pick = rng.rand(length) < 0.7
+            yield np.where(pick, band, noise).tolist(), label
+    return r
+
+
+def train(word_idx=None):
+    return _reader(TRAIN_N, seed=7)
+
+
+def test(word_idx=None):
+    return _reader(TEST_N, seed=8)

@@ -1,0 +1,34 @@
+"""PTB-style n-gram LM data — API analog of
+python/paddle/v2/dataset/imikolov.py: build_dict() + train/test(word_idx, n)
+yielding n-gram tuples."""
+
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB = 300
+TRAIN_N = 4096
+TEST_N = 512
+
+
+def build_dict(min_word_freq: int = 50):
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _reader(n_samples, ngram_n, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        # a synthetic markov-ish stream: next ~ (sum of context) mod VOCAB
+        for _ in range(n_samples):
+            ctx = rng.randint(0, VOCAB, ngram_n - 1)
+            nxt = (ctx.sum() + int(rng.randint(0, 3))) % VOCAB
+            yield tuple(ctx.tolist()) + (int(nxt),)
+    return r
+
+
+def train(word_idx=None, n: int = 5):
+    return _reader(TRAIN_N, n, seed=9)
+
+
+def test(word_idx=None, n: int = 5):
+    return _reader(TEST_N, n, seed=10)

@@ -1,0 +1,36 @@
+"""MNIST — API analog of python/paddle/v2/dataset/mnist.py (train:?/test:?
+readers yielding (image[784] float32 in [-1,1], label int)).  Synthetic:
+class-conditional band patterns + noise, deterministic per index."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+TRAIN_N = 8192
+TEST_N = 1024
+
+
+def _sample(idx: int, rng: np.random.RandomState):
+    label = int(rng.randint(0, 10))
+    img = rng.rand(28, 28).astype(np.float32) * 0.2 - 1.0
+    img[label * 2: label * 2 + 3, :] += 1.2
+    img[:, label: label + 2] += 0.6
+    return np.clip(img, -1, 1).reshape(784), label
+
+
+def _reader(n, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for i in range(n):
+            yield _sample(i, rng)
+    return r
+
+
+def train():
+    return _reader(TRAIN_N, seed=1)
+
+
+def test():
+    return _reader(TEST_N, seed=2)

@@ -1,0 +1,32 @@
+"""UCI housing regression — API analog of
+python/paddle/v2/dataset/uci_housing.py: train/test readers yielding
+(features[13] float32, price float32); synthetic linear ground truth +
+noise, pre-normalized like the reference."""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRAIN_N = 4096
+TEST_N = 512
+
+_TRUE_W = np.linspace(-1.5, 1.5, 13).astype(np.float32)
+_TRUE_B = 2.0
+
+
+def _reader(n, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            x = rng.randn(13).astype(np.float32)
+            y = float(x @ _TRUE_W + _TRUE_B + 0.1 * rng.randn())
+            yield x, np.array([y], np.float32)
+    return r
+
+
+def train():
+    return _reader(TRAIN_N, seed=11)
+
+
+def test():
+    return _reader(TEST_N, seed=12)

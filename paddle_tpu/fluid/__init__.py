@@ -7,8 +7,10 @@ dispatching per-op kernels), with save/load, initializers, regularizers,
 clipping, and profiler."""
 
 from . import ops as _ops  # registers all op emitters  # noqa: F401
-from . import (clip, initializer, io, layers, nets, optimizer, regularizer,
-               unique_name)
+from . import (clip, debugger, evaluator, initializer, io, layers,
+               learning_rate_decay, memory_optimization_transpiler, nets,
+               optimizer, profiler, regularizer, unique_name)
+from .memory_optimization_transpiler import memory_optimize
 from .backward import append_backward, calc_gradient
 from .core.lod import SeqArray, make_seq
 from .core.registry import registered_ops
@@ -23,7 +25,8 @@ from .param_attr import ParamAttr
 
 __all__ = [
     "layers", "optimizer", "initializer", "regularizer", "clip", "io",
-    "nets", "unique_name",
+    "nets", "unique_name", "evaluator", "profiler", "learning_rate_decay",
+    "memory_optimize", "debugger",
     "append_backward", "calc_gradient",
     "Executor", "Scope", "global_scope", "scope_guard",
     "TPUPlace", "CPUPlace",

@@ -29,12 +29,13 @@ class VarDesc:
     """Analog of framework.proto VarDesc (:119) / var_desc.h:56."""
 
     __slots__ = ("name", "type", "dtype", "shape", "lod_level", "persistable",
-                 "stop_gradient")
+                 "stop_gradient", "sharding")
 
     def __init__(self, name: str, type: str = VarType.DENSE_TENSOR,
                  dtype: str = "float32", shape: Optional[List[int]] = None,
                  lod_level: int = 0, persistable: bool = False,
-                 stop_gradient: bool = False):
+                 stop_gradient: bool = False,
+                 sharding: Optional[List[Optional[str]]] = None):
         self.name = name
         self.type = type
         self.dtype = canonical_dtype(dtype)
@@ -42,12 +43,17 @@ class VarDesc:
         self.lod_level = lod_level
         self.persistable = persistable
         self.stop_gradient = stop_gradient
+        # per-dim mesh-axis names (TPU extension: SPMD placement is part of
+        # the serialized program, the way pserver block assignment was part
+        # of the reference's transpiled program)
+        self.sharding = list(sharding) if sharding is not None else None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
             "name": self.name, "type": self.type, "dtype": self.dtype,
             "shape": self.shape, "lod_level": self.lod_level,
             "persistable": self.persistable, "stop_gradient": self.stop_gradient,
+            "sharding": self.sharding,
         }
 
     @classmethod

@@ -1,0 +1,42 @@
+"""Program inspection — analog of python/paddle/v2/fluid/debuger.py +
+graphviz.py: pretty-print programs and render them to dot."""
+
+from __future__ import annotations
+
+from .framework import Program
+
+__all__ = ["pprint_program_codes", "draw_block_graphviz"]
+
+
+def pprint_program_codes(program: Program) -> str:
+    """Readable pseudo-code of the program (debuger.py pprint_program_codes)."""
+    lines = []
+    for block in program.blocks:
+        lines.append(f"// block {block.idx} (parent {block.parent_idx})")
+        for name, v in sorted(block.vars.items()):
+            mark = "persist " if v.persistable else ""
+            lines.append(f"var {mark}{name} : {v.dtype}{list(v.shape or [])}"
+                         + (f" lod={v.lod_level}" if v.lod_level else ""))
+        for op in block.ops:
+            ins = ", ".join(f"{k}={v}" for k, v in op.desc.inputs.items())
+            outs = ", ".join(f"{k}={v}" for k, v in op.desc.outputs.items())
+            attrs = {k: v for k, v in op.desc.attrs.items()
+                     if not k.startswith("__")}
+            lines.append(f"  {outs} = {op.type}({ins}) {attrs}")
+    text = "\n".join(lines)
+    return text
+
+
+def draw_block_graphviz(block, path: str = "block.dot") -> str:
+    """Emit a graphviz dot file of one block (graphviz.py analog)."""
+    lines = ["digraph G {", "  rankdir=TB;"]
+    for i, op in enumerate(block.ops):
+        lines.append(f'  op{i} [shape=box, label="{op.type}"];')
+        for name in op.input_names:
+            lines.append(f'  "{name}" -> op{i};')
+        for name in op.output_names:
+            lines.append(f'  op{i} -> "{name}";')
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path

@@ -1,0 +1,125 @@
+"""Evaluators — analog of python/paddle/v2/fluid/evaluator.py: metric
+aggregation across minibatches expressed as persistable state vars updated
+by program ops (Accuracy) — so they ride inside the compiled step — plus
+reset/eval host hooks."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .executor import global_scope
+from .framework import Variable
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+
+__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator"]
+
+
+class Evaluator:
+    """Base: tracks persistable state vars; reset() zeroes them in the scope
+    (the reference re-runs fill ops; writing the scope directly is the same
+    contract without a program run)."""
+
+    def __init__(self, name: str, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states = []
+        self.metrics = []
+
+    def _create_state(self, suffix: str, dtype: str, shape):
+        var = self.helper.create_global_variable(
+            shape=shape, dtype=dtype, persistable=True,
+            name=f"{self.helper.name}.{suffix}")
+        self.helper.set_variable_initializer(var, ConstantInitializer(0.0))
+        self.states.append(var)
+        return var
+
+    def reset(self, executor=None, reset_program=None, scope=None):
+        scope = scope or global_scope()
+        for s in self.states:
+            cur = scope.find_var(s.name)
+            if cur is not None:
+                scope.set_var(s.name, np.zeros_like(np.asarray(cur)))
+
+    def eval(self, executor=None, eval_program=None, scope=None):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    """Streaming accuracy over batches (reference evaluator.py Accuracy)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy", **kwargs)
+        self.total = self._create_state("total", "float32", [1])
+        self.correct = self._create_state("correct", "float32", [1])
+        correct = self.helper.create_tmp_variable("int32",
+                                                  stop_gradient=True)
+        total = self.helper.create_tmp_variable("int32", stop_gradient=True)
+        acc = layers.accuracy(input=input, label=label, k=k,
+                              correct=correct, total=total)
+        # accumulate into the persistable state inside the step
+        self.helper.append_op(
+            "elementwise_add",
+            {"X": self.total, "Y": _as_float(self.helper, total)},
+            {"Out": self.total})
+        self.helper.append_op(
+            "elementwise_add",
+            {"X": self.correct, "Y": _as_float(self.helper, correct)},
+            {"Out": self.correct})
+        self.metrics.append(acc)
+
+    def eval(self, executor=None, eval_program=None, scope=None):
+        scope = scope or global_scope()
+        total = float(np.asarray(scope.find_var(self.total.name)).sum())
+        correct = float(np.asarray(scope.find_var(self.correct.name)).sum())
+        return np.array(correct / max(total, 1.0), np.float32)
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (reference evaluator.py ChunkEvaluator, backed by
+    chunk_eval_op.cc).  Consumes the chunk_eval op's per-batch counts."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, **kwargs):
+        super().__init__("chunk", **kwargs)
+        self.num_infer = self._create_state("num_infer", "float32", [1])
+        self.num_label = self._create_state("num_label", "float32", [1])
+        self.num_correct = self._create_state("num_correct", "float32", [1])
+        precision = self.helper.create_tmp_variable("float32",
+                                                    stop_gradient=True)
+        recall = self.helper.create_tmp_variable("float32",
+                                                 stop_gradient=True)
+        f1 = self.helper.create_tmp_variable("float32", stop_gradient=True)
+        ni = self.helper.create_tmp_variable("float32", stop_gradient=True)
+        nl = self.helper.create_tmp_variable("float32", stop_gradient=True)
+        nc = self.helper.create_tmp_variable("float32", stop_gradient=True)
+        self.helper.append_op(
+            "chunk_eval", {"Inference": input, "Label": label},
+            {"Precision": precision, "Recall": recall, "F1-Score": f1,
+             "NumInferChunks": ni, "NumLabelChunks": nl,
+             "NumCorrectChunks": nc},
+            {"chunk_scheme": chunk_scheme,
+             "num_chunk_types": num_chunk_types,
+             "excluded_chunk_types": excluded_chunk_types or []})
+        for state, cur in [(self.num_infer, ni), (self.num_label, nl),
+                           (self.num_correct, nc)]:
+            self.helper.append_op("elementwise_add",
+                                  {"X": state, "Y": cur}, {"Out": state})
+        self.metrics += [precision, recall, f1]
+
+    def eval(self, executor=None, eval_program=None, scope=None):
+        scope = scope or global_scope()
+        ni = float(np.asarray(scope.find_var(self.num_infer.name)).sum())
+        nl = float(np.asarray(scope.find_var(self.num_label.name)).sum())
+        nc = float(np.asarray(scope.find_var(self.num_correct.name)).sum())
+        p = nc / max(ni, 1e-6)
+        r = nc / max(nl, 1e-6)
+        f1 = 2 * p * r / max(p + r, 1e-6)
+        return np.array([p, r, f1], np.float32)
+
+
+def _as_float(helper, int_var):
+    out = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op("cast", {"X": int_var}, {"Out": out},
+                     {"out_dtype": "float32"})
+    return out

@@ -248,19 +248,75 @@ class Executor:
                     f"program? (reference executor raises the same way)")
             state_vals[n] = v
 
-        key = (id(program), program.version, mode,
+        from ..parallel import mesh as _pmesh
+
+        mesh = _pmesh.current_mesh()
+        key = (id(program), program.version, mode, id(mesh),
                tuple((n, _sig_of(v)) for n, v in sorted(feed.items())),
                tuple(fetch_names),
                tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))
-        compiled = self._cache.get(key)
+        from ..utils.flags import FLAGS
+
+        compiled, state_sh = self._cache.get(key, (None, None))
         if compiled is None:
+            if FLAGS["log_recompiles"] and self._cache:
+                import sys
+
+                print(f"[paddle_tpu] compiling new step signature "
+                      f"(cache size {len(self._cache)})", file=sys.stderr)
             step = build_step_fn(desc, 0, list(feed), state_in, state_out,
                                  fetch_names, mode)
-            compiled = jax.jit(step, donate_argnums=(1,))
-            self._cache[key] = compiled
+            if mesh is not None:
+                # SPMD: feeds batch-sharded over 'dp', persistables per
+                # their desc annotations; the partitioner emits the grad
+                # all-reduce the reference needed pserver/NCCL for.
+                feed_sh = {n: _pmesh.feed_sharding(mesh, v)
+                           for n, v in feed.items()}
+                state_sh = {
+                    n: _pmesh.state_sharding(
+                        mesh, v,
+                        block.vars[n].sharding if n in block.vars else None)
+                    for n, v in state_vals.items()}
+                from jax.sharding import NamedSharding, PartitionSpec
 
-        fetches, new_state = compiled(feed, state_vals,
-                                      scope.next_rng_bits(program.random_seed))
+                rng_sh = NamedSharding(mesh, PartitionSpec())
+                compiled = jax.jit(step, donate_argnums=(1,),
+                                   in_shardings=(feed_sh, state_sh, rng_sh))
+            else:
+                compiled = jax.jit(step, donate_argnums=(1,))
+            self._cache[key] = (compiled, state_sh if mesh is not None
+                                else None)
+
+        if state_sh is not None:
+            # re-lay out state whose current placement disagrees with its
+            # annotation (e.g. arrays produced by a mesh-less startup run or
+            # loaded from a checkpoint) — an explicit device_put, the analog
+            # of the reference's DataTransform between kernels
+            for n, target in state_sh.items():
+                v = state_vals[n]
+                cur = getattr(v, "sharding", None)
+                if cur is not None and not isinstance(v, SeqArray) \
+                        and cur != target:
+                    state_vals[n] = jax.device_put(v, target)
+
+        from .profiler import record_event
+
+        with record_event(f"executor_step/{mode}"):
+            fetches, new_state = compiled(
+                feed, state_vals, scope.next_rng_bits(program.random_seed))
+            if FLAGS["benchmark"]:
+                jax.block_until_ready(fetches)
+        if FLAGS["check_nan_inf"]:
+            # post-step scan of every produced value — the analog of
+            # CheckTensorNANOrInf per op output (executor.cc:64,129)
+            for name, v in list(new_state.items()) + list(
+                    zip(fetch_names, fetches)):
+                arr = np.asarray(v.data if isinstance(v, SeqArray) else v)
+                if np.issubdtype(arr.dtype, np.floating) and \
+                        not np.isfinite(arr).all():
+                    raise FloatingPointError(
+                        f"Tensor {name!r} contains NaN/Inf "
+                        f"(FLAGS check_nan_inf)")
         for n, v in new_state.items():
             scope.set_var(n, v)
         for op in post_host:

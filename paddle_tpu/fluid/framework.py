@@ -149,6 +149,8 @@ class Parameter(Variable):
         self.regularizer = regularizer
         self.gradient_clip_attr = gradient_clip_attr
         self.sharding = tuple(sharding) if sharding is not None else None
+        if sharding is not None:
+            self.desc.sharding = list(sharding)
 
     def __repr__(self):
         return f"Parameter(name={self.name}, shape={self.shape}, dtype={self.dtype})"

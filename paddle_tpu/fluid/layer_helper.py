@@ -82,10 +82,16 @@ class LayerHelper:
             # named parameter sharing (the reference's shared_w pattern in
             # book/test_word2vec.py): reuse, don't re-create/re-init
             existing = main_block.vars[name]
-            if list(existing.shape) != list(shape):
+            if not isinstance(existing, Parameter):
                 raise ValueError(
-                    f"shared parameter {name!r} shape mismatch: "
-                    f"{existing.shape} vs {shape}")
+                    f"variable {name!r} already exists and is not a "
+                    f"Parameter; cannot share it via ParamAttr(name=...)")
+            if list(existing.shape) != list(shape) or \
+                    existing.dtype != str(dtype):
+                raise ValueError(
+                    f"shared parameter {name!r} mismatch: existing "
+                    f"{existing.dtype}{list(existing.shape)} vs requested "
+                    f"{dtype}{list(shape)}")
             return existing
         param = main_block.create_parameter(
             name=name, shape=list(shape), dtype=dtype,

@@ -60,10 +60,11 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         pre_bias = helper.create_tmp_variable(dtype)
         helper.append_op("sum", {"X": mul_results}, {"Out": pre_bias})
     lod = pre_bias.lod_level
-    # bias is always [size]; for sequence inputs the runtime data is
-    # [b, t, size], so the broadcast axis shifts by the time dim
+    # bias is always [size], broadcast on the last (feature) axis: that is
+    # num_flatten_dims for dense inputs (reference fc dim_start), +1 for
+    # the implicit time axis of padded sequence inputs
     pre_act = helper.append_bias_op(pre_bias,
-                                    dim_start=1 + (1 if lod else 0),
+                                    dim_start=num_flatten_dims + (1 if lod else 0),
                                     bias_shape=[size])
     return helper.append_activation(pre_act)
 

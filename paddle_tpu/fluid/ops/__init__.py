@@ -7,6 +7,7 @@ USE_OP machinery (op_registry.h) becomes Python imports.
 from . import (  # noqa: F401
     activation_ops,
     io_ops,
+    crf_ops,
     loss_ops,
     math_ops,
     nn_ops,

@@ -1,0 +1,189 @@
+"""Linear-chain CRF + chunk evaluation.
+
+Replaces the reference's linear_chain_crf_op.cc (forward algorithm +
+hand-written backward), crf_decoding_op.cc (Viterbi), and chunk_eval_op.cc
+(IOB chunk counting).  TPU-first differences:
+
+* the forward algorithm is a lax.scan of log-sum-exp steps over the padded
+  time axis with carry masking — one fused kernel per batch instead of the
+  reference's per-sequence CPU loop (the reference has NO GPU kernel for
+  CRF; this runs on TPU);
+* the backward pass is DERIVED (vjp through the scan) — the reference
+  hand-writes the beta recursion (linear_chain_crf_op.h); jax's adjoint of
+  the scan computes exactly the same marginals;
+* Viterbi decoding is a scan of max/argmax steps + a backtrace scan.
+
+Transition layout matches the reference (linear_chain_crf_op.cc): row 0 =
+start scores, row 1 = stop scores, rows 2.. = transition matrix [tags,tags].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import SeqArray, seq_mask
+from ..core.registry import primitive
+
+
+def _split_transition(transition):
+    return transition[0], transition[1], transition[2:]
+
+
+@primitive("linear_chain_crf", inputs=["Emission", "Transition", "Label"],
+           outputs=["LogLikelihood"], stop_grad_slots=("Label",))
+def linear_chain_crf(ctx, emission, transition, label):
+    """Negative log-likelihood per sequence (matches the reference's output
+    semantics: maximizing likelihood == minimizing this op's output summed)."""
+    assert isinstance(emission, SeqArray)
+    e = emission.data.astype(jnp.float32)          # [b, t, k]
+    b, t, k = e.shape
+    lbl = label.data if isinstance(label, SeqArray) else label
+    lbl = lbl.reshape(b, t).astype(jnp.int32)
+    mask = seq_mask(emission.lengths, t).astype(jnp.float32)  # [b, t]
+    start, stop, trans = _split_transition(transition.astype(jnp.float32))
+
+    # --- partition function: forward algorithm over time ---
+    def fwd_step(alpha, inputs):
+        e_t, m_t = inputs                          # [b, k], [b]
+        scores = alpha[:, :, None] + trans[None]   # [b, k_prev, k]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + e_t
+        alpha = jnp.where(m_t[:, None] > 0, new, alpha)
+        return alpha, None
+
+    alpha0 = start[None] + e[:, 0]
+    alpha, _ = jax.lax.scan(
+        fwd_step, alpha0,
+        (jnp.swapaxes(e, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:]))
+    log_z = jax.scipy.special.logsumexp(alpha + stop[None], axis=1)  # [b]
+
+    # --- gold path score ---
+    first_e = jnp.take_along_axis(e[:, 0], lbl[:, :1], axis=1)[:, 0]
+    path = start[lbl[:, 0]] + first_e
+    prev, cur = lbl[:, :-1], lbl[:, 1:]
+    trans_scores = trans[prev, cur]                          # [b, t-1]
+    emis_scores = jnp.take_along_axis(e, lbl[..., None], axis=2)[..., 0]
+    path = path + (trans_scores * mask[:, 1:]).sum(axis=1)
+    path = path + (emis_scores[:, 1:] * mask[:, 1:]).sum(axis=1)
+    last_idx = jnp.maximum(emission.lengths.astype(jnp.int32) - 1, 0)
+    last_tag = jnp.take_along_axis(lbl, last_idx[:, None], axis=1)[:, 0]
+    path = path + stop[last_tag]
+
+    return (log_z - path)[:, None]                           # [b, 1] NLL
+
+
+@primitive("crf_decoding", inputs=["Emission", "Transition", "Label?"],
+           outputs=["ViterbiPath"], no_grad=True)
+def crf_decoding(ctx, emission, transition, label):
+    """Viterbi decode (reference crf_decoding_op.cc).  With Label given,
+    outputs per-step correctness mask instead (reference behavior)."""
+    assert isinstance(emission, SeqArray)
+    e = emission.data.astype(jnp.float32)
+    b, t, k = e.shape
+    mask = seq_mask(emission.lengths, t)
+    start, stop, trans = _split_transition(transition.astype(jnp.float32))
+
+    def vit_step(carry, inputs):
+        alpha = carry
+        e_t, m_t = inputs
+        scores = alpha[:, :, None] + trans[None]     # [b, kp, k]
+        best_prev = jnp.argmax(scores, axis=1)       # [b, k]
+        new = scores.max(axis=1) + e_t
+        alpha = jnp.where(m_t[:, None], new, alpha)
+        return alpha, best_prev
+
+    alpha0 = start[None] + e[:, 0]
+    alpha, back = jax.lax.scan(
+        vit_step, alpha0,
+        (jnp.swapaxes(e, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:]))
+    # back: [t-1, b, k] best predecessor at each step
+    last = jnp.argmax(alpha + stop[None], axis=1)    # [b]
+
+    # backtrace from each sequence's true last position
+    steps = jnp.arange(t - 2, -1, -1)
+
+    def bt_step(tag, i):
+        bp = back[i]                                  # [b, k]
+        prev_tag = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only move while i+1 < length (position i+1 was valid)
+        valid = (i + 1) < emission.lengths.astype(jnp.int32)
+        tag = jnp.where(valid, prev_tag, tag)
+        return tag, tag
+
+    _, rev_path = jax.lax.scan(bt_step, last, steps)
+    path = jnp.concatenate(
+        [rev_path[::-1], last[None]], axis=0).swapaxes(0, 1)  # [b, t]
+    path = path * mask.astype(path.dtype)
+    if label is not None:
+        lbl = (label.data if isinstance(label, SeqArray) else label)
+        lbl = lbl.reshape(b, t).astype(path.dtype)
+        correct = (path == lbl) & mask
+        return SeqArray(correct.astype(jnp.int32)[..., None],
+                        emission.lengths)
+    return SeqArray(path.astype(jnp.int32)[..., None], emission.lengths)
+
+
+def _iob_chunks(tags, length, max_len):
+    """Chunk set for IOB tagging: tag = 2*type for B, 2*type+1 for I
+    (reference chunk_eval_op.h tag scheme).  Returns [t, 3] array of
+    (start, end, type) with -1 padding rows, computed with masks."""
+    pos = jnp.arange(max_len)
+    valid = pos < length
+    is_b = (tags % 2 == 0) & valid
+    typ = tags // 2
+    prev_typ = jnp.concatenate([jnp.full((1,), -1, typ.dtype), typ[:-1]])
+    prev_valid = jnp.concatenate([jnp.zeros((1,), bool), valid[:-1]])
+    is_i = (tags % 2 == 1) & valid
+    # a chunk starts at B, or at I whose predecessor is a different type/absent
+    starts = is_b | (is_i & (~prev_valid | (prev_typ != typ)))
+    # chunk id per position = cumsum of starts
+    chunk_id = jnp.cumsum(starts.astype(jnp.int32)) * valid - 1
+    return typ, chunk_id, starts, valid
+
+
+@primitive("chunk_eval", inputs=["Inference", "Label"],
+           outputs=["Precision", "Recall", "F1-Score", "NumInferChunks",
+                    "NumLabelChunks", "NumCorrectChunks"], no_grad=True)
+def chunk_eval(ctx, inference, label):
+    """IOB chunk precision/recall/F1 — reference chunk_eval_op.cc.  A chunk
+    is correct iff its (start, end, type) triple matches exactly; computed
+    densely: positions agree on (chunk boundary structure AND type) for the
+    whole chunk."""
+    assert isinstance(inference, SeqArray) and isinstance(label, SeqArray)
+    inf = inference.data.reshape(inference.data.shape[0], -1).astype(jnp.int32)
+    lbl = label.data.reshape(label.data.shape[0], -1).astype(jnp.int32)
+    t = inf.shape[1]
+
+    def per_seq(inf_row, lbl_row, length):
+        ityp, icid, istarts, valid = _iob_chunks(inf_row, length, t)
+        ltyp, lcid, lstarts, _ = _iob_chunks(lbl_row, length, t)
+        n_inf = istarts.sum()
+        n_lbl = lstarts.sum()
+        # positions where both assign same chunk structure AND type:
+        agree = (istarts == lstarts) & (ityp == ltyp) & \
+                ((icid >= 0) == (lcid >= 0))
+        # a label chunk is matched iff every position of it agrees and the
+        # inference chunk has identical extent: check agreement at all
+        # positions of the chunk via segment min
+        ok = jnp.where(valid, agree, True)
+        # chunk k correct = AND over its positions; use min over segment
+        seg_ok = jnp.ones((t,), bool)
+        correct = 0
+        # segment-and via scatter-min on label chunk ids
+        cid = jnp.clip(lcid, 0, t - 1)
+        seg = jnp.ones((t,), jnp.int32).at[cid].min(
+            jnp.where(valid, ok.astype(jnp.int32), 1))
+        n_chunks = lstarts.sum()
+        chunk_ids = jnp.arange(t)
+        correct = jnp.where(chunk_ids < n_chunks, seg, 0).sum()
+        return n_inf, n_lbl, correct
+
+    n_inf, n_lbl, n_cor = jax.vmap(per_seq)(
+        inf, lbl, inference.lengths.astype(jnp.int32))
+    ni = n_inf.sum().astype(jnp.float32)
+    nl = n_lbl.sum().astype(jnp.float32)
+    nc = n_cor.sum().astype(jnp.float32)
+    p = nc / jnp.maximum(ni, 1e-6)
+    r = nc / jnp.maximum(nl, 1e-6)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-6)
+    return p, r, f1, ni[None], nl[None], nc[None]

@@ -117,9 +117,13 @@ def dynamic_gru(ctx, x, w, b, h0):
     def step(carry, xt):
         (h,) = carry
         x_ur, x_c = xt[..., : 2 * size], xt[..., 2 * size:]
-        ur = gate_act(x_ur + jnp.matmul(h, w_ur) + bias[: 2 * size])
+        ur = gate_act(x_ur + jnp.matmul(
+            h, w_ur, preferred_element_type=jnp.float32).astype(h.dtype)
+            + bias[: 2 * size])
         u, r = jnp.split(ur, 2, axis=-1)
-        c = cand_act(x_c + jnp.matmul(r * h, w_c) + bias[2 * size:])
+        c = cand_act(x_c + jnp.matmul(
+            r * h, w_c, preferred_element_type=jnp.float32).astype(h.dtype)
+            + bias[2 * size:])
         # reference gru_kernel.h:62: out = prev - u*prev + u*candidate
         h_new = (1 - u) * h + u * c
         return (h_new,), h_new
@@ -131,12 +135,13 @@ def dynamic_gru(ctx, x, w, b, h0):
 @primitive("lstm_unit", inputs=["X", "C_prev"], outputs=["C", "H"])
 def lstm_unit(ctx, x, c_prev):
     """Single LSTM step (reference lstm_unit_op.cc) — building block for
-    StaticRNN-composed nets; x = [b, 4*size] pre-projected gates."""
+    StaticRNN-composed nets; x = [b, 4*size] pre-projected gates packed
+    [i, f, o, g] (reference lstm_unit_op.h:63-66 slot order)."""
     forget_bias = ctx.attr("forget_bias", 0.0)
-    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    gi, gf, go, gg = jnp.split(x, 4, axis=-1)
     i = jax.nn.sigmoid(gi)
     f = jax.nn.sigmoid(gf + forget_bias)
-    c = f * c_prev + i * jnp.tanh(gc)
+    c = f * c_prev + i * jnp.tanh(gg)
     h = jax.nn.sigmoid(go) * jnp.tanh(c)
     return c, h
 
@@ -152,10 +157,14 @@ def gru_unit(ctx, x, h_prev, w, b):
     w_ur = w[:, : 2 * size]
     w_c = w[:, 2 * size:]
     x_ur, x_c = x[..., : 2 * size], x[..., 2 * size:]
-    ur = gate_act(x_ur + jnp.matmul(h_prev, w_ur) + bias[: 2 * size])
+    ur = gate_act(x_ur + jnp.matmul(
+        h_prev, w_ur, preferred_element_type=jnp.float32).astype(x.dtype)
+        + bias[: 2 * size])
     u, r = jnp.split(ur, 2, axis=-1)
     rh = r * h_prev
-    c = cand_act(x_c + jnp.matmul(rh, w_c) + bias[2 * size:])
+    c = cand_act(x_c + jnp.matmul(
+        rh, w_c, preferred_element_type=jnp.float32).astype(x.dtype)
+        + bias[2 * size:])
     h = (1 - u) * h_prev + u * c   # gru_kernel.h:62 convention
     gate = jnp.concatenate([u, r, c], axis=-1)
     return gate, rh, h

@@ -1,0 +1,100 @@
+"""Profiler — analog of python/paddle/v2/fluid/profiler.py (profiler
+context manager :76, cuda_profiler :33) over platform/profiler.h's
+RecordEvent machinery.
+
+Re-architected for XLA: per-op RecordEvent timing is meaningless when ops
+fuse into one executable, so the op-level table is produced by costed
+HLO analysis + whole-step wall times, and deep profiling delegates to JAX's
+trace profiler (jax.profiler.start_trace -> xprof/perfetto, the TPU
+equivalent of nvprof)."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["profiler", "cuda_profiler", "tpu_trace", "reset_profiler",
+           "record_event", "get_profile_table"]
+
+_events: Dict[str, List[float]] = defaultdict(list)
+_enabled = False
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RAII timing block — analog of platform::RecordEvent (profiler.h:25).
+    The executor wraps each compiled-step invocation in one of these."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _events[name].append(time.perf_counter() - t0)
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def get_profile_table(sorted_key: Optional[str] = "total"):
+    """Event table like the reference's ParseEvents output
+    (platform/profiler.cc): name, calls, total, min, max, ave."""
+    rows = []
+    for name, times in _events.items():
+        rows.append({
+            "name": name, "calls": len(times),
+            "total": sum(times), "min": min(times), "max": max(times),
+            "ave": sum(times) / len(times),
+        })
+    if sorted_key:
+        rows.sort(key=lambda r: -r.get(sorted_key, 0))
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             print_table: bool = True):
+    """Mirror of fluid.profiler.profiler(state, sorted_key): enables event
+    collection for the block and prints the table at exit."""
+    global _enabled
+    old, _enabled = _enabled, True
+    reset_profiler()
+    try:
+        yield
+    finally:
+        _enabled = old
+        if print_table:
+            rows = get_profile_table(sorted_key)
+            if rows:
+                w = max(len(r["name"]) for r in rows)
+                print(f"{'Event':<{w}}  Calls  Total(s)   Min(s)    Max(s)"
+                      f"    Ave(s)")
+                for r in rows:
+                    print(f"{r['name']:<{w}}  {r['calls']:>5}  "
+                          f"{r['total']:8.4f}  {r['min']:8.4f}  "
+                          f"{r['max']:8.4f}  {r['ave']:8.4f}")
+
+
+@contextlib.contextmanager
+def tpu_trace(log_dir: str = "/tmp/paddle_tpu_trace"):
+    """Deep device profile via the JAX trace profiler (xprof) — the TPU
+    analog of the reference's cuda_profiler/nvprof path."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Reference-API alias (fluid/profiler.py:33); routes to tpu_trace."""
+    with tpu_trace() as d:
+        yield d

@@ -7,6 +7,20 @@ from __future__ import annotations
 from ..fluid import layers, nets
 
 
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+    """conv (no bias) + batch_norm — shared by both ResNet builders."""
+    tmp = layers.conv2d(input=input, filter_size=filter_size,
+                        num_filters=ch_out, stride=stride,
+                        padding=padding, act=None, bias_attr=False)
+    return layers.batch_norm(input=tmp, act=act)
+
+
+def shortcut(input, ch_in, ch_out, stride):
+    if ch_in != ch_out:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+    return input
+
+
 def vgg16_bn_drop(input, class_num: int = 10):
     def conv_block(ipt, num_filter, groups, dropouts):
         return nets.img_conv_group(
@@ -33,18 +47,6 @@ def resnet_cifar10(input, depth: int = 32, class_num: int = 10):
     """The chapter's pre-activation-free CIFAR ResNet: conv_bn_layer +
     shortcut + basicblock stacks (reference book ch.03 resnet_cifar10)."""
     assert (depth - 2) % 6 == 0
-
-    def conv_bn_layer(input, ch_out, filter_size, stride, padding,
-                      act="relu"):
-        tmp = layers.conv2d(input=input, filter_size=filter_size,
-                            num_filters=ch_out, stride=stride,
-                            padding=padding, act=None, bias_attr=False)
-        return layers.batch_norm(input=tmp, act=act)
-
-    def shortcut(input, ch_in, ch_out, stride):
-        if ch_in != ch_out:
-            return conv_bn_layer(input, ch_out, 1, stride, 0, None)
-        return input
 
     def basicblock(input, ch_in, ch_out, stride):
         tmp = conv_bn_layer(input, ch_out, 3, stride, 1)
@@ -73,18 +75,6 @@ def resnet_imagenet(input, class_num: int = 1000, depth: int = 50):
     """ResNet-50 bottleneck variant (benchmark/paddle/image/resnet.py) —
     the BASELINE.md perf target network."""
     cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}[depth]
-
-    def conv_bn_layer(input, ch_out, filter_size, stride, padding,
-                      act="relu"):
-        tmp = layers.conv2d(input=input, filter_size=filter_size,
-                            num_filters=ch_out, stride=stride,
-                            padding=padding, act=None, bias_attr=False)
-        return layers.batch_norm(input=tmp, act=act)
-
-    def shortcut(input, ch_in, ch_out, stride):
-        if ch_in != ch_out:
-            return conv_bn_layer(input, ch_out, 1, stride, 0, None)
-        return input
 
     def bottleneck(input, ch_in, ch_out, stride):
         tmp = conv_bn_layer(input, ch_out, 1, stride, 0)

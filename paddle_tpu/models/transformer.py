@@ -1,0 +1,231 @@
+"""Transformer (encoder-decoder NMT) — the machine_translation capability of
+the reference book (ch.08, fluid/tests/book/test_machine_translation.py) in
+its modern form, and the BASELINE.md "Transformer-base WMT en-de" perf target.
+
+Built entirely from program ops (fc/matmul/softmax/layer_norm/dropout), so
+the whole model — attention included — compiles into the one XLA step the
+executor emits.  Tensor parallelism: pass mp_shard=True to annotate the QKV/
+FFN weights over the 'mp' mesh axis (Megatron-style column→row split), and
+run under parallel.mesh_guard; the SPMD partitioner inserts the all-reduces.
+
+Sequence layout is dense [batch, seq_len] with additive attention-bias
+inputs (0 for valid, -1e9 for pad/future), exactly like the reference's
+later transformer benchmark scripts — this keeps XLA shapes static.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid import ParamAttr, layers
+
+__all__ = ["transformer", "encoder", "wrap_encoder", "make_attn_bias",
+           "position_encoding_init"]
+
+
+def _col_attr(mp_shard):
+    return ParamAttr(sharding=(None, "mp")) if mp_shard else None
+
+
+def _row_attr(mp_shard):
+    return ParamAttr(sharding=("mp", None)) if mp_shard else None
+
+
+def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
+                         d_model, n_head=1, dropout_rate=0.0,
+                         mp_shard=False):
+    """Reference-shape MHA: project, split heads, scaled dot-product with
+    additive bias, merge heads, output projection."""
+    q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
+                  num_flatten_dims=2, param_attr=_col_attr(mp_shard))
+    k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
+                  num_flatten_dims=2, param_attr=_col_attr(mp_shard))
+    v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
+                  num_flatten_dims=2, param_attr=_col_attr(mp_shard))
+
+    def split_heads(x, d_head):
+        b, l = x.shape[0], x.shape[1]
+        reshaped = layers.reshape(x, [-1 if b == -1 else b, l, n_head,
+                                      d_head])
+        return layers.transpose(reshaped, [0, 2, 1, 3])
+
+    q = split_heads(q, d_key)           # [b, h, lq, dk]
+    k = split_heads(k, d_key)
+    v = split_heads(v, d_value)
+
+    q = layers.scale(q, scale=float(d_key) ** -0.5)
+    product = layers.matmul(q, k, transpose_y=True)   # [b, h, lq, lk]
+    if attn_bias is not None:
+        product = layers.elementwise_add(product, attn_bias)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)                   # [b, h, lq, dv]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    b, l = ctx.shape[0], ctx.shape[1]
+    ctx = layers.reshape(ctx, [-1 if b == -1 else b, l, n_head * d_value])
+    return layers.fc(input=ctx, size=d_model, bias_attr=False,
+                     num_flatten_dims=2, param_attr=_row_attr(mp_shard))
+
+
+def positionwise_feed_forward(x, d_inner_hid, d_hid, mp_shard=False):
+    hidden = layers.fc(input=x, size=d_inner_hid, num_flatten_dims=2,
+                       act="relu", param_attr=_col_attr(mp_shard))
+    return layers.fc(input=hidden, size=d_hid, num_flatten_dims=2,
+                     param_attr=_row_attr(mp_shard))
+
+
+def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
+    """reference transformer's a/n/d processing chain."""
+    for cmd in process_cmd:
+        if cmd == "a":
+            out = layers.elementwise_add(out, prev_out) if prev_out is not None else out
+        elif cmd == "n":
+            out = layers.layer_norm(out, begin_norm_axis=len(out.shape) - 1)
+        elif cmd == "d" and dropout_rate:
+            out = layers.dropout(out, dropout_prob=dropout_rate)
+    return out
+
+
+def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
+                  d_inner_hid, dropout_rate=0.0, mp_shard=False):
+    attn_output = multi_head_attention(
+        enc_input, enc_input, enc_input, attn_bias, d_key, d_value, d_model,
+        n_head, dropout_rate, mp_shard)
+    attn_output = pre_post_process_layer(enc_input, attn_output, "dan",
+                                         dropout_rate)
+    ffd_output = positionwise_feed_forward(attn_output, d_inner_hid, d_model,
+                                           mp_shard)
+    return pre_post_process_layer(attn_output, ffd_output, "dan",
+                                  dropout_rate)
+
+
+def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
+            d_inner_hid, dropout_rate=0.0, mp_shard=False):
+    for _ in range(n_layer):
+        enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
+                                  d_value, d_model, d_inner_hid,
+                                  dropout_rate, mp_shard)
+    return enc_input
+
+
+def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
+                  n_head, d_key, d_value, d_model, d_inner_hid,
+                  dropout_rate=0.0, mp_shard=False):
+    slf_attn = multi_head_attention(dec_input, dec_input, dec_input,
+                                    slf_attn_bias, d_key, d_value, d_model,
+                                    n_head, dropout_rate, mp_shard)
+    slf_attn = pre_post_process_layer(dec_input, slf_attn, "dan",
+                                      dropout_rate)
+    cross = multi_head_attention(slf_attn, enc_output, enc_output,
+                                 dec_enc_attn_bias, d_key, d_value, d_model,
+                                 n_head, dropout_rate, mp_shard)
+    cross = pre_post_process_layer(slf_attn, cross, "dan", dropout_rate)
+    ffd = positionwise_feed_forward(cross, d_inner_hid, d_model, mp_shard)
+    return pre_post_process_layer(cross, ffd, "dan", dropout_rate)
+
+
+def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
+            n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
+            dropout_rate=0.0, mp_shard=False):
+    for _ in range(n_layer):
+        dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
+                                  dec_enc_attn_bias, n_head, d_key, d_value,
+                                  d_model, d_inner_hid, dropout_rate,
+                                  mp_shard)
+    return dec_input
+
+
+def prepare_embedding(word_ids, pos_ids, vocab_size, max_length, d_model,
+                      dropout_rate=0.0, emb_name=None):
+    word_emb = layers.embedding(
+        input=word_ids, size=[vocab_size, d_model],
+        param_attr=emb_name)
+    word_emb = layers.scale(word_emb, scale=float(d_model) ** 0.5)
+    pos_emb = layers.embedding(input=pos_ids, size=[max_length, d_model])
+    out = layers.elementwise_add(word_emb, pos_emb)
+    if dropout_rate:
+        out = layers.dropout(out, dropout_prob=dropout_rate)
+    return out
+
+
+def wrap_encoder(src_word, src_pos, src_slf_attn_bias, src_vocab_size,
+                 max_length, n_layer, n_head, d_key, d_value, d_model,
+                 d_inner_hid, dropout_rate=0.0, mp_shard=False):
+    emb = prepare_embedding(src_word, src_pos, src_vocab_size, max_length,
+                            d_model, dropout_rate)
+    return encoder(emb, src_slf_attn_bias, n_layer, n_head, d_key, d_value,
+                   d_model, d_inner_hid, dropout_rate, mp_shard)
+
+
+def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
+                n_head=8, d_key=64, d_value=64, d_model=512,
+                d_inner_hid=2048, dropout_rate=0.1, src_seq_len=32,
+                trg_seq_len=32, mp_shard=False):
+    """Build the full training graph; returns (avg_cost, predict, feed_vars).
+
+    Data vars (dense, static seq lens — bucket on the host side):
+      src_word/src_pos [b, slen], trg_word/trg_pos [b, tlen] int64,
+      *_attn_bias float32 additive masks, lbl_word [b, tlen] int64,
+      lbl_weight [b, tlen] float32 (0 at padding).
+    """
+    src_word = layers.data("src_word", [src_seq_len], "int64")
+    src_pos = layers.data("src_pos", [src_seq_len], "int64")
+    trg_word = layers.data("trg_word", [trg_seq_len], "int64")
+    trg_pos = layers.data("trg_pos", [trg_seq_len], "int64")
+    src_slf_attn_bias = layers.data(
+        "src_slf_attn_bias", [n_head, src_seq_len, src_seq_len], "float32")
+    trg_slf_attn_bias = layers.data(
+        "trg_slf_attn_bias", [n_head, trg_seq_len, trg_seq_len], "float32")
+    trg_src_attn_bias = layers.data(
+        "trg_src_attn_bias", [n_head, trg_seq_len, src_seq_len], "float32")
+    lbl_word = layers.data("lbl_word", [trg_seq_len], "int64")
+    lbl_weight = layers.data("lbl_weight", [trg_seq_len], "float32")
+
+    enc_output = wrap_encoder(src_word, src_pos, src_slf_attn_bias,
+                              src_vocab_size, max_length, n_layer, n_head,
+                              d_key, d_value, d_model, d_inner_hid,
+                              dropout_rate, mp_shard)
+    dec_emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size,
+                                max_length, d_model, dropout_rate)
+    dec_output = decoder(dec_emb, enc_output, trg_slf_attn_bias,
+                         trg_src_attn_bias, n_layer, n_head, d_key, d_value,
+                         d_model, d_inner_hid, dropout_rate, mp_shard)
+    predict = layers.fc(input=dec_output, size=trg_vocab_size,
+                        num_flatten_dims=2, bias_attr=False,
+                        param_attr=_col_attr(mp_shard))
+
+    cost = layers.softmax_with_cross_entropy(
+        logits=predict, label=layers.reshape(lbl_word, [0, trg_seq_len, 1]))
+    weighted = layers.elementwise_mul(
+        layers.reshape(cost, [0, trg_seq_len]), lbl_weight)
+    sum_cost = layers.reduce_sum(weighted)
+    token_count = layers.reduce_sum(lbl_weight)
+    avg_cost = layers.elementwise_div(sum_cost, token_count)
+    feeds = [src_word, src_pos, trg_word, trg_pos, src_slf_attn_bias,
+             trg_slf_attn_bias, trg_src_attn_bias, lbl_word, lbl_weight]
+    return avg_cost, predict, feeds
+
+
+def make_attn_bias(lengths, seq_len, n_head, causal=False):
+    """Host-side helper: additive bias [b, h, q, k] — 0 valid, -1e9 masked."""
+    lengths = np.asarray(lengths)
+    b = lengths.shape[0]
+    valid = (np.arange(seq_len)[None, :] < lengths[:, None])
+    bias = np.where(valid[:, None, None, :], 0.0, -1e9)
+    bias = np.broadcast_to(bias, (b, n_head, seq_len, seq_len)).copy()
+    if causal:
+        future = np.triu(np.ones((seq_len, seq_len)), k=1) * -1e9
+        bias = bias + future[None, None]
+    return bias.astype(np.float32)
+
+
+def position_encoding_init(n_position, d_model):
+    """Sinusoid table (reference transformer position_encoding_init)."""
+    pos = np.arange(n_position)[:, None]
+    dim = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000, 2 * (dim // 2) / d_model)
+    table = np.zeros((n_position, d_model), np.float32)
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table

@@ -1,0 +1,47 @@
+"""Multi-host control plane.
+
+Replaces the reference's etcd + Go master/pserver discovery machinery
+(go/pserver/etcd_client.go, go/master/service.go:89) and the transpiler's
+endpoint lists (distribute_transpiler.py:82 pservers=..., trainers=N) with
+JAX's coordination service: one coordinator address, every host calls
+``init_distributed``, and ``jax.devices()`` then spans the whole pod —
+the SAME program/bench scripts run unchanged, the mesh just gets bigger.
+Data sharding per host uses process_index/process_count (the master-server
+task-dispatch analog; see utils/reader.py shard()).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_distributed", "process_index", "process_count"]
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize the multi-host runtime.  Arguments default from the env
+    (PADDLE_TPU_COORDINATOR / _NPROCS / _PROC_ID), mirroring the reference's
+    env-var role selection (TRAINING_ROLE / PSERVERS, SURVEY.md §3.2) but
+    with a single role: every process is a worker."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "PADDLE_TPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TPU_NPROCS", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("PADDLE_TPU_PROC_ID", "0"))
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

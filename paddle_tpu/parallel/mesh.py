@@ -1,0 +1,103 @@
+"""Device meshes + sharding helpers.
+
+The mesh is the TPU-native replacement for the reference's device lists
+(layers/device.py:26 get_places, platform/Place) — instead of enumerating
+CUDAPlaces and splitting work per place (parallel_do_op.cc:37
+SplitTensorAndMoveTensorToScopes), a Mesh names logical axes ('dp' data,
+'mp' model/tensor, 'sp' sequence) and sharding specs map tensor dims onto
+them; XLA's SPMD partitioner does the splitting and inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..fluid.core.lod import SeqArray
+
+__all__ = ["Mesh", "make_mesh", "set_mesh", "current_mesh", "mesh_guard",
+           "feed_sharding", "state_sharding"]
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Build a named mesh, e.g. make_mesh({'dp': 4, 'mp': 2}).
+
+    Axis order follows dict order; put the fastest-varying (most
+    bandwidth-hungry, usually 'mp') axis LAST so it lands on the
+    innermost ICI ring.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise ValueError(f"mesh {axes} needs {n} devices, "
+                         f"have {len(devices)}")
+    dev = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(dev, tuple(axes.keys()))
+
+
+def set_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    global _current_mesh
+    old, _current_mesh = _current_mesh, mesh
+    return old
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    old = set_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_mesh(old)
+
+
+def _dp_axes(mesh: Mesh):
+    """Axes used for batch sharding: 'dp' if present, else none."""
+    return [a for a in ("dp",) if a in mesh.axis_names]
+
+
+def feed_sharding(mesh: Mesh, value):
+    """Sharding tree for one feed value: batch (dim 0) over 'dp'."""
+    dp = _dp_axes(mesh)
+
+    def leaf(v):
+        arr = np.asarray(v)
+        if dp and arr.ndim >= 1 and arr.shape[0] % mesh.shape[dp[0]] == 0:
+            return NamedSharding(mesh, PartitionSpec(dp[0]))
+        return NamedSharding(mesh, PartitionSpec())
+
+    if isinstance(value, SeqArray):
+        return SeqArray(leaf(value.data), leaf(value.lengths))
+    return leaf(value)
+
+
+def state_sharding(mesh: Mesh, value, annotation: Optional[Sequence]):
+    """Sharding for a persistable var from its VarDesc annotation (tuple of
+    mesh-axis names or None per dim).  Unannotated or non-divisible dims
+    replicate."""
+    def leaf(v, ann):
+        arr = np.asarray(v)
+        if not ann:
+            return NamedSharding(mesh, PartitionSpec())
+        spec = []
+        for d, ax in zip(arr.shape, list(ann) + [None] * arr.ndim):
+            if ax is not None and ax in mesh.axis_names and \
+                    d % mesh.shape[ax] == 0:
+                spec.append(ax)
+            else:
+                spec.append(None)
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    if isinstance(value, SeqArray):
+        return SeqArray(leaf(value.data, annotation),
+                        NamedSharding(mesh, PartitionSpec()))
+    return leaf(value, annotation)

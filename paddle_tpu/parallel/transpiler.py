@@ -1,0 +1,80 @@
+"""DistributeTranspiler-shaped planner.
+
+API mirror of the reference's DistributeTranspiler
+(python/paddle/v2/fluid/distribute_transpiler.py:82 transpile,
+:441 get_pserver_program, :502 get_startup_program), re-targeted: instead of
+splitting parameters into blocks, round-robining them to parameter servers
+and rewriting the program with send/recv ops, `transpile` only PLANS
+sharding — it annotates parameters with mesh-axis shardings and returns the
+program otherwise unchanged, because on TPU the "parameter server" is the
+sharded HBM of the mesh itself and the gradient exchange is the SPMD
+all-reduce.  Scripts written against the reference API keep working:
+get_pserver_program returns an empty program (there is nothing to run on a
+"server"), and get_trainer_program returns the annotated main program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ..fluid.framework import Parameter, Program, default_main_program
+
+__all__ = ["DistributeTranspiler"]
+
+
+class DistributeTranspiler:
+    def __init__(self):
+        self._program: Optional[Program] = None
+        self._mesh_axes: Dict[str, int] = {}
+
+    def transpile(self, optimize_ops=None, params_grads=None,
+                  trainer_id: int = 0, program: Optional[Program] = None,
+                  pservers: str = "", trainers: int = 1,
+                  mesh_axes: Optional[Dict[str, int]] = None,
+                  shard_params_over: Optional[str] = "mp",
+                  min_shard_dim: int = 1024) -> None:
+        """Plan sharding.  `pservers`/`trainers` are accepted for reference
+        API compatibility; `trainers` maps to the data-parallel degree.
+
+        Parameters whose first dim is large (>= min_shard_dim) and divisible
+        by the `shard_params_over` axis get annotated for tensor sharding —
+        the analog of split_dense_variable's block splitting
+        (distribute_transpiler.py:40), except the "blocks" are SPMD shards.
+        """
+        program = program or default_main_program()
+        self._program = program
+        self._mesh_axes = dict(mesh_axes or {})
+        if trainers > 1 and "dp" not in self._mesh_axes:
+            self._mesh_axes["dp"] = trainers
+        mp = self._mesh_axes.get(shard_params_over)
+        if not mp or mp <= 1:
+            return
+        for p in program.global_block().all_parameters():
+            if p.sharding is not None or not p.shape:
+                continue
+            # shard the largest dim that divides evenly
+            dims = sorted(range(len(p.shape)), key=lambda i: -p.shape[i])
+            for i in dims:
+                if p.shape[i] >= min_shard_dim and p.shape[i] % mp == 0:
+                    sharding = [None] * len(p.shape)
+                    sharding[i] = shard_params_over
+                    p.sharding = tuple(sharding)
+                    p.desc.sharding = list(sharding)
+                    break
+
+    @property
+    def mesh_axes(self) -> Dict[str, int]:
+        return self._mesh_axes
+
+    def get_trainer_program(self) -> Program:
+        return self._program
+
+    def get_pserver_program(self, endpoint: str = "") -> Program:
+        """No servers exist on TPU; returns an empty program so reference
+        launcher scripts that exe.run() it are no-ops."""
+        return Program()
+
+    def get_startup_program(self, endpoint: str = "",
+                            pserver_program: Optional[Program] = None
+                            ) -> Program:
+        return Program()

@@ -1,0 +1,48 @@
+"""Runtime flags — analog of the reference's gflags registries
+(paddle/utils/Flags.cpp:18+ and the Fluid flags defined at point of use:
+FLAGS_check_nan_inf / FLAGS_benchmark in framework/executor.cc:28-31,
+fraction_of_gpu_memory_to_use in platform/gpu_info.cc).
+
+Flags initialize from PADDLE_TPU_* environment variables (the analog of
+core.init_gflags forwarding argv, pybind.cc:413) and can be set
+programmatically."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["FLAGS", "set_flag", "get_flag"]
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(f"PADDLE_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return cast(raw)
+
+
+FLAGS: Dict[str, Any] = {
+    # scan every fetched/state output for NaN/Inf after each step
+    # (executor.cc:29 FLAGS_check_nan_inf)
+    "check_nan_inf": _env("check_nan_inf", False, bool),
+    # block on every step and record wall time (executor.cc:30
+    # FLAGS_benchmark)
+    "benchmark": _env("benchmark", False, bool),
+    # bucket multiple for padded sequence lengths (bounds recompilation)
+    "seq_bucket": _env("seq_bucket", 16, int),
+    # print compiled-step cache misses (recompile visibility)
+    "log_recompiles": _env("log_recompiles", False, bool),
+}
+
+
+def set_flag(name: str, value) -> None:
+    if name not in FLAGS:
+        raise KeyError(f"unknown flag {name!r}; known: {sorted(FLAGS)}")
+    FLAGS[name] = value
+
+
+def get_flag(name: str):
+    return FLAGS[name]

@@ -1,0 +1,132 @@
+"""Composable reader decorators — analog of python/paddle/v2/reader/
+(decorator.py: batch/shuffle/map_readers/buffered/compose/chain, and
+creator.py:91 cloud_reader).
+
+A reader is a zero-arg callable returning an iterator over samples, exactly
+the reference's convention, so user data pipelines port unchanged.  The
+distributed helper `shard` replaces the Go master's task dispatch
+(go/master/service.go:368 GetTask) with deterministic per-process striding
+over the sample stream."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from queue import Queue
+from threading import Thread
+
+__all__ = ["batch", "shuffle", "map_readers", "buffered", "compose",
+           "chain", "firstn", "shard", "cache"]
+
+
+def batch(reader, batch_size, drop_last=True):
+    """Group samples into lists of batch_size (reference minibatch.py)."""
+    def _r():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return _r
+
+
+def shuffle(reader, buf_size, seed=None):
+    """Pool-shuffle with a bounded buffer (reference decorator.py shuffle)."""
+    def _r():
+        rng = random.Random(seed)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+    return _r
+
+
+def map_readers(func, *readers):
+    def _r():
+        for samples in zip(*[r() for r in readers]):
+            yield func(*samples)
+    return _r
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (reference decorator.py buffered) — the
+    host-side overlap that hides data prep behind device steps."""
+    END = object()
+
+    def _r():
+        q: Queue = Queue(maxsize=size)
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(END)
+
+        t = Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is END:
+                break
+            yield s
+    return _r
+
+
+def compose(*readers):
+    """Zip readers into tuple samples (reference decorator.py compose)."""
+    def _r():
+        for parts in zip(*[r() for r in readers]):
+            out = []
+            for p in parts:
+                out.extend(p if isinstance(p, tuple) else (p,))
+            yield tuple(out)
+    return _r
+
+
+def chain(*readers):
+    def _r():
+        return itertools.chain(*[r() for r in readers])
+    return _r
+
+
+def firstn(reader, n):
+    def _r():
+        return itertools.islice(reader(), n)
+    return _r
+
+
+def cache(reader):
+    all_samples = []
+
+    def _r():
+        if not all_samples:
+            all_samples.extend(reader())
+        return iter(all_samples)
+    return _r
+
+
+def shard(reader, num_shards=None, shard_id=None):
+    """Deterministic per-process sample striding — the multi-host data
+    dispatch (replaces the Go master task queue for the common case; each
+    process feeds its own slice of every epoch)."""
+    import jax
+
+    if num_shards is None:
+        num_shards = jax.process_count()
+    if shard_id is None:
+        shard_id = jax.process_index()
+
+    def _r():
+        for i, sample in enumerate(reader()):
+            if i % num_shards == shard_id:
+                yield sample
+    return _r

@@ -18,7 +18,6 @@ import numpy as np
 
 from paddle_tpu import fluid
 from paddle_tpu.fluid import SeqArray
-from paddle_tpu.fluid.core.types import is_float_dtype
 
 
 def _is_float(arr) -> bool:

@@ -1,0 +1,182 @@
+"""Aux-subsystem tests: LR decay schedules, evaluators, CRF, profiler,
+flags/check_nan_inf, readers/datasets, memory_optimize shim, debugger."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import make_seq
+from paddle_tpu.utils import reader as reader_mod
+
+
+def test_exponential_decay_schedule(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    p = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(p)
+    lr = fluid.learning_rate_decay.exponential_decay(
+        learning_rate=0.1, decay_steps=10, decay_rate=0.5)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((2, 2), np.float32)
+    lrs = []
+    for _ in range(21):
+        lr_v, = exe.run(main, feed={"x": xv}, fetch_list=[lr])
+        lrs.append(float(np.asarray(lr_v).reshape(-1)[0]))
+    # step counter increments before fetch: steps 1..21
+    np.testing.assert_allclose(lrs[0], 0.1 * 0.5 ** (1 / 10), rtol=1e-5)
+    np.testing.assert_allclose(lrs[20], 0.1 * 0.5 ** (21 / 10), rtol=1e-5)
+
+
+def test_piecewise_decay(fresh_programs):
+    main, startup, scope = fresh_programs
+    lr = fluid.learning_rate_decay.piecewise_decay([3, 6], [1.0, 0.5, 0.1])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    vals = [float(np.asarray(exe.run(main, fetch_list=[lr])[0]).reshape(-1)[0])
+            for _ in range(8)]
+    np.testing.assert_allclose(vals[:2], 1.0, rtol=1e-6)   # steps 1,2
+    np.testing.assert_allclose(vals[3], 0.5, rtol=1e-6)     # step 4 (>3)
+    np.testing.assert_allclose(vals[7], 0.1, rtol=1e-6)     # step 8 (>6)
+
+
+def test_accuracy_evaluator(fresh_programs):
+    main, startup, scope = fresh_programs
+    probs = fluid.layers.data(name="p", shape=[4], dtype="float32")
+    label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    ev = fluid.evaluator.Accuracy(input=probs, label=label)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pv = np.eye(4, dtype=np.float32)               # predicts class i for row i
+    exe.run(main, feed={"p": pv, "y": np.array([[0], [1], [2], [0]],
+                                               np.int64)},
+            fetch_list=ev.metrics)
+    exe.run(main, feed={"p": pv, "y": np.array([[0], [1], [2], [3]],
+                                               np.int64)},
+            fetch_list=ev.metrics)
+    acc = ev.eval()
+    np.testing.assert_allclose(acc, 7 / 8, rtol=1e-6)
+    ev.reset()
+    assert ev.eval() == 0.0
+
+
+def test_linear_chain_crf_trains(fresh_programs):
+    main, startup, scope = fresh_programs
+    emission = fluid.layers.data(name="e", shape=[5], dtype="float32",
+                                 lod_level=1)
+    label = fluid.layers.data(name="l", shape=[1], dtype="int64",
+                              lod_level=1)
+    nll = fluid.layers.linear_chain_crf(
+        emission, label, param_attr=fluid.ParamAttr(name="crf_trans"))
+    loss = fluid.layers.mean(nll)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(40):
+        seqs, lbls = [], []
+        for _ in range(8):
+            n = rng.randint(2, 7)
+            em = 0.1 * rng.randn(n, 5).astype(np.float32)  # uninformative
+            start = rng.randint(0, 5)
+            lb = ((start + np.arange(n)) % 5).reshape(-1, 1)  # cyclic chain
+            seqs.append(em)
+            lbls.append(lb)
+        feed = {"e": make_seq(seqs, np.float32, bucket=8),
+                "l": make_seq(lbls, np.int32, bucket=8)}
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+    # the transition matrix must learn the cycle: NLL drops markedly
+    assert np.mean(losses[-5:]) < 0.7 * np.mean(losses[:5]), losses[::8]
+
+
+def test_crf_decoding_matches_greedy_when_no_transitions(fresh_programs):
+    main, startup, scope = fresh_programs
+    emission = fluid.layers.data(name="e", shape=[4], dtype="float32",
+                                 lod_level=1)
+    path = fluid.layers.crf_decoding(
+        emission, param_attr=fluid.ParamAttr(
+            name="trans0", initializer=fluid.initializer.Constant(0.0)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    seqs = [rng.randn(5, 4).astype(np.float32),
+            rng.randn(2, 4).astype(np.float32)]
+    out, = exe.run(main, feed={"e": make_seq(seqs, np.float32)},
+                   fetch_list=[path], return_numpy=False)
+    got = np.asarray(out.data).squeeze(-1)
+    np.testing.assert_array_equal(got[0, :5], seqs[0].argmax(-1))
+    np.testing.assert_array_equal(got[1, :2], seqs[1].argmax(-1))
+    assert (got[1, 2:] == 0).all()
+
+
+def test_check_nan_inf_flag(fresh_programs):
+    from paddle_tpu.utils.flags import set_flag
+
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    y = fluid.layers.log(x)  # log(-1) -> nan
+    exe = fluid.Executor(fluid.CPUPlace())
+    set_flag("check_nan_inf", True)
+    try:
+        with pytest.raises(FloatingPointError):
+            exe.run(main, feed={"x": -np.ones((1, 2), np.float32)},
+                    fetch_list=[y])
+    finally:
+        set_flag("check_nan_inf", False)
+
+
+def test_profiler_table(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.profiler.profiler(print_table=False):
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                    fetch_list=[y])
+        rows = fluid.profiler.get_profile_table()
+    assert rows and rows[0]["calls"] == 3
+
+
+def test_reader_decorators():
+    base = lambda: iter(range(10))
+    b = reader_mod.batch(lambda: iter(range(10)), 3)
+    assert list(b()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    s = reader_mod.shuffle(lambda: iter(range(10)), 5, seed=0)
+    assert sorted(s()) == list(range(10))
+    m = reader_mod.map_readers(lambda a: a * 2, lambda: iter(range(3)))
+    assert list(m()) == [0, 2, 4]
+    buf = reader_mod.buffered(lambda: iter(range(5)), 2)
+    assert list(buf()) == [0, 1, 2, 3, 4]
+    sh = reader_mod.shard(lambda: iter(range(10)), num_shards=2, shard_id=1)
+    assert list(sh()) == [1, 3, 5, 7, 9]
+    f = reader_mod.firstn(lambda: iter(range(10)), 4)
+    assert list(f()) == [0, 1, 2, 3]
+
+
+def test_datasets_api():
+    from paddle_tpu import datasets
+
+    img, lbl = next(datasets.mnist.train()())
+    assert img.shape == (784,) and 0 <= lbl < 10
+    x, y = next(datasets.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    words, sentiment = next(datasets.imdb.train()())
+    assert isinstance(words, list) and sentiment in (0, 1)
+    gram = next(datasets.imikolov.train(n=5)())
+    assert len(gram) == 5
+
+
+def test_memory_optimize_shim_and_debugger(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=3, act="relu")
+    loss = fluid.layers.mean(h)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    n = fluid.memory_optimize(main)
+    assert n >= 0
+    code = fluid.debugger.pprint_program_codes(main)
+    assert "mul" in code and "sgd" in code

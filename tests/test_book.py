@@ -75,7 +75,7 @@ def test_image_classification_resnet_small(fresh_programs):
     predict = image_classification.resnet_cifar10(img, depth=8, class_num=4)
     cost = fluid.layers.cross_entropy(input=predict, label=label)
     avg_cost = fluid.layers.mean(cost)
-    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+    fluid.optimizer.Momentum(learning_rate=0.02, momentum=0.9).minimize(
         avg_cost)
 
     rng = np.random.RandomState(2)
@@ -87,8 +87,8 @@ def test_image_classification_resnet_small(fresh_programs):
             img_v[b, k % 3, :, :] += 0.8  # class -> dominant channel
         return {"img": img_v, "label": lbl}
 
-    losses = _train(main, startup, scope, feeder, avg_cost, steps=25)
-    assert losses[-1] < losses[0], losses[::5]
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=30)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses[::6]
 
 
 def test_vgg_builds_and_steps(fresh_programs):

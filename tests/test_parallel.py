@@ -1,0 +1,158 @@
+"""SPMD parallelism tests on the 8-device virtual CPU mesh — the same-process
+multi-device testing SURVEY.md §4 calls for (the reference couldn't test its
+distributed path in CI at all; its dist tests were `notest_`)."""
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu import parallel
+
+
+def _build_fit_a_line():
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return loss
+
+
+def _train(loss, main, startup, scope, steps=20, seed=0):
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(seed)
+    true_w = rng.randn(16, 1).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = []
+        for _ in range(steps):
+            xv = rng.randn(32, 16).astype(np.float32)
+            yv = xv @ true_w
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            out.append(float(lv))
+    return out
+
+
+def test_data_parallel_matches_single_device(fresh_programs):
+    """The SAME program trains identically under dp=8 sharding (modulo fp
+    reduction order) — the capability parallel_do/MultiGradientMachine
+    provided, now via pure annotation."""
+    main, startup, scope = fresh_programs
+    main.random_seed = 1234
+    startup.random_seed = 99  # identical init in both runs
+    loss = _build_fit_a_line()
+
+    single = _train(loss, main, startup, scope, steps=15)
+
+    scope2 = fluid.Scope()
+    mesh = parallel.make_mesh({"dp": 8})
+    with parallel.mesh_guard(mesh):
+        dp = _train(loss, main, startup, scope2, steps=15)
+
+    np.testing.assert_allclose(single, dp, rtol=2e-4, atol=1e-5)
+    assert dp[-1] < dp[0] * 0.5
+
+
+def test_data_parallel_shards_feed_compute(fresh_programs):
+    """Check the compiled step really places sharded feeds across devices."""
+    main, startup, scope = fresh_programs
+    loss = _build_fit_a_line()
+    mesh = parallel.make_mesh({"dp": 8})
+    with parallel.mesh_guard(mesh), fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.randn(32, 16).astype(np.float32)
+        yv = np.random.randn(32, 1).astype(np.float32)
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        w = [p for p in main.global_block().all_parameters()
+             if tuple(p.shape) == (16, 1)][0]
+        wv = scope.find_var(w.name)
+        # replicated param: every device holds it
+        assert len(wv.sharding.device_set) == 8
+
+
+def test_tensor_parallel_sharded_param(fresh_programs):
+    """fc weight sharded over 'mp' (ParallelNeuralNetwork analog)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    h = fluid.layers.fc(
+        input=x, size=32,
+        param_attr=fluid.ParamAttr(sharding=(None, "mp")), bias_attr=False)
+    out = fluid.layers.reduce_sum(h)
+    mesh = parallel.make_mesh({"dp": 2, "mp": 4})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with parallel.mesh_guard(mesh), fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.random.randn(4, 8).astype(np.float32)
+        ov, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        w = main.global_block().all_parameters()[0]
+        wv = scope.find_var(w.name)
+        spec = wv.sharding.spec
+        assert tuple(spec) == (None, "mp"), spec
+        wv_np = np.asarray(wv)
+        np.testing.assert_allclose(ov, (xv @ wv_np).sum(), rtol=1e-4)
+
+
+def test_dp_with_tp_training_step(fresh_programs):
+    """Full train step with both axes: dp-sharded batch, mp-sharded fc."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu",
+                        param_attr=fluid.ParamAttr(sharding=(None, "mp")))
+    p = fluid.layers.fc(input=h, size=1,
+                        param_attr=fluid.ParamAttr(sharding=("mp", None)))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    mesh = parallel.make_mesh({"dp": 4, "mp": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(5)
+    with parallel.mesh_guard(mesh), fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(20):
+            xv = rng.randn(16, 8).astype(np.float32)
+            yv = xv.sum(1, keepdims=True)
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0]
+
+
+def test_transpiler_annotates_params(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=2048, bias_attr=False)
+    loss = fluid.layers.mean(h)
+    opt_ops, pg = fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    t = parallel.DistributeTranspiler()
+    t.transpile(opt_ops, pg, trainers=4, mesh_axes={"dp": 4, "mp": 2})
+    w = [p for p in main.global_block().all_parameters()
+         if 2048 in p.shape][0]
+    assert w.sharding is not None and "mp" in w.sharding
+    assert t.mesh_axes["dp"] == 4
+    # reference-API surface intact
+    assert t.get_pserver_program("h:0").global_block() is not None
+
+
+def test_seq_model_data_parallel(fresh_programs):
+    """SeqArray feeds shard over dp too (data + lengths)."""
+    from paddle_tpu.fluid import make_seq
+
+    main, startup, scope = fresh_programs
+    words = fluid.layers.data(name="w", shape=[1], dtype="int64",
+                              lod_level=1)
+    emb = fluid.layers.embedding(input=words, size=[30, 8])
+    pooled = fluid.layers.sequence_pool(emb, "average")
+    logits = fluid.layers.fc(input=pooled, size=3)
+    loss = fluid.layers.mean(logits)
+    mesh = parallel.make_mesh({"dp": 8})
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(9)
+    with parallel.mesh_guard(mesh), fluid.scope_guard(scope):
+        exe.run(startup)
+        seqs = [rng.randint(0, 30, (rng.randint(1, 6), 1))
+                for _ in range(16)]
+        lv, = exe.run(main, feed={"w": make_seq(seqs, np.int32, bucket=8)},
+                      fetch_list=[loss])
+    assert np.isfinite(lv)
