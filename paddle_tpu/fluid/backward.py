@@ -157,12 +157,23 @@ def append_backward(loss: Variable,
         g_inputs = {slot: [block.var(n) for n in names if n]
                     for slot, names in op.desc.inputs.items()}
         g_inputs.update(grad_inputs)
-        g_outputs: Dict[str, List[Variable]] = defaultdict(list)
+        # grad outputs stay POSITIONALLY aligned with the forward slot's
+        # entries ("" = hole for a non-differentiable entry) so the generic
+        # vjp emitter can pair gradients by position
+        g_outputs: Dict[str, List] = defaultdict(list)
         for slot, pos, name in targets:
+            aligned = g_outputs[slot + GRAD_SUFFIX]
+            want = len(op.desc.inputs[slot])
+            if not aligned:
+                aligned.extend([""] * want)
             gname = f"{grad_var_name(name)}@RENAME@{len(pending[name])}"
             _make_grad_var(block, name, gname)
             pending[name].append(gname)
-            g_outputs[slot + GRAD_SUFFIX].append(block.vars[gname])
+            aligned[pos] = block.vars[gname]
+        # drop trailing holes (keeps single-entry slots tidy)
+        for slot in list(g_outputs):
+            while g_outputs[slot] and g_outputs[slot][-1] == "":
+                g_outputs[slot].pop()
         block.append_op(op.type + "_grad", inputs=g_inputs,
                         outputs=dict(g_outputs), attrs=dict(op.desc.attrs),
                         infer_shape=False)

@@ -320,6 +320,8 @@ class Block:
             return
         for slot, vals in out_abs.items():
             for var, av in zip(out_vars.get(slot, []), vals):
+                if not isinstance(av, SeqArray) and not hasattr(av, "shape"):
+                    continue  # opaque value (RankTable, TensorArray, ...)
                 if isinstance(av, SeqArray):
                     dshape = list(av.data.shape)
                     shape = [dshape[0]] + dshape[2:]

@@ -1,6 +1,8 @@
 """fluid.layers — analog of python/paddle/v2/fluid/layers/__init__.py."""
 
-from . import io, nn, ops, recurrent, sequence, tensor  # noqa: F401
+from . import (control_flow, io, nn, ops, recurrent, sequence,  # noqa: F401
+               tensor)
+from .control_flow import *  # noqa: F401,F403
 from .recurrent import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403

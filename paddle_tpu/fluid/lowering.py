@@ -87,7 +87,12 @@ def _emit_generic_grad(ctx: EmitCtx, op: OpDesc, ins: Dict[str, list]):
     cotangents = {s[: -len(GRAD_SUFFIX)]: v for s, v in ins.items()
                   if s.endswith(GRAD_SUFFIX)}
 
-    fwd_op = OpDesc(base, {}, {}, dict(op.attrs))
+    # reconstruct the forward op's slot->var-name map: control-flow emitters
+    # (while/recurrent/conditional_block) read input NAMES off the desc to
+    # seed their sub-block environments
+    fwd_inputs = {s: names for s, names in op.inputs.items()
+                  if not s.endswith(GRAD_SUFFIX)}
+    fwd_op = OpDesc(base, fwd_inputs, {}, dict(op.attrs))
     grad_slot_order = sorted(cotangents)
 
     def fwd_selected(p):

@@ -1,0 +1,415 @@
+"""Control-flow ops: sub-block execution inside an op.
+
+TPU-native replacement for the reference's control-flow operator family —
+``while_op.cc`` (352 LoC), ``recurrent_op.cc:635`` (static RNN over time
+steps with step-scopes), ``conditional_block_op.cc``, and the tensor-array
+machinery behind DynamicRNN (``lod_tensor_to_array_op``,
+``tensor_array_read_write_op``, ``shrink_rnn_memory_op``,
+``lod_rank_table_op``).  Where the reference re-enters the C++ Executor
+recursively per iteration with a fresh step-scope, here the sub-block is
+traced ONCE into the surrounding XLA computation through
+``lax.while_loop`` / ``lax.scan`` / ``lax.cond``:
+
+* loop-carried variables become scan/while carries (the step-scope
+  collapses into a functional carry tuple);
+* the per-iteration scope creation, variable lookup and kernel dispatch
+  all disappear — XLA compiles one fused loop body;
+* ``while`` with a ``max_iters`` attr lowers to a predicate-masked
+  ``lax.scan`` so it stays reverse-mode differentiable (the analog of
+  while_grad_op's step-scope replay, without storing per-step scopes);
+  unbounded ``while`` lowers to ``lax.while_loop`` (forward-only);
+* LoD tensor arrays become a dense ``TensorArray`` pytree (stacked buffer
+  + element count) with ``dynamic_update_slice`` writes — static shapes,
+  as XLA requires; the lod_rank_table sort machinery is unnecessary under
+  the padded SeqArray layout and survives as a lengths wrapper.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import SeqArray
+from ..core.registry import OpInfo, primitive, register
+
+__all__ = ["TensorArray", "RankTable"]
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """Dense tensor array: stacked buffer [capacity, ...] + element count.
+
+    The XLA-friendly answer to the reference's LoDTensorArray variable type
+    (framework.proto var type LOD_TENSOR_ARRAY; vector<LoDTensor> in C++):
+    writes are ``lax.dynamic_update_slice`` into a preallocated buffer so the
+    array can be a loop carry with a static shape.
+    """
+
+    __slots__ = ("data", "size")
+
+    def __init__(self, data, size):
+        self.data = data            # [capacity, *elem_shape]
+        self.size = size            # scalar int32: number of valid entries
+
+    def tree_flatten(self):
+        return (self.data, self.size), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self):
+        return self.data.shape[0]
+
+    def __repr__(self):
+        return f"TensorArray(data={self.data.shape}, size={self.size})"
+
+
+@jax.tree_util.register_pytree_node_class
+class RankTable:
+    """Per-sequence lengths (reference LoDRankTable, lod_rank_table.cc).
+
+    The reference sorts sequences by descending length so the RNN batch can
+    shrink as short sequences finish (shrink_rnn_memory).  Under the padded
+    SeqArray layout masking replaces shrinking, so the table only carries
+    lengths.
+    """
+
+    __slots__ = ("lengths",)
+
+    def __init__(self, lengths):
+        self.lengths = lengths
+
+    def tree_flatten(self):
+        return (self.lengths,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _scalar_bool(c):
+    x = c.data if isinstance(c, SeqArray) else c
+    return jnp.reshape(x, ()).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical ops (reference compare_op.cc, logical_op.cc)
+# ---------------------------------------------------------------------------
+
+def _cmp(op_type, fn):
+    @primitive(op_type, inputs=["X", "Y"], outputs=["Out"], no_grad=True,
+               seq_transparent=True)
+    def _emit(ctx, x, y):
+        return fn(x, y)
+    _emit.__name__ = op_type
+    return _emit
+
+
+_cmp("less_than", lambda x, y: x < y)
+_cmp("less_equal", lambda x, y: x <= y)
+_cmp("greater_than", lambda x, y: x > y)
+_cmp("greater_equal", lambda x, y: x >= y)
+_cmp("equal", lambda x, y: x == y)
+_cmp("not_equal", lambda x, y: x != y)
+
+
+def _logical(op_type, fn, arity=2):
+    ins = ["X", "Y"][:arity]
+
+    @primitive(op_type, inputs=ins, outputs=["Out"], no_grad=True,
+               seq_transparent=True)
+    def _emit(ctx, *args):
+        return fn(*args)
+    _emit.__name__ = op_type
+    return _emit
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, arity=1)
+
+
+@primitive("increment", inputs=["X"], outputs=["Out"], no_grad=True)
+def increment(ctx, x):
+    """reference increment_op.cc — counter bump for loop indices."""
+    return x + jnp.asarray(ctx.attr("step", 1.0), x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# tensor-array ops
+# ---------------------------------------------------------------------------
+
+@primitive("lod_rank_table", inputs=["X"], outputs=["Out"], no_grad=True)
+def lod_rank_table(ctx, x):
+    """reference lod_rank_table_op.cc — lengths table for a sequence batch."""
+    if isinstance(x, SeqArray):
+        return RankTable(x.lengths)
+    return RankTable(jnp.full((x.shape[0],), x.shape[1], jnp.int32))
+
+
+@primitive("max_sequence_len", inputs=["RankTable"], outputs=["Out"],
+           no_grad=True)
+def max_sequence_len(ctx, rt):
+    """reference max_sequence_len_op.cc."""
+    return jnp.max(rt.lengths).astype(jnp.int64).reshape(1)
+
+
+def _ta_emit(ctx, ins):
+    """write_to_array (tensor_array_read_write_op.cc WriteToArrayOp): write X
+    at index I; allocates the buffer on first write (capacity attr)."""
+    x = ins["X"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    arr = ins.get("Array", [None])[0]
+    xd = x.data if isinstance(x, SeqArray) else x
+    if arr is None:
+        cap = int(ctx.attr("capacity", 64))
+        arr = TensorArray(jnp.zeros((cap,) + xd.shape, xd.dtype),
+                          jnp.zeros((), jnp.int32))
+    in_range = i < arr.data.shape[0]
+    data = jax.lax.dynamic_update_index_in_dim(arr.data, xd.astype(
+        arr.data.dtype), i, axis=0)
+    data = jnp.where(in_range, data, arr.data)  # drop past-capacity writes
+    size = jnp.where(in_range, jnp.maximum(arr.size, i + 1), arr.size)
+    return {"Out": [TensorArray(data, size)]}
+
+
+register(OpInfo("write_to_array", _ta_emit, no_grad=False))
+
+
+@primitive("read_from_array", inputs=["X", "I"], outputs=["Out"])
+def read_from_array(ctx, arr, i):
+    """tensor_array_read_write_op.cc ReadFromArrayOp."""
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    return jax.lax.dynamic_index_in_dim(arr.data, i, axis=0, keepdims=False)
+
+
+@primitive("array_length", inputs=["X"], outputs=["Out"], no_grad=True)
+def array_length(ctx, arr):
+    """lod_array_length_op.cc."""
+    return arr.size.astype(jnp.int64).reshape(1)
+
+
+@primitive("lod_tensor_to_array", inputs=["X", "RankTable"], outputs=["Out"])
+def lod_tensor_to_array(ctx, x, rt):
+    """lod_tensor_to_array_op.cc: split a sequence batch into per-timestep
+    array entries.  Under the padded layout this is a [B,T,...]->[T,B,...]
+    transpose into a full TensorArray (no rank-table sort needed)."""
+    data = x.data if isinstance(x, SeqArray) else x
+    stacked = jnp.swapaxes(data, 0, 1)
+    return TensorArray(stacked, jnp.asarray(stacked.shape[0], jnp.int32))
+
+
+@primitive("array_to_lod_tensor", inputs=["X", "RankTable"], outputs=["Out"])
+def array_to_lod_tensor(ctx, arr, rt):
+    """array_to_lod_tensor_op.cc: stack array entries back to a sequence
+    batch, reattaching lengths from the rank table."""
+    data = jnp.swapaxes(arr.data, 0, 1)
+    if rt is not None and isinstance(rt, RankTable):
+        return SeqArray(data, rt.lengths)
+    return data
+
+
+@primitive("shrink_rnn_memory", inputs=["X", "RankTable", "I"],
+           outputs=["Out"])
+def shrink_rnn_memory(ctx, x, rt, i):
+    """shrink_rnn_memory_op.cc shrinks the carry to sequences still alive at
+    step I.  With padding+masking the carry keeps its full batch; masking in
+    dynamic_recurrent preserves finished sequences' state, so this is an
+    identity (capability kept, mechanism superseded)."""
+    return x
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+def _while_emit(ctx, ins):
+    op = ctx.op
+    sub_idx = op.block_attr("sub_block")
+    # carried/cond names are the ORIGINAL parent-var names the sub-block
+    # reads/writes; the X/Condition input slots hold @PRE snapshot vars so
+    # the grad twin re-reads loop-ENTRY values (SSA at the desc level — the
+    # functional analog of while_grad's saved step-scopes)
+    x_names = op.attr("carried_names", None) or op.input("X")
+    p_names = op.input("P")
+    cond_name = op.attr("cond_name", None) or op.input("Condition")[0]
+    xs0 = tuple(ins.get("X", []))
+    p_env = dict(zip(p_names, ins.get("P", [])))
+    cond0 = ins["Condition"][0]
+    max_iters = op.attr("max_iters", None)
+
+    def body(cond, xs):
+        env = dict(p_env)
+        env.update(zip(x_names, xs))
+        env[cond_name] = cond
+        env = ctx.lower_block(sub_idx, env)
+        return env[cond_name], tuple(env[n] for n in x_names)
+
+    if max_iters is None:
+        # forward-only: XLA's native while; trip count is data-dependent
+        def cond_fn(carry):
+            return _scalar_bool(carry[0])
+
+        def body_fn(carry):
+            return body(*carry)
+
+        final_cond, xs = jax.lax.while_loop(cond_fn, body_fn, (cond0, xs0))
+    else:
+        # bounded + masked scan: reverse-mode differentiable (the analog of
+        # while_grad's step-scope replay, without materializing scopes)
+        def scan_body(carry, _):
+            cond, xs = carry
+            pred = _scalar_bool(cond)
+            ncond, nxs = body(cond, xs)
+            sel = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(pred, n, o), nxs, xs)
+            ncond = jnp.where(pred, ncond, cond)
+            return (ncond, sel), None
+
+        (final_cond, xs), _ = jax.lax.scan(scan_body, (cond0, xs0), None,
+                                           length=int(max_iters))
+    out = {"Out": list(xs)}
+    if op.output("CondOut"):
+        out["CondOut"] = [final_cond]
+    return out
+
+
+register(OpInfo("while", _while_emit,
+                stop_grad_slots=("Condition",),
+                doc="reference while_op.cc:52 WhileOp"))
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN) / dynamic_recurrent (DynamicRNN)
+# ---------------------------------------------------------------------------
+
+def _zero_states(specs, batch, like_dtype):
+    out = []
+    for spec in specs:
+        out.append(jnp.full((batch,) + tuple(spec["shape"]),
+                            spec.get("value", 0.0),
+                            spec.get("dtype", like_dtype)))
+    return out
+
+
+def _recurrent_common(ctx, ins, masked: bool):
+    op = ctx.op
+    sub_idx = op.block_attr("sub_block")
+    in_names = op.attr("step_input_names")       # inner per-step vars
+    state_names = op.attr("state_names")         # inner pre-state vars
+    update_names = op.attr("state_update_names")  # inner updated-state vars
+    out_names = op.attr("step_output_names")     # inner per-step outputs
+    auto_init = op.attr("auto_init_states", [])  # specs for zero-init states
+    reverse = bool(op.attr("is_reverse", False))
+
+    xs = ins.get("X", [])
+    p_env = dict(zip(op.input("P"), ins.get("P", [])))
+    lengths = None
+    datas = []
+    for x in xs:
+        if isinstance(x, SeqArray):
+            lengths = x.lengths if lengths is None else lengths
+            datas.append(jnp.swapaxes(x.data, 0, 1))      # [T, B, ...]
+        else:
+            datas.append(jnp.swapaxes(x, 0, 1))
+    T, batch = datas[0].shape[0], datas[0].shape[1]
+    dtype = datas[0].dtype if jnp.issubdtype(datas[0].dtype, jnp.floating) \
+        else jnp.float32
+
+    inits = list(ins.get("InitStates", []))
+    carries = []
+    ii = 0
+    for k, name in enumerate(state_names):
+        if k < len(auto_init) and auto_init[k] is not None:
+            carries.append(_zero_states([auto_init[k]], batch, dtype)[0])
+        else:
+            carries.append(inits[ii])
+            ii += 1
+    carries = tuple(carries)
+
+    if masked and lengths is not None:
+        mask = jnp.swapaxes(SeqArray(datas[0].swapaxes(0, 1),
+                                     lengths).mask(dtype), 0, 1)  # [T, B]
+    else:
+        mask = jnp.ones((T, batch), dtype)
+    if reverse:
+        datas = [d[::-1] for d in datas]
+        mask = mask[::-1]
+
+    def step(carry, slices):
+        xt, mt = slices
+        env = dict(p_env)
+        env.update(zip(state_names, carry))
+        env.update(zip(in_names, xt))
+        env = ctx.lower_block(sub_idx, env)
+        new_carry = tuple(env[n] for n in update_names)
+        if masked:
+            new_carry = tuple(
+                mt.reshape((-1,) + (1,) * (n.ndim - 1)) * n
+                + (1 - mt.reshape((-1,) + (1,) * (n.ndim - 1))) * o
+                for n, o in zip(new_carry, carry))
+        outs = tuple(env[n] for n in out_names)
+        if masked:
+            outs = tuple(o * mt.reshape((-1,) + (1,) * (o.ndim - 1))
+                         for o in outs)
+        return new_carry, outs
+
+    final, outs = jax.lax.scan(step, carries, (tuple(datas), mask))
+    stacked = []
+    for o in outs:
+        o = o[::-1] if reverse else o
+        o = jnp.swapaxes(o, 0, 1)                 # [B, T, ...]
+        stacked.append(SeqArray(o, lengths) if (masked and lengths is not None)
+                       else o)
+    return {"Out": stacked, "FinalStates": list(final)}
+
+
+def _recurrent_emit(ctx, ins):
+    return _recurrent_common(ctx, ins, masked=False)
+
+
+def _dynamic_recurrent_emit(ctx, ins):
+    return _recurrent_common(ctx, ins, masked=True)
+
+
+register(OpInfo("recurrent", _recurrent_emit,
+                doc="reference recurrent_op.cc:635 RecurrentOp — static RNN "
+                    "over time steps; step-scopes become a lax.scan carry"))
+register(OpInfo("dynamic_recurrent", _dynamic_recurrent_emit,
+                doc="DynamicRNN engine (reference builds it from while + "
+                    "lod_rank_table + shrink_memory, control_flow.py:1252); "
+                    "here: masked lax.scan over the padded time axis"))
+
+
+# ---------------------------------------------------------------------------
+# conditional_block
+# ---------------------------------------------------------------------------
+
+def _conditional_block_emit(ctx, ins):
+    op = ctx.op
+    sub_idx = op.block_attr("sub_block")
+    x_names = op.attr("in_names", None) or op.input("X")
+    out_names = op.attr("out_names")
+    xs = tuple(ins.get("X", []))
+    pred = _scalar_bool(ins["Cond"][0])
+
+    def true_fn(vals):
+        env = dict(zip(x_names, vals))
+        env = ctx.lower_block(sub_idx, env)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(vals):
+        env = dict(zip(x_names, vals))
+        return tuple(env[n] for n in out_names)
+
+    outs = jax.lax.cond(pred, true_fn, false_fn, xs)
+    return {"Out": list(outs)}
+
+
+register(OpInfo("conditional_block", _conditional_block_emit,
+                stop_grad_slots=("Cond",),
+                doc="reference conditional_block_op.cc — sub-block under a "
+                    "scalar predicate, lowered to lax.cond"))

@@ -1,0 +1,222 @@
+"""Control-flow tests — mirror of the reference's
+fluid/tests/test_while_op.py, test_recurrent_op.py, test_dyn_rnn.py,
+test_switch.py, test_array_read_write_op.py, test_lod_tensor_array_ops.py."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.core.lod import make_seq
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_while_sums_array(fresh_programs):
+    """reference test_while_op.py: sum array entries with a While loop."""
+    main, startup, scope = fresh_programs
+    d0 = fluid.layers.data(name="d0", shape=[10], dtype="float32")
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    i.stop_gradient = True
+    table = layers.lod_rank_table(d0)
+    arr = layers.lod_tensor_to_array(
+        fluid.layers.reshape(d0, [-1, 10, 1]), table)
+    mem = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    n = layers.fill_constant(shape=[1], dtype="int64", value=10)
+    n.stop_gradient = True
+    cond = layers.less_than(x=i, y=n)
+    loop = layers.While(cond=cond)
+    with loop.block():
+        elem = layers.array_read(array=arr, i=i)
+        summed = fluid.layers.elementwise_add(
+            x=mem, y=fluid.layers.reduce_sum(elem))
+        fluid.layers.assign(summed, mem)
+        layers.increment(x=i, in_place=True)
+        layers.less_than(x=i, y=n, cond=cond)
+
+    exe = _exe()
+    exe.run(startup)
+    dv = np.random.RandomState(0).rand(3, 10).astype(np.float32)
+    out, = exe.run(main, feed={"d0": dv}, fetch_list=[mem])
+    np.testing.assert_allclose(np.asarray(out).sum(), dv.sum(), rtol=1e-5)
+
+
+def test_while_bounded_is_differentiable(fresh_programs):
+    """max_iters lowers to a masked scan, so append_backward works through
+    the loop (the analog of while_grad_op)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    x.stop_gradient = False
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    i.stop_gradient = True
+    n = layers.fill_constant(shape=[1], dtype="int64", value=3)
+    n.stop_gradient = True
+    acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.less_than(x=i, y=n)
+    loop = layers.While(cond=cond, max_iters=8)
+    with loop.block():
+        s = fluid.layers.reduce_sum(fluid.layers.square(x))
+        fluid.layers.assign(fluid.layers.elementwise_add(x=acc, y=s), acc)
+        layers.increment(x=i, in_place=True)
+        layers.less_than(x=i, y=n, cond=cond)
+    loss = fluid.layers.mean(acc)
+    fluid.append_backward(loss)
+
+    exe = _exe()
+    exe.run(startup)
+    xv = np.array([[1.0, 2.0, -1.0, 0.5]], np.float32)
+    gx, lv = exe.run(main, feed={"x": xv}, fetch_list=[x.grad_name, loss])
+    # loss = 3 * sum(x^2)  -> dloss/dx = 6x
+    np.testing.assert_allclose(np.asarray(lv), 3 * (xv ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx), 6 * xv, rtol=1e-5)
+
+
+def test_static_rnn_matches_manual(fresh_programs):
+    """reference test_recurrent_op.py: h_t = tanh(x_t W + h_{t-1} U)."""
+    main, startup, scope = fresh_programs
+    B, T, D, H = 2, 5, 3, 4
+    x = fluid.layers.data(name="x", shape=[T, D], dtype="float32")
+    x.stop_gradient = False
+    h0 = fluid.layers.data(name="h0", shape=[H], dtype="float32")
+    h0.stop_gradient = False
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        hprev = rnn.memory(init=h0)
+        h = fluid.layers.fc(input=[xt, hprev], size=H, act="tanh",
+                            bias_attr=False)
+        rnn.update_memory(hprev, h)
+        rnn.step_output(h)
+    out = rnn()
+    loss = fluid.layers.mean(out)
+    fluid.append_backward(loss)
+
+    exe = _exe()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    h0v = rng.randn(B, H).astype(np.float32)
+    params = sorted(p.name for p in main.global_block().all_parameters())
+    assert len(params) == 2  # W_x and W_h of the concat-fc
+    ws = [np.asarray(scope.find_var(p)) for p in params]
+    w = next(a for a in ws if a.shape == (D, H))
+    u = next(a for a in ws if a.shape == (H, H))
+
+    ov, gh0 = exe.run(main, feed={"x": xv, "h0": h0v},
+                      fetch_list=[out, h0.grad_name])
+    ov = np.asarray(ov)
+    h = h0v
+    ref = []
+    for t in range(T):
+        h = np.tanh(xv[:, t] @ w + h @ u)
+        ref.append(h)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(ov, ref, rtol=1e-4, atol=1e-5)
+    assert np.abs(np.asarray(gh0)).sum() > 0  # grads flow through the scan
+
+
+def test_dynamic_rnn_masks_finished_sequences(fresh_programs):
+    """reference test_dyn_rnn.py: variable-length sequences freeze their
+    state once finished (shrink_memory semantics under padding)."""
+    main, startup, scope = fresh_programs
+    H = 3
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32", lod_level=1)
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(x)
+        mem = drnn.memory(shape=[H], value=0.0)
+        h = fluid.layers.fc(input=[xt, mem], size=H, act="sigmoid",
+                            bias_attr=False)
+        drnn.update_memory(mem, h)
+        drnn.output(h)
+    out = drnn()
+    last = fluid.layers.sequence_last_step(out)
+    loss = fluid.layers.mean(last)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = _exe()
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    seqs = [rng.randn(4, 2).astype(np.float32),
+            rng.randn(2, 2).astype(np.float32)]
+    sa = make_seq(seqs)
+    ov, lastv, _ = exe.run(main, feed={"x": sa}, fetch_list=[out, last, loss],
+                           return_numpy=False)
+    data = np.asarray(ov.data if hasattr(ov, "data") else ov)
+    # padded steps of the short sequence must be zeroed by the mask
+    assert np.all(data[1, 2:] == 0)
+    params = [p.name for p in main.global_block().all_parameters()]
+    w = np.asarray(scope.find_var(params[0]))
+    assert np.isfinite(w).all()
+
+
+def test_switch_piecewise(fresh_programs):
+    """reference test_switch.py — Switch picks the branch of the first true
+    condition."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+    zero = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    one = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+    out = layers.fill_constant(shape=[1], dtype="float32", value=-1.0)
+    with layers.Switch() as sw:
+        with sw.case(layers.less_than(x=x, y=zero)):
+            fluid.layers.assign(
+                layers.fill_constant(shape=[1], dtype="float32", value=10.0),
+                out)
+        with sw.case(layers.less_than(x=x, y=one)):
+            fluid.layers.assign(
+                layers.fill_constant(shape=[1], dtype="float32", value=20.0),
+                out)
+        with sw.default():
+            fluid.layers.assign(
+                layers.fill_constant(shape=[1], dtype="float32", value=30.0),
+                out)
+    exe = _exe()
+    exe.run(startup)
+    for xv, expect in [(-5.0, 10.0), (0.5, 20.0), (7.0, 30.0)]:
+        ov, = exe.run(main, feed={"x": np.array([[xv]], np.float32)},
+                      fetch_list=[out])
+        assert float(np.asarray(ov).reshape(())) == expect, (xv, ov)
+
+
+def test_array_write_read_roundtrip(fresh_programs):
+    """reference test_array_read_write_op.py."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    i0 = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    i1 = layers.fill_constant(shape=[1], dtype="int64", value=1)
+    arr = layers.array_write(x, i0, capacity=4)
+    doubled = fluid.layers.scale(x, scale=2.0)
+    layers.array_write(doubled, i1, array=arr)
+    r0 = layers.array_read(arr, i0)
+    r1 = layers.array_read(arr, i1)
+    ln = layers.array_length(arr)
+    exe = _exe()
+    exe.run(startup)
+    xv = np.random.RandomState(3).rand(2, 3).astype(np.float32)
+    a, b, n = exe.run(main, feed={"x": xv}, fetch_list=[r0, r1, ln])
+    np.testing.assert_allclose(np.asarray(a), xv)
+    np.testing.assert_allclose(np.asarray(b), 2 * xv, rtol=1e-6)
+    assert int(np.asarray(n).reshape(())) == 2
+
+
+def test_lod_tensor_array_roundtrip(fresh_programs):
+    """reference test_lod_tensor_array_ops.py: to_array o to_lod_tensor = id
+    (modulo the rank-table reorder padding makes unnecessary)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32", lod_level=1)
+    table = layers.lod_rank_table(x)
+    arr = layers.lod_tensor_to_array(x, table)
+    back = layers.array_to_lod_tensor(arr, table)
+    ml = layers.max_sequence_len(table)
+    exe = _exe()
+    exe.run(startup)
+    sa = make_seq([np.ones((3, 4), np.float32),
+                   2 * np.ones((5, 4), np.float32)])
+    b, m = exe.run(main, feed={"x": sa}, fetch_list=[back, ml],
+                   return_numpy=False)
+    np.testing.assert_allclose(np.asarray(b.data), sa.data)
+    np.testing.assert_allclose(np.asarray(b.lengths), sa.lengths)
+    assert int(np.asarray(m).reshape(())) == 5
