@@ -109,6 +109,10 @@ def array_write(x, i, array=None, capacity=64):
     helper.append_op("write_to_array", inputs=inputs,
                      outputs={"Out": array}, attrs={"capacity": capacity})
     array._written = True
+    # element shape metadata so array_read consumers can infer shapes
+    if x.shape is not None:
+        array.desc.shape = list(x.shape)
+        array.desc.dtype = x.dtype
     return array
 
 
@@ -116,8 +120,10 @@ def array_read(array, i):
     """reference array_read (ReadFromArray)."""
     helper = LayerHelper("array_read")
     out = helper.create_tmp_variable(array.dtype)
+    if array.shape is not None:
+        out.desc.shape = list(array.shape)
     helper.append_op("read_from_array", inputs={"X": array, "I": i},
-                     outputs={"Out": out})
+                     outputs={"Out": out}, infer_shape=False)
     return out
 
 
@@ -159,6 +165,12 @@ def lod_tensor_to_array(x, table):
                      inputs={"X": x, "RankTable": table},
                      outputs={"Out": array})
     array._written = True
+    if x.shape is not None:
+        # per-timestep element: [batch, features] (seq desc shapes already
+        # exclude the time axis; dense [B, T, ...] drops dim 1)
+        array.desc.shape = (list(x.shape) if x.lod_level
+                            else [x.shape[0]] + list(x.shape[2:]))
+        array.desc.dtype = x.dtype
     return array
 
 

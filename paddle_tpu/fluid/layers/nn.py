@@ -19,7 +19,8 @@ __all__ = [
     "batch_norm", "layer_norm", "reduce_sum", "reduce_mean", "reduce_max",
     "reduce_min", "reduce_prod", "reshape", "transpose", "matmul", "one_hot",
     "softmax_with_cross_entropy", "smooth_l1", "l2_normalize", "split",
-    "nce", "im2sequence",
+    "nce", "im2sequence", "beam_search", "beam_search_decode", "batch_gather",
+    "gather", "expand", "multiplex",
 ]
 
 
@@ -428,6 +429,78 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
     helper.append_op("im2sequence", {"X": input}, {"Out": out},
                      {"kernels": _pair(filter_size),
                       "strides": _pair(stride), "paddings": _pair(padding)})
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=False, name=None):
+    """One beam-search step — reference layers/nn.py beam_search:1801 /
+    beam_search_op.cc, re-laid-out on a dense [batch, beam] grid (see
+    ops/beam_ops.py).  Returns (selected_ids, selected_scores, parent_idx);
+    the extra parent_idx output replaces the LoD ancestry encoding."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_tmp_variable(pre_ids.dtype)
+    sel_scores = helper.create_tmp_variable("float32")
+    parent = helper.create_tmp_variable("int32")
+    sel_ids.stop_gradient = parent.stop_gradient = True
+    helper.append_op(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "ids": ids,
+         "scores": scores},
+        {"selected_ids": sel_ids, "selected_scores": sel_scores,
+         "parent_idx": parent},
+        {"beam_size": beam_size, "end_id": end_id, "level": level,
+         "is_accumulated": is_accumulated})
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, scores, parents, end_id, name=None):
+    """Backtrace beam arrays into ranked hypotheses — reference
+    beam_search_decode_op.cc (LoD backtrace becomes a reverse scan over the
+    explicit parent pointers)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_tmp_variable(ids.dtype)
+    sent_scores = helper.create_tmp_variable("float32")
+    sent_ids.stop_gradient = sent_scores.stop_gradient = True
+    helper.append_op(
+        "beam_search_decode",
+        {"Ids": ids, "Scores": scores, "Parents": parents},
+        {"SentenceIds": sent_ids, "SentenceScores": sent_scores},
+        {"end_id": end_id})
+    return sent_ids, sent_scores
+
+
+def batch_gather(x, index, name=None):
+    """out[b, j] = x[b, index[b, j]] — the dense-beam state reorder (the
+    reference reorders decoder state via LoD sequence_expand instead)."""
+    helper = LayerHelper("batch_gather", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("batch_gather", {"X": x, "Index": index}, {"Out": out})
+    return out
+
+
+def gather(input, index, name=None):
+    """reference gather_op.cc — rows of input by index."""
+    helper = LayerHelper("gather", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("gather", {"X": input, "Index": index}, {"Out": out})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    """reference expand_op.cc — tile each dim expand_times[i] times."""
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op("expand", {"X": x}, {"Out": out},
+                     {"expand_times": list(expand_times)})
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    """reference multiplex_op.cc — per-row select among candidate tensors."""
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_tmp_variable(inputs[0].dtype)
+    helper.append_op("multiplex", {"Ids": index, "X": inputs}, {"Out": out})
     return out
 
 

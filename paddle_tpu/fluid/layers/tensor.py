@@ -5,8 +5,9 @@ from __future__ import annotations
 from ..framework import Variable
 from ..layer_helper import LayerHelper
 
-__all__ = ["create_tensor", "create_global_var", "fill_constant", "zeros",
-           "ones", "concat", "sums", "assign", "cast", "argmax"]
+__all__ = ["create_tensor", "create_global_var", "fill_constant",
+           "fill_constant_batch_size_like", "zeros", "ones", "concat",
+           "sums", "assign", "cast", "argmax"]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -31,6 +32,20 @@ def fill_constant(shape, dtype, value, out=None, name=None):
     helper.append_op("fill_constant", {}, {"Out": out},
                      {"shape": list(shape), "dtype": dtype,
                       "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    """reference fill_constant_batch_size_like_op.cc."""
+    helper = LayerHelper("fill_constant_batch_size_like", name=name)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("fill_constant_batch_size_like", {"Input": input},
+                     {"Out": out},
+                     {"shape": list(shape), "dtype": dtype,
+                      "value": float(value), "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
     return out
 
 

@@ -6,6 +6,7 @@ USE_OP machinery (op_registry.h) becomes Python imports.
 
 from . import (  # noqa: F401
     activation_ops,
+    beam_ops,
     control_flow_ops,
     io_ops,
     crf_ops,

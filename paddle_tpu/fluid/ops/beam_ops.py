@@ -1,0 +1,123 @@
+"""Beam search ops — dense [batch, beam] layout.
+
+TPU-native replacement for the reference's beam_search_op.cc +
+beam_search_decode_op.cc.  The reference threads variable-width beams
+through 2-level LoD tensors (each source sentence owns a variable slice of
+candidates) and prunes finished hypotheses by shrinking the LoD; that is
+pure dynamic shape, which XLA cannot compile.  Here every step works on a
+static [batch, beam] grid:
+
+* candidate expansion is [batch, beam, K] -> flat top-k over beam*K;
+* finished beams (pre_id == end_id) contribute exactly one candidate —
+  end_id at their unchanged accumulated score — so they survive ranking
+  without growing (the analog of the reference keeping finished items in
+  the beam);
+* hypothesis ancestry is an explicit ParentIdx tensor per step (the
+  reference encodes ancestry in the LoD structure); beam_search_decode
+  backtraces parent pointers with a reverse lax.scan.
+
+The whole decode loop therefore jit-compiles into one XLA while loop with
+static shapes — no host round-trips per step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import primitive
+
+NEG_INF = -1e9
+
+
+@primitive("beam_search",
+           inputs=["pre_ids", "pre_scores", "ids", "scores"],
+           outputs=["selected_ids", "selected_scores", "parent_idx"],
+           no_grad=True)
+def beam_search(ctx, pre_ids, pre_scores, ids, scores):
+    """One beam-search step (reference beam_search_op.cc:Operator).
+
+    pre_ids/pre_scores: [B, W] current beam tokens + accumulated log-probs.
+    ids/scores: [B, W, K] top-K candidate tokens + their probabilities
+    (post-softmax, like the reference; set attr is_accumulated=True if the
+    scores are already accumulated log-probs)."""
+    beam_size = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    accumulated = bool(ctx.attr("is_accumulated", False))
+
+    B, W, K = scores.shape
+    if accumulated:
+        total = scores
+    else:
+        total = pre_scores[..., None] + jnp.log(
+            jnp.clip(scores.astype(jnp.float32), 1e-12, None))
+
+    finished = (pre_ids == end_id)                       # [B, W]
+    # a finished beam's only candidate: end_id at its frozen score
+    only = jnp.zeros((B, W, K), bool).at[:, :, 0].set(True)
+    total = jnp.where(finished[..., None],
+                      jnp.where(only, pre_scores[..., None], NEG_INF),
+                      total)
+    ids = jnp.where(finished[..., None], end_id, ids)
+
+    flat = total.reshape(B, W * K)
+    sel_scores, flat_idx = jax.lax.top_k(flat, beam_size)   # [B, beam]
+    parent = (flat_idx // K).astype(jnp.int32)
+    sel_ids = jnp.take_along_axis(ids.reshape(B, W * K),
+                                  flat_idx, axis=1).astype(pre_ids.dtype)
+    return sel_ids, sel_scores, parent
+
+
+@primitive("beam_search_decode",
+           inputs=["Ids", "Scores", "Parents"],
+           outputs=["SentenceIds", "SentenceScores"], no_grad=True)
+def beam_search_decode(ctx, ids_arr, scores_arr, parents_arr):
+    """Backtrace the per-step (ids, parents) arrays into full hypotheses
+    (reference beam_search_decode_op.cc).
+
+    Array layout (written by the decode loop): index 0 holds the init
+    tokens; index t>=1 holds step t's selected ids/scores/parents.  Returns
+    SentenceIds [B, W, T-1] (init token dropped, end_id padded) and
+    SentenceScores [B, W], beams sorted best-first."""
+    end_id = int(ctx.attr("end_id"))
+    ids = ids_arr.data          # [T, B, W]
+    parents = parents_arr.data  # [T, B, W] int32
+    scores = scores_arr.data    # [T, B, W]
+    T, B, W = ids.shape
+
+    final_scores = scores[T - 1]                       # [B, W]
+    # backtrace from the last step to step 1
+    cursor0 = jnp.tile(jnp.arange(W, dtype=jnp.int32)[None, :], (B, 1))
+
+    def back(cursor, t):
+        tok = jnp.take_along_axis(ids[t], cursor, axis=1)       # [B, W]
+        prev = jnp.take_along_axis(parents[t], cursor, axis=1)
+        return prev, tok
+
+    steps = jnp.arange(T - 1, 0, -1)
+    _, toks = jax.lax.scan(back, cursor0, steps)       # [T-1, B, W] reversed
+    toks = toks[::-1]
+    sents = jnp.moveaxis(toks, 0, -1)                  # [B, W, T-1]
+
+    # trim: everything after the first end_id becomes end_id padding
+    is_end = (sents == end_id)
+    seen = jnp.cumsum(is_end.astype(jnp.int32), axis=-1)
+    sents = jnp.where(seen > 1, end_id, sents)
+
+    # order beams best-first by final accumulated score
+    order = jnp.argsort(-final_scores, axis=1)         # [B, W]
+    sents = jnp.take_along_axis(sents, order[..., None], axis=1)
+    final_scores = jnp.take_along_axis(final_scores, order, axis=1)
+    return sents, final_scores
+
+
+@primitive("batch_gather", inputs=["X", "Index"], stop_grad_slots=("Index",))
+def batch_gather(ctx, x, index):
+    """Reorder along axis 1 by per-batch indices: out[b, j] = x[b, index[b,j]].
+
+    The dense-beam analog of the reference's LoD-expansion state reorder in
+    the decode loop (test_machine_translation.py's sequence_expand of
+    pre_state); gradient is the scatter-add transpose, native on TPU."""
+    idx = index.astype(jnp.int32)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - idx.ndim))
+    return jnp.take_along_axis(x, idx, axis=1)

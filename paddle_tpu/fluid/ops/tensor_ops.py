@@ -31,6 +31,18 @@ def fill_constant(ctx, *_):
                     dtype=_rt_dtype(ctx.attr("dtype", "float32")))
 
 
+@primitive("fill_constant_batch_size_like", inputs=["Input"], no_grad=True)
+def fill_constant_batch_size_like(ctx, ref):
+    """reference fill_constant_batch_size_like_op.cc — constant fill whose
+    output_dim_idx dim copies the reference input's input_dim_idx dim."""
+    data = ref.data if isinstance(ref, SeqArray) else ref
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = \
+        data.shape[ctx.attr("input_dim_idx", 0)]
+    return jnp.full(tuple(shape), ctx.attr("value", 0.0),
+                    dtype=_rt_dtype(ctx.attr("dtype", "float32")))
+
+
 @primitive("fill_zeros_like", no_grad=True)
 def fill_zeros_like(ctx, x):
     return jnp.zeros_like(x)

@@ -1,0 +1,144 @@
+"""Machine translation — book ch.08
+(fluid/tests/book/test_machine_translation.py): LSTM encoder, DynamicRNN
+decoder for training, and a While-loop beam-search decoder for inference.
+
+The decode loop follows the reference program shape (arrays carried through
+a While, topk -> beam_search -> array_write each step) but on the dense
+[batch, beam] layout: hypothesis ancestry is an explicit parent-pointer
+tensor instead of 2-level LoD, and decoder state is reordered with
+batch_gather instead of LoD sequence_expand.  The whole loop compiles to a
+single XLA while loop on TPU.
+"""
+
+from __future__ import annotations
+
+from ..fluid import ParamAttr, layers
+
+__all__ = ["encoder", "decoder_train", "decoder_decode", "train_model",
+           "decode_model"]
+
+
+def encoder(src_word, dict_size, word_dim=16, hidden_dim=32,
+            emb_name="src_emb"):
+    """Uni-directional LSTM encoder; returns the last hidden state [B, H]."""
+    src_embedding = layers.embedding(
+        input=src_word, size=[dict_size, word_dim],
+        param_attr=ParamAttr(name=emb_name))
+    fc1 = layers.fc(input=src_embedding, size=hidden_dim * 4, act="tanh")
+    lstm_hidden, _ = layers.dynamic_lstm(input=fc1, size=hidden_dim * 4)
+    return layers.sequence_last_step(input=lstm_hidden)
+
+
+def decoder_train(context, trg_word, dict_size, word_dim=16, decoder_size=32,
+                  emb_name="trg_emb"):
+    """Teacher-forced DynamicRNN decoder; returns per-step vocab softmax."""
+    trg_embedding = layers.embedding(
+        input=trg_word, size=[dict_size, word_dim],
+        param_attr=ParamAttr(name=emb_name))
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(trg_embedding)
+        pre_state = rnn.memory(init=context)
+        current_state = layers.fc(input=[current_word, pre_state],
+                                  size=decoder_size, act="tanh")
+        current_score = layers.fc(input=current_state, size=dict_size,
+                                  act="softmax")
+        rnn.update_memory(pre_state, current_state)
+        rnn.output(current_score)
+    return rnn()
+
+
+def train_model(src_word, trg_word, trg_next_word, dict_size, word_dim=16,
+                hidden_dim=32):
+    """Full training graph: encoder + decoder + length-masked CE loss."""
+    context = encoder(src_word, dict_size, word_dim, hidden_dim)
+    rnn_out = decoder_train(context, trg_word, dict_size, word_dim,
+                            decoder_size=hidden_dim)
+    cost = layers.cross_entropy(input=rnn_out, label=trg_next_word)
+    # per-sequence sum (masked by lengths), then batch mean — padding
+    # contributes nothing, the analog of LoD's pad-free loss
+    seq_cost = layers.sequence_pool(input=cost, pool_type="sum")
+    avg_cost = layers.mean(seq_cost)
+    return avg_cost, rnn_out
+
+
+def decoder_decode(context, dict_size, word_dim=16, decoder_size=32,
+                   beam_size=2, topk_size=50, max_length=8, start_id=0,
+                   end_id=1, emb_name="trg_emb"):
+    """Beam-search decoding loop (reference decoder_decode) on the dense
+    [batch, beam] grid; returns (translation_ids [B, W, T],
+    translation_scores [B, W])."""
+    W = beam_size
+    counter = layers.zeros(shape=[1], dtype="int64")
+    counter.stop_gradient = True
+    array_len = layers.fill_constant(shape=[1], dtype="int64",
+                                     value=max_length)
+    array_len.stop_gradient = True
+    cap = max_length + 1
+
+    # [B, W, H] decoder state, each beam starting from the encoder context
+    state0 = layers.expand(
+        layers.reshape(context, [-1, 1, decoder_size]), [1, W, 1])
+    state_array = layers.array_write(state0, i=counter, capacity=cap)
+
+    # [B, W] beams: all start tokens; only beam 0 live (others at -1e9)
+    init_ids = layers.fill_constant_batch_size_like(
+        context, shape=[-1, W], dtype="int64", value=float(start_id))
+    init_ids.stop_gradient = True
+    live0 = layers.fill_constant_batch_size_like(
+        context, shape=[-1, 1], dtype="float32", value=0.0)
+    dead = layers.fill_constant_batch_size_like(
+        context, shape=[-1, W - 1], dtype="float32", value=-1e9)
+    init_scores = layers.concat([live0, dead], axis=1)
+    init_parents = layers.fill_constant_batch_size_like(
+        context, shape=[-1, W], dtype="int32", value=0.0)
+    init_parents.stop_gradient = True
+
+    ids_array = layers.array_write(init_ids, i=counter, capacity=cap)
+    scores_array = layers.array_write(init_scores, i=counter, capacity=cap)
+    parents_array = layers.array_write(init_parents, i=counter, capacity=cap)
+
+    cond = layers.less_than(x=counter, y=array_len)
+    while_op = layers.While(cond=cond)
+    with while_op.block():
+        pre_ids = layers.array_read(array=ids_array, i=counter)
+        pre_scores = layers.array_read(array=scores_array, i=counter)
+        pre_state = layers.array_read(array=state_array, i=counter)
+
+        pre_ids_emb = layers.embedding(
+            input=pre_ids, size=[dict_size, word_dim],
+            param_attr=ParamAttr(name=emb_name))
+
+        current_state = layers.fc(input=[pre_ids_emb, pre_state],
+                                  size=decoder_size, act="tanh",
+                                  num_flatten_dims=2)
+        current_score = layers.fc(input=current_state, size=dict_size,
+                                  act="softmax", num_flatten_dims=2)
+        topk_scores, topk_indices = layers.topk(current_score, k=topk_size)
+        selected_ids, selected_scores, parent_idx = layers.beam_search(
+            pre_ids, pre_scores, topk_indices, topk_scores, W,
+            end_id=end_id)
+        new_state = layers.batch_gather(current_state, parent_idx)
+
+        layers.increment(x=counter, value=1, in_place=True)
+        layers.array_write(new_state, array=state_array, i=counter)
+        layers.array_write(selected_ids, array=ids_array, i=counter)
+        layers.array_write(selected_scores, array=scores_array, i=counter)
+        layers.array_write(parent_idx, array=parents_array, i=counter)
+
+        layers.less_than(x=counter, y=array_len, cond=cond)
+
+    translation_ids, translation_scores = layers.beam_search_decode(
+        ids=ids_array, scores=scores_array, parents=parents_array,
+        end_id=end_id)
+    return translation_ids, translation_scores
+
+
+def decode_model(src_word, dict_size, word_dim=16, hidden_dim=32,
+                 beam_size=2, topk_size=50, max_length=8, start_id=0,
+                 end_id=1):
+    context = encoder(src_word, dict_size, word_dim, hidden_dim)
+    return decoder_decode(context, dict_size, word_dim,
+                          decoder_size=hidden_dim, beam_size=beam_size,
+                          topk_size=topk_size, max_length=max_length,
+                          start_id=start_id, end_id=end_id)

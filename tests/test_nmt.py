@@ -1,0 +1,134 @@
+"""NMT tests — mirror of the reference book tests
+test_machine_translation.py / test_rnn_encoder_decoder.py plus
+test_beam_search_op.py / test_beam_search_decode_op.py."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.core.lod import make_seq
+from paddle_tpu.models import machine_translation as mt
+from paddle_tpu.models import rnn_encoder_decoder as red
+
+DICT = 12
+START, END = 0, 1
+
+
+def _toy_batch(rng, batch=4, min_len=3, max_len=5):
+    srcs, trgs, nexts = [], [], []
+    for _ in range(batch):
+        n = rng.randint(min_len, max_len + 1)
+        s = rng.randint(2, DICT, n)
+        srcs.append(s)
+        trgs.append(np.concatenate([[START], s]))
+        nexts.append(np.concatenate([s, [END]]))
+    return (make_seq(srcs, dtype=np.int64),
+            make_seq(trgs, dtype=np.int64),
+            make_seq(nexts, dtype=np.int64))
+
+
+def test_beam_search_step(fresh_programs):
+    """reference test_beam_search_op.py: one step selects the top beams and
+    freezes finished hypotheses."""
+    main, startup, scope = fresh_programs
+    pre_ids = fluid.layers.data(name="pre_ids", shape=[2], dtype="int64")
+    pre_scores = fluid.layers.data(name="pre_scores", shape=[2],
+                                   dtype="float32")
+    ids = fluid.layers.data(name="ids", shape=[2, 3], dtype="int64")
+    scores = fluid.layers.data(name="scores", shape=[2, 3], dtype="float32")
+    sel_ids, sel_scores, parent = layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=2, end_id=END)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # batch of 1, beam 2; beam 1 already finished (END)
+    pre_ids_v = np.array([[5, END]], np.int64)
+    pre_scores_v = np.array([[-1.0, -0.5]], np.float32)
+    ids_v = np.array([[[3, 4, 2], [7, 8, 9]]], np.int64)
+    scores_v = np.array([[[0.6, 0.3, 0.1], [0.5, 0.4, 0.1]]], np.float32)
+    si, ss, pa = exe.run(
+        main, feed={"pre_ids": pre_ids_v, "pre_scores": pre_scores_v,
+                    "ids": ids_v, "scores": scores_v},
+        fetch_list=[sel_ids, sel_scores, parent])
+    si, ss, pa = map(np.asarray, (si, ss, pa))
+    # finished beam keeps END at score -0.5 (best); live beam's best
+    # candidate: -1 + log(0.6) ~ -1.51
+    assert si[0, 0] == END and pa[0, 0] == 1
+    np.testing.assert_allclose(ss[0, 0], -0.5, rtol=1e-5)
+    assert si[0, 1] == 3 and pa[0, 1] == 0
+    np.testing.assert_allclose(ss[0, 1], -1.0 + np.log(0.6), rtol=1e-5)
+
+
+def test_machine_translation_train(fresh_programs):
+    main, startup, scope = fresh_programs
+    src = fluid.layers.data(name="src", shape=[1], dtype="int64", lod_level=1)
+    trg = fluid.layers.data(name="trg", shape=[1], dtype="int64", lod_level=1)
+    nxt = fluid.layers.data(name="nxt", shape=[1], dtype="int64", lod_level=1)
+    avg_cost, _ = mt.train_model(src, trg, nxt, DICT, word_dim=8,
+                                 hidden_dim=16)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    sa, ta, na = _toy_batch(rng)
+    first = last = None
+    for i in range(30):
+        lv, = exe.run(main, feed={"src": sa, "trg": ta, "nxt": na},
+                      fetch_list=[avg_cost])
+        lv = float(np.asarray(lv))
+        if first is None:
+            first = lv
+        last = lv
+    assert np.isfinite(last)
+    assert last < first * 0.7, (first, last)
+
+
+def test_machine_translation_decode(fresh_programs):
+    main, startup, scope = fresh_programs
+    src = fluid.layers.data(name="src", shape=[1], dtype="int64", lod_level=1)
+    ids, scores = mt.decode_model(src, DICT, word_dim=8, hidden_dim=16,
+                                  beam_size=3, topk_size=5, max_length=6,
+                                  start_id=START, end_id=END)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    sa, _, _ = _toy_batch(rng, batch=2)
+    iv, sv = exe.run(main, feed={"src": sa}, fetch_list=[ids, scores])
+    iv, sv = np.asarray(iv), np.asarray(sv)
+    assert iv.shape == (2, 3, 6)
+    assert sv.shape == (2, 3)
+    assert np.isfinite(sv).all()
+    # beams ranked best-first
+    assert (np.diff(sv, axis=1) <= 1e-6).all()
+    # tokens in range; after first END only END (trim semantics)
+    assert ((iv >= 0) & (iv < DICT)).all()
+    for b in range(2):
+        for w in range(3):
+            row = iv[b, w]
+            hits = np.where(row == END)[0]
+            if hits.size:
+                assert (row[hits[0]:] == END).all()
+
+
+def test_rnn_encoder_decoder_train(fresh_programs):
+    main, startup, scope = fresh_programs
+    src = fluid.layers.data(name="src", shape=[1], dtype="int64", lod_level=1)
+    trg = fluid.layers.data(name="trg", shape=[1], dtype="int64", lod_level=1)
+    lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64", lod_level=1)
+    avg_cost, _ = red.seq_to_seq_net(src, trg, lbl, DICT, DICT,
+                                     embedding_dim=8, encoder_size=8,
+                                     decoder_size=8)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    sa, ta, na = _toy_batch(rng)
+    first = last = None
+    for i in range(25):
+        lv, = exe.run(main, feed={"src": sa, "trg": ta, "lbl": na},
+                      fetch_list=[avg_cost])
+        lv = float(np.asarray(lv))
+        if first is None:
+            first = lv
+        last = lv
+    assert np.isfinite(last) and last < first * 0.8, (first, last)
