@@ -124,9 +124,12 @@ def scope_guard(scope: Scope):
 
 def _as_feed_value(v):
     """Normalise one feed entry to a device-ready value (int64/f64 narrowed to
-    JAX defaults)."""
+    JAX defaults).  Device-resident arrays pass through untouched — feeding a
+    jax.Array skips the per-step H2D transfer (device-side input pipelines)."""
     if isinstance(v, SeqArray):
         return SeqArray(_as_feed_value(v.data), np.asarray(v.lengths, np.int32))
+    if isinstance(v, jax.Array):
+        return v
     a = np.asarray(v)
     if a.dtype == np.int64:
         a = a.astype(np.int32)
@@ -136,8 +139,13 @@ def _as_feed_value(v):
 
 
 def _sig_of(v):
+    # shape/dtype only — must NOT materialise device arrays (np.asarray on a
+    # device value is a D2H transfer; doing that per state var per step would
+    # ship every parameter to the host each iteration)
     if isinstance(v, SeqArray):
-        return ("seq",) + tuple(v.data.shape) + (str(np.asarray(v.data).dtype),)
+        return ("seq",) + tuple(v.data.shape) + (str(v.data.dtype),)
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return tuple(v.shape) + (str(v.dtype),)
     a = np.asarray(v)
     return tuple(a.shape) + (str(a.dtype),)
 
