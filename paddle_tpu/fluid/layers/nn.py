@@ -20,7 +20,7 @@ __all__ = [
     "reduce_min", "reduce_prod", "reshape", "transpose", "matmul", "one_hot",
     "softmax_with_cross_entropy", "smooth_l1", "l2_normalize", "split",
     "nce", "im2sequence", "beam_search", "beam_search_decode", "batch_gather",
-    "gather", "expand", "multiplex",
+    "gather", "expand", "multiplex", "fused_attention",
 ]
 
 
@@ -520,4 +520,24 @@ def _append_channel_bias(helper, pre_bias):
     out = helper.create_tmp_variable(pre_bias.dtype)
     helper.append_op("elementwise_add", {"X": pre_bias, "Y": b},
                      {"Out": out}, {"axis": 1})
+    return out
+
+
+def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                    seq_parallel=False, impl=None, name=None):
+    """Fused scaled-dot-product attention over [b, h, l, d] tensors — flash
+    attention on one chip, ring attention over an 'sp' mesh axis when
+    ``seq_parallel`` and the active mesh shard the sequence.  O(L) memory,
+    unlike the matmul+softmax composition which materialises [lq, lk]."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype)
+    inputs = {"Q": q, "K": k, "V": v}
+    if bias is not None:
+        inputs["Bias"] = bias
+    attrs = {"causal": bool(causal), "seq_parallel": bool(seq_parallel)}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    if impl is not None:
+        attrs["impl"] = impl
+    helper.append_op("fused_attention", inputs, {"Out": out}, attrs)
     return out

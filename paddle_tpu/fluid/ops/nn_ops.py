@@ -206,3 +206,34 @@ def im2sequence(ctx, x):
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     b, f, oh, ow = patches.shape
     return patches.reshape(b, f, oh * ow).transpose(0, 2, 1)
+
+
+@primitive("fused_attention", inputs=["Q", "K", "V", "Bias?"],
+           outputs=["Out"])
+def fused_attention(ctx, q, k, v, bias):
+    """Fused scaled-dot-product attention over [b, h, l, d] tensors.
+
+    The TPU replacement for the reference's explicit matmul->softmax->matmul
+    attention composition (its Transformer config builds [lq, lk] score
+    tensors) — O(L) memory via the Pallas flash kernel
+    (paddle_tpu/kernels/flash_attention.py).  With an active mesh that has a
+    sequence axis, lowers to ring attention over the ICI instead
+    (kernels/ring_attention.py) — sequence parallelism the 2018 reference
+    had no analog for.
+    """
+    from ...kernels import flash_attention as _flash
+    from ...kernels import ring_attention_sharded as _ring
+
+    causal = ctx.attr("causal", False)
+    sm_scale = ctx.attr("sm_scale", None)
+    impl = ctx.attr("impl", None)
+    from ...parallel import mesh as _pmesh
+
+    mesh = _pmesh.current_mesh()
+    if ctx.attr("seq_parallel", False) and mesh is not None \
+            and "sp" in mesh.axis_names:
+        return _ring(mesh, q, k, v, bias=bias, causal=causal,
+                     sm_scale=sm_scale,
+                     dp_axis="dp", mp_axis="mp", sp_axis="sp")
+    return _flash(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale,
+                  impl=impl)

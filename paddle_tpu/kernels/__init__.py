@@ -1,0 +1,15 @@
+"""Hand-written TPU kernels (Pallas) + sequence-parallel attention.
+
+The reference's hot custom kernels live in paddle/cuda/src/hl_cuda_*.cu and
+paddle/operators/math/ (fused LSTM, im2col, softmax...).  On TPU, XLA fusion
+covers almost all of those; what it cannot do is (a) O(L) - memory attention
+over long sequences (flash attention) and (b) attention over a sequence
+sharded across chips (ring attention over the ICI) — the modern counterpart
+of the reference's variable-length-efficiency machinery (LoD batching,
+RecurrentGradientMachine).  These are the Pallas kernels.
+"""
+
+from .flash_attention import flash_attention
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = ["flash_attention", "ring_attention", "ring_attention_sharded"]

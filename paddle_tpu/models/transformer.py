@@ -33,7 +33,7 @@ def _row_attr(mp_shard):
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head=1, dropout_rate=0.0,
-                         mp_shard=False):
+                         mp_shard=False, fused=False, seq_parallel=False):
     """Reference-shape MHA: project, split heads, scaled dot-product with
     additive bias, merge heads, output projection."""
     q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
@@ -53,14 +53,28 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     k = split_heads(k, d_key)
     v = split_heads(v, d_value)
 
-    q = layers.scale(q, scale=float(d_key) ** -0.5)
-    product = layers.matmul(q, k, transpose_y=True)   # [b, h, lq, lk]
-    if attn_bias is not None:
-        product = layers.elementwise_add(product, attn_bias)
-    weights = layers.softmax(product)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    ctx = layers.matmul(weights, v)                   # [b, h, lq, dv]
+    if fused:
+        # flash/ring kernel path: O(L) memory, no [lq, lk] score tensor.
+        if dropout_rate:
+            import warnings
+
+            warnings.warn(
+                "fused attention does not apply attention-probability "
+                "dropout (the probabilities never exist as a tensor); "
+                f"dropout_rate={dropout_rate} is ignored inside attention",
+                stacklevel=2)
+        ctx = layers.fused_attention(q, k, v, bias=attn_bias,
+                                     sm_scale=float(d_key) ** -0.5,
+                                     seq_parallel=seq_parallel)
+    else:
+        q = layers.scale(q, scale=float(d_key) ** -0.5)
+        product = layers.matmul(q, k, transpose_y=True)   # [b, h, lq, lk]
+        if attn_bias is not None:
+            product = layers.elementwise_add(product, attn_bias)
+        weights = layers.softmax(product)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate)
+        ctx = layers.matmul(weights, v)                   # [b, h, lq, dv]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     b, l = ctx.shape[0], ctx.shape[1]
     ctx = layers.reshape(ctx, [-1 if b == -1 else b, l, n_head * d_value])
@@ -88,10 +102,11 @@ def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
 
 
 def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
-                  d_inner_hid, dropout_rate=0.0, mp_shard=False):
+                  d_inner_hid, dropout_rate=0.0, mp_shard=False,
+                  fused=False, seq_parallel=False):
     attn_output = multi_head_attention(
         enc_input, enc_input, enc_input, attn_bias, d_key, d_value, d_model,
-        n_head, dropout_rate, mp_shard)
+        n_head, dropout_rate, mp_shard, fused, seq_parallel)
     attn_output = pre_post_process_layer(enc_input, attn_output, "dan",
                                          dropout_rate)
     ffd_output = positionwise_feed_forward(attn_output, d_inner_hid, d_model,
@@ -101,25 +116,30 @@ def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
 
 
 def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
-            d_inner_hid, dropout_rate=0.0, mp_shard=False):
+            d_inner_hid, dropout_rate=0.0, mp_shard=False, fused=False,
+            seq_parallel=False):
     for _ in range(n_layer):
         enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
                                   d_value, d_model, d_inner_hid,
-                                  dropout_rate, mp_shard)
+                                  dropout_rate, mp_shard, fused,
+                                  seq_parallel)
     return enc_input
 
 
 def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                   n_head, d_key, d_value, d_model, d_inner_hid,
-                  dropout_rate=0.0, mp_shard=False):
+                  dropout_rate=0.0, mp_shard=False, fused=False,
+                  seq_parallel=False):
     slf_attn = multi_head_attention(dec_input, dec_input, dec_input,
                                     slf_attn_bias, d_key, d_value, d_model,
-                                    n_head, dropout_rate, mp_shard)
+                                    n_head, dropout_rate, mp_shard, fused,
+                                    seq_parallel)
     slf_attn = pre_post_process_layer(dec_input, slf_attn, "dan",
                                       dropout_rate)
     cross = multi_head_attention(slf_attn, enc_output, enc_output,
                                  dec_enc_attn_bias, d_key, d_value, d_model,
-                                 n_head, dropout_rate, mp_shard)
+                                 n_head, dropout_rate, mp_shard, fused,
+                                 seq_parallel)
     cross = pre_post_process_layer(slf_attn, cross, "dan", dropout_rate)
     ffd = positionwise_feed_forward(cross, d_inner_hid, d_model, mp_shard)
     return pre_post_process_layer(cross, ffd, "dan", dropout_rate)
@@ -127,12 +147,13 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
 
 def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
-            dropout_rate=0.0, mp_shard=False):
+            dropout_rate=0.0, mp_shard=False, fused=False,
+            seq_parallel=False):
     for _ in range(n_layer):
         dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
                                   dec_enc_attn_bias, n_head, d_key, d_value,
                                   d_model, d_inner_hid, dropout_rate,
-                                  mp_shard)
+                                  mp_shard, fused, seq_parallel)
     return dec_input
 
 
@@ -151,17 +172,20 @@ def prepare_embedding(word_ids, pos_ids, vocab_size, max_length, d_model,
 
 def wrap_encoder(src_word, src_pos, src_slf_attn_bias, src_vocab_size,
                  max_length, n_layer, n_head, d_key, d_value, d_model,
-                 d_inner_hid, dropout_rate=0.0, mp_shard=False):
+                 d_inner_hid, dropout_rate=0.0, mp_shard=False, fused=False,
+                 seq_parallel=False):
     emb = prepare_embedding(src_word, src_pos, src_vocab_size, max_length,
                             d_model, dropout_rate)
     return encoder(emb, src_slf_attn_bias, n_layer, n_head, d_key, d_value,
-                   d_model, d_inner_hid, dropout_rate, mp_shard)
+                   d_model, d_inner_hid, dropout_rate, mp_shard, fused,
+                   seq_parallel)
 
 
 def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                 n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner_hid=2048, dropout_rate=0.1, src_seq_len=32,
-                trg_seq_len=32, mp_shard=False):
+                trg_seq_len=32, mp_shard=False, fused=False,
+                seq_parallel=False):
     """Build the full training graph; returns (avg_cost, predict, feed_vars).
 
     Data vars (dense, static seq lens — bucket on the host side):
@@ -185,12 +209,13 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
     enc_output = wrap_encoder(src_word, src_pos, src_slf_attn_bias,
                               src_vocab_size, max_length, n_layer, n_head,
                               d_key, d_value, d_model, d_inner_hid,
-                              dropout_rate, mp_shard)
+                              dropout_rate, mp_shard, fused, seq_parallel)
     dec_emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size,
                                 max_length, d_model, dropout_rate)
     dec_output = decoder(dec_emb, enc_output, trg_slf_attn_bias,
                          trg_src_attn_bias, n_layer, n_head, d_key, d_value,
-                         d_model, d_inner_hid, dropout_rate, mp_shard)
+                         d_model, d_inner_hid, dropout_rate, mp_shard,
+                         fused, seq_parallel)
     predict = layers.fc(input=dec_output, size=trg_vocab_size,
                         num_flatten_dims=2, bias_attr=False,
                         param_attr=_col_attr(mp_shard))
