@@ -1,0 +1,321 @@
+// Graph IR: native Program/Block/Op/Var model + validation + scheduling +
+// liveness-based memory planning.
+//
+// TPU-native counterpart of the reference's C++ desc layer
+// (paddle/framework/program_desc.cc, block_desc.cc, op_desc.cc,
+// var_desc.cc), its executor's per-block walk (executor.cc:77), and the
+// Python memory_optimization_transpiler's ControlFlowGraph liveness pass
+// (python/paddle/v2/fluid/memory_optimization_transpiler.py:33,90) — here a
+// native analysis the Python side calls through ctypes.  Where the reference
+// executor *runs* ops in block order, the TPU executor compiles whole blocks
+// with XLA; what remains native is what must be fast and host-side: parsing,
+// validation, topological scheduling, liveness/reuse planning.
+
+#include <algorithm>
+#include <queue>
+#include <set>
+#include <unordered_map>
+#include <unordered_set>
+
+#include "json.h"
+
+namespace ptpu {
+
+struct VarDesc {
+  std::string name, type, dtype;
+  std::vector<int64_t> shape;
+  bool has_shape = false;
+  bool persistable = false;
+};
+
+struct OpDesc {
+  std::string type;
+  // slot -> ordered var names
+  std::map<std::string, std::vector<std::string>> inputs, outputs;
+  JsonPtr attrs;  // opaque; block refs = {"__block__": idx}
+
+  std::vector<std::string> all_inputs() const {
+    std::vector<std::string> v;
+    for (auto& kv : inputs) v.insert(v.end(), kv.second.begin(),
+                                     kv.second.end());
+    return v;
+  }
+  std::vector<std::string> all_outputs() const {
+    std::vector<std::string> v;
+    for (auto& kv : outputs) v.insert(v.end(), kv.second.begin(),
+                                      kv.second.end());
+    return v;
+  }
+  std::vector<int> block_attrs() const {
+    std::vector<int> out;
+    if (attrs && attrs->type == Json::OBJECT) {
+      for (auto& kv : attrs->obj) {
+        if (kv.second->type == Json::OBJECT) {
+          auto b = kv.second->get("__block__");
+          if (b && b->type == Json::INT) out.push_back((int)b->i);
+        }
+      }
+    }
+    return out;
+  }
+};
+
+struct BlockDesc {
+  int idx = 0, parent_idx = -1;
+  std::map<std::string, VarDesc> vars;
+  std::vector<OpDesc> ops;
+};
+
+struct ProgramDesc {
+  int version = 1;
+  std::vector<BlockDesc> blocks;
+};
+
+// ---------------------------------------------------------------------------
+// parse / serialize (canonical JSON wire format shared with desc.py)
+// ---------------------------------------------------------------------------
+
+static VarDesc parse_var(const JsonPtr& j) {
+  VarDesc v;
+  v.name = j->at("name")->s;
+  v.type = j->at("type")->s;
+  v.dtype = j->at("dtype")->s;
+  auto sh = j->get("shape");
+  if (sh && sh->type == Json::ARRAY) {
+    v.has_shape = true;
+    for (auto& e : sh->arr) v.shape.push_back(e->i);
+  }
+  auto p = j->get("persistable");
+  v.persistable = p && p->type == Json::BOOL && p->b;
+  return v;
+}
+
+static OpDesc parse_op(const JsonPtr& j) {
+  OpDesc op;
+  op.type = j->at("type")->s;
+  for (auto* slot_map : {std::make_pair("inputs", &op.inputs),
+                         std::make_pair("outputs", &op.outputs)}) {
+  }
+  auto ins = j->get("inputs");
+  if (ins)
+    for (auto& kv : ins->obj) {
+      auto& lst = op.inputs[kv.first];
+      for (auto& e : kv.second->arr) lst.push_back(e->s);
+    }
+  auto outs = j->get("outputs");
+  if (outs)
+    for (auto& kv : outs->obj) {
+      auto& lst = op.outputs[kv.first];
+      for (auto& e : kv.second->arr) lst.push_back(e->s);
+    }
+  op.attrs = j->get("attrs");
+  return op;
+}
+
+ProgramDesc parse_program(const std::string& text) {
+  JsonParser p(text);
+  JsonPtr root = p.parse();
+  ProgramDesc prog;
+  prog.blocks.clear();
+  auto ver = root->get("version");
+  if (ver && ver->type == Json::INT) prog.version = (int)ver->i;
+  for (auto& bj : root->at("blocks")->arr) {
+    BlockDesc b;
+    b.idx = (int)bj->at("idx")->i;
+    auto pi = bj->get("parent_idx");
+    b.parent_idx = pi ? (int)pi->i : -1;
+    auto vars = bj->get("vars");
+    if (vars)
+      for (auto& kv : vars->obj) b.vars[kv.first] = parse_var(kv.second);
+    auto ops = bj->get("ops");
+    if (ops)
+      for (auto& oj : ops->arr) b.ops.push_back(parse_op(oj));
+    prog.blocks.push_back(std::move(b));
+  }
+  return prog;
+}
+
+// rebuild the Json tree from the parsed model and write canonically; note
+// vars' full field set must survive, so we keep the original var/op attr
+// subtrees when round-tripping.  For byte-exact round trips we simply
+// re-serialize the *parsed JSON tree* (not the typed model).
+std::string reserialize(const std::string& text) {
+  JsonParser p(text);
+  JsonPtr root = p.parse();
+  std::string out;
+  write_json(root, &out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// validation — the analog of the reference's OpDesc::CheckAttrs/InferShape
+// pre-flight and executor var-existence checks (executor.cc:36-75)
+// ---------------------------------------------------------------------------
+
+std::vector<std::string> validate_program(const ProgramDesc& prog) {
+  std::vector<std::string> errors;
+  int nblocks = (int)prog.blocks.size();
+  if (nblocks == 0) {
+    errors.push_back("program has no blocks");
+    return errors;
+  }
+  for (auto& b : prog.blocks) {
+    if (b.parent_idx >= nblocks)
+      errors.push_back("block " + std::to_string(b.idx) +
+                       ": parent_idx out of range");
+    // a var is visible if declared in this block or an ancestor
+    auto visible = [&](const std::string& name) {
+      const BlockDesc* cur = &b;
+      while (cur) {
+        if (cur->vars.count(name)) return true;
+        cur = cur->parent_idx >= 0 && cur->parent_idx < nblocks
+                  ? &prog.blocks[cur->parent_idx]
+                  : nullptr;
+      }
+      return false;
+    };
+    for (size_t oi = 0; oi < b.ops.size(); ++oi) {
+      const OpDesc& op = b.ops[oi];
+      std::string where = "block " + std::to_string(b.idx) + " op#" +
+                          std::to_string(oi) + " (" + op.type + ")";
+      if (op.type.empty()) errors.push_back(where + ": empty op type");
+      for (auto& n : op.all_inputs())
+        if (!n.empty() && !visible(n))
+          errors.push_back(where + ": input var '" + n + "' not declared");
+      for (auto& n : op.all_outputs())
+        if (!n.empty() && !visible(n))
+          errors.push_back(where + ": output var '" + n + "' not declared");
+      for (int bi : op.block_attrs())
+        if (bi < 0 || bi >= nblocks)
+          errors.push_back(where + ": sub-block index " + std::to_string(bi) +
+                           " out of range");
+    }
+  }
+  return errors;
+}
+
+// ---------------------------------------------------------------------------
+// scheduling + liveness + reuse planning
+// ---------------------------------------------------------------------------
+
+struct BlockAnalysis {
+  std::vector<int> topo_order;          // op indices in dependency order
+  std::vector<int> level;               // parallel wavefront per op
+  std::vector<int> last_use;            // per op: ops whose outputs die here
+  std::map<std::string, std::pair<int, int>> live_range;  // var -> [def,last]
+  std::map<std::string, int> reuse_slot;  // var -> buffer slot id
+  int num_slots = 0;
+};
+
+// Kahn topo sort over def-use edges, preserving program order among ready
+// ops (stable) — mirrors how the reference executor's sequential order is a
+// valid schedule, while exposing wavefronts the reference never computed.
+BlockAnalysis analyze_block(const ProgramDesc& prog, int block_idx) {
+  const BlockDesc& b = prog.blocks.at(block_idx);
+  int n = (int)b.ops.size();
+  BlockAnalysis out;
+  std::unordered_map<std::string, int> last_writer;
+  std::vector<std::vector<int>> succ(n);
+  std::vector<int> indeg(n, 0);
+  for (int i = 0; i < n; ++i) {
+    std::set<int> preds;
+    for (auto& name : b.ops[i].all_inputs()) {
+      auto it = last_writer.find(name);
+      if (it != last_writer.end()) preds.insert(it->second);
+    }
+    // write-after-write: order multiple writers of the same var
+    for (auto& name : b.ops[i].all_outputs()) {
+      auto it = last_writer.find(name);
+      if (it != last_writer.end()) preds.insert(it->second);
+    }
+    for (int p : preds) {
+      succ[p].push_back(i);
+      indeg[i]++;
+    }
+    for (auto& name : b.ops[i].all_outputs()) last_writer[name] = i;
+  }
+  std::priority_queue<int, std::vector<int>, std::greater<int>> ready;
+  for (int i = 0; i < n; ++i)
+    if (!indeg[i]) ready.push(i);
+  std::vector<int> level(n, 0);
+  while (!ready.empty()) {
+    int i = ready.top();
+    ready.pop();
+    out.topo_order.push_back(i);
+    for (int s : succ[i]) {
+      level[s] = std::max(level[s], level[i] + 1);
+      if (--indeg[s] == 0) ready.push(s);
+    }
+  }
+  out.level = level;
+
+  // liveness over the (stable) topo order
+  std::unordered_map<std::string, int> def_pos, last_pos;
+  for (int pos = 0; pos < (int)out.topo_order.size(); ++pos) {
+    int i = out.topo_order[pos];
+    for (auto& name : b.ops[i].all_outputs())
+      if (!def_pos.count(name)) def_pos[name] = pos;
+    for (auto& name : b.ops[i].all_inputs()) last_pos[name] = pos;
+    for (auto& name : b.ops[i].all_outputs()) last_pos[name] = pos;
+  }
+  for (auto& kv : def_pos) {
+    const std::string& name = kv.first;
+    auto vit = b.vars.find(name);
+    bool pers = vit != b.vars.end() && vit->second.persistable;
+    if (pers) continue;  // parameters never recycle
+    out.live_range[name] = {kv.second, last_pos[name]};
+  }
+
+  // greedy interval-graph coloring = the reference transpiler's var-reuse
+  // (memory_optimization_transpiler.py:259 memory_optimize), done natively.
+  std::vector<std::pair<std::pair<int, int>, std::string>> ivs;
+  for (auto& kv : out.live_range)
+    ivs.push_back({kv.second, kv.first});
+  std::sort(ivs.begin(), ivs.end());
+  // slot -> position where it frees
+  std::vector<int> free_at;
+  for (auto& iv : ivs) {
+    int start = iv.first.first, end = iv.first.second;
+    int slot = -1;
+    for (int s = 0; s < (int)free_at.size(); ++s)
+      if (free_at[s] < start) {
+        slot = s;
+        break;
+      }
+    if (slot < 0) {
+      slot = (int)free_at.size();
+      free_at.push_back(-1);
+    }
+    free_at[slot] = end;
+    out.reuse_slot[iv.second] = slot;
+  }
+  out.num_slots = (int)free_at.size();
+  return out;
+}
+
+std::string analysis_to_json(const BlockAnalysis& a) {
+  auto root = Json::make(Json::OBJECT);
+  auto topo = Json::make(Json::ARRAY);
+  for (int i : a.topo_order) topo->arr.push_back(Json::of_int(i));
+  root->obj["topo_order"] = topo;
+  auto lev = Json::make(Json::ARRAY);
+  for (int l : a.level) lev->arr.push_back(Json::of_int(l));
+  root->obj["level"] = lev;
+  auto lr = Json::make(Json::OBJECT);
+  for (auto& kv : a.live_range) {
+    auto pr = Json::make(Json::ARRAY);
+    pr->arr.push_back(Json::of_int(kv.second.first));
+    pr->arr.push_back(Json::of_int(kv.second.second));
+    lr->obj[kv.first] = pr;
+  }
+  root->obj["live_range"] = lr;
+  auto rs = Json::make(Json::OBJECT);
+  for (auto& kv : a.reuse_slot) rs->obj[kv.first] = Json::of_int(kv.second);
+  root->obj["reuse_slot"] = rs;
+  root->obj["num_slots"] = Json::of_int(a.num_slots);
+  std::string out;
+  write_json(root, &out);
+  return out;
+}
+
+}  // namespace ptpu

@@ -1,0 +1,356 @@
+// Minimal JSON value model + parser + canonical writer.
+//
+// The program IR's wire format is canonical JSON (sorted keys, no spaces) —
+// see paddle_tpu/fluid/core/desc.py serialize_to_string.  This parser/writer
+// round-trips that format byte-identically, which is how the C++ core and
+// the Python front end prove they agree on the graph (fingerprint equality).
+// Counterpart of the reference's protobuf layer (paddle/framework/
+// framework.proto + program_desc.cc).
+#pragma once
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+
+class Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+class Json {
+ public:
+  enum Type { NUL, BOOL, INT, DOUBLE, STRING, ARRAY, OBJECT };
+
+  Type type = NUL;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;
+  std::vector<JsonPtr> arr;
+  std::map<std::string, JsonPtr> obj;  // std::map => sorted keys for free
+
+  static JsonPtr make(Type t) {
+    auto j = std::make_shared<Json>();
+    j->type = t;
+    return j;
+  }
+  static JsonPtr of_int(int64_t v) {
+    auto j = make(INT);
+    j->i = v;
+    return j;
+  }
+  static JsonPtr of_str(const std::string& v) {
+    auto j = make(STRING);
+    j->s = v;
+    return j;
+  }
+  static JsonPtr of_bool(bool v) {
+    auto j = make(BOOL);
+    j->b = v;
+    return j;
+  }
+
+  bool is_null() const { return type == NUL; }
+  const JsonPtr& at(const std::string& k) const {
+    auto it = obj.find(k);
+    if (it == obj.end()) throw std::runtime_error("missing key: " + k);
+    return it->second;
+  }
+  JsonPtr get(const std::string& k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : it->second;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// parser
+// ---------------------------------------------------------------------------
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& text) : t_(text) {}
+
+  JsonPtr parse() {
+    JsonPtr v = value();
+    ws();
+    if (p_ != t_.size()) fail("trailing characters");
+    return v;
+  }
+
+ private:
+  const std::string& t_;
+  size_t p_ = 0;
+
+  [[noreturn]] void fail(const std::string& msg) {
+    throw std::runtime_error("json parse error at " + std::to_string(p_) +
+                             ": " + msg);
+  }
+  void ws() {
+    while (p_ < t_.size() && (t_[p_] == ' ' || t_[p_] == '\t' ||
+                              t_[p_] == '\n' || t_[p_] == '\r'))
+      ++p_;
+  }
+  char peek() {
+    if (p_ >= t_.size()) fail("unexpected end");
+    return t_[p_];
+  }
+  void expect(char c) {
+    if (peek() != c) fail(std::string("expected '") + c + "'");
+    ++p_;
+  }
+  bool consume(const char* lit) {
+    size_t n = strlen(lit);
+    if (t_.compare(p_, n, lit) == 0) {
+      p_ += n;
+      return true;
+    }
+    return false;
+  }
+
+  JsonPtr value() {
+    ws();
+    char c = peek();
+    switch (c) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return Json::of_str(string());
+      case 't':
+        if (consume("true")) return Json::of_bool(true);
+        fail("bad literal");
+      case 'f':
+        if (consume("false")) return Json::of_bool(false);
+        fail("bad literal");
+      case 'n':
+        if (consume("null")) return Json::make(Json::NUL);
+        fail("bad literal");
+      default: return number();
+    }
+  }
+
+  JsonPtr object() {
+    expect('{');
+    auto j = Json::make(Json::OBJECT);
+    ws();
+    if (peek() == '}') {
+      ++p_;
+      return j;
+    }
+    while (true) {
+      ws();
+      std::string k = string();
+      ws();
+      expect(':');
+      j->obj[k] = value();
+      ws();
+      if (peek() == ',') {
+        ++p_;
+        continue;
+      }
+      expect('}');
+      return j;
+    }
+  }
+
+  JsonPtr array() {
+    expect('[');
+    auto j = Json::make(Json::ARRAY);
+    ws();
+    if (peek() == ']') {
+      ++p_;
+      return j;
+    }
+    while (true) {
+      j->arr.push_back(value());
+      ws();
+      if (peek() == ',') {
+        ++p_;
+        continue;
+      }
+      expect(']');
+      return j;
+    }
+  }
+
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (p_ >= t_.size()) fail("unterminated string");
+      char c = t_[p_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (p_ >= t_.size()) fail("bad escape");
+        char e = t_[p_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (p_ + 4 > t_.size()) fail("bad \\u escape");
+            unsigned cp = std::stoul(t_.substr(p_, 4), nullptr, 16);
+            p_ += 4;
+            // encode UTF-8 (surrogate pairs for completeness)
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              if (p_ + 6 > t_.size() || t_[p_] != '\\' || t_[p_ + 1] != 'u')
+                fail("unpaired surrogate");
+              unsigned lo = std::stoul(t_.substr(p_ + 2, 4), nullptr, 16);
+              p_ += 6;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (cp >> 18));
+              out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  JsonPtr number() {
+    size_t start = p_;
+    if (peek() == '-') ++p_;
+    while (p_ < t_.size() && isdigit(t_[p_])) ++p_;
+    bool is_double = false;
+    if (p_ < t_.size() && t_[p_] == '.') {
+      is_double = true;
+      ++p_;
+      while (p_ < t_.size() && isdigit(t_[p_])) ++p_;
+    }
+    if (p_ < t_.size() && (t_[p_] == 'e' || t_[p_] == 'E')) {
+      is_double = true;
+      ++p_;
+      if (p_ < t_.size() && (t_[p_] == '+' || t_[p_] == '-')) ++p_;
+      while (p_ < t_.size() && isdigit(t_[p_])) ++p_;
+    }
+    std::string tok = t_.substr(start, p_ - start);
+    if (tok.empty() || tok == "-") fail("bad number");
+    auto j = std::make_shared<Json>();
+    if (is_double) {
+      j->type = Json::DOUBLE;
+      j->d = std::stod(tok);
+    } else {
+      j->type = Json::INT;
+      j->i = std::stoll(tok);
+    }
+    return j;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// canonical writer — must byte-match python json.dumps(sort_keys=True,
+// separators=(",", ":")) for the values the IR produces
+// ---------------------------------------------------------------------------
+
+inline void write_json(const JsonPtr& j, std::string* out);
+
+inline void write_escaped(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+          // NOTE: python json.dumps defaults to ensure_ascii=True, but the
+          // IR writer below re-encodes non-ascii via \u escapes too
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+// python repr(float) — shortest round-trip representation
+inline std::string double_repr(double d) {
+  for (int prec = 1; prec <= 17; ++prec) {
+    std::ostringstream os;
+    os.precision(prec);
+    os << d;
+    if (std::stod(os.str()) == d) {
+      std::string s = os.str();
+      // python always renders a decimal point or exponent for floats
+      if (s.find('.') == std::string::npos &&
+          s.find('e') == std::string::npos &&
+          s.find("inf") == std::string::npos &&
+          s.find("nan") == std::string::npos)
+        s += ".0";
+      return s;
+    }
+  }
+  return "0.0";
+}
+
+inline void write_json(const JsonPtr& j, std::string* out) {
+  if (!j) {
+    *out += "null";
+    return;
+  }
+  switch (j->type) {
+    case Json::NUL: *out += "null"; break;
+    case Json::BOOL: *out += j->b ? "true" : "false"; break;
+    case Json::INT: *out += std::to_string(j->i); break;
+    case Json::DOUBLE: *out += double_repr(j->d); break;
+    case Json::STRING: write_escaped(j->s, out); break;
+    case Json::ARRAY: {
+      out->push_back('[');
+      for (size_t k = 0; k < j->arr.size(); ++k) {
+        if (k) out->push_back(',');
+        write_json(j->arr[k], out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Json::OBJECT: {
+      out->push_back('{');
+      bool first = true;
+      for (auto& kv : j->obj) {
+        if (!first) out->push_back(',');
+        first = false;
+        write_escaped(kv.first, out);
+        out->push_back(':');
+        write_json(kv.second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace ptpu
