@@ -154,9 +154,28 @@ class Executor:
     """Compiling executor.  API mirrors fluid.Executor (executor.py:149):
     ``run(program, feed, fetch_list, scope)`` -> list of numpy arrays."""
 
+    # bound on distinct (program, signature) executables kept alive; LRU
+    # eviction — the reference keeps no executable cache at all (it re-walks
+    # the block per step), so any bound here is strictly better
+    CACHE_CAPACITY = 64
+
     def __init__(self, place: Union[TPUPlace, CPUPlace, None] = None):
         self.place = place or TPUPlace(0)
-        self._cache: Dict[tuple, Any] = {}
+        from collections import OrderedDict
+
+        self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
+
+    @staticmethod
+    def _program_key(program: Program) -> str:
+        """Content-addressed cache key: a sha256 fingerprint of the desc,
+        recomputed only when the program's mutation version changes.  Keying
+        on id(program) would alias a GC'd program whose id was reused."""
+        cached = getattr(program, "_fp_cache", None)
+        if cached is not None and cached[0] == program.version:
+            return cached[1]
+        fp = program.desc.fingerprint()
+        program._fp_cache = (program.version, fp)
+        return fp
 
     # -- host-side IO ops ---------------------------------------------------
     def _run_host_op(self, op, scope: Scope) -> None:
@@ -259,13 +278,15 @@ class Executor:
         from ..parallel import mesh as _pmesh
 
         mesh = _pmesh.current_mesh()
-        key = (id(program), program.version, mode, id(mesh),
+        key = (self._program_key(program), mode, id(mesh),
                tuple((n, _sig_of(v)) for n, v in sorted(feed.items())),
                tuple(fetch_names),
                tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))
         from ..utils.flags import FLAGS
 
         compiled, state_sh = self._cache.get(key, (None, None))
+        if compiled is not None:
+            self._cache.move_to_end(key)
         if compiled is None:
             if FLAGS["log_recompiles"] and self._cache:
                 import sys
@@ -294,6 +315,8 @@ class Executor:
                 compiled = jax.jit(step, donate_argnums=(1,))
             self._cache[key] = (compiled, state_sh if mesh is not None
                                 else None)
+            while len(self._cache) > self.CACHE_CAPACITY:
+                self._cache.popitem(last=False)
 
         if state_sh is not None:
             # re-lay out state whose current placement disagrees with its

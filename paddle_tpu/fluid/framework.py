@@ -130,6 +130,16 @@ class Variable:
             return SeqArray(data, lens)
         return jax.ShapeDtypeStruct(tuple(shape), np_dt)
 
+    def set_sharding(self, sharding: Optional[Sequence[Optional[str]]]):
+        """Mutate the desc-level sharding annotation.  Goes through the
+        program version bump so the executor's content-addressed compile
+        cache (executor._program_key) sees the change."""
+        self.desc.sharding = list(sharding) if sharding is not None else None
+        if isinstance(self, Parameter):
+            self.sharding = (tuple(sharding) if sharding is not None
+                             else None)
+        self.block.program._bump_version()
+
     def __repr__(self):
         return (f"Variable(name={self.name}, shape={self.shape}, "
                 f"dtype={self.dtype}, lod_level={self.lod_level})")

@@ -36,12 +36,19 @@ class Optimizer:
     """Base optimizer (reference optimizer.py:29)."""
 
     def __init__(self, learning_rate, regularization=None,
-                 global_step: Optional[Variable] = None):
+                 global_step: Optional[Variable] = None,
+                 shard_moments_over: Optional[str] = None):
         if not isinstance(learning_rate, (float, int, Variable)):
             raise TypeError("learning_rate must be float or Variable")
         self._global_step = global_step
         self.regularization = regularization
         self._learning_rate = learning_rate
+        # opt-in ZeRO-style sharding: accumulators additionally shard their
+        # first unannotated dim over this mesh axis (usually 'dp'), so Adam
+        # moments for replicated params stop replicating per device — the
+        # capability the reference gets from pserver param blocks
+        # (distribute_transpiler.py:40 split_dense_variable)
+        self._shard_moments_over = shard_moments_over
         self._learning_rate_map: Dict[int, Variable] = {}
         # accumulators[name][param_name] = Variable (reference :57)
         self._accumulators: Dict[str, Dict[str, Variable]] = defaultdict(dict)
@@ -84,9 +91,28 @@ class Optimizer:
         if param.name in self._accumulators[name]:
             raise ValueError(f"accumulator {name} already exists for "
                              f"{param.name}")
+        acc_shape = list(shape) if shape is not None else list(param.shape)
         var = self.helper.create_global_variable(
             name=unique_name.generate(f"{param.name}_{name}"),
-            shape=shape or list(param.shape), dtype=dtype, persistable=True)
+            shape=acc_shape, dtype=dtype, persistable=True)
+        # full-shape accumulators inherit the param's sharding annotation —
+        # an mp-sharded weight's Adam moments shard the same way instead of
+        # replicating on every device (scalar [1] accumulators excepted)
+        if acc_shape == list(param.shape):
+            ann = list(param.sharding) if param.sharding is not None else None
+            if self._shard_moments_over is not None and acc_shape:
+                ann = ann or [None] * len(acc_shape)
+                ax = self._shard_moments_over
+                if ax not in ann and (ax + "?") not in ann:
+                    # '?' marker: mesh.state_sharding resolves it to the
+                    # first dim divisible by the axis size at run time (the
+                    # axis size isn't known at graph-build time)
+                    for i, a in enumerate(ann):
+                        if a is None:
+                            ann[i] = ax + "?"
+                            break
+            if ann is not None:
+                var.set_sharding(ann)
         self.helper.set_variable_initializer(
             var, ConstantInitializer(fill_value))
         self._accumulators[name][param.name] = var

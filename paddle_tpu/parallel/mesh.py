@@ -83,18 +83,32 @@ def feed_sharding(mesh: Mesh, value):
 def state_sharding(mesh: Mesh, value, annotation: Optional[Sequence]):
     """Sharding for a persistable var from its VarDesc annotation (tuple of
     mesh-axis names or None per dim).  Unannotated or non-divisible dims
-    replicate."""
+    replicate.  An entry ``"axis?"`` (e.g. ZeRO moment sharding, see
+    optimizer._add_accumulator) is a deferred placement: it binds to the
+    first dim divisible by the axis size — preferring the annotated dim —
+    or drops out entirely if none divides."""
     def leaf(v, ann):
         arr = np.asarray(v)
         if not ann:
             return NamedSharding(mesh, PartitionSpec())
-        spec = []
-        for d, ax in zip(arr.shape, list(ann) + [None] * arr.ndim):
-            if ax is not None and ax in mesh.axis_names and \
-                    d % mesh.shape[ax] == 0:
-                spec.append(ax)
-            else:
-                spec.append(None)
+        ann = (list(ann) + [None] * arr.ndim)[: arr.ndim]
+        spec = [None] * arr.ndim
+        deferred = []
+        for i, (d, ax) in enumerate(zip(arr.shape, ann)):
+            if ax is None:
+                continue
+            if isinstance(ax, str) and ax.endswith("?"):
+                deferred.append((i, ax[:-1]))
+            elif ax in mesh.axis_names and d % mesh.shape[ax] == 0:
+                spec[i] = ax
+        for i, ax in deferred:
+            if ax not in mesh.axis_names or ax in spec:
+                continue
+            size = mesh.shape[ax]
+            for j in [i] + [k for k in range(arr.ndim) if k != i]:
+                if spec[j] is None and arr.shape[j] % size == 0:
+                    spec[j] = ax
+                    break
         return NamedSharding(mesh, PartitionSpec(*spec))
 
     if isinstance(value, SeqArray):

