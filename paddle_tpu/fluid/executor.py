@@ -278,7 +278,13 @@ class Executor:
         from ..parallel import mesh as _pmesh
 
         mesh = _pmesh.current_mesh()
-        key = (self._program_key(program), mode, id(mesh),
+        # content key, not id(mesh): a GC'd Mesh's reused id must not replay
+        # an executable jitted for different axes/devices (same hazard the
+        # program fingerprint guards against)
+        mesh_key = None if mesh is None else (
+            tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat))
+        key = (self._program_key(program), mode, mesh_key,
                tuple((n, _sig_of(v)) for n, v in sorted(feed.items())),
                tuple(fetch_names),
                tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))

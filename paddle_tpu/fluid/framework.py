@@ -51,7 +51,8 @@ _NO_INFER_OPS = {"feed", "fetch", "while", "conditional_block", "print",
 # (lowering.py) derives the IDENTICAL key — the property the reference gets
 # by saving dropout masks (dropout_op.cc), we get by key determinism.
 _RANDOM_OPS = {"dropout", "uniform_random", "gaussian_random",
-               "truncated_gaussian_random", "nce", "sampling_id"}
+               "truncated_gaussian_random", "nce", "sampling_id",
+               "fused_attention"}
 _rng_salt_counter = [0]
 
 
@@ -271,7 +272,10 @@ class Block:
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None,
                   infer_shape: bool = True) -> Operator:
         attrs = dict(attrs or {})
-        if type in _RANDOM_OPS and "__rng_salt__" not in attrs:
+        consumes_rng = type in _RANDOM_OPS
+        if type == "fused_attention" and not attrs.get("dropout_rate"):
+            consumes_rng = False  # deterministic unless dropout is on
+        if consumes_rng and "__rng_salt__" not in attrs:
             _rng_salt_counter[0] += 1
             attrs["__rng_salt__"] = _rng_salt_counter[0]
         desc = OpDesc(type=type,
@@ -500,6 +504,7 @@ class Program:
 _TEST_SENSITIVE_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
+    "fused_attention": ("is_test",),
 }
 
 

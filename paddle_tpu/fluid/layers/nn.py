@@ -524,17 +524,22 @@ def _append_channel_bias(helper, pre_bias):
 
 
 def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
-                    seq_parallel=False, impl=None, name=None):
+                    seq_parallel=False, impl=None, dropout_rate=0.0,
+                    is_test=False, name=None):
     """Fused scaled-dot-product attention over [b, h, l, d] tensors — flash
     attention on one chip, ring attention over an 'sp' mesh axis when
     ``seq_parallel`` and the active mesh shard the sequence.  O(L) memory,
-    unlike the matmul+softmax composition which materialises [lq, lk]."""
+    unlike the matmul+softmax composition which materialises [lq, lk].
+    ``dropout_rate`` applies attention-probability dropout inside the kernel
+    (counter-based hash mask, train mode only) — same semantics as the
+    softmax→dropout→matmul composition."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_tmp_variable(q.dtype)
     inputs = {"Q": q, "K": k, "V": v}
     if bias is not None:
         inputs["Bias"] = bias
-    attrs = {"causal": bool(causal), "seq_parallel": bool(seq_parallel)}
+    attrs = {"causal": bool(causal), "seq_parallel": bool(seq_parallel),
+             "dropout_rate": float(dropout_rate), "is_test": bool(is_test)}
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
     if impl is not None:

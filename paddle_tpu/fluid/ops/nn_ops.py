@@ -227,6 +227,14 @@ def fused_attention(ctx, q, k, v, bias):
     causal = ctx.attr("causal", False)
     sm_scale = ctx.attr("sm_scale", None)
     impl = ctx.attr("impl", None)
+    rate = ctx.attr("dropout_rate", 0.0)
+    if ctx.attr("is_test", False) or ctx.mode == "infer":
+        rate = 0.0
+    seed = None
+    if rate:
+        # per-op salted key; identical in the vjp-recomputed backward, so
+        # the in-kernel hash mask matches between forward and gradient
+        seed = jax.random.bits(ctx.rng, (), jnp.uint32)
     from ...parallel import mesh as _pmesh
 
     mesh = _pmesh.current_mesh()
@@ -234,6 +242,7 @@ def fused_attention(ctx, q, k, v, bias):
             and "sp" in mesh.axis_names:
         return _ring(mesh, q, k, v, bias=bias, causal=causal,
                      sm_scale=sm_scale,
-                     dp_axis="dp", mp_axis="mp", sp_axis="sp")
+                     dp_axis="dp", mp_axis="mp", sp_axis="sp",
+                     dropout_rate=rate, dropout_seed=seed)
     return _flash(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale,
-                  impl=impl)
+                  impl=impl, dropout_rate=rate, dropout_seed=seed)

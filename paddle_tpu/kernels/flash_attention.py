@@ -33,12 +33,61 @@ DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 __all__ = ["flash_attention"]
 
 
+def keep_scale(seed_u32, bh, rows, cols, rate):
+    """Deterministic counter-based dropout mask for attention probabilities.
+
+    A murmur3-style finalizer over the *global* (batch*head, query, key)
+    position and a traced uint32 seed, in pure uint32 jnp arithmetic — so the
+    identical expression runs inside the Pallas forward kernel and the XLA
+    backward scan, and the two masks match bit-exactly without ever
+    materialising an [Lq, Lk] mask tensor.  Inputs broadcast; returns float32
+    values in {0, 1/(1-rate)} (inverted-dropout scaling).
+    """
+    u32 = jnp.uint32
+    x = (rows.astype(u32) * u32(0x9E3779B1) +
+         cols.astype(u32) * u32(0x85EBCA77))
+    x = x ^ (jnp.asarray(bh).astype(u32) * u32(0xC2B2AE3D)) ^ seed_u32
+    x = x ^ (x >> 16)
+    x = x * u32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * u32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    # top 24 bits -> uniform [0,1); bitcast through int32 because Mosaic
+    # has no uint32->float32 cast (value < 2^24, so the int32 is positive)
+    u = jax.lax.bitcast_convert_type(x >> 8, jnp.int32).astype(
+        jnp.float32) * (1.0 / (1 << 24))
+    return jnp.where(u >= rate, 1.0 / (1.0 - rate), 0.0).astype(jnp.float32)
+
+
+def seed_to_carrier(bits) -> jax.Array:
+    """Pack RNG bits into a float32 scalar (bit-cast) so it can ride through
+    custom_vjp as an ordinary differentiable operand with a zero cotangent."""
+    arr = jnp.asarray(bits)
+    if arr.dtype == jnp.float32:
+        return arr
+    return jax.lax.bitcast_convert_type(arr.astype(jnp.uint32), jnp.float32)
+
+
+def _carrier_to_u32(seed_f: jax.Array) -> jax.Array:
+    return jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
+
+
+def bh_grid(b: int, h: int) -> jax.Array:
+    """[b,h,1,1] flattened batch*head index — MUST match the Pallas grid's
+    program_id(0) = b_idx*h + h_idx convention so XLA-side masks equal the
+    in-kernel ones."""
+    return (jnp.arange(b, dtype=jnp.int32)[:, None] * h +
+            jnp.arange(h, dtype=jnp.int32)[None, :])[:, :, None, None]
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, num_k_blocks):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+                m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_k_blocks,
+                dropout_rate):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -80,7 +129,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
             jnp.sum(p, axis=1)[:, None], l_prev.shape)
         m_scr[...] = m_new
         l_scr[...] = l_new
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        if dropout_rate > 0.0:
+            # mask the unnormalised probs (l keeps the full softmax sum —
+            # dropout acts after normalisation, and /l distributes)
+            rows_g = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols_g = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            # vector-shaped bitcast: Mosaic's tpu.bitcast rejects bare scalars
+            seed_u = jax.lax.bitcast_convert_type(seed_ref[...],
+                                                  jnp.uint32)[0, 0]
+            pd = p * keep_scale(seed_u, pl.program_id(0), rows_g, cols_g,
+                                dropout_rate)
+        else:
+            pd = p
+        pv = jax.lax.dot_general(pd, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
 
@@ -91,8 +154,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
         o_ref[0, ...] = (acc_scr[...] / denom).astype(o_ref.dtype)
 
 
-def _pallas_forward(q, k, v, bias, sm_scale, causal, block_q, block_k,
-                    interpret):
+def _pallas_forward(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+                    dropout_rate, interpret):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     block_q = min(block_q, lq)
@@ -116,7 +179,8 @@ def _pallas_forward(q, k, v, bias, sm_scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_k, d), kv_map),
     ]
     args = [q3, k3, v3]
-    if bias is not None:
+    have_bias = bias is not None
+    if have_bias:
         bb, bh_, _, _ = bias.shape
 
         def bias_map(bh, qi, ki):
@@ -126,17 +190,22 @@ def _pallas_forward(q, k, v, bias, sm_scale, causal, block_q, block_k,
 
         in_specs.append(pl.BlockSpec((1, block_q, block_k), bias_map))
         args.append(bias.reshape(bb * bh_, lq, lk))
-        kernel = functools.partial(
-            _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-            block_k=block_k, num_k_blocks=nk)
-    else:
-        base = functools.partial(
-            _fwd_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_k_blocks=nk)
+    have_seed = dropout_rate > 0.0
+    if have_seed:
+        in_specs.append(pl.BlockSpec((1, 1), lambda bh, qi, ki: (0, 0)))
+        args.append(jnp.asarray(seed, jnp.float32).reshape(1, 1))
 
-        def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
-            return base(q_ref, k_ref, v_ref, None, o_ref,
-                        m_scr, l_scr, acc_scr)
+    base = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=nk, dropout_rate=dropout_rate)
+
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        rest = list(rest)
+        bias_ref = rest.pop(0) if have_bias else None
+        seed_ref = rest.pop(0) if have_seed else None
+        o_ref, m_scr, l_scr, acc_scr = rest
+        return base(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+                    m_scr, l_scr, acc_scr)
 
     scratch = [
         pltpu.VMEM((block_q, 128), jnp.float32),   # running max
@@ -161,7 +230,18 @@ def _pallas_forward(q, k, v, bias, sm_scale, causal, block_q, block_k,
 # Blockwise XLA path: reference forward (CPU / fallback) and the backward
 # ---------------------------------------------------------------------------
 
-def _xla_forward(q, k, v, bias, sm_scale, causal, block_k):
+def _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k, rate):
+    """[b,h,lq,block_k] inverted-dropout scale for one key block, using the
+    same global-position hash as the Pallas kernel (bh = b*h + h index)."""
+    bh = bh_grid(b, h)
+    rows = lq_rows[None, None, :, None]
+    cols = (ki * block_k +
+            jnp.arange(block_k, dtype=jnp.int32))[None, None, None, :]
+    return keep_scale(seed_u, bh, rows, cols, rate)
+
+
+def _xla_forward(q, k, v, bias, seed, sm_scale, causal, block_k,
+                 dropout_rate=0.0):
     """lax.scan over key blocks with online softmax; returns (out, m, l)."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -169,6 +249,9 @@ def _xla_forward(q, k, v, bias, sm_scale, causal, block_k):
     nk = lk // block_k
     qf = q.astype(jnp.float32)
     rows = jnp.arange(lq)[:, None]
+    lq_rows = jnp.arange(lq, dtype=jnp.int32)
+    seed_u = _carrier_to_u32(jnp.asarray(seed, jnp.float32)) \
+        if dropout_rate > 0.0 else None
 
     def step(carry, ki):
         m_prev, l_prev, acc = carry
@@ -187,8 +270,13 @@ def _xla_forward(q, k, v, bias, sm_scale, causal, block_k):
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[..., None])
         l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        if dropout_rate > 0.0:
+            pd = p * _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k,
+                                       dropout_rate)
+        else:
+            pd = p
         acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vs.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", pd, vs.astype(jnp.float32))
         return (m_new, l_new, acc), None
 
     init = (jnp.full((b, h, lq), -jnp.inf, jnp.float32),
@@ -199,7 +287,8 @@ def _xla_forward(q, k, v, bias, sm_scale, causal, block_k):
     return (acc / denom[..., None]).astype(q.dtype), m, l
 
 
-def _xla_backward(q, k, v, bias, o, do, m, l, sm_scale, causal, block_k):
+def _xla_backward(q, k, v, bias, o, do, m, l, seed, sm_scale, causal,
+                  block_k, dropout_rate=0.0):
     """Recompute p blockwise and accumulate dq/dk/dv (+dbias) — the
     flash-attention backward; no [Lq, Lk] intermediate, only the dbias
     *output* (when bias is given) has that shape."""
@@ -209,10 +298,15 @@ def _xla_backward(q, k, v, bias, o, do, m, l, sm_scale, causal, block_k):
     nk = lk // block_k
     qf = q.astype(jnp.float32)
     dof = do.astype(jnp.float32)
-    # delta_i = sum_d o_i * do_i  (rowwise), standard flash bwd identity
+    # delta_i = sum_d o_i * do_i  (rowwise), standard flash bwd identity;
+    # with dropout, o is the *dropped* output, so delta still equals
+    # sum_k p_dropped * dp — the identity survives unchanged.
     delta = jnp.sum(o.astype(jnp.float32) * dof, axis=-1)      # [b,h,lq]
     lse_denom = jnp.where(l == 0.0, 1.0, l)
     rows = jnp.arange(lq)[:, None]
+    lq_rows = jnp.arange(lq, dtype=jnp.int32)
+    seed_u = _carrier_to_u32(jnp.asarray(seed, jnp.float32)) \
+        if dropout_rate > 0.0 else None
 
     def step(dq_acc, ki):
         ks = jax.lax.dynamic_slice_in_dim(k, ki * block_k, block_k, 2)
@@ -226,9 +320,15 @@ def _xla_backward(q, k, v, bias, o, do, m, l, sm_scale, causal, block_k):
             cols = ki * block_k + jnp.arange(block_k)[None, :]
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - m[..., None]) / lse_denom[..., None]   # [b,h,q,bk]
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vs.astype(jnp.float32))
-        ds_raw = p * (dp - delta[..., None])                   # = dbias block
+        if dropout_rate > 0.0:
+            dscale = _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k,
+                                       dropout_rate)
+            dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p * dscale, dof)
+            ds_raw = p * (dscale * dp - delta[..., None])       # dbias block
+        else:
+            dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+            ds_raw = p * (dp - delta[..., None])                # dbias block
         ds = ds_raw * sm_scale
         dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds,
                                      ks.astype(jnp.float32))
@@ -259,30 +359,37 @@ def _xla_backward(q, k, v, bias, o, do, m, l, sm_scale, causal, block_k):
 # Public entry with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, sm_scale, causal, block_q, block_k, impl):
-    return _flash_fwd(q, k, v, bias, sm_scale, causal, block_q,
-                      block_k, impl)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, bias, seed, sm_scale, causal, block_q, block_k, impl,
+           dropout_rate):
+    return _flash_fwd(q, k, v, bias, seed, sm_scale, causal, block_q,
+                      block_k, impl, dropout_rate)[0]
 
 
-def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k, impl):
+def _flash_fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
+               impl, dropout_rate):
     if impl == "pallas" or impl == "pallas_interpret":
-        out = _pallas_forward(q, k, v, bias, sm_scale, causal, block_q,
-                              block_k, interpret=(impl == "pallas_interpret"))
+        out = _pallas_forward(q, k, v, bias, seed, sm_scale, causal, block_q,
+                              block_k, dropout_rate,
+                              interpret=(impl == "pallas_interpret"))
         # m/l recomputed in bwd from scratch (cheap vs the matmuls there)
         m = l = None
     else:
-        out, m, l = _xla_forward(q, k, v, bias, sm_scale, causal, block_k)
-    return out, (q, k, v, bias, out, m, l)
+        out, m, l = _xla_forward(q, k, v, bias, seed, sm_scale, causal,
+                                 block_k, dropout_rate)
+    return out, (q, k, v, bias, seed, out, m, l)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, impl, res, do):
-    q, k, v, bias, out, m, l = res
+def _flash_bwd(sm_scale, causal, block_q, block_k, impl, dropout_rate,
+               res, do):
+    q, k, v, bias, seed, out, m, l = res
     if m is None:
-        _, m, l = _xla_forward(q, k, v, bias, sm_scale, causal, block_k)
-    dq, dk, dv, dbias = _xla_backward(q, k, v, bias, out, do, m, l,
-                                      sm_scale, causal, block_k)
-    return dq, dk, dv, dbias
+        # recompute m/l WITHOUT dropout: l must be the full softmax sum
+        _, m, l = _xla_forward(q, k, v, bias, seed, sm_scale, causal,
+                               block_k, dropout_rate=0.0)
+    dq, dk, dv, dbias = _xla_backward(q, k, v, bias, out, do, m, l, seed,
+                                      sm_scale, causal, block_k, dropout_rate)
+    return dq, dk, dv, dbias, jnp.zeros((), jnp.float32)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -291,11 +398,20 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                     causal: bool = False, sm_scale: Optional[float] = None,
                     block_q: int = 256, block_k: int = 256,
-                    impl: Optional[str] = None) -> jax.Array:
+                    impl: Optional[str] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed=None) -> jax.Array:
     """Fused attention. q [B,H,Lq,D], k/v [B,H,Lk,D], optional additive bias
     [B|1, H|1, Lq, Lk] (the fluid attn-bias convention).  impl: 'pallas'
     (TPU), 'xla' (any backend), 'pallas_interpret' (testing); default picks
-    pallas on TPU, xla elsewhere."""
+    pallas on TPU, xla elsewhere.
+
+    dropout_rate > 0 applies attention-probability dropout (inverted
+    scaling) inside the kernel via a counter-based hash of the global
+    position — no [Lq, Lk] mask tensor exists in either direction.
+    dropout_seed: int/uint32 scalar (may be traced), required when
+    dropout_rate > 0; same seed ⇒ same mask.
+    """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if impl is None:
@@ -303,6 +419,13 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                             jax.default_backend() == "tpu") else "xla"
     if bias is not None and bias.ndim != 4:
         raise ValueError(f"bias must be 4-d, got {bias.shape}")
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        seed = seed_to_carrier(dropout_seed)
+    else:
+        seed = jnp.zeros((), jnp.float32)
     lq, lk = q.shape[2], k.shape[2]
     pq = (-lq) % min(block_q, lq)
     pk = (-lk) % min(block_k, lk)
@@ -319,8 +442,8 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
             bias = jnp.broadcast_to(cb, (1, 1, lq + pq, lk + pk))
         else:
             bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pq), (0, pk))) + cb
-        out = _flash(q, k, v, bias, float(sm_scale), bool(causal),
-                     int(block_q), int(block_k), impl)
+        out = _flash(q, k, v, bias, seed, float(sm_scale), bool(causal),
+                     int(block_q), int(block_k), impl, dropout_rate)
         return out[:, :, :lq, :]
-    return _flash(q, k, v, bias, float(sm_scale), bool(causal),
-                  int(block_q), int(block_k), impl)
+    return _flash(q, k, v, bias, seed, float(sm_scale), bool(causal),
+                  int(block_q), int(block_k), impl, dropout_rate)

@@ -29,20 +29,27 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .flash_attention import DEFAULT_MASK_VALUE
+from .flash_attention import (DEFAULT_MASK_VALUE, bh_grid, keep_scale,
+                              seed_to_carrier)
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
 def ring_attention(q, k, v, bias: Optional[jax.Array] = None,
                    causal: bool = False, sm_scale: Optional[float] = None,
-                   axis_name: str = "sp"):
+                   axis_name: str = "sp", dropout_rate: float = 0.0,
+                   dropout_seed=None):
     """Attention with q/k/v sharded on the sequence axis over `axis_name`.
 
     Must be called inside shard_map/pjit with a mapped `axis_name`.
     q [B,H,Lq/n,D], k/v [B,H,Lk/n,D] (local shards).
     bias: optional additive [B|1, H|1, Lq/n, Lk_global] — rows local,
     columns global (so padding masks survive sharding).
+
+    dropout_rate > 0 applies attention-prob dropout via the same
+    global-position hash as flash_attention (the mask depends only on the
+    *global* (head, q, k) coordinate, so it is invariant to how the
+    sequence is sharded); the backward ring regenerates it under AD.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -53,28 +60,39 @@ def ring_attention(q, k, v, bias: Optional[jax.Array] = None,
     qf = q.astype(jnp.float32)
     rows_local = jnp.arange(lq)[:, None]
     perm = [(i, (i + 1) % n) for i in range(n)]
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        seed_u = jax.lax.bitcast_convert_type(
+            seed_to_carrier(dropout_seed), jnp.uint32)
 
     def fold(state, k_blk, v_blk, t):
         """One online-softmax accumulation of the held k/v block."""
         m_prev, l_prev, acc = state
         # the block held at step t originated on device (my - t) mod n
         src = (my - t) % n
+        grows = my * lq + rows_local                  # global q positions
+        gcols = src * lk + jnp.arange(lk)[None, :]
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
         s = s * sm_scale
         if bias is not None:
             bs = jax.lax.dynamic_slice_in_dim(bias, src * lk, lk, 3)
             s = s + bs.astype(jnp.float32)
         if causal:
-            grows = my * lq + rows_local              # global q positions
-            gcols = src * lk + jnp.arange(lk)[None, :]
             s = jnp.where(grows >= gcols, s, DEFAULT_MASK_VALUE)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[..., None])
         l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        if dropout_rate > 0.0:
+            pd = p * keep_scale(seed_u, bh_grid(b, h), grows[None, None],
+                                gcols[None, None], dropout_rate)
+        else:
+            pd = p
         acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", pd, v_blk.astype(jnp.float32))
         return m_new, l_new, acc
 
     def step(carry, t):
@@ -102,12 +120,16 @@ def ring_attention_sharded(mesh: Mesh, q, k, v,
                            sm_scale: Optional[float] = None,
                            dp_axis: Optional[str] = "dp",
                            mp_axis: Optional[str] = None,
-                           sp_axis: str = "sp"):
+                           sp_axis: str = "sp",
+                           dropout_rate: float = 0.0,
+                           dropout_seed=None):
     """Convenience wrapper: shard_map ring attention over a mesh.
 
     q/k/v [B,H,L,D] global; batch sharded on dp_axis, heads on mp_axis
     (tensor parallel), sequence on sp_axis.  Returns [B,H,L,D] with the same
-    sharding as q.
+    sharding as q.  Dropout masks are decorrelated across dp/mp shards by
+    folding the device's axis indices into the seed (the hash already keys
+    on the global sequence position, so sp shards need no special care).
     """
     names = mesh.axis_names
     dp = dp_axis if dp_axis in names else None
@@ -115,22 +137,42 @@ def ring_attention_sharded(mesh: Mesh, q, k, v,
     if sp_axis not in names:
         raise ValueError(f"mesh {names} has no sequence axis {sp_axis!r}")
     qkv_spec = P(dp, mp, sp_axis, None)
-    bias_spec = None
-    if bias is not None:
-        bias_spec = P(dp if bias.shape[0] > 1 else None,
-                      mp if bias.shape[1] > 1 else None,
-                      sp_axis, None)
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        seed = seed_to_carrier(dropout_seed)
+    else:
+        seed = jnp.zeros((), jnp.float32)
 
     fn = functools.partial(ring_attention, causal=causal, sm_scale=sm_scale,
-                           axis_name=sp_axis)
+                           axis_name=sp_axis, dropout_rate=dropout_rate)
+
+    def local_seed(s_):
+        if dropout_rate == 0.0:
+            return None
+        s = jax.lax.bitcast_convert_type(s_, jnp.uint32)
+        if dp:
+            s = s ^ (jax.lax.axis_index(dp).astype(jnp.uint32)
+                     * jnp.uint32(0x27D4EB2F))
+        if mp:
+            s = s ^ (jax.lax.axis_index(mp).astype(jnp.uint32)
+                     * jnp.uint32(0x165667B1))
+        return s
+
     if bias is None:
         mapped = jax.shard_map(
-            lambda q_, k_, v_: fn(q_, k_, v_),
-            mesh=mesh, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec,
-            check_vma=False)
-        return mapped(q, k, v)
+            lambda q_, k_, v_, s_: fn(q_, k_, v_,
+                                      dropout_seed=local_seed(s_)),
+            mesh=mesh, in_specs=(qkv_spec,) * 3 + (P(),),
+            out_specs=qkv_spec, check_vma=False)
+        return mapped(q, k, v, seed)
+    bias_spec = P(dp if bias.shape[0] > 1 else None,
+                  mp if bias.shape[1] > 1 else None,
+                  sp_axis, None)
     mapped = jax.shard_map(
-        lambda q_, k_, v_, b_: fn(q_, k_, v_, bias=b_),
-        mesh=mesh, in_specs=(qkv_spec,) * 3 + (bias_spec,),
+        lambda q_, k_, v_, b_, s_: fn(q_, k_, v_, bias=b_,
+                                      dropout_seed=local_seed(s_)),
+        mesh=mesh, in_specs=(qkv_spec,) * 3 + (bias_spec, P()),
         out_specs=qkv_spec, check_vma=False)
-    return mapped(q, k, v, bias)
+    return mapped(q, k, v, bias, seed)

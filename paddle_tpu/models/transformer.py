@@ -54,17 +54,11 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     v = split_heads(v, d_value)
 
     if fused:
-        # flash/ring kernel path: O(L) memory, no [lq, lk] score tensor.
-        if dropout_rate:
-            import warnings
-
-            warnings.warn(
-                "fused attention does not apply attention-probability "
-                "dropout (the probabilities never exist as a tensor); "
-                f"dropout_rate={dropout_rate} is ignored inside attention",
-                stacklevel=2)
+        # flash/ring kernel path: O(L) memory, no [lq, lk] score tensor;
+        # attention-prob dropout happens inside the kernel (hash mask)
         ctx = layers.fused_attention(q, k, v, bias=attn_bias,
                                      sm_scale=float(d_key) ** -0.5,
+                                     dropout_rate=dropout_rate,
                                      seq_parallel=seq_parallel)
     else:
         q = layers.scale(q, scale=float(d_key) ** -0.5)

@@ -78,9 +78,29 @@ class DistributeTranspiler:
                 for n in names:
                     if n in block.vars:
                         v = block.vars[n]
-                        if v.shape and tuple(v.shape) == pshape \
-                                and v.desc.sharding is None:
+                        if not v.shape or tuple(v.shape) != pshape:
+                            continue
+                        cur = v.desc.sharding
+                        if cur is None:
                             v.set_sharding(sharding)
+                            continue
+                        # ZeRO 'ax?' deferred markers (optimizer.py
+                        # _add_accumulator) merge with the param's new
+                        # annotation instead of blocking it; real axes
+                        # were deliberate — leave those alone
+                        if all(a is None or (isinstance(a, str)
+                                             and a.endswith("?"))
+                               for a in cur):
+                            merged = list(sharding)
+                            for mk in cur:
+                                if mk is None or mk[:-1] in merged \
+                                        or mk in merged:
+                                    continue
+                                for i, a in enumerate(merged):
+                                    if a is None:
+                                        merged[i] = mk
+                                        break
+                            v.set_sharding(merged)
 
     @property
     def mesh_axes(self) -> Dict[str, int]:

@@ -181,3 +181,116 @@ def test_flash_non_divisible_bias_grad():
         q, k, v, bias=b, block_q=32, block_k=32, impl="xla").sum())(bias)
     g2 = jax.grad(lambda b: naive_attention(q, k, v, bias=b).sum())(bias)
     np.testing.assert_allclose(g1, g2, atol=5e-4, rtol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel attention-probability dropout (VERDICT r1 weak#4)
+# ---------------------------------------------------------------------------
+
+def naive_dropout_attention(q, k, v, seed, rate, bias=None, causal=False):
+    """Golden: dense softmax attention with the SAME hash mask the kernels
+    use, applied to the normalised probabilities (inverted dropout)."""
+    from paddle_tpu.kernels.flash_attention import keep_scale
+    b, h, lq, _ = q.shape
+    lk = k.shape[2]
+    sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    bh = (jnp.arange(b, dtype=jnp.int32)[:, None] * h +
+          jnp.arange(h, dtype=jnp.int32)[None, :])[:, :, None, None]
+    rows = jnp.arange(lq, dtype=jnp.int32)[None, None, :, None]
+    cols = jnp.arange(lk, dtype=jnp.int32)[None, None, None, :]
+    scale = keep_scale(jnp.uint32(seed), bh, rows, cols, rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", p * scale,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_matches_hash_reference(causal):
+    q, k, v = make_qkv(lq=32, lk=32, d=8)
+    out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                          impl="xla", dropout_rate=0.3, dropout_seed=7)
+    ref = naive_dropout_attention(q, k, v, seed=7, rate=0.3, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    # and the grads: fwd custom-vjp vs jax AD through the dense reference
+    g1 = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=8, block_k=8, impl="xla",
+        dropout_rate=0.3, dropout_seed=7).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: naive_dropout_attention(
+        q, k, v, seed=7, rate=0.3, causal=causal).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_flash_dropout_bias_grad():
+    q, k, v = make_qkv(lq=32, lk=32, d=8)
+    bias = jnp.asarray(np.random.RandomState(1).randn(2, 1, 32, 32)
+                       .astype(np.float32))
+    g1 = jax.grad(lambda b: flash_attention(
+        q, k, v, bias=b, block_q=8, block_k=8, impl="xla",
+        dropout_rate=0.2, dropout_seed=3).sum())(bias)
+    g2 = jax.grad(lambda b: naive_dropout_attention(
+        q, k, v, seed=3, rate=0.2, bias=b).sum())(bias)
+    np.testing.assert_allclose(g1, g2, atol=5e-4, rtol=5e-4)
+
+
+def test_flash_dropout_pallas_interpret_matches_xla():
+    # the pallas kernel's in-kernel hash mask must equal the XLA path's —
+    # that is what makes the custom-vjp backward consistent on TPU
+    q, k, v = make_qkv(b=1, h=2, lq=32, lk=32, d=8)
+    out_p = flash_attention(q, k, v, block_q=16, block_k=16,
+                            impl="pallas_interpret",
+                            dropout_rate=0.25, dropout_seed=11)
+    out_x = flash_attention(q, k, v, block_q=16, block_k=16, impl="xla",
+                            dropout_rate=0.25, dropout_seed=11)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_dropout_statistics():
+    # ~rate of the attention mass is dropped; mean is preserved (inverted)
+    q, k, v = make_qkv(b=4, h=4, lq=64, lk=64, d=8)
+    clean = flash_attention(q, k, v, impl="xla")
+    drop = flash_attention(q, k, v, impl="xla", dropout_rate=0.5,
+                           dropout_seed=123)
+    assert not np.allclose(np.asarray(clean), np.asarray(drop))
+    # different seeds give different masks; same seed reproduces
+    drop2 = flash_attention(q, k, v, impl="xla", dropout_rate=0.5,
+                            dropout_seed=124)
+    drop_same = flash_attention(q, k, v, impl="xla", dropout_rate=0.5,
+                                dropout_seed=123)
+    assert not np.allclose(np.asarray(drop), np.asarray(drop2))
+    np.testing.assert_array_equal(np.asarray(drop), np.asarray(drop_same))
+
+
+def test_keep_scale_rate():
+    from paddle_tpu.kernels.flash_attention import keep_scale
+    rows = jnp.arange(512, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(512, dtype=jnp.int32)[None, :]
+    sc = keep_scale(jnp.uint32(42), jnp.int32(0), rows, cols, 0.3)
+    frac_dropped = float((sc == 0).mean())
+    assert abs(frac_dropped - 0.3) < 0.01
+
+
+def test_ring_dropout_runs_and_differs():
+    mesh = make_mesh({"sp": 4}, jax.devices()[:4])
+    q, k, v = make_qkv(b=2, h=2, lq=32, lk=32, d=8)
+    clean = ring_attention_sharded(mesh, q, k, v, dp_axis=None)
+    drop = ring_attention_sharded(mesh, q, k, v, dp_axis=None,
+                                  dropout_rate=0.4, dropout_seed=5)
+    assert not np.allclose(np.asarray(clean), np.asarray(drop))
+    # deterministic given the seed, and differentiable
+    drop2 = ring_attention_sharded(mesh, q, k, v, dp_axis=None,
+                                   dropout_rate=0.4, dropout_seed=5)
+    np.testing.assert_array_equal(np.asarray(drop), np.asarray(drop2))
+    g = jax.grad(lambda q: ring_attention_sharded(
+        mesh, q, k, v, dp_axis=None, dropout_rate=0.4,
+        dropout_seed=5).sum())(q)
+    assert np.isfinite(np.asarray(g)).all()

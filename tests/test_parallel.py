@@ -224,3 +224,23 @@ def test_seq_model_data_parallel(fresh_programs):
         lv, = exe.run(main, feed={"w": make_seq(seqs, np.int32, bucket=8)},
                       fetch_list=[loss])
     assert np.isfinite(lv)
+
+
+def test_zero_markers_merge_with_transpile(fresh_programs):
+    """Adam(shard_moments_over='dp') + transpile(mp) must leave moments
+    sharded over BOTH axes — the deferred 'dp?' marker merges with the
+    param's mp annotation instead of blocking it (r2 review finding)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=2048, bias_attr=False)
+    loss = fluid.layers.mean(h)
+    opt = fluid.optimizer.Adam(learning_rate=0.1, shard_moments_over="dp")
+    opt_ops, pg = opt.minimize(loss)
+    t = parallel.DistributeTranspiler()
+    t.transpile(opt_ops, pg, trainers=4, mesh_axes={"dp": 4, "mp": 2})
+    w = [p for p in main.global_block().all_parameters()
+         if 2048 in p.shape][0]
+    assert "mp" in w.sharding
+    m1 = opt._get_accumulator("moment1", w)
+    assert "mp" in m1.desc.sharding          # param's axis propagated
+    assert "dp?" in m1.desc.sharding         # ZeRO marker survived the merge
