@@ -103,6 +103,15 @@ def append_gradient_clip_ops(param_grads, main_program=None):
     attrs = []
     for p, g in param_grads:
         clip = getattr(p, "gradient_clip_attr", None) or _default_clip
+        if clip is not None:
+            from .regularizer import grad_is_selected_rows
+
+            if grad_is_selected_rows(g):
+                raise NotImplementedError(
+                    f"gradient clipping on sparse-grad parameter "
+                    f"{p.name!r} (embedding is_sparse=True) is not "
+                    f"supported — SelectedRows grads cannot flow through "
+                    f"clip ops; build the embedding with is_sparse=False")
         attrs.append(clip)
         if clip is not None:
             clip.process_context(context, p, g)

@@ -1,0 +1,84 @@
+"""SelectedRows: the sparse row-slice gradient container, TPU-native.
+
+Reference analog: paddle/framework/selected_rows.h:19 — a (rows, value,
+height) triple used chiefly for embedding-table gradients
+(lookup_table_op.cc grad), so a huge-vocab table's gradient is a [N, D]
+slab of looked-up rows instead of a dense [V, D] tensor.
+
+TPU redesign: a registered pytree so it flows through the jitted step
+function like any array.  Rows MAY contain duplicates (the reference allows
+this too); every *linear* consumer — scatter-apply, allreduce, sum fan-in —
+is exact under duplicates, and non-linear consumers (adagrad's g²) call
+:func:`merge_rows` first, which sums duplicates with a static-shape
+sort+segment-sum (XLA-friendly: no dynamic output size; vacated slots get
+an out-of-range sentinel row that scatter drops).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "merge_rows"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int32 [N] row indices (duplicates allowed; entries equal to
+    ``height`` are vacated slots and are ignored); values: [N, D] row data;
+    height: static vocab size V."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def to_dense(self):
+        z = jnp.zeros(self.dense_shape, self.values.dtype)
+        # mode='drop' ignores sentinel (== height) rows from merge_rows
+        return z.at[self.rows].add(self.values, mode="drop")
+
+    def scatter_add_to(self, dense, scale=None):
+        """dense.at[rows] += scale * values (exact under duplicates)."""
+        v = self.values.astype(dense.dtype)
+        if scale is not None:
+            v = v * scale
+        return dense.at[self.rows].add(v, mode="drop")
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={getattr(self.rows, 'shape', None)}, "
+                f"values={getattr(self.values, 'shape', None)}, "
+                f"height={self.height})")
+
+
+def merge_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum duplicate rows — static-shape analog of the reference's
+    scatter-merge (operators/math/selected_rows_functor.cc MergeAdd).
+
+    Output keeps length N: slot i holds the sum of one distinct row's
+    duplicates if i is the first (sorted) occurrence of that row, else the
+    sentinel row ``height`` with zero values (dropped by consumers).
+    """
+    n = sr.rows.shape[0]
+    order = jnp.argsort(sr.rows)
+    r = sr.rows[order]
+    v = sr.values[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(first) - 1                       # [N] segment ids
+    merged_v = jax.ops.segment_sum(v, seg, num_segments=n)
+    merged_r = jnp.full((n,), sr.height, jnp.int32).at[seg].set(r)
+    return SelectedRows(merged_r, merged_v, sr.height)

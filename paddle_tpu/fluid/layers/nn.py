@@ -15,6 +15,7 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     "fc", "embedding", "dropout", "cross_entropy", "square_error_cost",
+    "sigmoid_cross_entropy_with_logits",
     "accuracy", "auc", "topk", "conv2d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "reduce_sum", "reduce_mean", "reduce_max",
     "reduce_min", "reduce_prod", "reshape", "transpose", "matmul", "one_hot",
@@ -74,15 +75,17 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32", name=None,
               main_program=None, startup_program=None):
     """Embedding lookup — reference layers/nn.py embedding:192.  is_sparse
-    selected SelectedRows grads in the reference; on TPU the backward is an
-    XLA scatter-add either way, so the flag is accepted and ignored."""
+    selects the SelectedRows gradient path (rows+values of the looked-up
+    ids only — no dense [vocab, dim] scatter), exactly like the reference's
+    lookup_table_op SelectedRows grad; sgd/adagrad apply it as a row
+    scatter, other optimizers densify."""
     helper = LayerHelper("embedding", param_attr=param_attr, name=name,
                          main_program=main_program,
                          startup_program=startup_program)
     w = helper.create_parameter(helper.param_attr, shape=list(size),
                                 dtype=dtype)
     out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
-    attrs = {}
+    attrs = {"is_sparse": bool(is_sparse)}
     if padding_idx is not None:
         attrs["padding_idx"] = int(padding_idx)
     helper.append_op("lookup_table", {"W": w, "Ids": input}, {"Out": out},
@@ -117,6 +120,16 @@ def softmax_with_cross_entropy(logits, label, soft_label=False):
                      {"Softmax": softmax, "Loss": loss},
                      {"soft_label": soft_label})
     return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    """Per-element binary CE on logits — reference
+    sigmoid_cross_entropy_with_logits_op.cc / layers usage in CTR nets."""
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     {"X": x, "Label": label}, {"Out": out})
+    return out
 
 
 def square_error_cost(input, label, name=None):

@@ -86,7 +86,27 @@ def mean(ctx, x):
 @primitive("sum", inputs=["X*"], seq_transparent=True)
 def sum_op(ctx, xs):
     """Variadic add — reference sum_op.cc (also the grad fan-in accumulator
-    inserted by backward, reference backward.py:134)."""
+    inserted by backward, reference backward.py:134).  SelectedRows inputs
+    (sparse embedding grads, reference sum_op.cc SelectedRows path): all
+    sparse -> concatenated SelectedRows (exact, duplicates allowed); mixed
+    sparse+dense -> scatter the sparse parts onto the dense sum."""
+    from ..core.selected_rows import SelectedRows
+
+    sparse = [x for x in xs if isinstance(x, SelectedRows)]
+    if sparse:
+        dense = [x for x in xs if not isinstance(x, SelectedRows)]
+        if not dense:
+            if len(sparse) == 1:
+                return sparse[0]
+            rows = jnp.concatenate([s.rows for s in sparse])
+            vals = jnp.concatenate([s.values for s in sparse])
+            return SelectedRows(rows, vals, sparse[0].height)
+        out = dense[0]
+        for x in dense[1:]:
+            out = out + x
+        for s in sparse:
+            out = s.scatter_add_to(out)
+        return out
     out = xs[0]
     for x in xs[1:]:
         out = out + x

@@ -20,12 +20,26 @@ from ..core.registry import primitive
 
 
 def _f32(x):
+    from ..core.selected_rows import SelectedRows
+
+    if isinstance(x, SelectedRows):
+        # optimizers without a dedicated sparse kernel densify the grad
+        # (exact — to_dense sums duplicate rows), matching the reference,
+        # where only sgd/adagrad have SelectedRows kernels
+        x = x.to_dense()
     return x.astype(jnp.float32)
 
 
 @primitive("sgd", inputs=["Param", "Grad", "LearningRate"],
            outputs=["ParamOut"], no_grad=True)
 def sgd(ctx, p, g, lr):
+    from ..core.selected_rows import SelectedRows
+
+    if isinstance(g, SelectedRows):
+        # sparse row update (reference sgd_op.h SelectedRows kernel):
+        # touches only looked-up rows; exact under duplicate rows since
+        # the update is linear in the gradient
+        return g.scatter_add_to(p, scale=-lr.astype(jnp.float32))
     return (_f32(p) - lr * _f32(g)).astype(p.dtype)
 
 
@@ -77,7 +91,19 @@ def adamax(ctx, p, g, lr, m, u, b1p):
 @primitive("adagrad", inputs=["Param", "Grad", "Moment", "LearningRate"],
            outputs=["ParamOut", "MomentOut"], no_grad=True)
 def adagrad(ctx, p, g, m, lr):
+    from ..core.selected_rows import SelectedRows, merge_rows
+
     eps = ctx.attr("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        # reference adagrad_op.cc SelectedRows kernel: merge duplicate rows
+        # first (g² is non-linear), then update only the touched rows
+        sr = merge_rows(g)
+        gv = sr.values.astype(jnp.float32)
+        mo = m.at[sr.rows].add(gv * gv, mode="drop")
+        mrows = jnp.take(mo, sr.rows, axis=0, mode="clip")
+        upd = -lr.astype(jnp.float32) * gv / (jnp.sqrt(mrows) + eps)
+        po = p.at[sr.rows].add(upd.astype(p.dtype), mode="drop")
+        return po, mo
     g = _f32(g)
     mo = m + g * g
     return (_f32(p) - lr * g / (jnp.sqrt(mo) + eps)).astype(p.dtype), mo

@@ -176,6 +176,34 @@ def lookup_table(ctx, w, ids):
     return SeqArray(out, lengths) if seq else out
 
 
+@primitive("lookup_table_grad", inputs=["W", "Ids", "Out@GRAD"],
+           outputs=["W@GRAD"], no_grad=True)
+def lookup_table_grad(ctx, w, ids, og):
+    """Hand-written adjoint of lookup_table (preempts the generic vjp).
+
+    is_sparse=True returns a SelectedRows (rows=looked-up ids, values=output
+    grads, duplicates allowed) — the TPU analog of the reference's
+    SelectedRows grad in lookup_table_op.cc: no [V, D] dense buffer is ever
+    written for huge-vocab tables; the optimizer applies it as a row
+    scatter.  Dense mode is the plain scatter-add.
+    """
+    from ..core.selected_rows import SelectedRows
+
+    idv = ids.data if isinstance(ids, SeqArray) else ids
+    ogv = og.data if isinstance(og, SeqArray) else og
+    if idv.ndim > 1 and idv.shape[-1] == 1:
+        idv = idv.squeeze(-1)
+    rows = idv.reshape(-1).astype(jnp.int32)            # [N]
+    dim = ogv.shape[-1]
+    vals = ogv.reshape(-1, dim)                         # [N, D]
+    pad = ctx.attr("padding_idx", None)
+    if pad is not None:
+        vals = jnp.where((rows == pad)[:, None], 0.0, vals)
+    if ctx.attr("is_sparse", False):
+        return SelectedRows(rows, vals, w.shape[0])
+    return jnp.zeros_like(w).at[rows].add(vals.astype(w.dtype))
+
+
 @primitive("multiplex", inputs=["Ids", "X*"], stop_grad_slots=("Ids",))
 def multiplex(ctx, ids, xs):
     """reference multiplex_op.cc: per-row select among candidate tensors."""

@@ -36,16 +36,52 @@ class L1DecayRegularizer(WeightDecayRegularizer):
         return decay
 
 
+def grad_is_selected_rows(grad) -> bool:
+    """True if `grad` is produced by a sparse lookup_table_grad (directly or
+    through a sum fan-in) — i.e. its runtime value is a SelectedRows, which
+    elementwise ops cannot consume."""
+    producers = {}
+    for op in grad.block.ops:
+        for names in op.desc.outputs.values():
+            for n in names:
+                producers[n] = op
+
+    def check(name, depth=0):
+        op = producers.get(name)
+        if op is None or depth > 8:
+            return False
+        if op.type == "lookup_table_grad":
+            return bool(op.desc.attrs.get("is_sparse"))
+        if op.type in ("sum", "assign"):   # fan-in / finalize passthrough
+            return any(check(n, depth + 1)
+                       for ns in op.desc.inputs.values() for n in ns)
+        return False
+
+    return check(grad.name)
+
+
 def append_regularization_ops(parameters_and_grads, regularization=None,
                               main_program=None):
     """reference regularizer.py:15 — param-level regularizer wins over the
-    optimizer-level default."""
+    optimizer-level default.  Sparse (SelectedRows) grads skip
+    regularization with a warning, matching the reference, which has no
+    SelectedRows weight-decay kernel either."""
     from .layer_helper import LayerHelper
 
     out = []
     for param, grad in parameters_and_grads:
         regularizer = getattr(param, "regularizer", None) or regularization
         if grad is None or regularizer is None:
+            out.append((param, grad))
+            continue
+        if grad_is_selected_rows(grad):
+            import warnings
+
+            warnings.warn(
+                f"regularization on sparse-grad parameter {param.name!r} "
+                "is not applied (SelectedRows grads have no dense decay "
+                "path); use is_sparse=False if decay is required",
+                stacklevel=2)
             out.append((param, grad))
             continue
         helper = LayerHelper("regularization", main_program=main_program)

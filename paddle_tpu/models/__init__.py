@@ -8,6 +8,7 @@ program, mirroring how the book chapters build nets, so user scripts look
 identical to the reference's."""
 
 from . import (  # noqa: F401
+    ctr,
     fit_a_line,
     image_classification,
     recognize_digits,

@@ -159,8 +159,6 @@ def test_fused_dropout_off_in_test_mode():
 def test_fused_dropout_trains():
     """Training with fused attention dropout converges (statistically the
     same regularisation as the unfused softmax->dropout->matmul chain)."""
-    main, startup, scope, avg_cost = build(fused=True)
-    # rebuild with dropout on
     from paddle_tpu.fluid import framework
     framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
