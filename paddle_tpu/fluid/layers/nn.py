@@ -15,7 +15,7 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     "fc", "embedding", "dropout", "cross_entropy", "square_error_cost",
-    "sigmoid_cross_entropy_with_logits",
+    "sigmoid_cross_entropy_with_logits", "cos_sim",
     "accuracy", "auc", "topk", "conv2d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "reduce_sum", "reduce_mean", "reduce_max",
     "reduce_min", "reduce_prod", "reshape", "transpose", "matmul", "one_hot",
@@ -137,6 +137,17 @@ def square_error_cost(input, label, name=None):
     out = helper.create_tmp_variable(input.dtype)
     helper.append_op("square_error_cost", {"X": input, "Y": label},
                      {"Out": out})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity — reference layers cos_sim (cos_sim_op.cc)."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_tmp_variable(X.dtype)
+    xnorm = helper.create_tmp_variable(X.dtype, stop_gradient=True)
+    ynorm = helper.create_tmp_variable(X.dtype, stop_gradient=True)
+    helper.append_op("cos_sim", {"X": X, "Y": Y},
+                     {"Out": out, "XNorm": xnorm, "YNorm": ynorm})
     return out
 
 

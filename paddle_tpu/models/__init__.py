@@ -11,7 +11,9 @@ from . import (  # noqa: F401
     ctr,
     fit_a_line,
     image_classification,
+    label_semantic_roles,
     recognize_digits,
+    recommender,
     sentiment,
     word2vec,
 )
