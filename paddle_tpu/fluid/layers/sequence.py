@@ -11,7 +11,49 @@ __all__ = ["linear_chain_crf", "crf_decoding",
            "sequence_conv", "sequence_pool", "sequence_first_step",
            "sequence_last_step", "sequence_expand", "sequence_concat",
            "sequence_reshape", "sequence_slice", "sequence_erase",
-           "sequence_mask"]
+           "sequence_mask", "warpctc", "edit_distance", "ctc_align",
+           "ctc_greedy_decoder"]
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, name=None):
+    """CTC loss — reference layers/nn.py warpctc:2548 (warpctc_op.cc).
+    `input`: SeqArray var [b, T, num_classes(+blank)] raw logits;
+    `label`: SeqArray var of blank-free targets; returns [b, 1] loss."""
+    helper = LayerHelper("warpctc", name=name)
+    loss = helper.create_tmp_variable(input.dtype)
+    helper.append_op("warpctc", {"Logits": input, "Label": label},
+                     {"Loss": loss},
+                     {"blank": int(blank),
+                      "norm_by_times": bool(norm_by_times)})
+    return loss
+
+
+def edit_distance(input, label, normalized=False, name=None):
+    """Levenshtein distance per pair — reference edit_distance_op.cc."""
+    helper = LayerHelper("edit_distance", name=name)
+    out = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op("edit_distance", {"Hyps": input, "Refs": label},
+                     {"Out": out}, {"normalized": bool(normalized)})
+    return out
+
+
+def ctc_align(input, blank=0, name=None):
+    """Merge repeats + drop blanks from a greedy CTC path."""
+    helper = LayerHelper("ctc_align", name=name)
+    out = helper.create_tmp_variable("int32", lod_level=1,
+                                     stop_gradient=True)
+    helper.append_op("ctc_align", {"Input": input}, {"Output": out},
+                     {"blank": int(blank)})
+    return out
+
+
+def ctc_greedy_decoder(input, blank=0, name=None):
+    """argmax over classes then ctc_align — the standard greedy decode."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    ids = helper.create_tmp_variable("int32", lod_level=1,
+                                     stop_gradient=True)
+    helper.append_op("argmax", {"X": input}, {"Out": ids}, {"axis": -1})
+    return ctc_align(ids, blank=blank, name=name)
 
 
 def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,

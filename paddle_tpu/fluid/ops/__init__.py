@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     activation_ops,
     beam_ops,
     control_flow_ops,
+    ctc_ops,
     io_ops,
     crf_ops,
     loss_ops,

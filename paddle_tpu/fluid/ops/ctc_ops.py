@@ -1,0 +1,167 @@
+"""CTC ops: warpctc loss, edit_distance, ctc_align.
+
+TPU-native replacements for the reference's CTC stack:
+- warpctc_op.cc (which dlopens Baidu's warp-ctc CUDA library,
+  platform/dynload/warpctc.h) becomes a `lax.scan` log-space
+  forward-algorithm over the extended blank-interleaved label sequence;
+  the backward is jax's adjoint of the scan — no hand-written grad, no
+  vendored library.  Semantics match warpctc_op.cc: raw (unnormalized)
+  logits in, internal log-softmax, `blank` attr, `norm_by_times`.
+- edit_distance_op.cc becomes a scanned Levenshtein DP (vmapped over the
+  batch).
+- ctc_align (greedy-path collapse: merge repeats, drop blanks) becomes a
+  static-shape mask + cumsum compaction.
+
+Sequences ride the SeqArray convention ([b, Tmax, ...] data + lengths)
+instead of LoD offsets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import SeqArray
+from ..core.registry import primitive
+
+NEG = -1e30
+
+
+def _ctc_loss_single(logp, t_len, labels, l_len, blank):
+    """Negative log-likelihood of `labels` under CTC for ONE sequence.
+
+    logp [Tmax, C] log-probs; labels [Lmax] int32 (blank-free);
+    t_len / l_len: actual lengths.  Standard alpha recursion over the
+    extended sequence ext = [blank, l1, blank, l2, ..., blank].
+    """
+    l_max = labels.shape[0]
+    s = 2 * l_max + 1
+    s_idx = jnp.arange(s)
+    lab_idx = jnp.clip((s_idx - 1) // 2, 0, l_max - 1)
+    ext = jnp.where(s_idx % 2 == 0, blank, labels[lab_idx])      # [S]
+    ext_prev2 = jnp.concatenate([jnp.full((2,), blank, ext.dtype), ext[:-2]])
+    # diagonal skip allowed into non-blank positions whose label differs
+    # from the one two back (the classic CTC transition rule)
+    allow_skip = (s_idx >= 2) & (ext != blank) & (ext != ext_prev2)
+    # positions beyond the true extended length never become valid ends;
+    # they also cannot pollute earlier positions (transitions only move
+    # forward), so no masking of the recursion itself is needed.
+
+    alpha0 = jnp.full((s,), NEG)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = alpha0.at[1].set(logp[0, ext[1]] if s > 1 else NEG)
+
+    def step(alpha, t):
+        lp = logp[t]                                             # [C]
+        a1 = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
+        a2 = jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]])
+        new = jnp.logaddexp(alpha, a1)
+        new = jnp.where(allow_skip, jnp.logaddexp(new, a2), new)
+        new = new + lp[ext]
+        # frozen past the sequence's true end
+        return jnp.where(t < t_len, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, logp.shape[0]))
+    end = 2 * l_len                       # index of final blank
+    # empty labels: only the all-blank path (alpha[0]) counts — logaddexp
+    # with max(end-1,0)=0 would double-count it (+ln 2)
+    ll = jnp.where(l_len > 0,
+                   jnp.logaddexp(alpha[end], alpha[jnp.maximum(end - 1, 0)]),
+                   alpha[0])
+    return -ll
+
+
+@primitive("warpctc", inputs=["Logits", "Label"], outputs=["Loss"],
+           stop_grad_slots=("Label",))
+def warpctc(ctx, logits, label):
+    """CTC loss — reference warpctc_op.cc.  Logits: SeqArray [b, T, C]
+    raw scores (class C-1 ... any index may be blank, attr `blank`,
+    default 0, must satisfy 0 <= blank < C).  Label: SeqArray [b, L]
+    blank-free targets.  Loss: [b, 1] float32."""
+    blank = ctx.attr("blank", 0)
+    norm_by_times = ctx.attr("norm_by_times", False)
+    assert isinstance(logits, SeqArray) and isinstance(label, SeqArray), \
+        "warpctc expects SeqArray logits and labels"
+    logp = jax.nn.log_softmax(logits.data.astype(jnp.float32), axis=-1)
+    lab = label.data.astype(jnp.int32)
+    if lab.ndim == 3 and lab.shape[-1] == 1:
+        lab = lab.squeeze(-1)
+    loss = jax.vmap(
+        lambda p, tl, y, yl: _ctc_loss_single(p, tl, y, yl, blank))(
+        logp, logits.lengths.astype(jnp.int32), lab,
+        label.lengths.astype(jnp.int32))
+    if norm_by_times:
+        loss = loss / jnp.maximum(logits.lengths.astype(jnp.float32), 1.0)
+    return loss[:, None]
+
+
+def _edit_distance_single(hyp, h_len, ref, r_len):
+    """Levenshtein distance for one (hyp, ref) pair, scanned row-wise."""
+    r_max = ref.shape[0]
+    d0 = jnp.arange(r_max + 1, dtype=jnp.float32)
+
+    def row(d, i):
+        h_tok = hyp[i]
+
+        def cell(left, j):
+            # left = new_d[j-1]; d[j] = up, d[j-1] = diag
+            sub = d[j] + jnp.where(h_tok == ref[j], 0.0, 1.0)
+            val = jnp.minimum(jnp.minimum(d[j + 1] + 1.0, left + 1.0), sub)
+            return val, val
+
+        _, tail = jax.lax.scan(cell, jnp.asarray(i + 1, jnp.float32),
+                               jnp.arange(r_max))
+        new_d = jnp.concatenate(
+            [jnp.asarray([i + 1], jnp.float32), tail])
+        return jnp.where(i < h_len, new_d, d), None
+
+    d, _ = jax.lax.scan(row, d0, jnp.arange(hyp.shape[0]))
+    return d[r_len]
+
+
+@primitive("edit_distance", inputs=["Hyps", "Refs"], outputs=["Out"],
+           no_grad=True)
+def edit_distance(ctx, hyps, refs):
+    """Levenshtein distance per sequence pair — reference
+    edit_distance_op.cc.  `normalized` divides by the reference length."""
+    normalized = ctx.attr("normalized", False)
+    assert isinstance(hyps, SeqArray) and isinstance(refs, SeqArray)
+    h = hyps.data.astype(jnp.int32)
+    r = refs.data.astype(jnp.int32)
+    if h.ndim == 3 and h.shape[-1] == 1:
+        h = h.squeeze(-1)
+    if r.ndim == 3 and r.shape[-1] == 1:
+        r = r.squeeze(-1)
+    hl = hyps.lengths.astype(jnp.int32)
+    rl = refs.lengths.astype(jnp.int32)
+    dist = jax.vmap(_edit_distance_single)(h, hl, r, rl)
+    if normalized:
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return dist[:, None]
+
+
+@primitive("ctc_align", inputs=["Input"], outputs=["Output"], no_grad=True)
+def ctc_align(ctx, x):
+    """Collapse a greedy CTC path: merge adjacent repeats, drop blanks —
+    the decode half of the reference's CTC stack (gserver
+    CTCLayer/evaluators; later fluid's ctc_align op).  In: SeqArray [b, T]
+    int paths; out: SeqArray [b, T] with compacted tokens left-aligned and
+    new lengths."""
+    blank = ctx.attr("blank", 0)
+    assert isinstance(x, SeqArray)
+    ids = x.data.astype(jnp.int32)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    b, t_max = ids.shape
+    t_idx = jnp.arange(t_max)[None, :]
+    in_range = t_idx < x.lengths.astype(jnp.int32)[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((b, 1), -1, ids.dtype), ids[:, :-1]], axis=1)
+    keep = (ids != blank) & (ids != prev) & in_range
+    pos = jnp.cumsum(keep, axis=1) - 1                  # target slot
+    pos = jnp.where(keep, pos, t_max)                   # dropped -> OOB
+    out = jnp.zeros_like(ids)
+    out = jax.vmap(lambda o, p, v: o.at[p].set(v, mode="drop"))(
+        out, pos, ids)
+    new_len = keep.sum(axis=1).astype(x.lengths.dtype)
+    return SeqArray(out, new_len)

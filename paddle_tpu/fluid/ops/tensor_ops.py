@@ -152,7 +152,7 @@ def top_k(ctx, x):
     return vals, idx.astype(jnp.int32)
 
 
-@primitive("argmax", no_grad=True)
+@primitive("argmax", no_grad=True, seq_transparent=True)
 def argmax(ctx, x):
     return jnp.argmax(x, axis=ctx.attr("axis", -1)).astype(jnp.int32)
 

@@ -1,0 +1,239 @@
+"""CTC stack: warpctc loss, edit_distance, ctc_align, greedy decode.
+
+Goldens: a brute-force enumeration of all CTC paths (exact for tiny T),
+python-Levenshtein DP for edit_distance, and hand-collapsed paths for
+ctc_align — mirroring the reference's OpTest goldens for warpctc_op /
+edit_distance_op.  The analytic grad (vjp of the scanned forward
+algorithm) is checked against a central finite difference.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import SeqArray, make_seq
+from tests.op_test import OpTestCase
+
+
+def brute_force_ctc_nll(logits, labels, blank=0):
+    """- log P(labels | logits): enumerate EVERY length-T path and sum the
+    probabilities of those that collapse to `labels`."""
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != blank and s != prev:
+                collapsed.append(s)
+            prev = s
+        if collapsed == list(labels):
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    return -np.log(total)
+
+
+def levenshtein(a, b):
+    d = np.arange(len(b) + 1, dtype=float)
+    for i, x in enumerate(a):
+        prev = d.copy()
+        d[0] = i + 1
+        for j, y in enumerate(b):
+            d[j + 1] = min(prev[j + 1] + 1, d[j] + 1,
+                           prev[j] + (0 if x == y else 1))
+    return d[len(b)]
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(0)
+    T, C = 4, 3
+    seqs = [rng.randn(T, C).astype(np.float32) for _ in range(3)]
+    labels = [[1], [2, 1], [1, 2]]
+    logits = SeqArray(np.stack(seqs)[..., :], np.array([T] * 3))
+    lab = make_seq(labels, dtype=np.int32, bucket=2)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [C], "float32", lod_level=1)
+        y = fluid.layers.data("y", [1], "int64", lod_level=1)
+        loss = fluid.layers.warpctc(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        out, = exe.run(main, feed={"x": logits, "y": lab},
+                       fetch_list=[loss])
+    got = np.asarray(out).ravel()
+    want = [brute_force_ctc_nll(s, l) for s, l in zip(seqs, labels)]
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_warpctc_variable_lengths():
+    """Shorter logit sequences and shorter labels inside one batch."""
+    rng = np.random.RandomState(1)
+    T, C = 5, 4
+    data = rng.randn(2, T, C).astype(np.float32)
+    t_lens = [5, 3]
+    labels = [[1, 3, 2], [2]]
+    logits = SeqArray(data, np.array(t_lens))
+    lab = make_seq(labels, dtype=np.int32, bucket=3)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [C], "float32", lod_level=1)
+        y = fluid.layers.data("y", [1], "int64", lod_level=1)
+        loss = fluid.layers.warpctc(x, y)
+        norm = fluid.layers.warpctc(x, y, norm_by_times=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        out, out_n = exe.run(main, feed={"x": logits, "y": lab},
+                             fetch_list=[loss, norm])
+    got = np.asarray(out).ravel()
+    for b in range(2):
+        want = brute_force_ctc_nll(data[b, :t_lens[b]], labels[b])
+        np.testing.assert_allclose(got[b], want, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out_n).ravel(),
+                               got / np.array(t_lens), rtol=1e-5)
+
+
+def test_warpctc_numeric_grad():
+    """OpTest-style: analytic grad of the scanned forward algorithm vs
+    central finite differences (the reference's check_grad contract)."""
+    rng = np.random.RandomState(2)
+    T, C = 4, 3
+    logits = SeqArray(rng.randn(2, T, C).astype(np.float32),
+                      np.array([T, 3]))
+    lab = make_seq([[1, 2], [1]], dtype=np.int32, bucket=2)
+    case = OpTestCase("warpctc",
+                      {"Logits": logits, "Label": lab},
+                      attrs={"blank": 0})
+    case.check_grad(["Logits"])
+
+
+def test_edit_distance():
+    hyps = make_seq([[1, 2, 3], [4, 5], [1]], dtype=np.int32, bucket=3)
+    refs = make_seq([[1, 3, 3], [4, 5, 6], [7, 8]], dtype=np.int32,
+                    bucket=3)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        h = fluid.layers.data("h", [1], "int64", lod_level=1)
+        r = fluid.layers.data("r", [1], "int64", lod_level=1)
+        d = fluid.layers.edit_distance(h, r)
+        dn = fluid.layers.edit_distance(h, r, normalized=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        out, out_n = exe.run(main, feed={"h": hyps, "r": refs},
+                             fetch_list=[d, dn])
+    want = [levenshtein([1, 2, 3], [1, 3, 3]),
+            levenshtein([4, 5], [4, 5, 6]),
+            levenshtein([1], [7, 8])]
+    np.testing.assert_allclose(np.asarray(out).ravel(), want)
+    np.testing.assert_allclose(np.asarray(out_n).ravel(),
+                               np.array(want) / np.array([3, 3, 2]))
+
+
+def test_ctc_align():
+    paths = make_seq([[0, 1, 1, 0, 2, 2], [3, 0, 3, 3, 0, 0]],
+                     dtype=np.int32, bucket=6)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        p = fluid.layers.data("p", [1], "int64", lod_level=1)
+        out = fluid.layers.ctc_align(p)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        res, = exe.run(main, feed={"p": paths}, fetch_list=[out])
+    assert isinstance(res, SeqArray)
+    lens = np.asarray(res.lengths)
+    data = np.asarray(res.data)
+    np.testing.assert_array_equal(lens, [2, 2])
+    np.testing.assert_array_equal(data[0, :2], [1, 2])
+    np.testing.assert_array_equal(data[1, :2], [3, 3])
+
+
+def test_ctc_speech_model_trains():
+    """A DeepSpeech-shaped slice: BiGRU over frames -> per-frame logits ->
+    warpctc; the loss decreases and greedy decode approaches the target
+    transcripts (the reference's CTC book-level capability)."""
+    rng = np.random.RandomState(0)
+    n_classes, feat_dim, T = 6, 8, 12     # class 0 = blank
+    batch = 8
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feats = fluid.layers.data("feats", [feat_dim], "float32",
+                                  lod_level=1)
+        label = fluid.layers.data("label", [1], "int64", lod_level=1)
+        h = fluid.layers.fc(input=feats, size=24, act="tanh")
+        gru = fluid.layers.dynamic_gru(input=fluid.layers.fc(input=h,
+                                                             size=72),
+                                       size=24)
+        logits = fluid.layers.fc(input=gru, size=n_classes)
+        loss_vec = fluid.layers.warpctc(logits, label, blank=0)
+        avg = fluid.layers.mean(loss_vec)
+        decoded = fluid.layers.ctc_greedy_decoder(logits, blank=0)
+        dist = fluid.layers.edit_distance(decoded, label)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg)
+
+    # synthetic "speech": frame features correlated with the class emitted
+    # at that frame; transcripts are the collapsed class sequence
+    protos = rng.randn(n_classes, feat_dim).astype(np.float32)
+
+    def sample():
+        frames, trans = [], []
+        t_per = T // 4
+        classes = rng.randint(1, n_classes, 4)
+        for c in classes:
+            for _ in range(t_per):
+                frames.append(protos[c] + 0.1 * rng.randn(feat_dim))
+        collapsed = [int(classes[0])]
+        for c in classes[1:]:
+            if c != collapsed[-1]:
+                collapsed.append(int(c))
+        return np.array(frames, np.float32), collapsed
+
+    data = [sample() for _ in range(batch)]
+    feed = {
+        "feats": SeqArray(np.stack([f for f, _ in data]),
+                          np.array([T] * batch)),
+        "label": make_seq([t for _, t in data], dtype=np.int32, bucket=4),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses, dists = [], []
+        for _ in range(60):
+            l, dv = exe.run(main, feed=feed, fetch_list=[avg, dist])
+            losses.append(float(l))
+            dists.append(float(np.asarray(dv).mean()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, losses[::15]
+    assert dists[-1] < dists[0], (dists[0], dists[-1])
+
+
+def test_warpctc_empty_label():
+    """Empty transcript (silence): loss is exactly -log P(all-blank path)
+    (r2 review: the double-logaddexp used to overcount by ln 2)."""
+    rng = np.random.RandomState(4)
+    T, C = 3, 3
+    data = rng.randn(1, T, C).astype(np.float32)
+    logits = SeqArray(data, np.array([T]))
+    lab = SeqArray(np.zeros((1, 2, 1), np.int32), np.array([0]))
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [C], "float32", lod_level=1)
+        y = fluid.layers.data("y", [1], "int64", lod_level=1)
+        loss = fluid.layers.warpctc(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        out, = exe.run(main, feed={"x": logits, "y": lab},
+                       fetch_list=[loss])
+    want = brute_force_ctc_nll(data[0], [])
+    np.testing.assert_allclose(np.asarray(out).ravel(), [want], rtol=1e-4)
