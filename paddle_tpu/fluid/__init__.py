@@ -7,9 +7,9 @@ dispatching per-op kernels), with save/load, initializers, regularizers,
 clipping, and profiler."""
 
 from . import ops as _ops  # registers all op emitters  # noqa: F401
-from . import (clip, debugger, evaluator, initializer, io, layers,
-               learning_rate_decay, memory_optimization_transpiler, nets,
-               optimizer, profiler, regularizer, unique_name)
+from . import (checkpoint, clip, debugger, evaluator, initializer, io,
+               layers, learning_rate_decay, memory_optimization_transpiler,
+               nets, optimizer, profiler, regularizer, unique_name)
 from .memory_optimization_transpiler import memory_optimize
 from .backward import append_backward, calc_gradient
 from .core.lod import SeqArray, make_seq

@@ -9,6 +9,11 @@ Tensor wire format: a JSON header line {dtype, shape, lod} followed by raw
 little-endian bytes (lengths bytes appended for SeqArray).  Combine files
 stack entries with a manifest.  Device arrays are fetched through the PJRT
 runtime (np.asarray) and restored with device_put on next use.
+
+Durability (reference go/pserver/service.go:119-175 checkpoint semantics):
+every file is written to a temp name then atomically `os.replace`d, and
+carries a trailing CRC32 of the payload that load verifies — a torn or
+corrupted write can never be mistaken for a checkpoint.
 """
 
 from __future__ import annotations
@@ -16,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -28,9 +34,67 @@ from .framework import (Parameter, Program, Variable, default_main_program,
 __all__ = ["save_tensor", "load_tensor", "save_tensors", "load_tensors",
            "save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
-           "load_inference_model", "get_inference_program"]
+           "load_inference_model", "get_inference_program",
+           "CheckpointCorrupt"]
 
-_MAGIC = b"PDTPU\x01"
+_MAGIC = b"PDTPU\x01"      # legacy: no checksum
+_MAGIC2 = b"PDTPU\x02"     # payload followed by crc32 trailer
+
+
+class CheckpointCorrupt(Exception):
+    """A tensor file failed its CRC32 check (torn/partial write)."""
+
+
+def _fsync_dir(dirname: str) -> None:
+    """Persist the rename itself: without fsyncing the directory entry a
+    power loss can roll back os.replace after the caller saw success."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:  # platforms/filesystems without dir fsync
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, payload: bytes) -> None:
+    """tmp + fsync + os.replace + dir fsync — the pserver checkpoint
+    recipe (service.go:119-175 writes .tmp then renames)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+
+
+def _read_checked(path: str) -> bytes:
+    """Read a tensor/combine file, verify magic + CRC; returns payload
+    (the bytes after the magic, without the crc trailer)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    if buf[: len(_MAGIC2)] == _MAGIC2:
+        payload, trailer = buf[len(_MAGIC2): -4], buf[-4:]
+        (want,) = struct.unpack("<I", trailer)
+        got = zlib.crc32(payload) & 0xFFFFFFFF
+        if got != want:
+            raise CheckpointCorrupt(
+                f"{path}: crc mismatch (file {want:#x}, computed {got:#x})")
+        return payload
+    if buf[: len(_MAGIC)] == _MAGIC:   # legacy, unchecked
+        return buf[len(_MAGIC):]
+    raise CheckpointCorrupt(f"bad tensor file {path} (unknown magic)")
 
 
 def _tensor_bytes(value) -> bytes:
@@ -72,38 +136,29 @@ def _tensor_from(buf: bytes, offset: int = 0):
 
 
 def save_tensor(value, path: str) -> None:
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        f.write(_tensor_bytes(value))
+    payload = _tensor_bytes(value)
+    crc = struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+    _atomic_write(path, _MAGIC2 + payload + crc)
 
 
 def load_tensor(path: str):
-    with open(path, "rb") as f:
-        buf = f.read()
-    assert buf[: len(_MAGIC)] == _MAGIC, f"bad tensor file {path}"
-    value, _ = _tensor_from(buf, len(_MAGIC))
+    value, _ = _tensor_from(_read_checked(path), 0)
     return value
 
 
 def save_tensors(named: Dict[str, object], path: str) -> None:
     """Combine-file variant (save_combine_op.cc)."""
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        names = sorted(named)
-        manifest = json.dumps(names).encode()
-        f.write(struct.pack("<I", len(manifest)))
-        f.write(manifest)
-        for n in names:
-            f.write(_tensor_bytes(named[n]))
+    names = sorted(named)
+    manifest = json.dumps(names).encode()
+    payload = struct.pack("<I", len(manifest)) + manifest + b"".join(
+        _tensor_bytes(named[n]) for n in names)
+    crc = struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+    _atomic_write(path, _MAGIC2 + payload + crc)
 
 
 def load_tensors(path: str) -> Dict[str, object]:
-    with open(path, "rb") as f:
-        buf = f.read()
-    assert buf[: len(_MAGIC)] == _MAGIC, f"bad tensor file {path}"
-    off = len(_MAGIC)
+    buf = _read_checked(path)
+    off = 0
     (mlen,) = struct.unpack_from("<I", buf, off)
     off += 4
     names = json.loads(buf[off: off + mlen].decode())

@@ -27,6 +27,13 @@ from ..core.registry import primitive
 NEG = -1e30
 
 
+def _squeeze_tokens(a):
+    """SeqArray int sequences carry [b, T, 1]; ops work on [b, T]."""
+    if a.ndim == 3 and a.shape[-1] == 1:
+        return a.squeeze(-1)
+    return a
+
+
 def _ctc_loss_single(logp, t_len, labels, l_len, blank):
     """Negative log-likelihood of `labels` under CTC for ONE sequence.
 
@@ -83,15 +90,17 @@ def warpctc(ctx, logits, label):
     assert isinstance(logits, SeqArray) and isinstance(label, SeqArray), \
         "warpctc expects SeqArray logits and labels"
     logp = jax.nn.log_softmax(logits.data.astype(jnp.float32), axis=-1)
-    lab = label.data.astype(jnp.int32)
-    if lab.ndim == 3 and lab.shape[-1] == 1:
-        lab = lab.squeeze(-1)
+    lab = _squeeze_tokens(label.data.astype(jnp.int32))
     loss = jax.vmap(
         lambda p, tl, y, yl: _ctc_loss_single(p, tl, y, yl, blank))(
         logp, logits.lengths.astype(jnp.int32), lab,
         label.lengths.astype(jnp.int32))
     if norm_by_times:
-        loss = loss / jnp.maximum(logits.lengths.astype(jnp.float32), 1.0)
+        # reference warpctc_grad_op scales ONLY the gradient by 1/T; the
+        # Loss values stay unnormalized — value=L, grad=grad(L)/T
+        t = jnp.maximum(logits.lengths.astype(jnp.float32), 1.0)
+        scaled = loss / t
+        loss = jax.lax.stop_gradient(loss - scaled) + scaled
     return loss[:, None]
 
 
@@ -126,12 +135,8 @@ def edit_distance(ctx, hyps, refs):
     edit_distance_op.cc.  `normalized` divides by the reference length."""
     normalized = ctx.attr("normalized", False)
     assert isinstance(hyps, SeqArray) and isinstance(refs, SeqArray)
-    h = hyps.data.astype(jnp.int32)
-    r = refs.data.astype(jnp.int32)
-    if h.ndim == 3 and h.shape[-1] == 1:
-        h = h.squeeze(-1)
-    if r.ndim == 3 and r.shape[-1] == 1:
-        r = r.squeeze(-1)
+    h = _squeeze_tokens(hyps.data.astype(jnp.int32))
+    r = _squeeze_tokens(refs.data.astype(jnp.int32))
     hl = hyps.lengths.astype(jnp.int32)
     rl = refs.lengths.astype(jnp.int32)
     dist = jax.vmap(_edit_distance_single)(h, hl, r, rl)
@@ -149,9 +154,7 @@ def ctc_align(ctx, x):
     new lengths."""
     blank = ctx.attr("blank", 0)
     assert isinstance(x, SeqArray)
-    ids = x.data.astype(jnp.int32)
-    if ids.ndim == 3 and ids.shape[-1] == 1:
-        ids = ids.squeeze(-1)
+    ids = _squeeze_tokens(x.data.astype(jnp.int32))
     b, t_max = ids.shape
     t_idx = jnp.arange(t_max)[None, :]
     in_range = t_idx < x.lengths.astype(jnp.int32)[:, None]

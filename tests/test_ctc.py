@@ -96,8 +96,43 @@ def test_warpctc_variable_lengths():
     for b in range(2):
         want = brute_force_ctc_nll(data[b, :t_lens[b]], labels[b])
         np.testing.assert_allclose(got[b], want, rtol=1e-4)
-    np.testing.assert_allclose(np.asarray(out_n).ravel(),
-                               got / np.array(t_lens), rtol=1e-5)
+    # norm_by_times: the LOSS VALUE stays unnormalized (reference
+    # warpctc_grad_op scales only the gradient by 1/T)
+    np.testing.assert_allclose(np.asarray(out_n).ravel(), got, rtol=1e-5)
+
+
+def test_warpctc_norm_by_times_scales_grad_only():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.fluid.core.desc import OpDesc
+    from paddle_tpu.fluid.core.registry import EmitCtx, get_op_info
+
+    rng = np.random.RandomState(3)
+    T, C = 4, 3
+    data = jnp.asarray(rng.randn(2, T, C).astype(np.float32))
+    t_lens = jnp.asarray([4, 2])
+    lab = make_seq([[1, 2], [1]], dtype=np.int32, bucket=2)
+    info = get_op_info("warpctc")
+
+    def run(logits_data, norm):
+        op = OpDesc("warpctc", {"Logits": ["x"], "Label": ["y"]},
+                    {"Loss": ["l"]},
+                    {"blank": 0, "norm_by_times": norm})
+        out = info.emit(EmitCtx(op),
+                        {"Logits": [SeqArray(logits_data, t_lens)],
+                         "Label": [lab]})
+        return out["Loss"][0].sum()
+
+    v_plain = run(data, False)
+    v_norm = run(data, True)
+    np.testing.assert_allclose(np.asarray(v_norm), np.asarray(v_plain),
+                               rtol=1e-6)                 # values equal
+    g_plain = jax.grad(lambda d: run(d, False))(data)
+    g_norm = jax.grad(lambda d: run(d, True))(data)
+    scale = np.asarray(t_lens, np.float32)[:, None, None]
+    np.testing.assert_allclose(np.asarray(g_norm),
+                               np.asarray(g_plain) / scale,
+                               atol=1e-6)                 # grads scaled 1/T
 
 
 def test_warpctc_numeric_grad():
