@@ -93,9 +93,6 @@ static VarDesc parse_var(const JsonPtr& j) {
 static OpDesc parse_op(const JsonPtr& j) {
   OpDesc op;
   op.type = j->at("type")->s;
-  for (auto* slot_map : {std::make_pair("inputs", &op.inputs),
-                         std::make_pair("outputs", &op.outputs)}) {
-  }
   auto ins = j->get("inputs");
   if (ins)
     for (auto& kv : ins->obj) {
@@ -160,15 +157,22 @@ std::vector<std::string> validate_program(const ProgramDesc& prog) {
     return errors;
   }
   for (auto& b : prog.blocks) {
-    if (b.parent_idx >= nblocks)
+    // parent must come earlier (blocks are created parent-first); this
+    // also rules out parent cycles, so the visible() walk terminates
+    bool parent_ok = b.parent_idx < b.idx;
+    if (b.parent_idx >= nblocks || !parent_ok)
       errors.push_back("block " + std::to_string(b.idx) +
-                       ": parent_idx out of range");
+                       ": parent_idx out of range or not an ancestor");
     // a var is visible if declared in this block or an ancestor
     auto visible = [&](const std::string& name) {
       const BlockDesc* cur = &b;
-      while (cur) {
+      int hops = 0;
+      while (cur && hops++ <= nblocks) {     // bounded even on bad input
         if (cur->vars.count(name)) return true;
-        cur = cur->parent_idx >= 0 && cur->parent_idx < nblocks
+        // parent must be a real, earlier block — idx is self-declared and
+        // may lie, so bound by nblocks too (OOB read otherwise)
+        cur = (cur->parent_idx >= 0 && cur->parent_idx < nblocks &&
+               cur->parent_idx < cur->idx)
                   ? &prog.blocks[cur->parent_idx]
                   : nullptr;
       }
@@ -293,6 +297,30 @@ BlockAnalysis analyze_block(const ProgramDesc& prog, int block_idx) {
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// inference pruning — the native engine behind fluid.io.prune_program
+// (reference framework Program.prune / prune.cc): backward slice of the
+// global block to the ops needed for `targets`; returns kept op indices.
+// ---------------------------------------------------------------------------
+
+std::vector<int> prune_block(const ProgramDesc& prog, int block_idx,
+                             const std::vector<std::string>& targets) {
+  const BlockDesc& b = prog.blocks.at(block_idx);
+  std::unordered_set<std::string> needed(targets.begin(), targets.end());
+  std::vector<int> keep;
+  for (int i = (int)b.ops.size() - 1; i >= 0; --i) {
+    bool hit = false;
+    for (auto& n : b.ops[i].all_outputs())
+      if (needed.count(n)) { hit = true; break; }
+    if (!hit) continue;
+    keep.push_back(i);
+    for (auto& n : b.ops[i].all_inputs())
+      if (!n.empty()) needed.insert(n);
+  }
+  std::reverse(keep.begin(), keep.end());
+  return keep;
+}
+
 std::string analysis_to_json(const BlockAnalysis& a) {
   auto root = Json::make(Json::OBJECT);
   auto topo = Json::make(Json::ARRAY);
@@ -319,3 +347,90 @@ std::string analysis_to_json(const BlockAnalysis& a) {
 }
 
 }  // namespace ptpu
+
+// ---------------------------------------------------------------------------
+// C ABI — the ctypes surface (paddle_tpu/native/__init__.py loads this .so).
+// Every entry returns a malloc'd NUL-terminated string the caller frees with
+// ptpu_free; errors come back as {"error": "..."} JSON.
+// ---------------------------------------------------------------------------
+
+#include <cstring>
+
+namespace {
+
+char* dup_out(const std::string& s) {
+  char* p = (char*)std::malloc(s.size() + 1);
+  std::memcpy(p, s.c_str(), s.size() + 1);
+  return p;
+}
+
+char* error_out(const std::string& msg) {
+  auto root = ptpu::Json::make(ptpu::Json::OBJECT);
+  root->obj["error"] = ptpu::Json::of_str(msg);
+  std::string out;
+  ptpu::write_json(root, &out);
+  return dup_out(out);
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptpu_free(char* p) { std::free(p); }
+
+// canonical re-serialization (fingerprint parity with desc.py)
+char* ptpu_reserialize(const char* text) {
+  try {
+    return dup_out(ptpu::reserialize(text));
+  } catch (const std::exception& e) {
+    return error_out(e.what());
+  }
+}
+
+// -> JSON array of error strings (empty array = valid)
+char* ptpu_validate(const char* text) {
+  try {
+    auto prog = ptpu::parse_program(text);
+    auto errs = ptpu::validate_program(prog);
+    auto root = ptpu::Json::make(ptpu::Json::ARRAY);
+    for (auto& m : errs) root->arr.push_back(ptpu::Json::of_str(m));
+    std::string out;
+    ptpu::write_json(root, &out);
+    return dup_out(out);
+  } catch (const std::exception& e) {
+    return error_out(e.what());
+  }
+}
+
+// -> {"topo_order":[...], "level":[...], "live_range":{...},
+//     "reuse_slot":{...}, "num_slots":N}
+char* ptpu_analyze(const char* text, int block_idx) {
+  try {
+    auto prog = ptpu::parse_program(text);
+    auto a = ptpu::analyze_block(prog, block_idx);
+    return dup_out(ptpu::analysis_to_json(a));
+  } catch (const std::exception& e) {
+    return error_out(e.what());
+  }
+}
+
+// targets_json: JSON array of var names -> JSON array of kept op indices
+char* ptpu_prune(const char* text, int block_idx, const char* targets_json) {
+  try {
+    auto prog = ptpu::parse_program(text);
+    ptpu::JsonParser tp(targets_json);
+    auto tj = tp.parse();
+    std::vector<std::string> targets;
+    for (auto& e : tj->arr) targets.push_back(e->s);
+    auto keep = ptpu::prune_block(prog, block_idx, targets);
+    auto root = ptpu::Json::make(ptpu::Json::ARRAY);
+    for (int i : keep) root->arr.push_back(ptpu::Json::of_int(i));
+    std::string out;
+    ptpu::write_json(root, &out);
+    return dup_out(out);
+  } catch (const std::exception& e) {
+    return error_out(e.what());
+  }
+}
+
+}  // extern "C"

@@ -272,27 +272,61 @@ class JsonParser {
 inline void write_json(const JsonPtr& j, std::string* out);
 
 inline void write_escaped(const std::string& s, std::string* out) {
+  // byte-matches python json.dumps default ensure_ascii=True: control
+  // chars and ALL non-ascii code points escape to \uXXXX (surrogate
+  // pairs above the BMP); UTF-8 is decoded here for that purpose
   out->push_back('"');
-  for (unsigned char c : s) {
-    switch (c) {
-      case '"': *out += "\\\""; break;
-      case '\\': *out += "\\\\"; break;
-      case '\b': *out += "\\b"; break;
-      case '\f': *out += "\\f"; break;
-      case '\n': *out += "\\n"; break;
-      case '\r': *out += "\\r"; break;
-      case '\t': *out += "\\t"; break;
-      default:
-        if (c < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof buf, "\\u%04x", c);
-          *out += buf;
-        } else {
-          out->push_back(static_cast<char>(c));
-          // NOTE: python json.dumps defaults to ensure_ascii=True, but the
-          // IR writer below re-encodes non-ascii via \u escapes too
-        }
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    unsigned char c = (unsigned char)s[i];
+    if (c < 0x80) {
+      switch (c) {
+        case '"': *out += "\\\""; break;
+        case '\\': *out += "\\\\"; break;
+        case '\b': *out += "\\b"; break;
+        case '\f': *out += "\\f"; break;
+        case '\n': *out += "\\n"; break;
+        case '\r': *out += "\\r"; break;
+        case '\t': *out += "\\t"; break;
+        default:
+          if (c < 0x20 || c == 0x7F) {   // python escapes DEL too
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            *out += buf;
+          } else {
+            out->push_back((char)c);
+          }
+      }
+      ++i;
+      continue;
     }
+    // decode one UTF-8 sequence -> code point
+    uint32_t cp = 0xFFFD;
+    size_t len = 1;
+    if ((c & 0xE0) == 0xC0 && i + 1 < n) {
+      cp = ((c & 0x1Fu) << 6) | ((unsigned char)s[i + 1] & 0x3Fu);
+      len = 2;
+    } else if ((c & 0xF0) == 0xE0 && i + 2 < n) {
+      cp = ((c & 0x0Fu) << 12) | (((unsigned char)s[i + 1] & 0x3Fu) << 6) |
+           ((unsigned char)s[i + 2] & 0x3Fu);
+      len = 3;
+    } else if ((c & 0xF8) == 0xF0 && i + 3 < n) {
+      cp = ((c & 0x07u) << 18) | (((unsigned char)s[i + 1] & 0x3Fu) << 12) |
+           (((unsigned char)s[i + 2] & 0x3Fu) << 6) |
+           ((unsigned char)s[i + 3] & 0x3Fu);
+      len = 4;
+    }
+    char buf[16];
+    if (cp <= 0xFFFF) {
+      snprintf(buf, sizeof buf, "\\u%04x", cp);
+      *out += buf;
+    } else {
+      uint32_t v = cp - 0x10000;
+      snprintf(buf, sizeof buf, "\\u%04x\\u%04x",
+               0xD800 + (v >> 10), 0xDC00 + (v & 0x3FF));
+      *out += buf;
+    }
+    i += len;
   }
   out->push_back('"');
 }

@@ -5,7 +5,8 @@ from __future__ import annotations
 
 from .framework import Program
 
-__all__ = ["pprint_program_codes", "draw_block_graphviz"]
+__all__ = ["pprint_program_codes", "draw_block_graphviz",
+           "validate_program"]
 
 
 def pprint_program_codes(program: Program) -> str:
@@ -40,3 +41,42 @@ def draw_block_graphviz(block, path: str = "block.dot") -> str:
     with open(path, "w") as f:
         f.write("\n".join(lines))
     return path
+
+
+def validate_program(program: Program):
+    """Structural pre-flight check — the analog of the reference's
+    OpDesc::CheckAttrs / executor var-existence enforcement
+    (executor.cc:36-75), run in the native IR library (csrc/ir.cc
+    validate_program) when built, else a Python walk.  Returns a list of
+    error strings ([] = valid)."""
+    from .. import native
+
+    if native.available():
+        try:
+            errs = native.validate(program)
+        except RuntimeError:     # unparseable attrs -> python fallback
+            errs = None
+        if errs is not None:
+            return errs
+    errors = []
+    for block in program.blocks:
+        declared = set()
+        b = block.desc
+        while b is not None:
+            declared |= set(b.vars)
+            b = (program.blocks[b.parent_idx].desc
+                 if 0 <= b.parent_idx < b.idx else None)
+        # walk the DESC (source of truth — same view the native lib parses)
+        for i, od in enumerate(block.desc.ops):
+            where = f"block {block.idx} op#{i} ({od.type})"
+            for names in od.inputs.values():
+                for n in names:
+                    if n and n not in declared:
+                        errors.append(
+                            f"{where}: input var '{n}' not declared")
+            for names in od.outputs.values():
+                for n in names:
+                    if n and n not in declared:
+                        errors.append(
+                            f"{where}: output var '{n}' not declared")
+    return errors

@@ -229,19 +229,38 @@ def load_persistables(executor, dirname, main_program=None, **kw):
 
 def prune_program(program: Program, targets: List[Variable]) -> Program:
     """Backward-slice the global block to the ops needed for `targets` —
-    analog of the reference's Program.prune (framework.py:893 + prune.cc)."""
+    analog of the reference's Program.prune (framework.py:893 + prune.cc).
+    The slice itself runs in the native IR library (csrc/ir.cc
+    prune_block) when built, with the identical pure-Python walk as
+    fallback (parity-tested in tests/test_native_ir.py)."""
     pruned = program.clone(for_test=True)
     block = pruned.global_block()
     needed = {t.name if isinstance(t, Variable) else str(t) for t in targets}
-    keep = []
-    for op in reversed(block.ops):
-        outs = set(op.output_names)
-        if outs & needed:
-            keep.append(op)
-            needed |= {n for n in op.input_names if n}
-    keep_set = {id(op.desc) for op in keep}
-    block.ops = [op for op in block.ops if id(op.desc) in keep_set]
-    block.desc.ops = [od for od in block.desc.ops if id(od) in keep_set]
+
+    keep_idx = None
+    from .. import native
+
+    if native.available():
+        try:
+            keep_idx = native.prune(pruned, sorted(needed))
+        except RuntimeError:
+            keep_idx = None
+    if keep_idx is None:
+        # identical walk over the DESC ops (the native lib's view)
+        keep_idx = []
+        descs = block.desc.ops
+        for i in range(len(descs) - 1, -1, -1):
+            od = descs[i]
+            outs = {n for ns in od.outputs.values() for n in ns}
+            if outs & needed:
+                keep_idx.append(i)
+                needed |= {n for ns in od.inputs.values() for n in ns if n}
+        keep_idx.reverse()
+    # indices address desc.ops; wrappers are filtered by desc identity so
+    # a desc-only op (no Python wrapper) cannot shift the alignment
+    kept_descs = {id(block.desc.ops[i]) for i in keep_idx}
+    block.desc.ops = [od for od in block.desc.ops if id(od) in kept_descs]
+    block.ops = [op for op in block.ops if id(op.desc) in kept_descs]
     pruned._bump_version()
     return pruned
 

@@ -2,39 +2,78 @@
 reuse pass (python/paddle/v2/fluid/memory_optimization_transpiler.py:
 ControlFlowGraph:33, _dataflow_analyze:90, memory_optimize:259).
 
-On TPU this pass is intentionally a no-op: the whole block compiles to one
-XLA executable and XLA's buffer assignment already performs exactly this
-liveness analysis and in-place reuse (plus rematerialization hooks the
-reference never had).  The function still runs the analysis to return reuse
-statistics so callers/tests keep working, but mutates nothing."""
+On TPU this pass is intentionally a no-op as a *rewrite*: the whole block
+compiles to one XLA executable and XLA's buffer assignment already performs
+exactly this liveness analysis and in-place reuse (plus rematerialization
+hooks the reference never had).  The function still runs the analysis — on
+the native IR library (csrc/ir.cc analyze_block: topo schedule + live
+ranges + greedy interval-coloring slots) when available, pure Python
+otherwise — and returns the reuse statistics so callers/tests keep
+working, but mutates nothing."""
 
 from __future__ import annotations
 
-from collections import defaultdict
-
 from .framework import Program, default_main_program
 
-__all__ = ["memory_optimize"]
+__all__ = ["memory_optimize", "liveness_stats"]
+
+
+def _python_stats(program: Program, block_idx: int = 0) -> dict:
+    """Fallback liveness: program order = schedule; live range
+    [first def, last use]; greedy interval coloring for slot count."""
+    block = program.blocks[block_idx]
+    first_def, last_pos = {}, {}
+    for i, op in enumerate(block.ops):
+        for name in op.output_names:
+            if name:
+                first_def.setdefault(name, i)
+                last_pos[name] = i
+        for name in op.input_names:
+            if name:
+                last_pos[name] = i
+    persistable = {n for n, v in block.vars.items() if v.persistable}
+    live_range = {n: (d, last_pos[n]) for n, d in first_def.items()
+                  if n not in persistable}
+    ivs = sorted((rng, n) for n, rng in live_range.items())
+    free_at, reuse_slot = [], {}
+    for (start, end), name in ivs:
+        slot = next((s for s, f in enumerate(free_at) if f < start), None)
+        if slot is None:
+            slot = len(free_at)
+            free_at.append(-1)
+        free_at[slot] = end
+        reuse_slot[name] = slot
+    return {"topo_order": list(range(len(block.ops))),
+            "level": list(range(len(block.ops))),
+            "live_range": {n: list(r) for n, r in live_range.items()},
+            "reuse_slot": reuse_slot,
+            "num_slots": len(free_at)}
+
+
+def liveness_stats(program: Program = None, block_idx: int = 0) -> dict:
+    """Topo schedule + live ranges + buffer-slot coloring for one block —
+    native (csrc/ir.cc) when the .so is available, Python otherwise."""
+    program = program or default_main_program()
+    from .. import native
+
+    if native.available():
+        try:
+            stats = native.analyze(program, block_idx)
+        except RuntimeError:      # e.g. attrs json.h can't parse (NaN)
+            stats = None
+        if stats is not None:
+            return stats
+    return _python_stats(program, block_idx)
 
 
 def memory_optimize(input_program: Program = None, print_log: bool = False):
     program = input_program or default_main_program()
-    block = program.global_block()
-    last_use = {}
-    first_def = {}
-    for i, op in enumerate(block.ops):
-        for name in op.input_names:
-            last_use[name] = i
-        for name in op.output_names:
-            first_def.setdefault(name, i)
-    # vars whose live ranges are disjoint could share buffers — count them
-    reusable = 0
-    for name, end in last_use.items():
-        for other, start in first_def.items():
-            if other != name and start > end:
-                reusable += 1
-                break
+    stats = liveness_stats(program)
+    n_vars = len(stats["live_range"])
+    reusable = max(0, n_vars - stats["num_slots"])
     if print_log:
-        print(f"[memory_optimize] XLA buffer assignment will reuse "
-              f"{reusable} candidate buffers; no program rewrite needed")
+        print(f"[memory_optimize] {n_vars} transient vars fit in "
+              f"{stats['num_slots']} buffer slots ({reusable} reuses); "
+              f"XLA buffer assignment performs the rewrite, no program "
+              f"mutation needed")
     return reusable

@@ -1,0 +1,126 @@
+"""ctypes bridge to the native IR library (csrc/ir.cc).
+
+The TPU-native analog of the reference's C++ desc/analysis layer
+(paddle/framework/program_desc.cc, prune.cc, and the liveness pass in
+memory_optimization_transpiler.py) compiled to `libptpu_ir.so`.  The
+library is built lazily on first use (one `g++ -shared` invocation, cached
+next to the sources); everything degrades gracefully to the pure-Python
+paths when no compiler is available or PADDLE_TPU_NO_NATIVE=1.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+__all__ = ["available", "validate", "analyze", "prune", "reserialize"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", "csrc")
+_SO = os.path.join(_CSRC, "libptpu_ir.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_CSRC, "ir.cc")
+    if not os.path.exists(src):
+        return False
+    newer = (not os.path.exists(_SO)
+             or os.path.getmtime(_SO) < max(
+                 os.path.getmtime(src),
+                 os.path.getmtime(os.path.join(_CSRC, "json.h"))))
+    if not newer:
+        return True
+    try:
+        subprocess.run(
+            ["make", "-s", "-C", _CSRC],
+            check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PADDLE_TPU_NO_NATIVE"):
+            return None
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        for name, argtypes in (
+                ("ptpu_reserialize", [ctypes.c_char_p]),
+                ("ptpu_validate", [ctypes.c_char_p]),
+                ("ptpu_analyze", [ctypes.c_char_p, ctypes.c_int]),
+                ("ptpu_prune", [ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_char_p])):
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = ctypes.c_void_p     # manual free via ptpu_free
+        lib.ptpu_free.argtypes = [ctypes.c_void_p]
+        lib.ptpu_free.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _call(fn_name: str, *args, raw: bool = False):
+    lib = _load()
+    if lib is None:
+        return None
+    ptr = getattr(lib, fn_name)(*args)
+    if not ptr:
+        return None
+    try:
+        out = ctypes.string_at(ptr).decode("utf-8")
+    finally:
+        lib.ptpu_free(ptr)
+    val = json.loads(out)
+    if isinstance(val, dict) and "error" in val:
+        raise RuntimeError(f"native IR {fn_name}: {val['error']}")
+    return out if raw else val
+
+
+def _prog_bytes(program) -> bytes:
+    ser = getattr(program, "desc", program)
+    return ser.serialize_to_string() if hasattr(ser, "serialize_to_string") \
+        else bytes(ser)
+
+
+def reserialize(program) -> Optional[str]:
+    """Canonical JSON via the native writer (fingerprint parity check)."""
+    return _call("ptpu_reserialize", _prog_bytes(program), raw=True)
+
+
+def validate(program) -> Optional[List[str]]:
+    """List of structural errors ([] = valid); None if native unavailable."""
+    return _call("ptpu_validate", _prog_bytes(program))
+
+
+def analyze(program, block_idx: int = 0) -> Optional[dict]:
+    """{"topo_order", "level", "live_range", "reuse_slot", "num_slots"}."""
+    return _call("ptpu_analyze", _prog_bytes(program),
+                 ctypes.c_int(block_idx))
+
+
+def prune(program, target_names: List[str],
+          block_idx: int = 0) -> Optional[List[int]]:
+    """Kept-op indices for the backward slice to `target_names`."""
+    return _call("ptpu_prune", _prog_bytes(program), ctypes.c_int(block_idx),
+                 json.dumps(list(target_names)).encode())
