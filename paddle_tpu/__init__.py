@@ -3,8 +3,10 @@ transition-era PaddlePaddle (v2 + Fluid).
 
 Structure:
   paddle_tpu.fluid     program IR + layers + lowering executor (the core)
+  paddle_tpu.v2        legacy v2 user API (init/layer/trainer/events) on fluid
   paddle_tpu.parallel  device meshes, SPMD sharding, distributed init
   paddle_tpu.models    the "book" model zoo (fit_a_line ... transformer)
+  paddle_tpu.native    ctypes bridge to the C++ IR library (csrc/)
   paddle_tpu.ops       Pallas TPU kernels for ops XLA fusion can't cover
   paddle_tpu.utils     profiler, flags, misc runtime utilities
 """
@@ -12,5 +14,6 @@ Structure:
 from . import fluid  # noqa: F401
 from . import parallel  # noqa: F401
 from . import utils  # noqa: F401
+from . import native  # noqa: F401
 
 __version__ = "0.1.0"

@@ -59,16 +59,25 @@ def validate_program(program: Program):
         if errs is not None:
             return errs
     errors = []
+    nblocks = len(program.blocks)
     for block in program.blocks:
+        bd = block.desc
+        if bd.parent_idx >= nblocks or not (bd.parent_idx < bd.idx):
+            errors.append(f"block {bd.idx}: parent_idx out of range or "
+                          f"not an ancestor")
         declared = set()
-        b = block.desc
-        while b is not None:
+        b = bd
+        hops = 0
+        while b is not None and hops <= nblocks:
+            hops += 1
             declared |= set(b.vars)
             b = (program.blocks[b.parent_idx].desc
-                 if 0 <= b.parent_idx < b.idx else None)
+                 if 0 <= b.parent_idx < min(b.idx, nblocks) else None)
         # walk the DESC (source of truth — same view the native lib parses)
-        for i, od in enumerate(block.desc.ops):
-            where = f"block {block.idx} op#{i} ({od.type})"
+        for i, od in enumerate(bd.ops):
+            where = f"block {bd.idx} op#{i} ({od.type})"
+            if not od.type:
+                errors.append(f"{where}: empty op type")
             for names in od.inputs.values():
                 for n in names:
                     if n and n not in declared:
@@ -79,4 +88,10 @@ def validate_program(program: Program):
                     if n and n not in declared:
                         errors.append(
                             f"{where}: output var '{n}' not declared")
+            for a in od.attrs.values():
+                if isinstance(a, dict) and "__block__" in a:
+                    bi = a["__block__"]
+                    if not (isinstance(bi, int) and 0 <= bi < nblocks):
+                        errors.append(f"{where}: sub-block index {bi} "
+                                      f"out of range")
     return errors

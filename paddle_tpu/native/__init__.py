@@ -29,16 +29,16 @@ _tried = False
 
 
 def _build() -> bool:
-    src = os.path.join(_CSRC, "ir.cc")
-    if not os.path.exists(src):
-        return False
-    newer = (not os.path.exists(_SO)
-             or os.path.getmtime(_SO) < max(
-                 os.path.getmtime(src),
-                 os.path.getmtime(os.path.join(_CSRC, "json.h"))))
-    if not newer:
-        return True
     try:
+        src = os.path.join(_CSRC, "ir.cc")
+        hdr = os.path.join(_CSRC, "json.h")
+        if not (os.path.exists(src) and os.path.exists(hdr)):
+            return False
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < max(os.path.getmtime(src),
+                                                os.path.getmtime(hdr)))
+        if not stale:
+            return True
         subprocess.run(
             ["make", "-s", "-C", _CSRC],
             check=True, capture_output=True, timeout=120)

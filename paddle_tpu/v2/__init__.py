@@ -1,0 +1,58 @@
+"""paddle.v2 — the legacy v2 user API (python/paddle/v2/__init__.py),
+re-seated on the fluid/XLA engine.
+
+A reference v2 script becomes a TPU program with an import swap:
+
+    import paddle_tpu.v2 as paddle
+
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.data(name='y', type=paddle.data_type.dense_vector(1))
+    y_hat = paddle.layer.fc(input=x, size=1, act=paddle.activation.Linear())
+    cost = paddle.layer.mse_cost(input=y_hat, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=paddle.optimizer.Momentum(
+                                     momentum=0.9, learning_rate=1e-3))
+    trainer.train(reader=paddle.batch(reader, 32), num_passes=2,
+                  event_handler=handler)
+
+The reference's layer DSL emitted ModelConfig protobuf interpreted by a
+C++ GradientMachine (trainer.py:137, config_parser.py); here layer calls
+append to a fluid Program and SGD.train drives the compiling executor —
+events, readers, feeding maps, parameters.to_tar/from_tar and infer()
+keep their reference contracts.
+"""
+
+from __future__ import annotations
+
+from .. import fluid as _fluid
+from ..utils import reader  # composable reader decorators  # noqa: F401
+from ..utils.reader import batch  # noqa: F401
+from . import (activation, data_type, event, inference, layer,  # noqa: F401
+               optimizer, parameters, pooling, trainer)
+from .inference import infer  # noqa: F401
+from .. import datasets as dataset  # noqa: F401
+
+__all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
+           "data_type", "event", "optimizer", "parameters", "trainer",
+           "inference", "infer", "dataset"]
+
+_initialized = False
+
+
+def init(use_gpu: bool = False, trainer_count: int = 1,
+         use_tpu: bool = True, seed: int = None, **kw) -> None:
+    """paddle.init (reference v2/__init__.py:127).  The gflags the
+    reference forwards to C++ (use_gpu, trainer_count, ...) have no
+    meaning under XLA — device selection is jax's; trainer_count>1 is a
+    mesh, configured via paddle_tpu.parallel.  Resets the default
+    programs so consecutive v2 scripts in one process start clean."""
+    global _initialized
+    _fluid.framework.switch_main_program(_fluid.Program())
+    _fluid.framework.switch_startup_program(_fluid.Program())
+    layer._data_types.clear()
+    if seed is not None:
+        _fluid.default_main_program().random_seed = seed
+        _fluid.default_startup_program().random_seed = seed
+    _initialized = True

@@ -1,0 +1,30 @@
+"""v2 activation objects — analog of python/paddle/v2/activation.py
+(wrapping trainer_config_helpers.activations).  Each maps onto the
+fluid activation string the op layer understands."""
+
+__all__ = ["Linear", "Relu", "Sigmoid", "Tanh", "Softmax", "Exp",
+           "SoftRelu", "Abs", "Square", "Log"]
+
+
+class BaseActivation:
+    name: str = ""
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+def _make(cls_name, act_name):
+    cls = type(cls_name, (BaseActivation,), {"name": act_name})
+    return cls
+
+
+Linear = _make("Linear", "")
+Relu = _make("Relu", "relu")
+Sigmoid = _make("Sigmoid", "sigmoid")
+Tanh = _make("Tanh", "tanh")
+Softmax = _make("Softmax", "softmax")
+Exp = _make("Exp", "exp")
+SoftRelu = _make("SoftRelu", "soft_relu")
+Abs = _make("Abs", "abs")
+Square = _make("Square", "square")
+Log = _make("Log", "log")

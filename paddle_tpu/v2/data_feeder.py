@@ -1,0 +1,56 @@
+"""v2 DataFeeder: reader rows (tuples) → executor feed dict.
+
+Analog of py_paddle/dataprovider_converter.py + v2 trainer feeding maps:
+`feeding` maps data-layer name → column index in each reader row; column
+values convert per the layer's InputType (dense stack, int ids, or
+SeqArray for sequences).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..fluid import make_seq
+from .data_type import InputType
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, data_types: Dict[str, InputType],
+                 feeding: Optional[Dict[str, int]] = None):
+        self._types = dict(data_types)
+        if feeding is None:
+            feeding = {n: i for i, n in enumerate(self._types)}
+        elif isinstance(feeding, (list, tuple)):
+            feeding = {n: i for i, n in enumerate(feeding)}
+        self._feeding = feeding
+
+    def __call__(self, batch: Sequence[tuple]) -> Dict[str, object]:
+        feed = {}
+        for name, t in self._types.items():
+            col = self._feeding.get(name)
+            if col is None:
+                continue
+            vals = [row[col] for row in batch]
+            if t.seq:
+                if t.kind == "int":
+                    seqs = [np.asarray(v, np.int32).reshape(-1, 1)
+                            for v in vals]
+                else:
+                    seqs = [np.asarray(v, np.float32).reshape(-1, t.dim)
+                            for v in vals]
+                bucket = 1 << int(np.ceil(np.log2(
+                    max(max(len(s) for s in seqs), 1))))
+                feed[name] = make_seq(seqs,
+                                      dtype=np.int32 if t.kind == "int"
+                                      else np.float32, bucket=bucket)
+            elif t.kind == "int":
+                feed[name] = np.asarray(vals, np.int64).reshape(
+                    len(batch), 1)
+            else:
+                feed[name] = np.asarray(vals, np.float32).reshape(
+                    len(batch), t.dim)
+        return feed

@@ -1,0 +1,39 @@
+"""v2 input type descriptors — analog of
+python/paddle/v2/data_type.py (which re-exports
+trainer/PyDataProvider2 InputType helpers).
+
+Each descriptor records how a reader column converts into an executor
+feed: dense rows, integer ids, or variable-length id/vector sequences
+(SeqArray on this stack, LoD in the reference).
+"""
+
+from __future__ import annotations
+
+__all__ = ["dense_vector", "integer_value", "dense_vector_sequence",
+           "integer_value_sequence", "InputType"]
+
+
+class InputType:
+    def __init__(self, kind: str, dim: int, seq: bool = False):
+        self.kind = kind          # 'dense' | 'int'
+        self.dim = dim
+        self.seq = seq
+
+    def __repr__(self):
+        return f"InputType({self.kind}, dim={self.dim}, seq={self.seq})"
+
+
+def dense_vector(dim: int) -> InputType:
+    return InputType("dense", dim)
+
+
+def integer_value(value_range: int) -> InputType:
+    return InputType("int", value_range)
+
+
+def dense_vector_sequence(dim: int) -> InputType:
+    return InputType("dense", dim, seq=True)
+
+
+def integer_value_sequence(value_range: int) -> InputType:
+    return InputType("int", value_range, seq=True)

@@ -1,0 +1,47 @@
+"""v2 training events (python/paddle/v2/event.py): the trainer invokes
+the user's event_handler with these at pass/iteration boundaries."""
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "TestResult", "EndForwardBackward"]
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass:
+    def __init__(self, pass_id, evaluator=None, metrics=None):
+        self.pass_id = pass_id
+        self.evaluator = evaluator
+        self.metrics = metrics or {}
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndForwardBackward:
+    def __init__(self, pass_id, batch_id, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.gm = gm
+
+
+class EndIteration:
+    def __init__(self, pass_id, batch_id, cost, evaluator=None,
+                 metrics=None, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.evaluator = evaluator
+        self.metrics = metrics or {}
+        self.gm = gm
+
+
+class TestResult:
+    def __init__(self, cost, metrics=None):
+        self.cost = cost
+        self.metrics = metrics or {}

@@ -1,0 +1,50 @@
+"""v2 inference (python/paddle/v2/inference.py): run a trained topology
+forward-only over a reader/array input and collect outputs."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import fluid
+from .data_feeder import DataFeeder
+from .parameters import Parameters
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters: Parameters):
+        outputs = (output_layer if isinstance(output_layer, (list, tuple))
+                   else [output_layer])
+        self._outputs = list(outputs)
+        self._params = parameters
+        program = outputs[0].block.program
+        self._program = fluid.io.prune_program(program, self._outputs)
+        self._exe = fluid.Executor(fluid.TPUPlace(0))
+        from .layer import _data_types
+
+        self._data_types = dict(_data_types)
+
+    def infer(self, input: Sequence[tuple], feeding=None, field="value"):
+        feeder = DataFeeder(self._data_types, feeding)
+        # only feed the data layers the pruned program still reads
+        needed = set()
+        for op in self._program.global_block().desc.ops:
+            for names in op.inputs.values():
+                needed |= set(names)
+        feed = {k: v for k, v in feeder(list(input)).items() if k in needed}
+        with fluid.scope_guard(self._params.scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=[v.name for v in self._outputs],
+                                 mode="infer")
+        outs = [np.asarray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def infer(output_layer, parameters: Parameters, input, feeding=None,
+          field="value"):
+    """reference inference.py:125 — one-shot helper."""
+    return Inference(output_layer, parameters).infer(input, feeding=feeding,
+                                                     field=field)

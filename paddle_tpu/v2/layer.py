@@ -1,0 +1,108 @@
+"""v2 layer functions — the user surface of python/paddle/v2/layer.py.
+
+The reference's v2 layers emit ModelConfig protobuf that a C++
+GradientMachine interprets (layer.py:263 parse_network →
+trainer_config_helpers → config_parser.py); here each call appends ops
+to the default fluid program immediately, so a v2 "topology" IS a fluid
+program and the whole v2 stack rides the XLA executor.  Scripts keep the
+reference shape:
+
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(13))
+    y_hat = paddle.layer.fc(input=x, size=1, act=paddle.activation.Linear())
+    cost = paddle.layer.mse_cost(input=y_hat, label=y)
+"""
+
+from __future__ import annotations
+
+from ..fluid import layers as flayers
+from .activation import BaseActivation
+from .data_type import InputType
+
+__all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
+           "regression_cost", "cross_entropy_cost", "img_conv", "img_pool",
+           "max_id", "concat", "dropout", "pool"]
+
+# name -> InputType for every data layer built in the current topology;
+# the v2 DataFeeder reads this to convert reader columns
+_data_types = {}
+
+
+def _act_name(act) -> str:
+    if act is None:
+        return None
+    if isinstance(act, BaseActivation):
+        return act.name or None
+    return str(act) or None
+
+
+def data(name: str, type: InputType, **kw):
+    assert isinstance(type, InputType), "use paddle.data_type.*"
+    _data_types[name] = type
+    if type.kind == "dense":
+        v = flayers.data(name, [type.dim], "float32",
+                         lod_level=1 if type.seq else 0)
+    else:
+        v = flayers.data(name, [1], "int64",
+                         lod_level=1 if type.seq else 0)
+    return v
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
+    return flayers.fc(input=input, size=size, act=_act_name(act),
+                      param_attr=param_attr,
+                      bias_attr=True if bias_attr is None else bias_attr)
+
+
+def embedding(input, size, param_attr=None, is_sparse=False, **kw):
+    return flayers.embedding(input=input,
+                             size=[_data_types[input.name].dim
+                                   if input.name in _data_types else size,
+                                   size],
+                             is_sparse=is_sparse, param_attr=param_attr)
+
+
+def classification_cost(input, label, **kw):
+    cost = flayers.cross_entropy(input=input, label=label)
+    return flayers.mean(cost)
+
+
+def cross_entropy_cost(input, label, **kw):
+    return classification_cost(input, label)
+
+
+def mse_cost(input, label, **kw):
+    return flayers.mean(flayers.square_error_cost(input=input, label=label))
+
+
+regression_cost = mse_cost
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None,
+             stride=1, padding=0, act=None, **kw):
+    return flayers.conv2d(input=input, num_filters=num_filters,
+                          filter_size=filter_size, stride=stride,
+                          padding=padding, act=_act_name(act))
+
+
+def img_pool(input, pool_size, stride=1, pool_type=None, **kw):
+    ptype = getattr(pool_type, "name", "max") if pool_type else "max"
+    return flayers.pool2d(input=input, pool_size=pool_size,
+                          pool_stride=stride, pool_type=ptype)
+
+
+def pool(input, pool_type=None, **kw):
+    ptype = getattr(pool_type, "name", "max") if pool_type else "max"
+    return flayers.sequence_pool(input=input, pool_type=ptype)
+
+
+def max_id(input, **kw):
+    return flayers.argmax_layer(input) if hasattr(
+        flayers, "argmax_layer") else flayers.topk(input, k=1)[1]
+
+
+def concat(input, **kw):
+    return flayers.concat(input=list(input), axis=1)
+
+
+def dropout(input, dropout_rate, **kw):
+    return flayers.dropout(input, dropout_prob=dropout_rate)

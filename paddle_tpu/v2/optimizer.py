@@ -1,0 +1,90 @@
+"""v2 optimizers (python/paddle/v2/optimizer.py) — thin adapters over the
+fluid optimizer classes.  The reference's create_updater machinery
+(local/remote/sparse ParameterUpdater selection, optimizer.py:65) is
+superseded: every update compiles into the SPMD step, so the only thing
+to keep is the constructor surface v2 scripts use."""
+
+from __future__ import annotations
+
+from ..fluid import optimizer as fopt
+
+__all__ = ["Optimizer", "Momentum", "Adam", "Adamax", "AdaGrad",
+           "DecayedAdaGrad", "AdaDelta", "RMSProp"]
+
+
+class Optimizer:
+    """Base: holds the fluid optimizer this v2 config maps to."""
+
+    def __init__(self, fluid_optimizer):
+        self._opt = fluid_optimizer
+
+    def to_fluid(self):
+        return self._opt
+
+
+def _reg(regularization):
+    # v2 passes e.g. L2Regularization(rate=8e-4); map onto fluid L2Decay
+    if regularization is None:
+        return None
+    rate = getattr(regularization, "rate",
+                   getattr(regularization, "_coeff", None))
+    if rate is None:
+        return None
+    from ..fluid.regularizer import L2Decay
+
+    return L2Decay(float(rate))
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, learning_rate=1e-3, sparse=False,
+                 regularization=None, model_average=None, **kw):
+        super().__init__(fopt.Momentum(
+            learning_rate=learning_rate, momentum=momentum,
+            regularization=_reg(regularization)))
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 learning_rate=1e-3, regularization=None, **kw):
+        super().__init__(fopt.Adam(
+            learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+            epsilon=epsilon, regularization=_reg(regularization)))
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, learning_rate=1e-3,
+                 regularization=None, **kw):
+        super().__init__(fopt.Adamax(
+            learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+            regularization=_reg(regularization)))
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=1e-3, regularization=None, **kw):
+        super().__init__(fopt.Adagrad(
+            learning_rate=learning_rate,
+            regularization=_reg(regularization)))
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
+                 regularization=None, **kw):
+        super().__init__(fopt.DecayedAdagrad(
+            learning_rate=learning_rate, decay=rho, epsilon=epsilon,
+            regularization=_reg(regularization)))
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
+                 regularization=None, **kw):
+        super().__init__(fopt.Adadelta(
+            learning_rate=learning_rate, rho=rho, epsilon=epsilon,
+            regularization=_reg(regularization)))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
+                 regularization=None, **kw):
+        super().__init__(fopt.RMSProp(
+            learning_rate=learning_rate, rho=rho, epsilon=epsilon,
+            regularization=_reg(regularization)))

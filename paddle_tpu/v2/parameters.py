@@ -1,0 +1,107 @@
+"""v2 Parameters store (python/paddle/v2/parameters.py): a name-addressed
+parameter dict with to_tar/from_tar persistence.
+
+In the reference this is a numpy mirror synchronized with the C++
+GradientMachine; here it wraps the (program, scope) pair the fluid
+executor trains, so reads hit live device arrays and writes land in the
+scope the next step consumes.  The tar wire format stores one tensor
+file per parameter (the fluid io format, CRC + header), so tars are
+also loadable with fluid.io.load_tensor.
+"""
+
+from __future__ import annotations
+
+import io as pyio
+import tarfile
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import io as fio
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters:
+    def __init__(self, program: "fluid.Program",
+                 scope: Optional["fluid.Scope"] = None):
+        self._program = program
+        self._scope = scope or fluid.Scope()
+
+    # -- book-keeping --------------------------------------------------------
+    @property
+    def scope(self):
+        return self._scope
+
+    @property
+    def program(self):
+        return self._program
+
+    def names(self):
+        return [p.name for p in
+                self._program.global_block().all_parameters()]
+
+    keys = names
+
+    def __contains__(self, name):
+        return name in self.names()
+
+    def __iter__(self):
+        return iter(self.names())
+
+    # -- value access --------------------------------------------------------
+    def get(self, name):
+        val = self._scope.find_var(name)
+        if val is None:
+            raise KeyError(f"parameter {name!r} is not initialized yet "
+                           f"(train or from_tar first)")
+        return np.asarray(val)
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        self._scope.set_var(name, np.asarray(value))
+
+    __setitem__ = set
+
+    # -- persistence (v2 parameters.to_tar/from_tar) -------------------------
+    def to_tar(self, f) -> None:
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.names():
+                val = self._scope.find_var(name)
+                if val is None:
+                    continue
+                buf = pyio.BytesIO()
+                import struct, zlib
+
+                payload = fio._tensor_bytes(val)
+                crc = struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+                buf.write(fio._MAGIC2 + payload + crc)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                info.mtime = int(time.time())
+                tar.addfile(info, pyio.BytesIO(data))
+
+    def from_tar(self, f) -> "Parameters":
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                data = tar.extractfile(member).read()
+                import struct, zlib
+
+                assert data[: len(fio._MAGIC2)] == fio._MAGIC2, member.name
+                payload, trailer = data[len(fio._MAGIC2): -4], data[-4:]
+                (want,) = struct.unpack("<I", trailer)
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != want:
+                    raise fio.CheckpointCorrupt(member.name)
+                val, _ = fio._tensor_from(payload, 0)
+                self._scope.set_var(member.name, val)
+        return self
+
+
+def create(cost) -> Parameters:
+    """v2 parameters.create(cost): bind a Parameters store to the
+    topology (program) that produced `cost`."""
+    return Parameters(cost.block.program)

@@ -1,0 +1,14 @@
+"""v2 pooling type objects (python/paddle/v2/pooling.py)."""
+
+__all__ = ["Max", "Avg", "Sum", "SquareRootN"]
+
+
+class _Pool:
+    def __init__(self, name):
+        self.name = name
+
+
+Max = _Pool("max")
+Avg = _Pool("average")
+Sum = _Pool("sum")
+SquareRootN = _Pool("sqrt")

@@ -1,0 +1,119 @@
+"""v2 trainer — the event-driven SGD.train loop of
+python/paddle/v2/trainer.py:137, re-seated on the fluid/XLA engine.
+
+The reference wires cost → GradientMachine (SWIG) → per-batch
+forwardBackward + ParameterUpdater.update per parameter; here
+`update_equation.minimize(cost)` compiles the whole step (grads +
+updates) into one XLA executable and train() just drives batches and
+fires events.  The event surface (BeginPass/EndIteration/...) and the
+reader/feeding contract are unchanged, so reference v2 scripts run with
+an import swap.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import fluid
+from . import event as v2_event
+from .data_feeder import DataFeeder
+from .layer import _data_types
+from .optimizer import Optimizer
+from .parameters import Parameters
+
+__all__ = ["SGD"]
+
+
+def default_event_handler(evt):
+    pass
+
+
+class SGD:
+    """v2 trainer (reference trainer.py:37).  cost: the fluid cost var the
+    v2 layers built; parameters: paddle.parameters.create(cost);
+    update_equation: a paddle.v2 optimizer."""
+
+    def __init__(self, cost, parameters: Parameters,
+                 update_equation: Optimizer, extra_layers=None,
+                 is_local: bool = True, **kw):
+        if not isinstance(update_equation, Optimizer):
+            raise TypeError("update_equation must be a paddle.optimizer.*")
+        self.__topology__ = cost.block.program
+        self.__cost__ = cost
+        self.__parameters__ = parameters
+        self.__extra_layers__ = extra_layers or []
+        # locate the startup program the layers populated
+        self.__startup__ = fluid.default_startup_program()
+        with fluid.program_guard(self.__topology__, self.__startup__):
+            update_equation.to_fluid().minimize(cost)
+        self.__exe__ = fluid.Executor(fluid.TPUPlace(0))
+        self.__initialized__ = False
+        # snapshot of the data types at construction (topology frozen now)
+        self.__data_types__ = dict(_data_types)
+
+    # -- internals -----------------------------------------------------------
+    def _ensure_init(self):
+        if not self.__initialized__:
+            with fluid.scope_guard(self.__parameters__.scope):
+                self.__exe__.run(self.__startup__)
+            self.__initialized__ = True
+
+    def _feeder(self, feeding):
+        return DataFeeder(self.__data_types__, feeding)
+
+    # -- API -----------------------------------------------------------------
+    def train(self, reader: Callable, num_passes: int = 1,
+              event_handler: Optional[Callable] = None, feeding=None):
+        event_handler = event_handler or default_event_handler
+        feeder = self._feeder(feeding)
+        self._ensure_init()
+        fetch = [self.__cost__] + list(self.__extra_layers__)
+        with fluid.scope_guard(self.__parameters__.scope):
+            for pass_id in range(num_passes):
+                event_handler(v2_event.BeginPass(pass_id))
+                pass_costs = []
+                for batch_id, data_batch in enumerate(reader()):
+                    event_handler(v2_event.BeginIteration(pass_id,
+                                                          batch_id))
+                    outs = self.__exe__.run(self.__topology__,
+                                            feed=feeder(data_batch),
+                                            fetch_list=fetch)
+                    cost = float(np.asarray(outs[0]))
+                    metrics = {getattr(v, "name", f"extra_{i}"):
+                               np.asarray(outs[1 + i])
+                               for i, v in
+                               enumerate(self.__extra_layers__)}
+                    pass_costs.append(cost)
+                    event_handler(v2_event.EndForwardBackward(
+                        pass_id, batch_id))
+                    event_handler(v2_event.EndIteration(
+                        pass_id, batch_id, cost, metrics=metrics))
+                event_handler(v2_event.EndPass(
+                    pass_id,
+                    metrics={"cost": float(np.mean(pass_costs))
+                             if pass_costs else float("nan")}))
+
+    def test(self, reader: Callable, feeding=None) -> v2_event.TestResult:
+        """Average cost over the reader on the inference clone (dropout
+        and friends disabled — reference Trainer::test)."""
+        feeder = self._feeder(feeding)
+        self._ensure_init()
+        test_prog = self.__topology__.clone(for_test=True)
+        costs, weights = [], []
+        with fluid.scope_guard(self.__parameters__.scope):
+            for data_batch in reader():
+                out, = self.__exe__.run(test_prog,
+                                        feed=feeder(data_batch),
+                                        fetch_list=[self.__cost__],
+                                        mode="infer")
+                costs.append(float(np.asarray(out)))
+                weights.append(len(data_batch))
+        cost = (float(np.average(costs, weights=weights))
+                if costs else float("nan"))
+        return v2_event.TestResult(cost)
+
+    def save_parameter_to_tar(self, f):
+        self._ensure_init()
+        self.__parameters__.to_tar(f)

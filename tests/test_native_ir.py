@@ -226,3 +226,34 @@ def test_prune_desc_only_op_alignment():
         got, = exe.run(pruned, feed={"x": rng.randn(2, 4).astype(
             np.float32)}, fetch_list=[pred2.name])
     assert np.asarray(got).shape == (2, 1)
+
+
+def test_python_fallback_parity_extras():
+    """r2 review: the Python fallbacks must agree with native on desc-only
+    ops (stats) and malformed parents (validate)."""
+    import paddle_tpu.native as N
+    from paddle_tpu.fluid.debugger import validate_program
+
+    # desc-only op: both backends see it
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        fluid.layers.fc(input=x, size=2)
+    main2.global_block().desc.prepend_op(
+        OpDesc("print", {"In": ["x"]}, {"Out": ["audit_out"]}, {}))
+    nat = liveness_stats(main2)
+    saved = (N._lib, N._tried)
+    N._lib, N._tried = None, True
+    try:
+        py = _python_stats(main2)
+        # self-parent block: python fallback flags it like native does
+        main3, startup3 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main3, startup3):
+            fluid.layers.data("z", [1], "float32")
+        main3.global_block().desc.parent_idx = 0
+        py_errs = validate_program(main3)
+    finally:
+        N._lib, N._tried = saved
+    assert len(py["topo_order"]) == len(nat["topo_order"])
+    assert set(py["live_range"]) == set(nat["live_range"])
+    assert any("parent_idx" in e for e in py_errs)
