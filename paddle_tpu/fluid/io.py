@@ -84,17 +84,7 @@ def _read_checked(path: str) -> bytes:
     (the bytes after the magic, without the crc trailer)."""
     with open(path, "rb") as f:
         buf = f.read()
-    if buf[: len(_MAGIC2)] == _MAGIC2:
-        payload, trailer = buf[len(_MAGIC2): -4], buf[-4:]
-        (want,) = struct.unpack("<I", trailer)
-        got = zlib.crc32(payload) & 0xFFFFFFFF
-        if got != want:
-            raise CheckpointCorrupt(
-                f"{path}: crc mismatch (file {want:#x}, computed {got:#x})")
-        return payload
-    if buf[: len(_MAGIC)] == _MAGIC:   # legacy, unchecked
-        return buf[len(_MAGIC):]
-    raise CheckpointCorrupt(f"bad tensor file {path} (unknown magic)")
+    return unframe_bytes(buf, path)
 
 
 def _tensor_bytes(value) -> bytes:
@@ -135,10 +125,43 @@ def _tensor_from(buf: bytes, offset: int = 0):
     return data, offset
 
 
-def save_tensor(value, path: str) -> None:
-    payload = _tensor_bytes(value)
+def frame_bytes(payload: bytes) -> bytes:
+    """MAGIC2 + payload + crc32 trailer — THE checkpoint wire framing;
+    every durable artifact (tensor files, v2 parameter tars, master
+    snapshots) shares it."""
     crc = struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
-    _atomic_write(path, _MAGIC2 + payload + crc)
+    return _MAGIC2 + payload + crc
+
+
+def unframe_bytes(data: bytes, what: str = "<bytes>") -> bytes:
+    """Inverse of frame_bytes; raises CheckpointCorrupt on bad magic or
+    CRC (legacy MAGIC1 passes through unchecked)."""
+    if data[: len(_MAGIC2)] == _MAGIC2:
+        payload, trailer = data[len(_MAGIC2): -4], data[-4:]
+        (want,) = struct.unpack("<I", trailer)
+        got = zlib.crc32(payload) & 0xFFFFFFFF
+        if got != want:
+            raise CheckpointCorrupt(
+                f"{what}: crc mismatch (file {want:#x}, computed {got:#x})")
+        return payload
+    if data[: len(_MAGIC)] == _MAGIC:
+        return data[len(_MAGIC):]
+    raise CheckpointCorrupt(f"bad tensor data {what} (unknown magic)")
+
+
+def tensor_to_bytes(value) -> bytes:
+    """One tensor/SeqArray as a framed byte string (the unit the v2
+    parameter tar stores per entry)."""
+    return frame_bytes(_tensor_bytes(value))
+
+
+def tensor_from_bytes(data: bytes, what: str = "<bytes>"):
+    value, _ = _tensor_from(unframe_bytes(data, what), 0)
+    return value
+
+
+def save_tensor(value, path: str) -> None:
+    _atomic_write(path, tensor_to_bytes(value))
 
 
 def load_tensor(path: str):
@@ -152,8 +175,7 @@ def save_tensors(named: Dict[str, object], path: str) -> None:
     manifest = json.dumps(names).encode()
     payload = struct.pack("<I", len(manifest)) + manifest + b"".join(
         _tensor_bytes(named[n]) for n in names)
-    crc = struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
-    _atomic_write(path, _MAGIC2 + payload + crc)
+    _atomic_write(path, frame_bytes(payload))
 
 
 def load_tensors(path: str) -> Dict[str, object]:

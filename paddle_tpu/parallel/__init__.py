@@ -24,7 +24,8 @@ from .mesh import (Mesh, current_mesh, make_mesh, mesh_guard, set_mesh,
                    feed_sharding, state_sharding)
 from .distributed import init_distributed
 from .transpiler import DistributeTranspiler
+from .master import Task, TaskQueue, master_reader
 
 __all__ = ["Mesh", "make_mesh", "mesh_guard", "set_mesh", "current_mesh",
            "feed_sharding", "state_sharding", "init_distributed",
-           "DistributeTranspiler"]
+           "DistributeTranspiler", "Task", "TaskQueue", "master_reader"]

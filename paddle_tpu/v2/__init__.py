@@ -28,9 +28,16 @@ from __future__ import annotations
 
 from .. import fluid as _fluid
 from ..utils import reader  # composable reader decorators  # noqa: F401
-from ..utils.reader import batch  # noqa: F401
+from ..utils import reader as _reader_mod
 from . import (activation, data_type, event, inference, layer,  # noqa: F401
                optimizer, parameters, pooling, trainer)
+
+
+def batch(reader, batch_size, drop_last: bool = False):
+    """v2 minibatch.batch: the trailing partial batch IS yielded
+    (reference python/paddle/v2/minibatch.py) — unlike the raw
+    utils.reader.batch whose default drops it."""
+    return _reader_mod.batch(reader, batch_size, drop_last=drop_last)
 from .inference import infer  # noqa: F401
 from .. import datasets as dataset  # noqa: F401
 

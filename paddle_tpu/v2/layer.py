@@ -54,10 +54,15 @@ def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
 
 
 def embedding(input, size, param_attr=None, is_sparse=False, **kw):
-    return flayers.embedding(input=input,
-                             size=[_data_types[input.name].dim
-                                   if input.name in _data_types else size,
-                                   size],
+    """v2 embedding: vocab comes from the data layer's integer_value
+    range, `size` is the embedding dim (reference layer.py embedding)."""
+    t = _data_types.get(input.name)
+    if t is None or t.kind != "int":
+        raise ValueError(
+            f"paddle.layer.embedding input must be an integer data layer "
+            f"(got {input.name!r}); its integer_value range provides the "
+            f"vocab size")
+    return flayers.embedding(input=input, size=[t.dim, size],
                              is_sparse=is_sparse, param_attr=param_attr)
 
 

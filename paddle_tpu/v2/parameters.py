@@ -73,13 +73,7 @@ class Parameters:
                 val = self._scope.find_var(name)
                 if val is None:
                     continue
-                buf = pyio.BytesIO()
-                import struct, zlib
-
-                payload = fio._tensor_bytes(val)
-                crc = struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
-                buf.write(fio._MAGIC2 + payload + crc)
-                data = buf.getvalue()
+                data = fio.tensor_to_bytes(val)     # shared CRC framing
                 info = tarfile.TarInfo(name=name)
                 info.size = len(data)
                 info.mtime = int(time.time())
@@ -89,14 +83,7 @@ class Parameters:
         with tarfile.open(fileobj=f, mode="r") as tar:
             for member in tar.getmembers():
                 data = tar.extractfile(member).read()
-                import struct, zlib
-
-                assert data[: len(fio._MAGIC2)] == fio._MAGIC2, member.name
-                payload, trailer = data[len(fio._MAGIC2): -4], data[-4:]
-                (want,) = struct.unpack("<I", trailer)
-                if (zlib.crc32(payload) & 0xFFFFFFFF) != want:
-                    raise fio.CheckpointCorrupt(member.name)
-                val, _ = fio._tensor_from(payload, 0)
+                val = fio.tensor_from_bytes(data, member.name)
                 self._scope.set_var(member.name, val)
         return self
 

@@ -96,17 +96,20 @@ class SGD:
                              if pass_costs else float("nan")}))
 
     def test(self, reader: Callable, feeding=None) -> v2_event.TestResult:
-        """Average cost over the reader on the inference clone (dropout
-        and friends disabled — reference Trainer::test)."""
+        """Average cost over the reader on the forward-only slice
+        (reference Trainer::test).  Pruning to the cost drops the
+        backward + optimizer ops minimize() appended — without it every
+        test batch would perform a parameter update."""
         feeder = self._feeder(feeding)
         self._ensure_init()
-        test_prog = self.__topology__.clone(for_test=True)
+        test_prog = fluid.io.prune_program(self.__topology__,
+                                           [self.__cost__])
         costs, weights = [], []
         with fluid.scope_guard(self.__parameters__.scope):
             for data_batch in reader():
                 out, = self.__exe__.run(test_prog,
                                         feed=feeder(data_batch),
-                                        fetch_list=[self.__cost__],
+                                        fetch_list=[self.__cost__.name],
                                         mode="infer")
                 costs.append(float(np.asarray(out)))
                 weights.append(len(data_batch))

@@ -1,0 +1,256 @@
+"""Elastic data dispatch (parallel/master.py) — the Go master's task
+queue semantics (go/master/service.go): lease/finish/fail/timeout/
+re-dispatch, failure budgets, epoch rollover, snapshot/recover, and the
+exactly-once-or-retried contract under an injected dying consumer.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.parallel import TaskQueue, master_reader
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_lease_finish_cycle():
+    q = TaskQueue(timeout_secs=10)
+    q.set_dataset(["a", "b", "c"])
+    seen = []
+    while True:
+        t = q.get_task("w0")
+        if t is None:
+            break
+        seen.append(t.chunk)
+        assert q.task_finished(t.task_id)
+    assert sorted(seen) == ["a", "b", "c"]
+    assert q.all_done()
+    c = q.counts()
+    assert c["done"] == 3 and c["todo"] == c["pending"] == 0
+
+
+def test_timeout_redispatch():
+    """A dead worker's lease expires and the task goes to a survivor
+    (checkTimeoutFunc :341)."""
+    clock = FakeClock()
+    q = TaskQueue(timeout_secs=5, clock=clock)
+    q.set_dataset(["only"])
+    t = q.get_task("dying-worker")
+    assert t is not None
+    assert q.get_task("healthy") is None          # leased elsewhere
+    clock.t = 6.0                                  # lease expires
+    t2 = q.get_task("healthy")
+    assert t2 is not None and t2.chunk == "only"
+    assert t2.num_failures == 1
+    assert q.task_finished(t2.task_id)
+    # the dead worker's late TaskFinished is rejected (stale lease)
+    assert not q.task_finished(t.task_id)
+    assert q.all_done()
+
+
+def test_failure_budget_discards():
+    """processFailedTask :313: more than failure_max failures -> failed
+    pile, not an infinite retry loop."""
+    q = TaskQueue(timeout_secs=100, failure_max=2)
+    q.set_dataset(["bad"])
+    for _ in range(2):
+        t = q.get_task()
+        assert t is not None
+        q.task_failed(t.task_id)
+    assert q.get_task() is None
+    assert q.all_done()
+    assert q.counts()["failed"] == 1
+
+
+def test_epoch_rollover():
+    q = TaskQueue()
+    q.set_dataset([1, 2])
+    for _ in range(2):
+        t = q.get_task()
+        q.task_finished(t.task_id)
+    assert q.all_done()
+    q.new_epoch()
+    assert q.counts()["todo"] == 2 and q.counts()["epoch"] == 1
+    t = q.get_task()
+    assert t.epoch == 1
+
+
+def test_snapshot_recover(tmp_path):
+    """Master crash: pending leases recover as todo (the lease is
+    unverifiable after restart), done stays done."""
+    q = TaskQueue(timeout_secs=30, failure_max=4)
+    q.set_dataset(["a", "b", "c"])
+    t1 = q.get_task("w")
+    q.task_finished(t1.task_id)
+    t2 = q.get_task("w")                       # left pending
+    path = str(tmp_path / "master.snap")
+    q.snapshot(path)
+    q2 = TaskQueue.recover(path)
+    c = q2.counts()
+    assert c["done"] == 1 and c["todo"] == 2 and c["pending"] == 0
+    chunks = set()
+    while True:
+        t = q2.get_task()
+        if t is None:
+            break
+        chunks.add(t.chunk)
+        q2.task_finished(t.task_id)
+    assert chunks == {"b", "c"}                # incl. the lost lease
+    assert q2.all_done()
+
+
+def test_master_reader_dying_consumer():
+    """End-to-end exactly-once-or-retried: one consumer dies mid-chunk
+    (records partially consumed, lease never finished); the surviving
+    reader re-processes that chunk after timeout — every record is
+    delivered to completion at least once, completed chunks exactly
+    once."""
+    chunks = {f"chunk{i}": list(range(i * 10, i * 10 + 10))
+              for i in range(6)}
+    q = TaskQueue(timeout_secs=0.3, failure_max=5)
+    q.set_dataset(sorted(chunks))
+
+    def read_chunk(name):
+        return chunks[name]
+
+    # dying consumer: leases one task, consumes 3 records, "crashes"
+    died_with = {}
+
+    def dying():
+        t = q.get_task("dying")
+        gen = iter(read_chunk(t.chunk))
+        for _ in range(3):
+            next(gen)
+        died_with["chunk"] = t.chunk
+        # never calls task_finished -> lease must expire
+
+    th = threading.Thread(target=dying)
+    th.start()
+    th.join()
+
+    survivor = master_reader(q, read_chunk, worker="survivor",
+                             poll_interval=0.05)
+    records = list(survivor())
+    # every chunk fully consumed by the survivor, incl. the one the dead
+    # consumer held — and no chunk twice
+    assert sorted(records) == sorted(
+        r for vals in chunks.values() for r in vals)
+    assert q.all_done()
+    counts = q.counts()
+    assert counts["done"] == 6 and counts["failed"] == 0
+
+
+def test_master_reader_bad_chunk_retry_then_discard():
+    """A chunk whose read raises consumes its failure budget then lands
+    in failed; the rest of the dataset still flows."""
+    calls = {"bad": 0}
+
+    def read_chunk(name):
+        if name == "bad":
+            calls["bad"] += 1
+            raise IOError("storage error")
+        return [name]
+
+    q = TaskQueue(timeout_secs=10, failure_max=3)
+    q.set_dataset(["good1", "bad", "good2"])
+    records = list(master_reader(q, read_chunk)())
+    assert sorted(records) == ["good1", "good2"]
+    assert calls["bad"] == 3
+    assert q.counts()["failed"] == 1
+
+
+def test_concurrent_workers_partition_work():
+    """Many threads pulling from one queue: every task completed exactly
+    once, no lost or duplicated chunks."""
+    n_chunks = 40
+    q = TaskQueue(timeout_secs=30)
+    q.set_dataset(list(range(n_chunks)))
+    done = []
+    lock = threading.Lock()
+
+    def worker(wid):
+        while True:
+            t = q.get_task(f"w{wid}")
+            if t is None:
+                if q.all_done():
+                    return
+                time.sleep(0.01)
+                continue
+            time.sleep(0.001)
+            with lock:
+                done.append(t.chunk)
+            q.task_finished(t.task_id)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert sorted(done) == list(range(n_chunks))
+
+
+def test_master_reader_feeds_training():
+    """Integration: the elastic reader drives a real training loop
+    (master_reader -> paddle.batch -> trainer.SGD), replacing the
+    reference's cloud_reader -> trainer pipeline."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init(seed=5)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1,
+                           act=paddle.activation.Linear())
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    rng = np.random.RandomState(0)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    chunks = {}
+    for c in range(4):
+        xs = rng.randn(16, 4).astype(np.float32)
+        chunks[c] = [(xs[i], xs[i] @ w[:, None]) for i in range(16)]
+
+    q = TaskQueue(timeout_secs=10)
+    q.set_dataset(sorted(chunks))
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    def epoch_reader():
+        # one pass over the queue per call; epochs recycle done tasks
+        if q.all_done() and q.counts()["done"]:
+            q.new_epoch()
+        return master_reader(q, lambda c: chunks[c])()
+
+    trainer.train(reader=lambda: paddle.batch(epoch_reader, 16)(),
+                  num_passes=6, event_handler=handler,
+                  feeding={"x": 0, "y": 1})
+    assert costs[-1] < costs[0] * 0.3, (costs[0], costs[-1])
+
+
+def test_snapshot_crc_detects_corruption(tmp_path):
+    from paddle_tpu.fluid.io import CheckpointCorrupt
+    import pytest
+
+    q = TaskQueue()
+    q.set_dataset(["a"])
+    p = str(tmp_path / "snap")
+    q.snapshot(p)
+    raw = bytearray(open(p, "rb").read())
+    raw[12] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(CheckpointCorrupt):
+        TaskQueue.recover(p)

@@ -153,3 +153,47 @@ def test_v2_sequence_classification():
         event_handler=lambda e: costs.append(e.cost)
         if isinstance(e, paddle.event.EndIteration) else None)
     assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+def test_v2_test_does_not_train():
+    """r2 review: trainer.test() must be forward-only — evaluating on a
+    reader cannot move parameters."""
+    paddle.init(seed=13)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1,
+                           act=paddle.activation.Linear())
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.1))
+    rng = np.random.RandomState(2)
+
+    def reader():
+        for _ in range(8):
+            xv = rng.randn(4).astype(np.float32)
+            yield xv, np.array([xv.sum()], np.float32)
+
+    trainer.train(reader=paddle.batch(reader, 4), num_passes=1)
+    name = params.names()[0]
+    before = params[name].copy()
+    trainer.test(reader=paddle.batch(reader, 4))
+    np.testing.assert_array_equal(params[name], before)
+
+
+def test_v2_partial_batch_yields():
+    """r2 review: v2 batch keeps the trailing partial batch (reference
+    minibatch contract); 5 rows @ batch 4 -> 2 batches."""
+    rows = [(np.zeros(2, np.float32),)] * 5
+    batches = list(paddle.batch(lambda: iter(rows), 4)())
+    assert [len(b) for b in batches] == [4, 1]
+
+
+def test_v2_embedding_requires_int_data_layer():
+    import pytest
+
+    paddle.init()
+    x = paddle.layer.data(name="xf", type=paddle.data_type.dense_vector(4))
+    with pytest.raises(ValueError, match="integer data layer"):
+        paddle.layer.embedding(input=x, size=8)
