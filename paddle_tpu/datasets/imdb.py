@@ -1,27 +1,80 @@
-"""IMDB sentiment — API analog of python/paddle/v2/dataset/imdb.py:
-word_dict() + train/test readers yielding (word_id_sequence, label)."""
+"""IMDB sentiment — python/paddle/v2/dataset/imdb.py: word_dict() builds
+a frequency-ranked vocab from the aclImdb corpus; train/test readers
+yield (word_id_sequence, label 0|1).
+
+Real data: the aclImdb_v1 tarball, tokenized like the reference
+(lowercase, punctuation stripped); synthetic class-banded token streams
+as the zero-egress fallback.
+"""
 
 from __future__ import annotations
 
+import re
+import string
+import tarfile
+from collections import Counter
+
 import numpy as np
 
-VOCAB = 500
+from . import common
+
+URL = ("https://ai.stanford.edu/~amaas/data/sentiment/"
+       "aclImdb_v1.tar.gz")
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+VOCAB = 500          # synthetic vocab size
 TRAIN_N = 2048
 TEST_N = 256
 
-
-def word_dict():
-    return {f"w{i}": i for i in range(VOCAB)}
+_tok_pat = re.compile(r"[^a-z0-9\s]")
 
 
-def _reader(n, seed):
+def tokenize(text: str):
+    return _tok_pat.sub("", text.lower().replace("<br />", " ")).split()
+
+
+def build_dict_from_tar(tar_path: str, pattern: str, cutoff: int = 150):
+    """Frequency-ranked word dict (reference imdb.py build_dict)."""
+    word_freq = Counter()
+    pat = re.compile(pattern)
+    with tarfile.open(tar_path, "r:gz") as tar:
+        for m in tar.getmembers():
+            if pat.match(m.name):
+                for w in tokenize(tar.extractfile(m).read().decode(
+                        "utf-8", "ignore")):
+                    word_freq[w] += 1
+    words = [(w, c) for w, c in word_freq.items() if c > cutoff]
+    words.sort(key=lambda x: (-x[1], x[0]))
+    return {w: i for i, (w, _) in enumerate(words)}
+
+
+def parse_imdb(tar_path: str, word_idx: dict, pos_pattern: str,
+               neg_pattern: str):
+    unk = len(word_idx)
+
+    def reader():
+        with tarfile.open(tar_path, "r:gz") as tar:
+            pos = re.compile(pos_pattern)
+            neg = re.compile(neg_pattern)
+            for m in tar.getmembers():
+                label = 0 if pos.match(m.name) else \
+                    1 if neg.match(m.name) else None
+                if label is None:
+                    continue
+                toks = tokenize(tar.extractfile(m).read().decode(
+                    "utf-8", "ignore"))
+                yield [word_idx.get(w, unk) for w in toks], label
+
+    return reader
+
+
+def _synthetic_reader(n, seed):
     def r():
         rng = np.random.RandomState(seed)
         for _ in range(n):
             label = int(rng.randint(0, 2))
             length = int(rng.randint(8, 64))
             lo, hi = (0, VOCAB // 2) if label == 0 else (VOCAB // 2, VOCAB)
-            # 70% class-band tokens, 30% noise — learnable but not trivial
             band = rng.randint(lo, hi, length)
             noise = rng.randint(0, VOCAB, length)
             pick = rng.rand(length) < 0.7
@@ -29,9 +82,33 @@ def _reader(n, seed):
     return r
 
 
+def word_dict():
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL, "imdb", MD5)
+            return build_dict_from_tar(
+                path, r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        except common.DownloadError as e:
+            common.fallback_warning("imdb", str(e))
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _make(split, n_syn, seed, word_idx=None):
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL, "imdb", MD5)
+            wd = word_idx or word_dict()
+            return parse_imdb(path, wd,
+                              rf"aclImdb/{split}/pos/.*\.txt$",
+                              rf"aclImdb/{split}/neg/.*\.txt$")
+        except common.DownloadError as e:
+            common.fallback_warning("imdb", str(e))
+    return _synthetic_reader(n_syn, seed)
+
+
 def train(word_idx=None):
-    return _reader(TRAIN_N, seed=7)
+    return _make("train", TRAIN_N, seed=7, word_idx=word_idx)
 
 
 def test(word_idx=None):
-    return _reader(TEST_N, seed=8)
+    return _make("test", TEST_N, seed=8, word_idx=word_idx)

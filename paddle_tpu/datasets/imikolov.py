@@ -1,24 +1,61 @@
-"""PTB-style n-gram LM data — API analog of
-python/paddle/v2/dataset/imikolov.py: build_dict() + train/test(word_idx, n)
-yielding n-gram tuples."""
+"""PTB n-gram LM data — python/paddle/v2/dataset/imikolov.py:
+build_dict() over the PTB train split, train/test(word_idx, n) yielding
+n-gram id tuples.
+
+Real data: the simple-examples tarball's ptb.{train,valid}.txt;
+synthetic markov-ish n-gram stream as the zero-egress fallback.
+"""
 
 from __future__ import annotations
 
+import tarfile
+from collections import Counter
+
 import numpy as np
 
-VOCAB = 300
+from . import common
+
+URL = "http://www.fit.vutbr.cz/~imikolov/rnnlm/simple-examples.tgz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+TRAIN_MEMBER = "./simple-examples/data/ptb.train.txt"
+TEST_MEMBER = "./simple-examples/data/ptb.valid.txt"
+
+VOCAB = 300          # synthetic vocab
 TRAIN_N = 4096
 TEST_N = 512
 
 
-def build_dict(min_word_freq: int = 50):
-    return {f"w{i}": i for i in range(VOCAB)}
+def build_dict_from_tar(tar_path: str, min_word_freq: int = 50):
+    word_freq = Counter()
+    with tarfile.open(tar_path, "r:gz") as tar:
+        for line in tar.extractfile(TRAIN_MEMBER):
+            word_freq.update(line.decode().split())
+    word_freq.pop("<unk>", None)
+    words = [(w, c) for w, c in word_freq.items() if c >= min_word_freq]
+    words.sort(key=lambda x: (-x[1], x[0]))
+    d = {w: i for i, (w, _) in enumerate(words)}
+    for special in ("<s>", "<e>", "<unk>"):
+        d.setdefault(special, len(d))
+    return d
 
 
-def _reader(n_samples, ngram_n, seed):
+def parse_ngrams(tar_path: str, member: str, word_idx: dict, n: int):
+    unk = word_idx.get("<unk>", len(word_idx))
+
+    def reader():
+        with tarfile.open(tar_path, "r:gz") as tar:
+            for line in tar.extractfile(member):
+                toks = ["<s>"] * (n - 1) + line.decode().split() + ["<e>"]
+                ids = [word_idx.get(w, unk) for w in toks]
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n: i])
+
+    return reader
+
+
+def _synthetic_reader(n_samples, ngram_n, seed):
     def r():
         rng = np.random.RandomState(seed)
-        # a synthetic markov-ish stream: next ~ (sum of context) mod VOCAB
         for _ in range(n_samples):
             ctx = rng.randint(0, VOCAB, ngram_n - 1)
             nxt = (ctx.sum() + int(rng.randint(0, 3))) % VOCAB
@@ -26,9 +63,29 @@ def _reader(n_samples, ngram_n, seed):
     return r
 
 
+def build_dict(min_word_freq: int = 50):
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL, "imikolov", MD5)
+            return build_dict_from_tar(path, min_word_freq)
+        except common.DownloadError as e:
+            common.fallback_warning("imikolov", str(e))
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _make(member, n_syn, seed, word_idx, n):
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL, "imikolov", MD5)
+            return parse_ngrams(path, member, word_idx or build_dict(), n)
+        except common.DownloadError as e:
+            common.fallback_warning("imikolov", str(e))
+    return _synthetic_reader(n_syn, n, seed)
+
+
 def train(word_idx=None, n: int = 5):
-    return _reader(TRAIN_N, n, seed=9)
+    return _make(TRAIN_MEMBER, TRAIN_N, 9, word_idx, n)
 
 
 def test(word_idx=None, n: int = 5):
-    return _reader(TEST_N, n, seed=10)
+    return _make(TEST_MEMBER, TEST_N, 10, word_idx, n)

@@ -1,18 +1,59 @@
-"""MNIST — API analog of python/paddle/v2/dataset/mnist.py (train:?/test:?
-readers yielding (image[784] float32 in [-1,1], label int)).  Synthetic:
-class-conditional band patterns + noise, deterministic per index."""
+"""MNIST — python/paddle/v2/dataset/mnist.py: readers yielding
+(image float32[784] scaled to [-1, 1], label int).
+
+Real data: the classic IDX files (download+md5+cache via common.py);
+falls back to the deterministic synthetic stand-in (class-conditional
+band patterns) when fetching is impossible.
+"""
 
 from __future__ import annotations
 
-import os
+import gzip
+import struct
 
 import numpy as np
 
-TRAIN_N = 8192
+from . import common
+
+URL_PREFIX = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+TRAIN_IMAGE_URL = URL_PREFIX + "train-images-idx3-ubyte.gz"
+TRAIN_IMAGE_MD5 = "f68b3c2dcbeaaa9fbdd348bbdeb94873"
+TRAIN_LABEL_URL = URL_PREFIX + "train-labels-idx1-ubyte.gz"
+TRAIN_LABEL_MD5 = "d53e105ee54ea40749a09fcbcd1e9432"
+TEST_IMAGE_URL = URL_PREFIX + "t10k-images-idx3-ubyte.gz"
+TEST_IMAGE_MD5 = "9fb629c4189551a2d022fa330f9573f3"
+TEST_LABEL_URL = URL_PREFIX + "t10k-labels-idx1-ubyte.gz"
+TEST_LABEL_MD5 = "ec29112dd5afa0611ce80d1b7f02629c"
+
+TRAIN_N = 8192    # synthetic sizes (real data serves full size)
 TEST_N = 1024
 
 
-def _sample(idx: int, rng: np.random.RandomState):
+def parse_idx(image_path: str, label_path: str):
+    """Reader over IDX image/label files (plain or gzip)."""
+
+    def opener(p):
+        return gzip.open(p, "rb") if p.endswith(".gz") else open(p, "rb")
+
+    def reader():
+        with opener(image_path) as fi, opener(label_path) as fl:
+            magic, n, rows, cols = struct.unpack(">IIII", fi.read(16))
+            assert magic == 2051, f"bad image magic {magic}"
+            lmagic, ln = struct.unpack(">II", fl.read(8))
+            assert lmagic == 2049, f"bad label magic {lmagic}"
+            n = min(n, ln)
+            per = rows * cols
+            for _ in range(n):
+                img = np.frombuffer(fi.read(per), np.uint8).astype(
+                    np.float32)
+                img = img / 255.0 * 2.0 - 1.0
+                label = fl.read(1)[0]
+                yield img, int(label)
+
+    return reader
+
+
+def _synthetic_sample(rng: np.random.RandomState):
     label = int(rng.randint(0, 10))
     img = rng.rand(28, 28).astype(np.float32) * 0.2 - 1.0
     img[label * 2: label * 2 + 3, :] += 1.2
@@ -20,17 +61,32 @@ def _sample(idx: int, rng: np.random.RandomState):
     return np.clip(img, -1, 1).reshape(784), label
 
 
-def _reader(n, seed):
+def _synthetic_reader(n, seed):
     def r():
         rng = np.random.RandomState(seed)
-        for i in range(n):
-            yield _sample(i, rng)
+        for _ in range(n):
+            yield _synthetic_sample(rng)
     return r
 
 
+def _real_or_synthetic(img_url, img_md5, lbl_url, lbl_md5, n_syn, seed):
+    if not common.synthetic_only():
+        try:
+            imgs = common.download(img_url, "mnist", img_md5)
+            lbls = common.download(lbl_url, "mnist", lbl_md5)
+            return parse_idx(imgs, lbls)
+        except common.DownloadError as e:
+            common.fallback_warning("mnist", str(e))
+    return _synthetic_reader(n_syn, seed)
+
+
 def train():
-    return _reader(TRAIN_N, seed=1)
+    return _real_or_synthetic(TRAIN_IMAGE_URL, TRAIN_IMAGE_MD5,
+                              TRAIN_LABEL_URL, TRAIN_LABEL_MD5,
+                              TRAIN_N, seed=1)
 
 
 def test():
-    return _reader(TEST_N, seed=2)
+    return _real_or_synthetic(TEST_IMAGE_URL, TEST_IMAGE_MD5,
+                              TEST_LABEL_URL, TEST_LABEL_MD5,
+                              TEST_N, seed=2)

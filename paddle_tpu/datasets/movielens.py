@@ -1,0 +1,159 @@
+"""MovieLens 1M — python/paddle/v2/dataset/movielens.py: rating rows
+for the recommender chapter.  Each sample is
+(user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+score) — the feed order of models/recommender.py.
+
+Real data: the ml-1m zip (users.dat/movies.dat/ratings.dat); synthetic
+parity-structured ratings as the zero-egress fallback.
+"""
+
+from __future__ import annotations
+
+import re
+import zipfile
+
+import numpy as np
+
+from . import common
+
+URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+# synthetic fallback dims (mirror MovieLensDims defaults)
+SYN = dict(max_user_id=944, max_job_id=21, max_movie_id=3953,
+           n_categories=18, title_dict_size=5175)
+TRAIN_N = 4096
+TEST_N = 512
+
+_cache = None
+
+
+def _load_real():
+    global _cache
+    if _cache is not None:
+        return _cache
+    path = common.download(URL, "movielens", MD5)
+    users, movies, cats, titles = {}, {}, {}, {}
+    with zipfile.ZipFile(path) as z:
+        with z.open("ml-1m/users.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                uid, gender, age, job, _ = line.split("::")
+                users[int(uid)] = (0 if gender == "M" else 1,
+                                   age_table.index(int(age)), int(job))
+        title_pat = re.compile(r"(.*)\s*\(\d{4}\)\s*$")
+        with z.open("ml-1m/movies.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                mid, title, genres = line.split("::")
+                gl = []
+                for g in genres.split("|"):
+                    gl.append(cats.setdefault(g, len(cats)))
+                m = title_pat.match(title)
+                words = (m.group(1) if m else title).lower().split()
+                tl = [titles.setdefault(w, len(titles)) for w in words]
+                movies[int(mid)] = (gl, tl)
+        ratings = []
+        with z.open("ml-1m/ratings.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                uid, mid, score, _ = line.split("::")
+                uid, mid = int(uid), int(mid)
+                if uid in users and mid in movies:
+                    ratings.append((uid, mid, float(score)))
+    _cache = (users, movies, cats, titles, ratings)
+    return _cache
+
+
+def max_user_id():
+    try:
+        if not common.synthetic_only():
+            return max(_load_real()[0]) + 1
+    except common.DownloadError:
+        pass
+    return SYN["max_user_id"]
+
+
+def max_job_id():
+    try:
+        if not common.synthetic_only():
+            return max(j for _, _, j in _load_real()[0].values()) + 1
+    except common.DownloadError:
+        pass
+    return SYN["max_job_id"]
+
+
+def max_movie_id():
+    try:
+        if not common.synthetic_only():
+            return max(_load_real()[1]) + 1
+    except common.DownloadError:
+        pass
+    return SYN["max_movie_id"]
+
+
+def movie_categories():
+    try:
+        if not common.synthetic_only():
+            return dict(_load_real()[2])
+    except common.DownloadError:
+        pass
+    return {f"genre{i}": i for i in range(SYN["n_categories"])}
+
+
+def get_movie_title_dict():
+    try:
+        if not common.synthetic_only():
+            return dict(_load_real()[3])
+    except common.DownloadError:
+        pass
+    return {f"t{i}": i for i in range(SYN["title_dict_size"])}
+
+
+def _real_reader(test_split: bool):
+    users, movies, _, _, ratings = _load_real()
+    n_test = len(ratings) // 10
+    rows = ratings[-n_test:] if test_split else ratings[:-n_test]
+
+    def reader():
+        for uid, mid, score in rows:
+            gender, age, job = users[uid]
+            gl, tl = movies[mid]
+            yield (uid, gender, age, job, mid, gl, tl,
+                   np.array([score], np.float32))
+
+    return reader
+
+
+def _synthetic_reader(n, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = int(rng.randint(0, SYN["max_user_id"]))
+            mid = int(rng.randint(0, SYN["max_movie_id"]))
+            gl = rng.randint(0, SYN["n_categories"],
+                             rng.randint(1, 4)).tolist()
+            tl = rng.randint(0, SYN["title_dict_size"],
+                             rng.randint(2, 8)).tolist()
+            score = 2.5 + ((uid + mid) % 2) * 2.0 + 0.2 * rng.randn()
+            yield (uid, uid % 2, uid % len(age_table),
+                   uid % SYN["max_job_id"], mid, gl, tl,
+                   np.array([score], np.float32))
+    return r
+
+
+def train():
+    if not common.synthetic_only():
+        try:
+            return _real_reader(test_split=False)
+        except common.DownloadError as e:
+            common.fallback_warning("movielens", str(e))
+    return _synthetic_reader(TRAIN_N, seed=13)
+
+
+def test():
+    if not common.synthetic_only():
+        try:
+            return _real_reader(test_split=True)
+        except common.DownloadError as e:
+            common.fallback_warning("movielens", str(e))
+    return _synthetic_reader(TEST_N, seed=14)

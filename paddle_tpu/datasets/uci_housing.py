@@ -1,20 +1,56 @@
-"""UCI housing regression — API analog of
-python/paddle/v2/dataset/uci_housing.py: train/test readers yielding
-(features[13] float32, price float32); synthetic linear ground truth +
-noise, pre-normalized like the reference."""
+"""UCI housing regression — python/paddle/v2/dataset/uci_housing.py:
+readers yielding (features[13] float32, price [1] float32), features
+min-max normalized over the train split like the reference
+(feature_range + load_data there).
+
+Real data: the UCI `housing.data` whitespace table; synthetic linear
+ground truth + noise as the zero-egress fallback.
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
+from . import common
+
+URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+       "housing/housing.data")
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+
 TRAIN_N = 4096
 TEST_N = 512
+TRAIN_RATIO = 0.8
 
 _TRUE_W = np.linspace(-1.5, 1.5, 13).astype(np.float32)
 _TRUE_B = 2.0
 
 
-def _reader(n, seed):
+def parse_housing(path: str):
+    """-> (train_rows, test_rows), each [(x[13] f32, y[1] f32)], with
+    min-max normalization fit on the train split (reference load_data)."""
+    data = np.loadtxt(path).astype(np.float32)      # [506, 14]
+    n_train = int(len(data) * TRAIN_RATIO)
+    feats, prices = data[:, :13], data[:, 13:]
+    lo = feats[:n_train].min(0)
+    hi = feats[:n_train].max(0)
+    span = np.where(hi > lo, hi - lo, 1.0)
+    feats = (feats - lo) / span - 0.5
+    rows = [(feats[i], prices[i]) for i in range(len(data))]
+    return rows[:n_train], rows[n_train:]
+
+
+_real_cache = None
+
+
+def _real_rows():
+    global _real_cache
+    if _real_cache is None:
+        path = common.download(URL, "uci_housing", MD5)
+        _real_cache = parse_housing(path)
+    return _real_cache
+
+
+def _synthetic_reader(n, seed):
     def r():
         rng = np.random.RandomState(seed)
         for _ in range(n):
@@ -24,9 +60,19 @@ def _reader(n, seed):
     return r
 
 
+def _reader(split, n_syn, seed):
+    if not common.synthetic_only():
+        try:
+            rows = _real_rows()[split]
+            return lambda: iter(rows)
+        except common.DownloadError as e:
+            common.fallback_warning("uci_housing", str(e))
+    return _synthetic_reader(n_syn, seed)
+
+
 def train():
-    return _reader(TRAIN_N, seed=11)
+    return _reader(0, TRAIN_N, seed=11)
 
 
 def test():
-    return _reader(TEST_N, seed=12)
+    return _reader(1, TEST_N, seed=12)

@@ -1,0 +1,109 @@
+"""WMT16 en-de NMT data — python/paddle/v2/dataset/wmt16.py:
+train/test readers yielding (src_ids, trg_ids_next, trg_ids) triples for
+the machine-translation chapters.
+
+Real data: the tokenized tarball (one tab-separated parallel pair per
+line) with BPE-less word vocabularies built from the train split;
+synthetic reversal-task pairs as the zero-egress fallback (copy/reverse
+is the classic seq2seq sanity task, learnable by the chapter models).
+"""
+
+from __future__ import annotations
+
+import tarfile
+from collections import Counter
+
+import numpy as np
+
+from . import common
+
+URL = ("http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz")
+MD5 = "0c38be43600334966403524a40dcd81e"
+
+START, END, UNK = 0, 1, 2
+START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+SYN_VOCAB = 120
+TRAIN_N = 4096
+TEST_N = 512
+
+
+def build_dict_from_tar(tar_path: str, member: str, col: int,
+                        size: int) -> dict:
+    freq = Counter()
+    with tarfile.open(tar_path, "r:gz") as tar:
+        for line in tar.extractfile(member):
+            parts = line.decode("utf-8", "ignore").split("\t")
+            if len(parts) > col:
+                freq.update(parts[col].split())
+    d = {START_MARK: START, END_MARK: END, UNK_MARK: UNK}
+    for w, _ in freq.most_common(size - 3):
+        d[w] = len(d)
+    return d
+
+
+def parse_pairs(tar_path: str, member: str, src_dict: dict,
+                trg_dict: dict):
+    def reader():
+        with tarfile.open(tar_path, "r:gz") as tar:
+            for line in tar.extractfile(member):
+                parts = line.decode("utf-8", "ignore").rstrip("\n") \
+                    .split("\t")
+                if len(parts) < 2:
+                    continue
+                src = [src_dict.get(w, UNK) for w in parts[0].split()]
+                trg = [trg_dict.get(w, UNK) for w in parts[1].split()]
+                if not src or not trg:
+                    continue
+                trg_in = [START] + trg
+                trg_next = trg + [END]
+                yield src, trg_next, trg_in
+
+    return reader
+
+
+def _synthetic_reader(n, seed):
+    """Reversal task: target = reversed source over a shared vocab."""
+
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(3, 10))
+            src = rng.randint(3, SYN_VOCAB, length).tolist()
+            trg = src[::-1]
+            yield src, trg + [END], [START] + trg
+    return r
+
+
+def get_dict(lang: str = "en", dict_size: int = 30000):
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL, "wmt16", MD5)
+            col = 0 if lang == "en" else 1
+            return build_dict_from_tar(path, "wmt16/train", col,
+                                       dict_size)
+        except common.DownloadError as e:
+            common.fallback_warning("wmt16", str(e))
+    return {f"w{i}": i for i in range(SYN_VOCAB)}
+
+
+def _make(member, n_syn, seed, src_dict_size, trg_dict_size):
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL, "wmt16", MD5)
+            src_d = build_dict_from_tar(path, "wmt16/train", 0,
+                                        src_dict_size)
+            trg_d = build_dict_from_tar(path, "wmt16/train", 1,
+                                        trg_dict_size)
+            return parse_pairs(path, member, src_d, trg_d)
+        except common.DownloadError as e:
+            common.fallback_warning("wmt16", str(e))
+    return _synthetic_reader(n_syn, seed)
+
+
+def train(src_dict_size: int = 30000, trg_dict_size: int = 30000):
+    return _make("wmt16/train", TRAIN_N, 16, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size: int = 30000, trg_dict_size: int = 30000):
+    return _make("wmt16/test", TEST_N, 17, src_dict_size, trg_dict_size)

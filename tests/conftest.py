@@ -7,6 +7,10 @@ excluded from CI as `notest_*`)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at TPU
+# zero-egress CI: datasets serve their synthetic stand-ins instead of
+# stalling on download timeouts (test_datasets.py covers the real parse
+# paths via local fixtures and clears this when exercising fallbacks)
+os.environ.setdefault("PADDLE_TPU_SYNTHETIC", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
