@@ -3,9 +3,11 @@ test() reader yielding the 9-column rows the label_semantic_roles model
 feeds (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark,
 target — all id sequences).
 
-Real data: the conll05st-tests tarball (words + props columns) plus the
-word/verb/target dict files; synthetic tag-from-word-id sentences as the
-zero-egress fallback.
+Real data: the conll05st-tests tarball (words + props columns); the
+word/verb/label dicts are built from the corpus itself (the reference
+downloads pre-made dict files; deriving them from the same corpus keeps
+model dims and reader ids consistent by construction).  Synthetic
+tag-from-word-id sentences are the zero-egress fallback.
 """
 
 from __future__ import annotations
@@ -23,6 +25,8 @@ DATA_MD5 = "387719152ae52d60422c016e92a742fc"
 SYN = dict(word_dict_len=800, label_dict_len=9, pred_len=60)
 TEST_N = 512
 
+_real_cache = None   # (sentences, word_dict, verb_dict, label_dict)
+
 
 def _syn_dicts():
     word = {f"w{i}": i for i in range(SYN["word_dict_len"])}
@@ -31,10 +35,70 @@ def _syn_dicts():
     return word, verb, label
 
 
+def _open_member(tar, name):
+    f = tar.extractfile(name)
+    return gzip.open(f) if name.endswith(".gz") else f
+
+
+def _parse_sentences(path):
+    """-> list of (words, prop_rows) per sentence."""
+    with tarfile.open(path, "r:gz") as tar:
+        names = [m.name for m in tar.getmembers()]
+        wf = sorted(n for n in names
+                    if n.endswith("words.gz") or n.endswith(".words"))
+        pf = sorted(n for n in names
+                    if n.endswith("props.gz") or n.endswith(".props"))
+        if not wf or not pf:
+            return []
+        words_lines = _open_member(tar, wf[0]).read().decode().splitlines()
+        props_lines = _open_member(tar, pf[0]).read().decode().splitlines()
+    sentences, cur_w, cur_p = [], [], []
+    for wl, pl in zip(words_lines, props_lines):
+        if not wl.strip():
+            if cur_w:
+                sentences.append((cur_w, cur_p))
+            cur_w, cur_p = [], []
+            continue
+        cur_w.append(wl.strip())
+        cur_p.append(pl.split())
+    if cur_w:
+        sentences.append((cur_w, cur_p))
+    return sentences
+
+
+def _load_real():
+    """Parse the corpus once and derive the three dicts from it."""
+    global _real_cache
+    if _real_cache is not None:
+        return _real_cache
+    path = common.download(DATA_URL, "conll05st", DATA_MD5)
+    sentences = _parse_sentences(path)
+    words, verbs, labels = {}, {}, {"O": 0}
+    for sent_words, props in sentences:
+        for w in sent_words:
+            words.setdefault(w.lower(), len(words))
+        for row in props:
+            if row and row[0] != "-":
+                verbs.setdefault(row[0], len(verbs))
+            for col in row[1:]:
+                if col.startswith("("):
+                    tag = col.strip("()*")
+                    labels.setdefault("B-" + tag, len(labels))
+                    labels.setdefault("I-" + tag, len(labels))
+    _real_cache = (sentences, words, verbs, labels)
+    return _real_cache
+
+
 def get_dict():
-    """(word_dict, verb_dict, label_dict) — synthetic when offline (the
-    reference additionally downloads three dict files; sizes here follow
-    SYN so the model builders agree with the reader)."""
+    """(word_dict, verb_dict, label_dict) — built from the real corpus
+    when it is fetchable, synthetic otherwise; model dims derived from
+    these lengths always agree with the reader's ids."""
+    if not common.synthetic_only():
+        try:
+            _, w, v, l = _load_real()
+            return w, v, l
+        except common.DownloadError as e:
+            common.fallback_warning("conll05", str(e))
     return _syn_dicts()
 
 
@@ -63,91 +127,53 @@ def _synthetic_reader(n, seed):
     return r
 
 
-def test():
-    if not common.synthetic_only():
-        try:
-            # presence check: the corpus tarball (reference reads
-            # words/props columns out of it); full column parsing mirrors
-            # reference conll05.py reader_creator
-            common.download(DATA_URL, "conll05st", DATA_MD5)
-        except common.DownloadError as e:
-            common.fallback_warning("conll05", str(e))
-            return _synthetic_reader(TEST_N, seed=15)
-        return _real_reader()
-    return _synthetic_reader(TEST_N, seed=15)
-
-
 def _real_reader():
-    """Parse the conll05st test split: per-sentence words + per-predicate
-    prop columns -> one sample per (sentence, predicate) pair."""
-    path = common.download(DATA_URL, "conll05st", DATA_MD5)
-    word_dict, verb_dict, label_dict = get_dict()
-    unk_w = len(word_dict)
-
-    def open_member(tar, name):
-        f = tar.extractfile(name)
-        return gzip.open(f) if name.endswith(".gz") else f
+    sentences, word_dict, verb_dict, label_dict = _load_real()
 
     def reader():
-        with tarfile.open(path, "r:gz") as tar:
-            names = [m.name for m in tar.getmembers()]
-            wf = [n for n in names if n.endswith("words.gz")
-                  or n.endswith(".words")]
-            pf = [n for n in names if n.endswith("props.gz")
-                  or n.endswith(".props")]
-            if not wf or not pf:
-                return
-            words_lines = open_member(tar, sorted(wf)[0]).read() \
-                .decode().splitlines()
-            props_lines = open_member(tar, sorted(pf)[0]).read() \
-                .decode().splitlines()
-        # group into sentences at blank lines
-        sent_words, sent_props, cur_w, cur_p = [], [], [], []
-        for wl, pl in zip(words_lines, props_lines):
-            if not wl.strip():
-                if cur_w:
-                    sent_words.append(cur_w)
-                    sent_props.append(cur_p)
-                cur_w, cur_p = [], []
+        for sent_words, props in sentences:
+            length = len(sent_words)
+            if not props or not props[0]:
                 continue
-            cur_w.append(wl.strip())
-            cur_p.append(pl.split())
-        if cur_w:
-            sent_words.append(cur_w)
-            sent_props.append(cur_p)
-
-        for words, props in zip(sent_words, sent_props):
-            length = len(words)
-            n_preds = len(props[0]) - 1 if props and props[0] else 0
-            wids = [word_dict.get(w.lower(), unk_w) for w in words]
+            n_preds = len(props[0]) - 1
+            wids = [word_dict.get(w.lower(), 0) for w in sent_words]
 
             def ctx(off):
                 return [wids[min(max(i + off, 0), length - 1)]
                         for i in range(length)]
 
-            for p in range(n_preds):
-                verb_rows = [row[0] for row in props]
-                pred_idx = next((i for i, row in enumerate(props)
-                                 if row[0] != "-"), 0)
-                verb = verb_rows[pred_idx]
-                vid = verb_dict.get(verb, 0)
+            # rows whose col 0 names a predicate, in order: the p-th
+            # predicate's arguments live in props column 1+p
+            pred_rows = [i for i, row in enumerate(props)
+                         if row and row[0] != "-"]
+            for p in range(min(n_preds, len(pred_rows))):
+                pred_idx = pred_rows[p]
+                vid = verb_dict.get(props[pred_idx][0], 0)
                 mark = [1 if i == pred_idx else 0 for i in range(length)]
-                # IOB-ify the bracketed props column (reference uses its
-                # own span decoding; labels default to O when absent)
                 tags = []
-                cur = "O"
+                cur = None
                 for row in props:
                     col = row[1 + p] if len(row) > 1 + p else "*"
                     if col.startswith("("):
                         cur = col.strip("()*")
                         tags.append(label_dict.get("B-" + cur, 0))
-                    elif cur != "O":
+                    elif cur is not None:
                         tags.append(label_dict.get("I-" + cur, 0))
                     else:
-                        tags.append(label_dict.get("O", 0))
+                        tags.append(label_dict["O"])
                     if col.endswith(")"):
-                        cur = "O"
+                        cur = None
                 yield (wids, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
                        [vid] * length, mark, tags)
 
     return reader
+
+
+def test():
+    if not common.synthetic_only():
+        try:
+            _load_real()
+            return _real_reader()
+        except common.DownloadError as e:
+            common.fallback_warning("conll05", str(e))
+    return _synthetic_reader(TEST_N, seed=15)

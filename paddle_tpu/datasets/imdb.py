@@ -45,12 +45,14 @@ def build_dict_from_tar(tar_path: str, pattern: str, cutoff: int = 150):
                     word_freq[w] += 1
     words = [(w, c) for w, c in word_freq.items() if c > cutoff]
     words.sort(key=lambda x: (-x[1], x[0]))
-    return {w: i for i, (w, _) in enumerate(words)}
+    d = {w: i for i, (w, _) in enumerate(words)}
+    d["<unk>"] = len(d)     # reference imdb.py reserves the unk slot
+    return d
 
 
 def parse_imdb(tar_path: str, word_idx: dict, pos_pattern: str,
                neg_pattern: str):
-    unk = len(word_idx)
+    unk = word_idx.get("<unk>", len(word_idx) - 1)  # stays in-vocab
 
     def reader():
         with tarfile.open(tar_path, "r:gz") as tar:
@@ -82,12 +84,19 @@ def _synthetic_reader(n, seed):
     return r
 
 
+_word_dict_cache = None
+
+
 def word_dict():
+    global _word_dict_cache
+    if _word_dict_cache is not None:
+        return _word_dict_cache
     if not common.synthetic_only():
         try:
             path = common.download(URL, "imdb", MD5)
-            return build_dict_from_tar(
+            _word_dict_cache = build_dict_from_tar(
                 path, r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+            return _word_dict_cache
         except common.DownloadError as e:
             common.fallback_warning("imdb", str(e))
     return {f"w{i}": i for i in range(VOCAB)}

@@ -63,11 +63,18 @@ def _synthetic_reader(n_samples, ngram_n, seed):
     return r
 
 
+_dict_cache = {}
+
+
 def build_dict(min_word_freq: int = 50):
+    if min_word_freq in _dict_cache:
+        return _dict_cache[min_word_freq]
     if not common.synthetic_only():
         try:
             path = common.download(URL, "imikolov", MD5)
-            return build_dict_from_tar(path, min_word_freq)
+            d = build_dict_from_tar(path, min_word_freq)
+            _dict_cache[min_word_freq] = d
+            return d
         except common.DownloadError as e:
             common.fallback_warning("imikolov", str(e))
     return {f"w{i}": i for i in range(VOCAB)}

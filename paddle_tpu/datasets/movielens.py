@@ -111,8 +111,9 @@ def get_movie_title_dict():
 
 def _real_reader(test_split: bool):
     users, movies, _, _, ratings = _load_real()
-    n_test = len(ratings) // 10
-    rows = ratings[-n_test:] if test_split else ratings[:-n_test]
+    n_test = max(1, len(ratings) // 10) if len(ratings) > 1 else 0
+    split = len(ratings) - n_test
+    rows = ratings[split:] if test_split else ratings[:split]
 
     def reader():
         for uid, mid, score in rows:

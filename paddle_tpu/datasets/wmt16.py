@@ -28,8 +28,14 @@ TRAIN_N = 4096
 TEST_N = 512
 
 
+_dict_cache = {}
+
+
 def build_dict_from_tar(tar_path: str, member: str, col: int,
                         size: int) -> dict:
+    key = (tar_path, member, col, size)
+    if key in _dict_cache:
+        return _dict_cache[key]
     freq = Counter()
     with tarfile.open(tar_path, "r:gz") as tar:
         for line in tar.extractfile(member):
@@ -39,6 +45,7 @@ def build_dict_from_tar(tar_path: str, member: str, col: int,
     d = {START_MARK: START, END_MARK: END, UNK_MARK: UNK}
     for w, _ in freq.most_common(size - 3):
         d[w] = len(d)
+    _dict_cache[key] = d
     return d
 
 

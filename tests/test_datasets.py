@@ -253,3 +253,53 @@ def test_all_synthetic_schemas(monkeypatch):
     assert len(cols) == 9 and len(cols[0]) == len(cols[8])
     src, trg_next, trg_in = next(wmt16.train()())
     assert trg_in[0] == wmt16.START and trg_next[-1] == wmt16.END
+
+
+def test_conll05_parse(tmp_path, monkeypatch):
+    import io as pyio
+
+    monkeypatch.setenv("PADDLE_TPU_SYNTHETIC", "")
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "home"))
+    words = "The\ncat\nsat\nquickly\n\nDogs\nbark\n\n"
+    # sentence 1: two predicates (sat, quickly-col is pred2's args)
+    props = ("-\t(A0*\t*\n"
+             "-\t*)\t(A1*)\n"
+             "sat\t(V*)\t*\n"
+             "ran\t*\t(V*)\n"
+             "\n"
+             "-\t(A0*)\n"
+             "bark\t(V*)\n"
+             "\n")
+    tp = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(tp, "w:gz") as tar:
+        for name, text in (("conll05st-release/test.wsj/words/"
+                            "test.wsj.words", words),
+                           ("conll05st-release/test.wsj/props/"
+                            "test.wsj.props", props)):
+            b = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(b)
+            tar.addfile(info, pyio.BytesIO(b))
+    monkeypatch.setattr(conll05, "DATA_URL", _file_url(tp))
+    monkeypatch.setattr(conll05, "DATA_MD5", common.md5file(str(tp)))
+    conll05._real_cache = None
+    try:
+        word_d, verb_d, label_d = conll05.get_dict()
+        assert "cat" in word_d and "sat" in verb_d and "B-A0" in label_d
+        rows = list(conll05.test()())
+        # sentence 1 yields 2 samples (one per predicate), sentence 2 one
+        assert len(rows) == 3
+        s1p1, s1p2, s2 = rows
+        # p-th predicate's mark matches the p-th verb row (r2 review:
+        # verb/mark used to always point at the first predicate)
+        assert s1p1[7] == [0, 0, 1, 0]       # mark for 'sat'
+        assert s1p2[7] == [0, 0, 0, 1]       # mark for 'ran'
+        assert s1p1[6][0] == verb_d["sat"]
+        assert s1p2[6][0] == verb_d["ran"]
+        # tags come from the matching column
+        assert s1p1[8][0] == label_d["B-A0"]
+        assert s1p2[8][1] == label_d["B-A1"]
+        # every id is in-vocab for model building off get_dict() lens
+        assert max(s1p1[0]) < len(word_d)
+    finally:
+        conll05._real_cache = None
