@@ -1,15 +1,24 @@
-"""Benchmark driver — mirrors the reference's benchmark/paddle/image/run.sh
-ResNet-50 training-throughput measurement, on one TPU chip.
+"""Benchmark driver — ResNet-50 images/sec + Transformer-base tokens/sec
+with honest MFU, on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline: the reference's best published ResNet-50 training number,
-84.08 images/sec (Xeon 6148 + MKL-DNN, bs=256 — BASELINE.md; its K40m GPU
-numbers cover AlexNet/GoogLeNet only, so ResNet-50 CPU is the recorded
-reference point for this metric).
+Mirrors the reference's benchmark/paddle/image/run.sh (ResNet-50 train
+throughput) and benchmark/paddle/rnn (seq model throughput), re-aimed at
+the BASELINE.json north star: "ResNet-50 ≥90% of published TPU v2-8
+img/s".  Published v2-8 ResNet-50 training throughput is ~2650 img/s
+(Google Cloud TPU reference models, bf16, global batch 1024) across the
+v2-8's 4 chips → 662.5 img/s per chip; `vs_baseline` is our single-chip
+img/s over that per-chip number, so vs_baseline ≥ 0.9 meets the bar
+(r1's 13.38 was against the reference's 2017 Xeon run — see VERDICT r1
+weak#1 — and said nothing about this target).
 
-Matmul/conv precision is set to bfloat16 (the MXU-native dtype) with fp32
-parameters/accumulation — the TPU analog of the reference's MKL-DNN
-lower-precision compute path.
+MFU = measured FLOP/s ÷ chip peak, with the step's FLOPs taken from XLA
+cost analysis of the exact compiled program (Executor.cost_analysis),
+not an analytic formula.  Matmul/conv precision is bfloat16 (MXU-native)
+with fp32 parameters/accumulation.
+
+Prints ONE JSON line.  Primary fields keep the driver contract
+{"metric", "value", "unit", "vs_baseline"}; supplementary fields carry
+the batch sweep, MFU, and the Transformer numbers.
 """
 
 from __future__ import annotations
@@ -21,16 +30,58 @@ import time
 
 import numpy as np
 
+V2_8_RESNET50_IMGS_PER_SEC = 2650.0     # published, whole v2-8 (4 chips)
+BASELINE_PER_CHIP = V2_8_RESNET50_IMGS_PER_SEC / 4.0
 
-def main() -> None:
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    steps = int(os.environ.get("BENCH_STEPS", "30"))
-    image_px = int(os.environ.get("BENCH_PX", "224"))
-    trials = max(1, int(os.environ.get("BENCH_TRIALS", "3")))
+# bf16 peak FLOP/s per chip by device kind (dense MXU)
+PEAK_BY_KIND = {
+    "TPU v2": 22.5e12,       # per chip (2 cores x 11.25)
+    "TPU v3": 61.5e12,
+    "TPU v4": 137.5e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 229e12,        # v5p
+    "TPU v6 lite": 459e12,
+}
 
+
+def chip_peak_flops() -> float:
     import jax
 
-    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_BY_KIND.items():
+        if kind.startswith(k):
+            return v
+    return 197e12
+
+
+def _time_steps(exe, prog, feed, fetch, scope, steps, trials):
+    """Warm, then best-of-trials wall time for `steps` steps; the final
+    fetch is a true barrier (params chain every step)."""
+    import jax  # noqa: F401
+    from paddle_tpu import fluid
+
+    best = float("inf")
+    with fluid.scope_guard(scope):
+        for _ in range(3):
+            out = exe.run(prog, feed=feed, fetch_list=fetch,
+                          return_numpy=False)[0]
+        float(np.asarray(out))
+        for _ in range(trials):
+            t0 = time.time()
+            for _ in range(steps):
+                out = exe.run(prog, feed=feed, fetch_list=fetch,
+                              return_numpy=False)[0]
+            final = float(np.asarray(out))
+            best = min(best, time.time() - t0)
+    assert np.isfinite(final), f"diverged: {final}"
+    return best / steps
+
+
+def bench_resnet(batch: int, steps: int, trials: int, px: int = 224):
+    import jax
 
     from paddle_tpu import fluid
     from paddle_tpu.models import image_classification
@@ -38,54 +89,135 @@ def main() -> None:
     main_prog, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
-        img = fluid.layers.data("img", [3, image_px, image_px], "float32")
+        img = fluid.layers.data("img", [3, px, px], "float32")
         label = fluid.layers.data("label", [1], "int64")
         predict = image_classification.resnet_imagenet(img, class_num=1000,
                                                        depth=50)
         cost = fluid.layers.cross_entropy(input=predict, label=label)
         avg_cost = fluid.layers.mean(cost)
-        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
-            avg_cost)
+        fluid.optimizer.Momentum(learning_rate=0.1,
+                                 momentum=0.9).minimize(avg_cost)
 
     exe = fluid.Executor(fluid.TPUPlace(0))
     rng = np.random.RandomState(0)
-    # device-resident feed: the input pipeline is measured separately from the
-    # training step (the reference's benchmark/paddle/image/run.sh likewise
-    # feeds a pre-staged in-memory batch)
     feed = {
         "img": jax.device_put(
-            rng.rand(batch, 3, image_px, image_px).astype(np.float32)),
+            rng.rand(batch, 3, px, px).astype(np.float32)),
         "label": jax.device_put(
             rng.randint(0, 1000, (batch, 1)).astype(np.int32)),
     }
-
-    best_dt = float("inf")
     with fluid.scope_guard(scope):
         exe.run(startup)
-        # warmup: compile + 2 steady steps
-        for _ in range(3):
-            loss = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                           return_numpy=False)[0]
-        float(np.asarray(loss))
-        for _ in range(trials):
-            t0 = time.time()
-            for _ in range(steps):
-                loss = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                               return_numpy=False)[0]
-            # the final loss transitively depends on every step's parameter
-            # update, so fetching it is a true end-of-trial barrier
-            final = float(np.asarray(loss))
-            best_dt = min(best_dt, time.time() - t0)
+        flops = exe.cost_analysis(main_prog, feed=feed,
+                                  fetch_list=[avg_cost]).get("flops", 0.0)
+    dt = _time_steps(exe, main_prog, feed, [avg_cost], scope, steps, trials)
+    ips = batch / dt
+    mfu = (flops / dt) / chip_peak_flops()
+    return ips, mfu, flops
 
-    assert np.isfinite(final), f"diverged: {final}"
-    ips = batch * steps / best_dt
-    baseline = 84.08  # BASELINE.md ResNet-50 train bs=256 MKL-DNN img/s
-    print(json.dumps({
+
+def bench_transformer(batch: int, steps: int, trials: int,
+                      seq_len: int = 256):
+    import jax
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import transformer as T
+
+    cfg = dict(n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+               d_inner_hid=2048)
+    vocab = 32768
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        avg_cost, _, _ = T.transformer(
+            src_vocab_size=vocab, trg_vocab_size=vocab,
+            max_length=seq_len + 1, dropout_rate=0.1,
+            src_seq_len=seq_len, trg_seq_len=seq_len, fused=True, **cfg)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+
+    rng = np.random.RandomState(0)
+    b = batch
+    feed = {
+        "src_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
+        "src_pos": np.tile(np.arange(seq_len, dtype=np.int32), (b, 1)),
+        "trg_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
+        "trg_pos": np.tile(np.arange(seq_len, dtype=np.int32), (b, 1)),
+        "src_slf_attn_bias": np.zeros(
+            (b, cfg["n_head"], seq_len, seq_len), np.float32),
+        "trg_slf_attn_bias": T.make_attn_bias(
+            [seq_len] * b, seq_len, cfg["n_head"], causal=True),
+        "trg_src_attn_bias": np.zeros(
+            (b, cfg["n_head"], seq_len, seq_len), np.float32),
+        "lbl_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
+        "lbl_weight": np.ones((b, seq_len), np.float32),
+    }
+    feed = {k: jax.device_put(v) for k, v in feed.items()}
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        flops = exe.cost_analysis(main_prog, feed=feed,
+                                  fetch_list=[avg_cost]).get("flops", 0.0)
+    dt = _time_steps(exe, main_prog, feed, [avg_cost], scope, steps, trials)
+    tokens = batch * seq_len * 2          # source + target tokens consumed
+    return tokens / dt, (flops / dt) / chip_peak_flops()
+
+
+def main() -> None:
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    trials = max(1, int(os.environ.get("BENCH_TRIALS", "2")))
+    batches = [int(b) for b in os.environ.get(
+        "BENCH_BATCHES", "64,128,256").split(",")]
+    tf_batch = int(os.environ.get("BENCH_TF_BATCH", "16"))
+    tf_seq = int(os.environ.get("BENCH_TF_SEQ", "256"))
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+
+    sweep = {}
+    best_ips, best_mfu, best_batch = 0.0, 0.0, batches[0]
+    for b in batches:
+        try:
+            ips, mfu, _ = bench_resnet(b, steps, trials)
+        except Exception as e:  # OOM at large batch: record and move on
+            sweep[str(b)] = {"error": str(e)[:120]}
+            continue
+        sweep[str(b)] = {"images_per_sec": round(ips, 2),
+                         "mfu": round(mfu, 4)}
+        if ips > best_ips:
+            best_ips, best_mfu, best_batch = ips, mfu, b
+
+    try:
+        tf_tps, tf_mfu = bench_transformer(tf_batch, steps, trials, tf_seq)
+    except Exception as e:
+        tf_tps, tf_mfu = None, None
+        print(f"transformer bench failed: {e}", file=sys.stderr)
+
+    if best_ips <= 0.0:
+        print(f"bench failed: no ResNet batch succeeded: {sweep}",
+              file=sys.stderr)
+        sys.exit(1)
+
+    out = {
         "metric": "resnet50_train_images_per_sec",
-        "value": round(ips, 2),
+        "value": round(best_ips, 2),
         "unit": "images/sec",
-        "vs_baseline": round(ips / baseline, 2),
-    }))
+        # single-chip img/s over the per-chip share of published v2-8
+        # throughput; >= 0.9 meets the BASELINE.json bar
+        "vs_baseline": round(best_ips / BASELINE_PER_CHIP, 2),
+        "baseline": {"published_v2_8_images_per_sec":
+                     V2_8_RESNET50_IMGS_PER_SEC,
+                     "per_chip": BASELINE_PER_CHIP},
+        "mfu": round(best_mfu, 4),
+        "best_batch": best_batch,
+        "batch_sweep": sweep,
+        "transformer_tokens_per_sec":
+            round(tf_tps, 1) if tf_tps is not None else None,
+        "transformer_mfu": round(tf_mfu, 4) if tf_mfu is not None else None,
+        "device": jax.devices()[0].device_kind,
+        "peak_tflops": chip_peak_flops() / 1e12,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -22,6 +22,10 @@ __all__ = [
     "softmax_with_cross_entropy", "smooth_l1", "l2_normalize", "split",
     "nce", "im2sequence", "beam_search", "beam_search_decode", "batch_gather",
     "gather", "expand", "multiplex", "fused_attention",
+    "pad", "crop", "lod_reset", "lrn", "label_smooth", "rank_loss",
+    "margin_rank_loss", "log_loss", "conv_shift", "row_conv",
+    "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
+    "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
 ]
 
 
@@ -570,3 +574,232 @@ def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
         attrs["impl"] = impl
     helper.append_op("fused_attention", inputs, {"Out": out}, attrs)
     return out
+
+
+# ---------------------------------------------------------------------------
+# r2 operator batch wrappers (VERDICT missing#7)
+# ---------------------------------------------------------------------------
+
+def _single_out_layer(op_type, inputs, attrs=None, dtype=None, lod=0,
+                      extra_outputs=None, stop_gradient=False, name=None):
+    helper = LayerHelper(op_type, name=name)
+    first = next(iter(inputs.values()))
+    first = first[0] if isinstance(first, list) else first
+    out = helper.create_tmp_variable(dtype or first.dtype, lod_level=lod,
+                                     stop_gradient=stop_gradient)
+    outputs = {"Out": out}
+    tmp = []
+    for slot in (extra_outputs or []):
+        v = helper.create_tmp_variable(first.dtype, stop_gradient=True)
+        outputs[slot] = v
+        tmp.append(v)
+    helper.append_op(op_type, inputs, outputs, attrs or {})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    """reference pad_op.cc."""
+    return _single_out_layer("pad", {"X": x},
+                             {"paddings": list(paddings),
+                              "pad_value": float(pad_value)}, name=name)
+
+
+def crop(x, shape=None, offsets=None, y=None, name=None):
+    """reference crop_op.cc (shape from attr or a second input)."""
+    inputs = {"X": x}
+    attrs = {"offsets": list(offsets or [0] * len(x.shape))}
+    if y is not None:
+        inputs["Y"] = y
+    else:
+        attrs["shape"] = list(shape)
+    return _single_out_layer("crop", inputs, attrs, name=name)
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    """reference lod_reset_op.cc — re-length a sequence batch."""
+    inputs = {"X": x}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = y
+    else:
+        attrs["target_lod"] = list(target_lod)
+    return _single_out_layer("lod_reset", inputs, attrs, lod=1, name=name)
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
+    """reference lrn_op.cc."""
+    return _single_out_layer("lrn", {"X": input},
+                             {"n": n, "k": k, "alpha": alpha,
+                              "beta": beta},
+                             extra_outputs=["MidOut"], name=name)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """reference label_smooth_op.cc."""
+    inputs = {"X": label}
+    if prior_dist is not None:
+        inputs["PriorDist"] = prior_dist
+    return _single_out_layer("label_smooth", inputs,
+                             {"epsilon": float(epsilon)}, name=name)
+
+
+def rank_loss(label, left, right, name=None):
+    """reference rank_loss_op.cc (RankNet)."""
+    return _single_out_layer("rank_loss",
+                             {"Label": label, "Left": left,
+                              "Right": right}, name=name)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """reference margin_rank_loss_op.cc."""
+    return _single_out_layer("margin_rank_loss",
+                             {"Label": label, "X1": left, "X2": right},
+                             {"margin": float(margin)},
+                             extra_outputs=["Activated"], name=name)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """reference log_loss_op.cc."""
+    helper = LayerHelper("log_loss", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("log_loss", {"Predicted": input, "Labels": label},
+                     {"Loss": out}, {"epsilon": float(epsilon)})
+    return out
+
+
+def conv_shift(x, y, name=None):
+    """reference conv_shift_op.cc — circular correlation (NTM)."""
+    return _single_out_layer("conv_shift", {"X": x, "Y": y}, name=name)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """reference layers row_conv (row_conv_op.cc, DeepSpeech2 lookahead)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act,
+                         name=name)
+    feat = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[future_context_size + 1, feat],
+                                dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("row_conv", {"X": input, "Filter": w}, {"Out": out})
+    return helper.append_activation(out)
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  name=None):
+    """reference layers dynamic_lstmp (lstmp_op.cc) — LSTM with recurrent
+    projection; `input` carries the 4*size gate pre-activations."""
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[proj_size, 4 * size],
+                                dtype=input.dtype)
+    w_proj = helper.create_parameter(helper.param_attr,
+                                     shape=[size, proj_size],
+                                     dtype=input.dtype)
+    bias_size = 7 * size if use_peepholes else 4 * size
+    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                shape=[1, bias_size], dtype=input.dtype,
+                                is_bias=True)
+    proj = helper.create_tmp_variable(input.dtype, lod_level=1)
+    cell = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("lstmp",
+                     {"Input": input, "Weight": w, "ProjWeight": w_proj,
+                      "Bias": b},
+                     {"Projection": proj, "Cell": cell},
+                     {"use_peepholes": use_peepholes,
+                      "is_reverse": is_reverse,
+                      "gate_activation": gate_activation,
+                      "cell_activation": cell_activation,
+                      "candidate_activation": candidate_activation,
+                      "proj_activation": proj_activation})
+    return proj, cell
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, name=None):
+    """reference roi_pool_op.cc; rois [R,5]=(batch_idx,x1,y1,x2,y2)."""
+    return _single_out_layer("roi_pool", {"X": input, "ROIs": rois},
+                             {"pooled_height": pooled_height,
+                              "pooled_width": pooled_width,
+                              "spatial_scale": spatial_scale}, name=name)
+
+
+def spp(input, pyramid_height=3, pool_type="max", name=None):
+    """reference spp_op.cc — spatial pyramid pooling."""
+    return _single_out_layer("spp", {"X": input},
+                             {"pyramid_height": pyramid_height,
+                              "pooling_type": pool_type}, name=name)
+
+
+def unpool(x, indices, unpooled_size, name=None):
+    """reference unpool_op.cc (consumes max_pool2d_with_index's mask)."""
+    return _single_out_layer("unpool", {"X": x, "Indices": indices},
+                             {"unpooled_size": list(unpooled_size)},
+                             name=name)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variances=None, flip=False, clip=False, step_h=0.0,
+              step_w=0.0, offset=0.5, name=None):
+    """reference prior_box_op.cc (SSD anchors)."""
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    var = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    helper.append_op("prior_box", {"Input": input, "Image": image},
+                     {"Boxes": boxes, "Variances": var},
+                     {"min_sizes": list(min_sizes),
+                      "max_sizes": list(max_sizes or []),
+                      "aspect_ratios": list(aspect_ratios or [1.0]),
+                      "variances": list(variances
+                                        or [0.1, 0.1, 0.2, 0.2]),
+                      "flip": flip, "clip": clip, "step_h": step_h,
+                      "step_w": step_w, "offset": offset})
+    return boxes, var
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """reference bipartite_match_op.cc."""
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_tmp_variable("int32", stop_gradient=True)
+    dist = helper.create_tmp_variable("float32", stop_gradient=True)
+    helper.append_op("bipartite_match", {"DistMat": dist_matrix},
+                     {"ColToRowMatchIndices": idx,
+                      "ColToRowMatchDist": dist},
+                     {"match_type": match_type,
+                      "dist_threshold": dist_threshold})
+    return idx, dist
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01,
+                   nms_threshold=0.45, nms_top_k=16, keep_top_k=16,
+                   name=None):
+    """detection_output analog: per-class NMS over [n,4] boxes."""
+    return _single_out_layer("multiclass_nms",
+                             {"BBoxes": bboxes, "Scores": scores},
+                             {"score_threshold": score_threshold,
+                              "nms_threshold": nms_threshold,
+                              "nms_top_k": nms_top_k,
+                              "keep_top_k": keep_top_k},
+                             stop_gradient=True, name=name)
+
+
+def max_pool2d_with_index(input, pool_size, pool_stride=None, name=None):
+    """reference pool_with_index_op.cc — max pool returning the flat
+    argmax Mask that `unpool` consumes."""
+    helper = LayerHelper("max_pool2d_with_index", name=name)
+    k = pool_size if isinstance(pool_size, (list, tuple)) \
+        else [pool_size, pool_size]
+    s = pool_stride if pool_stride is not None else list(k)
+    s = s if isinstance(s, (list, tuple)) else [s, s]
+    out = helper.create_tmp_variable(input.dtype)
+    mask = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("max_pool2d_with_index", {"X": input},
+                     {"Out": out, "Mask": mask},
+                     {"ksize": list(k), "strides": list(s)})
+    return out, mask

@@ -9,10 +9,12 @@ from . import (  # noqa: F401
     beam_ops,
     control_flow_ops,
     ctc_ops,
+    detection_ops,
     io_ops,
     crf_ops,
     loss_ops,
     math_ops,
+    misc_ops,
     nn_ops,
     optimizer_ops,
     rnn_ops,

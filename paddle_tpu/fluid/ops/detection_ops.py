@@ -1,0 +1,176 @@
+"""Detection ops — reference prior_box_op.cc, bipartite_match_op.cc and
+the gserver-era detection_output (here `multiclass_nms`).
+
+SSD-style plumbing, static-shape throughout: prior_box is a pure
+function of the feature-map geometry; bipartite matching runs a fixed
+number of greedy extraction rounds with masking; NMS keeps a fixed
+keep_top_k with -1 padding for vacant slots.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import primitive
+
+
+@primitive("prior_box", inputs=["Input", "Image"],
+           outputs=["Boxes", "Variances"], no_grad=True)
+def prior_box(ctx, feat, image):
+    """reference prior_box_op.cc: per feature-map cell, anchor boxes for
+    every (min_size [, max_size], aspect_ratio) combo, normalized
+    [xmin, ymin, xmax, ymax], plus broadcast variances.
+    Boxes: [fh, fw, n_priors, 4]."""
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", [])]
+    ratios = [float(r) for r in ctx.attr("aspect_ratios", [1.0])]
+    flip = ctx.attr("flip", False)
+    clip = ctx.attr("clip", False)
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    offset = ctx.attr("offset", 0.5)
+
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_h = ctx.attr("step_h", 0.0) or ih / fh
+    step_w = ctx.attr("step_w", 0.0) or iw / fw
+
+    ars = [1.0]
+    for r in ratios:
+        if all(abs(r - a) > 1e-6 for a in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        for ar in ars:
+            whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        if k < len(max_sizes):
+            s = (ms * max_sizes[k]) ** 0.5
+            whs.append((s, s))
+    n_priors = len(whs)
+
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, n_priors))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, n_priors))
+    bw = jnp.asarray([w for w, _ in whs], jnp.float32) / 2.0
+    bh = jnp.asarray([h for _, h in whs], jnp.float32) / 2.0
+    boxes = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+@primitive("bipartite_match", inputs=["DistMat"],
+           outputs=["ColToRowMatchIndices", "ColToRowMatchDist"],
+           no_grad=True)
+def bipartite_match(ctx, dist):
+    """reference bipartite_match_op.cc: greedy bipartite matching on a
+    [rows, cols] similarity matrix — repeatedly take the global argmax,
+    retire its row+column; optionally top up unmatched columns with
+    their per-column argmax row (match_type='per_prediction').
+    Outputs per column: matched row index (-1 = none) and distance."""
+    rows, cols = dist.shape
+    n_rounds = min(rows, cols)
+    NEG = jnp.asarray(-1e30, dist.dtype)
+
+    def round_step(state, _):
+        d, match_idx, match_dist = state
+        flat = jnp.argmax(d)
+        r, c = flat // cols, flat % cols
+        best = d[r, c]
+        live = best > NEG / 2
+        match_idx = jnp.where(live, match_idx.at[c].set(r), match_idx)
+        match_dist = jnp.where(live, match_dist.at[c].set(best),
+                               match_dist)
+        d = jnp.where(live, d.at[r, :].set(NEG).at[:, c].set(NEG), d)
+        return (d, match_idx, match_dist), None
+
+    init = (dist.astype(jnp.float32),
+            jnp.full((cols,), -1, jnp.int32),
+            jnp.zeros((cols,), jnp.float32))
+    (d, match_idx, match_dist), _ = jax.lax.scan(
+        round_step, init, None, length=n_rounds)
+
+    if ctx.attr("match_type", "bipartite") == "per_prediction":
+        thresh = ctx.attr("dist_threshold", 0.5)
+        col_best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        col_best = jnp.max(dist, axis=0)
+        fill = (match_idx < 0) & (col_best >= thresh)
+        match_idx = jnp.where(fill, col_best_row, match_idx)
+        match_dist = jnp.where(fill, col_best.astype(jnp.float32),
+                               match_dist)
+    return match_idx, match_dist
+
+
+def _iou(boxes):
+    """[n,4] boxes -> [n,n] IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                               1e-10)
+
+
+@primitive("multiclass_nms", inputs=["BBoxes", "Scores"],
+           outputs=["Out"], no_grad=True)
+def multiclass_nms(ctx, bboxes, scores):
+    """detection_output capability (gserver DetectionOutputLayer /
+    later multiclass_nms_op): per class, greedy NMS over [n, 4] boxes
+    with [c, n] scores; emits [keep_top_k, 6] rows
+    (class, score, x1, y1, x2, y2), score -1 padding for vacant slots."""
+    score_thresh = ctx.attr("score_threshold", 0.01)
+    iou_thresh = ctx.attr("nms_threshold", 0.45)
+    per_class_k = ctx.attr("nms_top_k", 16)
+    keep_k = ctx.attr("keep_top_k", 16)
+    n_cls, n_box = scores.shape
+    iou = _iou(bboxes)
+
+    def nms_one_class(cls_scores):
+        order_score, order_idx = jax.lax.top_k(
+            cls_scores, min(per_class_k, n_box))
+
+        def step(state, i):
+            keep_mask, = state
+            idx = order_idx[i]
+            ok = (order_score[i] >= score_thresh)
+            # suppressed if a kept, higher-scored box overlaps too much
+            sup = jnp.any(keep_mask & (iou[idx] > iou_thresh))
+            keep = ok & ~sup
+            keep_mask = keep_mask.at[idx].set(
+                keep_mask[idx] | keep)
+            return (keep_mask,), keep
+
+        (keep_mask,), kept = jax.lax.scan(
+            step, (jnp.zeros((n_box,), bool),),
+            jnp.arange(order_idx.shape[0]))
+        kept_scores = jnp.where(kept, order_score, -1.0)
+        return order_idx, kept_scores
+
+    idxs, kept_scores = jax.vmap(nms_one_class)(scores)   # [c, k]
+    c_ids = jnp.broadcast_to(jnp.arange(n_cls, dtype=jnp.float32)[:, None],
+                             kept_scores.shape)
+    flat_scores = kept_scores.reshape(-1)
+    flat_idx = idxs.reshape(-1)
+    flat_cls = c_ids.reshape(-1)
+    top_scores, top_pos = jax.lax.top_k(
+        flat_scores, min(keep_k, flat_scores.shape[0]))
+    out = jnp.concatenate([
+        flat_cls[top_pos][:, None],
+        top_scores[:, None],
+        bboxes[flat_idx[top_pos]],
+    ], axis=1)
+    # vacant slots (score<thresh) -> class -1 like the reference's empty
+    out = jnp.where(top_scores[:, None] >= score_thresh, out,
+                    jnp.full_like(out, -1.0))
+    return out

@@ -1,0 +1,331 @@
+"""Tail of the reference operator corpus — the ops VERDICT r1 missing#7
+listed: pad, crop, lrn, label_smooth, rank/margin-rank/log/modified-huber
+losses, conv_shift, row_conv, lod_reset, lstmp, roi_pool, spp, unpool
+(+ max_pool2d_with_index).  Each docstring cites its reference kernel;
+every implementation is a fresh XLA composition (no CUDA to port — the
+MXU/VPU get these through jnp/lax).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import SeqArray
+from ..core.registry import primitive
+
+# ---------------------------------------------------------------------------
+# shape surgery
+# ---------------------------------------------------------------------------
+
+
+@primitive("pad")
+def pad(ctx, x):
+    """reference pad_op.cc: paddings = [before0, after0, before1, ...],
+    constant pad_value."""
+    paddings = ctx.attr("paddings")
+    value = ctx.attr("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return jnp.pad(x, cfg, constant_values=value)
+
+
+@primitive("crop", inputs=["X", "Y?"])
+def crop(ctx, x, y):
+    """reference crop_op.cc: slice `shape` out of X at `offsets`; the
+    target shape may come from the attr or a second input's shape."""
+    offsets = ctx.attr("offsets", [0] * x.ndim)
+    shape = list(y.shape) if y is not None else list(ctx.attr("shape"))
+    return jax.lax.slice(x, offsets,
+                         [o + s for o, s in zip(offsets, shape)])
+
+
+@primitive("lod_reset", inputs=["X", "Y?"])
+def lod_reset(ctx, x, y):
+    """reference lod_reset_op.cc: replace a sequence batch's lengths —
+    either from attr target_lod (offsets) or from Y's lengths.  On the
+    SeqArray representation this re-interprets the same [b, t, ...] data
+    under new lengths (the data itself is unchanged)."""
+    data = x.data if isinstance(x, SeqArray) else x
+    if y is not None and isinstance(y, SeqArray):
+        return SeqArray(data, y.lengths)
+    target = ctx.attr("target_lod")
+    lengths = jnp.asarray([target[i + 1] - target[i]
+                           for i in range(len(target) - 1)], jnp.int32)
+    return SeqArray(data, lengths)
+
+
+# ---------------------------------------------------------------------------
+# normalization / losses
+# ---------------------------------------------------------------------------
+
+
+@primitive("lrn", outputs=["Out", "MidOut"])
+def lrn(ctx, x):
+    """reference lrn_op.cc: across-channel local response normalization
+    out = x / (k + alpha * sum_{window n} x^2)^beta on NCHW."""
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    half = n // 2
+    sq = x * x
+    # pad the channel axis and sum a sliding window over it
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(padded[:, i: i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return x / (mid ** beta), mid
+
+
+@primitive("label_smooth", inputs=["X", "PriorDist?"])
+def label_smooth(ctx, x, prior):
+    """reference label_smooth_op.cc: (1-eps)*label + eps*prior
+    (uniform 1/K when no prior)."""
+    eps = ctx.attr("epsilon", 0.1)
+    if prior is not None:
+        return (1.0 - eps) * x + eps * prior
+    return (1.0 - eps) * x + eps / x.shape[-1]
+
+
+@primitive("rank_loss", inputs=["Label", "Left", "Right"],
+           stop_grad_slots=("Label",))
+def rank_loss(ctx, label, left, right):
+    """reference rank_loss_op.cc (RankNet pairwise logistic):
+    C = o_left - o_right; out = log(1 + e^C) - label*C."""
+    c = left - right
+    return jnp.logaddexp(0.0, c) - label * c
+
+
+@primitive("margin_rank_loss", inputs=["Label", "X1", "X2"],
+           outputs=["Out", "Activated"], stop_grad_slots=("Label",))
+def margin_rank_loss(ctx, label, x1, x2):
+    """reference margin_rank_loss_op.cc:
+    out = max(0, -label*(x1-x2) + margin); Activated marks out > 0."""
+    margin = ctx.attr("margin", 0.0)
+    raw = -label * (x1 - x2) + margin
+    out = jnp.maximum(raw, 0.0)
+    return out, jax.lax.stop_gradient((raw > 0).astype(x1.dtype))
+
+
+@primitive("log_loss", inputs=["Predicted", "Labels"],
+           outputs=["Loss"], stop_grad_slots=("Labels",))
+def log_loss(ctx, pred, label):
+    """reference log_loss_op.cc: -l*log(p+eps) - (1-l)*log(1-p+eps)."""
+    eps = ctx.attr("epsilon", 1e-4)
+    return (-label * jnp.log(pred + eps)
+            - (1.0 - label) * jnp.log(1.0 - pred + eps))
+
+
+@primitive("modified_huber_loss", inputs=["X", "Y"],
+           outputs=["Out", "IntermediateVal"], stop_grad_slots=("Y",))
+def modified_huber_loss(ctx, x, y):
+    """reference modified_huber_loss_op.cc (labels {0,1} -> {-1,+1}):
+    v = (2y-1)*x; out = max(0, 1-v)^2 for v >= -1 else -4v."""
+    v = (2.0 * y - 1.0) * x
+    out = jnp.where(v < -1.0, -4.0 * v,
+                    jnp.square(jnp.maximum(0.0, 1.0 - v)))
+    return out, jax.lax.stop_gradient(v)
+
+
+# ---------------------------------------------------------------------------
+# sequence kernels
+# ---------------------------------------------------------------------------
+
+
+@primitive("conv_shift", inputs=["X", "Y"])
+def conv_shift(ctx, x, y):
+    """reference conv_shift_op.cc: per-row circular correlation — the NTM
+    rotation.  x [b, w], y [b, m] (m odd, m <= w):
+    out[b, i] = sum_j x[b, (i + j - m//2) mod w] * y[b, j]."""
+    w = x.shape[1]
+    m = y.shape[1]
+    half = m // 2
+    shifted = jnp.stack(
+        [jnp.roll(x, shift=half - j, axis=1) for j in range(m)], axis=-1)
+    return jnp.einsum("bwm,bm->bw", shifted, y)
+
+
+@primitive("row_conv", inputs=["X", "Filter"])
+def row_conv(ctx, x, w):
+    """reference row_conv_op.cc — DeepSpeech2's lookahead ("row")
+    convolution: out[t] = sum_{j=0..ctx} x[t+j] ⊙ w[j], per sequence
+    (no bleed past each sequence's end — future frames beyond the
+    length contribute zero, matching the LoD-aware CUDA kernel)."""
+    assert isinstance(x, SeqArray), "row_conv expects a sequence input"
+    data = x.data                                   # [b, t, d]
+    ctx_len = w.shape[0]
+    t = data.shape[1]
+    t_idx = jnp.arange(t)[None, :, None]
+    valid = t_idx < x.lengths[:, None, None].astype(jnp.int32)
+    masked = jnp.where(valid, data, 0.0)
+    padded = jnp.pad(masked, ((0, 0), (0, ctx_len - 1), (0, 0)))
+    out = sum(padded[:, j: j + t] * w[j] for j in range(ctx_len))
+    return SeqArray(jnp.where(valid, out, 0.0), x.lengths)
+
+
+@primitive("lstmp", inputs=["Input", "Weight", "ProjWeight", "Bias",
+                            "H0?", "C0?"],
+           outputs=["Projection", "Cell"])
+def lstmp(ctx, x, w, w_proj, b, h0, c0):
+    """reference lstmp_op.cc — LSTM with a recurrent projection layer:
+    the recurrent state is r = proj_act(h @ ProjWeight), fed back through
+    Weight [proj_size, 4*size]."""
+    from .rnn_ops import _ACTS, _scan_seq
+
+    assert isinstance(x, SeqArray)
+    size = w_proj.shape[0]
+    proj_size = w_proj.shape[1]
+    gate_act = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACTS[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACTS[ctx.attr("candidate_activation", "tanh")]
+    proj_act = _ACTS[ctx.attr("proj_activation", "tanh")]
+    use_peepholes = ctx.attr("use_peepholes", True)
+    batch = x.data.shape[0]
+
+    bias = b.reshape(-1)
+    gate_bias = bias[: 4 * size]
+    if use_peepholes:
+        w_ic = bias[4 * size: 5 * size]
+        w_fc = bias[5 * size: 6 * size]
+        w_oc = bias[6 * size: 7 * size]
+
+    r_init = h0 if h0 is not None else jnp.zeros((batch, proj_size),
+                                                 x.data.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((batch, size),
+                                                 x.data.dtype)
+
+    def step(carry, xt):
+        r, c = carry
+        gates = xt + jnp.matmul(r, w, preferred_element_type=jnp.float32
+                                ).astype(xt.dtype) + gate_bias
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + w_ic * c
+            gf = gf + w_fc * c
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if use_peepholes:
+            go = go + w_oc * c_new
+        h_new = gate_act(go) * cell_act(c_new)
+        r_new = proj_act(jnp.matmul(
+            h_new, w_proj,
+            preferred_element_type=jnp.float32).astype(xt.dtype))
+        return (r_new, c_new), jnp.concatenate([r_new, c_new], axis=-1)
+
+    rc = _scan_seq(x, step, (r_init, c_init), ctx.attr("is_reverse", False))
+    return (SeqArray(rc[..., :proj_size], x.lengths),
+            SeqArray(rc[..., proj_size:], x.lengths))
+
+
+# ---------------------------------------------------------------------------
+# spatial pooling family
+# ---------------------------------------------------------------------------
+
+
+@primitive("max_pool2d_with_index", outputs=["Out", "Mask"])
+def max_pool2d_with_index(ctx, x):
+    """reference pool_with_index_op.cc: max pool + flat argmax indices
+    (the mask `unpool` consumes)."""
+    k = ctx.attr("ksize", [2, 2])
+    s = ctx.attr("strides", list(k))
+    b, c, h, w = x.shape
+    oh = (h - k[0]) // s[0] + 1
+    ow = (w - k[1]) // s[1] + 1
+    # window-expanded view via gather of flat indices (static shapes)
+    rows = (jnp.arange(oh)[:, None] * s[0] + jnp.arange(k[0])[None, :])
+    cols = (jnp.arange(ow)[:, None] * s[1] + jnp.arange(k[1])[None, :])
+    flat = x.reshape(b, c, h * w)
+    idx = (rows[:, None, :, None] * w + cols[None, :, None, :])  # oh,ow,kh,kw
+    win = flat[:, :, idx.reshape(-1)].reshape(b, c, oh, ow, k[0] * k[1])
+    arg = jnp.argmax(win, axis=-1)
+    out = jnp.max(win, axis=-1)
+    mask = jnp.take_along_axis(
+        idx.reshape(oh, ow, -1)[None, None].repeat(b, 0).repeat(c, 1),
+        arg[..., None], axis=-1)[..., 0]
+    return out, jax.lax.stop_gradient(mask.astype(jnp.int32))
+
+
+@primitive("unpool", inputs=["X", "Indices"], stop_grad_slots=("Indices",))
+def unpool(ctx, x, indices):
+    """reference unpool_op.cc: scatter pooled values back to the flat
+    positions recorded by max_pool2d_with_index."""
+    out_hw = ctx.attr("unpooled_size")        # [H, W] of the dense output
+    b, c, oh, ow = x.shape
+    flat_out = jnp.zeros((b, c, out_hw[0] * out_hw[1]), x.dtype)
+    flat_idx = indices.reshape(b, c, oh * ow)
+    flat_x = x.reshape(b, c, oh * ow)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        flat_out, flat_idx, flat_x)
+    return out.reshape(b, c, out_hw[0], out_hw[1])
+
+
+@primitive("roi_pool", inputs=["X", "ROIs"], outputs=["Out"],
+           stop_grad_slots=("ROIs",))
+def roi_pool(ctx, x, rois):
+    """reference roi_pool_op.cc: per-ROI adaptive max pool to
+    [pooled_h, pooled_w].  ROIs [R, 5] = (batch_idx, x1, y1, x2, y2) in
+    input coordinates scaled by spatial_scale.  The variable-size
+    windows become a position mask + max (static shapes for XLA)."""
+    scale = ctx.attr("spatial_scale", 1.0)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    x = jnp.asarray(x)
+    b, c, h, w = x.shape
+    rois = jnp.asarray(rois).astype(jnp.float32)
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        img = x[bi]                                   # [c, h, w]
+        ys = jnp.arange(h, dtype=jnp.float32)[None, :, None]
+        xs = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+        out_cells = []
+        for iy in range(ph):
+            hs = jnp.floor(y1 + iy * rh / ph)
+            he = jnp.ceil(y1 + (iy + 1) * rh / ph)
+            for ix in range(pw):
+                ws = jnp.floor(x1 + ix * rw / pw)
+                we = jnp.ceil(x1 + (ix + 1) * rw / pw)
+                m = ((ys >= hs) & (ys < he) & (xs >= ws) & (xs < we))
+                cell = jnp.max(jnp.where(m, img, -jnp.inf), axis=(1, 2))
+                out_cells.append(jnp.where(jnp.isfinite(cell), cell, 0.0))
+        return jnp.stack(out_cells, -1).reshape(c, ph, pw)
+
+    return jax.vmap(one)(rois)
+
+
+@primitive("spp", outputs=["Out"])
+def spp(ctx, x):
+    """reference spp_op.cc: spatial pyramid pooling — concat of max (or
+    avg) pools at pyramid levels 2^0 .. 2^(L-1) bins per side, flattened
+    to [b, c * sum(bins^2)]."""
+    levels = ctx.attr("pyramid_height", 3)
+    pool_type = ctx.attr("pooling_type", "max")
+    b, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        ys = (jnp.arange(h) * bins) // h              # bin id per row
+        xs = (jnp.arange(w) * bins) // w
+        cell = ys[:, None] * bins + xs[None, :]       # [h, w] bin ids
+        seg = cell.reshape(-1)
+        flat = x.reshape(b, c, h * w)
+        if pool_type == "max":
+            pooled = jax.ops.segment_max(flat.transpose(2, 0, 1), seg,
+                                         num_segments=bins * bins)
+            # bins beyond the feature-map side are empty -> -inf; zero
+            # them (tiny maps with deep pyramids must not NaN the loss)
+            pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+        else:
+            sums = jax.ops.segment_sum(flat.transpose(2, 0, 1), seg,
+                                       num_segments=bins * bins)
+            cnt = jax.ops.segment_sum(jnp.ones_like(seg, jnp.float32), seg,
+                                      num_segments=bins * bins)
+            pooled = sums / cnt[:, None, None]
+        outs.append(pooled.transpose(1, 2, 0).reshape(b, -1))
+    return jnp.concatenate(outs, axis=1)

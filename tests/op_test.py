@@ -96,6 +96,29 @@ class OpTestCase:
         outs = get_op_info(self.op_type).emit(ctx, ins)
         return {slot: len(vals) for slot, vals in outs.items()}
 
+    # -- execution helpers ---------------------------------------------------
+    def run_all(self) -> Dict[str, list]:
+        """Run the op through the executor; -> {slot: [values]}."""
+        out_slots = self._discover_outputs()
+        main, startup, scope, feed, _, out_vars = self._build(out_slots)
+        exe = fluid.Executor(fluid.CPUPlace())
+        order = [(slot, i) for slot in out_slots
+                 for i in range(len(out_vars[slot]))]
+        with fluid.scope_guard(scope):
+            results = exe.run(main, feed=feed,
+                              fetch_list=[out_vars[s][i] for s, i in order],
+                              return_numpy=False)
+        out: Dict[str, list] = {}
+        for (slot, _), val in zip(order, results):
+            out.setdefault(slot, []).append(val)
+        return out
+
+    def run_single(self):
+        """Run and return the sole output value."""
+        outs = self.run_all()
+        (vals,) = outs.values()
+        return vals[0]
+
     # -- checks --------------------------------------------------------------
     def check_output(self, expect: Dict[str, Union[np.ndarray, list]],
                      atol: float = 1e-5, rtol: float = 1e-4):
