@@ -12,7 +12,8 @@ from . import (checkpoint, clip, debugger, evaluator, initializer, io,
                nets, optimizer, profiler, regularizer, unique_name)
 from .memory_optimization_transpiler import memory_optimize
 from .backward import append_backward, calc_gradient
-from .core.lod import SeqArray, make_seq
+from .core.lod import (NestedSeqArray, SeqArray, make_nested_seq,
+                       make_seq)
 from .core.registry import registered_ops
 from .data_feeder import DataFeeder
 from .executor import (CPUPlace, Executor, Scope, TPUPlace, global_scope,

@@ -21,7 +21,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-__all__ = ["SeqArray", "make_seq", "seq_mask"]
+__all__ = ["SeqArray", "make_seq", "seq_mask",
+           "NestedSeqArray", "make_nested_seq"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -98,3 +99,117 @@ def make_seq(seqs, dtype=None, max_len=None, bucket=None):
     for i, s in enumerate(seqs):
         data[i, : len(s)] = s
     return SeqArray(data, lengths)
+
+
+@jax.tree_util.register_pytree_node_class
+class NestedSeqArray:
+    """Level-2 LoD: a batch of sequences OF sequences — the static-shape
+    analog of the reference's nested LoD (lod_tensor.h:109, e.g.
+    paragraphs→sentences→words, or beam decode's per-source candidate
+    lists).
+
+        data           [batch, max_outer, max_inner, *feat]
+        outer_lengths  [batch]            # sub-sequences per row
+        inner_lengths  [batch, max_outer] # words per sub-sequence
+
+    np.asarray(nested) yields the padded data block, so dense consumers
+    (metrics, prints) work unchanged; LoD-aware ops read the lengths.
+    """
+
+    __slots__ = ("data", "outer_lengths", "inner_lengths")
+
+    def __init__(self, data, outer_lengths, inner_lengths):
+        self.data = data
+        self.outer_lengths = outer_lengths
+        self.inner_lengths = inner_lengths
+
+    def tree_flatten(self):
+        return (self.data, self.outer_lengths, self.inner_lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def lod_level(self):
+        return 2
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self.data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def outer_mask(self):
+        """[batch, max_outer] bool — which sub-sequences exist."""
+        return seq_mask(self.outer_lengths, self.data.shape[1])
+
+    def inner_mask(self):
+        """[batch, max_outer, max_inner] bool — which tokens exist."""
+        import jax.numpy as jnp
+
+        pos = jnp.arange(self.data.shape[2], dtype=jnp.int32)
+        m = pos[None, None, :] < self.inner_lengths[..., None].astype(
+            jnp.int32)
+        return m & self.outer_mask()[..., None]
+
+    def flatten_outer(self) -> "SeqArray":
+        """Collapse to level-1: [batch*max_outer, max_inner, *feat] with
+        per-sub-sequence lengths (vacant outer slots get length 0) — how
+        nested batches feed level-1 sequence ops."""
+        import jax.numpy as jnp
+
+        b, n = self.data.shape[0], self.data.shape[1]
+        flat = self.data.reshape((b * n,) + self.data.shape[2:])
+        lens = jnp.where(self.outer_mask(),
+                         self.inner_lengths.astype(jnp.int32),
+                         0).reshape(b * n)
+        return SeqArray(flat, lens)
+
+    def __repr__(self):
+        return (f"NestedSeqArray(data={tuple(self.data.shape)}, "
+                f"outer={tuple(np.asarray(self.outer_lengths).shape)}, "
+                f"inner={tuple(np.asarray(self.inner_lengths).shape)})")
+
+
+def make_nested_seq(nested, dtype=None, outer_bucket=None,
+                    inner_bucket=None):
+    """Host-side packing: list (batch) of lists (outer) of sequences ->
+    NestedSeqArray, padded on both levels."""
+    batch = len(nested)
+    outer_lengths = np.asarray([len(row) for row in nested], np.int32)
+    n_max = int(outer_lengths.max()) if batch else 0
+    if outer_bucket:
+        n_max = int(np.ceil(max(n_max, 1) / outer_bucket) * outer_bucket)
+    seqs = [[np.asarray(s, dtype=dtype) for s in row] for row in nested]
+    m_max = max((len(s) for row in seqs for s in row), default=0)
+    if inner_bucket:
+        m_max = int(np.ceil(max(m_max, 1) / inner_bucket) * inner_bucket)
+    feat = ()
+    for row in seqs:
+        for s in row:
+            feat = s.shape[1:]
+            break
+        if feat:
+            break
+    sample_dtype = None
+    for row in seqs:
+        for s in row:
+            sample_dtype = s.dtype
+            break
+        if sample_dtype is not None:
+            break
+    data = np.zeros((batch, n_max, m_max) + feat,
+                    dtype=sample_dtype or dtype)
+    inner_lengths = np.zeros((batch, n_max), np.int32)
+    for i, row in enumerate(seqs):
+        for j, s in enumerate(row):
+            data[i, j, : len(s)] = s
+            inner_lengths[i, j] = len(s)
+    return NestedSeqArray(data, outer_lengths, inner_lengths)

@@ -105,11 +105,18 @@ def _emit_generic_grad(ctx: EmitCtx, op: OpDesc, ins: Dict[str, list]):
                 sel.append(v.data if isinstance(v, SeqArray) else v)
         return sel
 
-    _, vjp_fn = jax.vjp(fwd_selected, primals)
+    primals_out, vjp_fn = jax.vjp(fwd_selected, primals)
     cts = []
-    for slot in grad_slot_order:
-        for v in cotangents[slot]:
-            cts.append(v.data if isinstance(v, SeqArray) else v)
+    for v, o in zip(
+            (v for slot in grad_slot_order for v in cotangents[slot]),
+            primals_out):
+        c = v.data if isinstance(v, SeqArray) else v
+        # mixed precision (bf16 activations, f32 master weights) can hand
+        # back an upcast cotangent; vjp transpose rules require the
+        # forward output's dtype exactly
+        if hasattr(c, "dtype") and c.dtype != o.dtype:
+            c = c.astype(o.dtype)
+        cts.append(c)
     grads = vjp_fn(cts)[0]
 
     out: Dict[str, list] = {}

@@ -16,10 +16,27 @@ import numpy as np
 from ..core.registry import primitive
 
 
+def _match_conv_dtype(x, w):
+    """Master-weight mixed precision: bf16 activations with f32 params
+    compute in the activation dtype (the MXU-native path); lax.conv
+    rejects mixed operand dtypes."""
+    if x.dtype != w.dtype:
+        w = w.astype(x.dtype)
+    return w
+
+
+def _conv_pet(x):
+    """preferred_element_type for convs: f32 accumulate for f32 inputs;
+    None for bf16 (MXU accumulation is f32 internally either way, and an
+    explicit f32 PET breaks the conv transpose rule under bf16)."""
+    return jnp.float32 if x.dtype == jnp.float32 else None
+
+
 @primitive("conv2d", inputs=["Input", "Filter"], outputs=["Output"])
 def conv2d(ctx, x, w):
     """NCHW conv — reference conv_op.cc.  Filter layout OIHW (out, in/groups,
     h, w), matching the reference."""
+    w = _match_conv_dtype(x, w)
     strides = tuple(ctx.attr("strides", [1, 1]))
     p = ctx.attr("paddings", [0, 0])
     dil = tuple(ctx.attr("dilations", [1, 1]))
@@ -29,12 +46,13 @@ def conv2d(ctx, x, w):
         padding=[(p[0], p[0]), (p[1], p[1])],
         rhs_dilation=dil, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=_conv_pet(x)).astype(x.dtype)
 
 
 @primitive("depthwise_conv2d", inputs=["Input", "Filter"], outputs=["Output"])
 def depthwise_conv2d(ctx, x, w):
     """reference conv_op.cc depthwise variant (function/DepthwiseConvOp)."""
+    w = _match_conv_dtype(x, w)
     strides = tuple(ctx.attr("strides", [1, 1]))
     p = ctx.attr("paddings", [0, 0])
     c = x.shape[1]
@@ -43,7 +61,7 @@ def depthwise_conv2d(ctx, x, w):
         padding=[(p[0], p[0]), (p[1], p[1])],
         feature_group_count=c,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=_conv_pet(x)).astype(x.dtype)
 
 
 @primitive("conv2d_transpose", inputs=["Input", "Filter"], outputs=["Output"])
@@ -51,6 +69,7 @@ def conv2d_transpose(ctx, x, w):
     """reference conv_transpose_op.cc — implemented as the standard
     lhs-dilated conv with a flipped, transposed kernel (filter layout IOHW).
     Output spatial = (in-1)*stride + filter - 2*pad."""
+    w = _match_conv_dtype(x, w)
     s = ctx.attr("strides", [1, 1])
     p = ctx.attr("paddings", [0, 0])
     wf = jnp.flip(w, axis=(2, 3)).transpose(1, 0, 2, 3)  # IOHW -> OIHW
@@ -61,7 +80,7 @@ def conv2d_transpose(ctx, x, w):
                  (fw - 1 - p[1], fw - 1 - p[1])],
         lhs_dilation=tuple(s),
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=_conv_pet(x)).astype(x.dtype)
 
 
 @primitive("pool2d")

@@ -101,7 +101,24 @@ def sequence_conv(ctx, x, w):
 @primitive("sequence_expand", inputs=["X", "Y"])
 def sequence_expand(ctx, x, y):
     """reference sequence_expand_op.cc: broadcast each batch row of X across
-    the time steps of the corresponding sequence in Y."""
+    the time steps of the corresponding sequence in Y.
+
+    Level-2 (nested) Y — the reference's ref_level semantics over a 2-level
+    LoD (lod_tensor.h:109): X is a level-1 batch over Y's OUTER axis
+    ([b, n, d]); each outer element broadcasts across its inner steps,
+    producing a NestedSeqArray with Y's nested lengths."""
+    from ..core.lod import NestedSeqArray
+
+    if isinstance(y, NestedSeqArray):
+        xd = x.data if isinstance(x, SeqArray) else x     # [b, n, d]
+        m_max = y.data.shape[2]
+        expanded = jnp.broadcast_to(
+            xd[:, :, None],
+            xd.shape[:2] + (m_max,) + xd.shape[2:])
+        mask = y.inner_mask().reshape(
+            y.inner_mask().shape + (1,) * (expanded.ndim - 3))
+        return NestedSeqArray(expanded * mask.astype(xd.dtype),
+                              y.outer_lengths, y.inner_lengths)
     assert isinstance(y, SeqArray)
     xd = x.data if isinstance(x, SeqArray) else x
     if xd.ndim == y.data.ndim:          # [b, 1, d] -> expand time
@@ -109,6 +126,34 @@ def sequence_expand(ctx, x, y):
     expanded = jnp.broadcast_to(
         xd[:, None], (xd.shape[0], y.max_len) + xd.shape[1:])
     return SeqArray(expanded * _mask(y).astype(xd.dtype), y.lengths)
+
+
+@primitive("nested_sequence_pool", inputs=["X"])
+def nested_sequence_pool(ctx, x):
+    """Pool the INNER level of a 2-level batch (paragraph→sentence→words
+    pooled to paragraph→sentence-vectors): NestedSeqArray [b,n,m,*f] ->
+    SeqArray [b,n,*f] carrying the outer lengths.  The level-collapsing
+    half of the reference's nested-LoD sequence_pool."""
+    from ..core.lod import NestedSeqArray
+
+    assert isinstance(x, NestedSeqArray), "expects a level-2 sequence"
+    ptype = ctx.attr("pool_type", "sum")
+    mask = x.inner_mask()
+    m = mask.reshape(mask.shape + (1,) * (x.data.ndim - 3))
+    masked = jnp.where(m, x.data, 0.0)
+    if ptype == "sum":
+        out = masked.sum(axis=2)
+    elif ptype == "average":
+        cnt = jnp.maximum(
+            x.inner_lengths.astype(jnp.float32), 1.0)
+        out = masked.sum(axis=2) / cnt.reshape(
+            cnt.shape + (1,) * (x.data.ndim - 3))
+    elif ptype == "max":
+        out = jnp.where(m, x.data, -jnp.inf).max(axis=2)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    else:
+        raise ValueError(f"nested_sequence_pool: unknown type {ptype!r}")
+    return SeqArray(out, x.outer_lengths)
 
 
 @primitive("sequence_concat", inputs=["X*"])

@@ -278,3 +278,34 @@ def test_label_semantic_roles(fresh_programs):
     # crf_decoding shares the trained 'crfw' transitions
     acc_after = decode_accuracy()
     assert acc_after > acc_before + 0.1, (acc_before, acc_after)
+
+
+def test_bf16_activation_training(fresh_programs):
+    """Mixed precision: bf16 activations + f32 master weights (the TPU
+    recipe; r2 conv PET fix) — a conv net trains without dtype errors
+    and the loss decreases."""
+    main, startup, scope = fresh_programs
+    img = fluid.layers.data(name="img", shape=[3, 16, 16],
+                            dtype="bfloat16")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                               padding=1, act="relu")
+    pool = fluid.layers.pool2d(input=conv, pool_size=2, pool_stride=2)
+    predict = fluid.layers.fc(input=pool, size=4, act="softmax")
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+        avg_cost)
+    import ml_dtypes
+    rng = np.random.RandomState(0)
+
+    def feeder(i):
+        lbl = rng.randint(0, 4, (8, 1)).astype(np.int64)
+        imgv = (rng.rand(8, 3, 16, 16) * 0.2).astype(ml_dtypes.bfloat16)
+        for b, k in enumerate(lbl[:, 0]):
+            imgv[b, k % 3] += ml_dtypes.bfloat16(0.8)
+        return {"img": imgv, "label": lbl}
+
+    losses = _train(main, startup, scope, feeder, avg_cost, steps=25)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses[::5]
