@@ -52,7 +52,16 @@ def build_dict_from_tar(tar_path: str, pattern: str, cutoff: int = 150):
 
 def parse_imdb(tar_path: str, word_idx: dict, pos_pattern: str,
                neg_pattern: str):
-    unk = word_idx.get("<unk>", len(word_idx) - 1)  # stays in-vocab
+    # OOV tokens need a dedicated in-vocab id: aliasing the last real word
+    # silently corrupts it, and an id past the table is out of range for
+    # embeddings sized len(word_idx). Require the caller's dict to carry
+    # the slot (build_dict_from_tar and word_dict() both reserve it).
+    if "<unk>" not in word_idx:
+        raise ValueError(
+            "parse_imdb: word_idx must contain an '<unk>' entry for OOV "
+            "tokens (build_dict_from_tar reserves one); add e.g. "
+            "word_idx['<unk>'] = len(word_idx)")
+    unk = word_idx["<unk>"]
 
     def reader():
         with tarfile.open(tar_path, "r:gz") as tar:
@@ -99,7 +108,9 @@ def word_dict():
             return _word_dict_cache
         except common.DownloadError as e:
             common.fallback_warning("imdb", str(e))
-    return {f"w{i}": i for i in range(VOCAB)}
+    d = {f"w{i}": i for i in range(VOCAB)}
+    d["<unk>"] = len(d)     # same reserved slot as build_dict_from_tar
+    return d
 
 
 def _make(split, n_syn, seed, word_idx=None):

@@ -142,32 +142,65 @@ def op_cost_table(program=None, feed=None, scope=None, mode="train",
     rows = []
     key_aval = jax.eval_shape(lambda: jax.random.key(0))
 
+    def fallback_outputs(op):
+        # when an op can't be emitted in isolation, still register avals
+        # for its outputs (block var descs, else a scalar placeholder) so
+        # downstream ops keep the table going instead of aborting with a
+        # misleading "run startup first" error
+        for names in op.outputs.values():
+            for n in names:
+                if not n or n in env:
+                    continue
+                v = scope.find_var(n)   # live value (param/state) is exact
+                if v is not None:
+                    env[n] = aval_of(v)
+                    continue
+                vd = block.vars.get(n)
+                if vd is not None and vd.shape is not None:
+                    # dynamic dims take the leading dim of the fed avals
+                    # (the real batch) so downstream shape-strict ops and
+                    # flop counts stay consistent; _DUMMY_BATCH otherwise
+                    from .framework import _DUMMY_BATCH
+
+                    batch = next((a.shape[0] for a in env.values()
+                                  if getattr(a, "shape", ()) and
+                                  a.shape[0] > 0), _DUMMY_BATCH)
+                    shape = [batch if d in (-1, None) else d
+                             for d in vd.shape]
+                    env[n] = jax.ShapeDtypeStruct(
+                        tuple(shape), np.dtype(vd.dtype or "float32"))
+                else:
+                    env[n] = jax.ShapeDtypeStruct((), np.float32)
+
     for idx, op in enumerate(block.ops):
         if op.type in MARKER_OPS or op.type in HOST_OPS:
             continue
-        # pull unmet inputs from the scope (params/state)
+        # pull unmet inputs from the scope (params/state) — OUTSIDE the
+        # try: an uninitialized scope must raise the actionable error, not
+        # degrade into an all-zero table. Inputs produced by an op whose
+        # emission failed are already in env via fallback_outputs.
         for names in op.inputs.values():
             for n in names:
                 if n and n not in env:
                     v = scope.find_var(n)
                     if v is None:
                         raise RuntimeError(
-                            f"op_cost_table: {op.type} input {n!r} absent "
-                            f"(run startup first)")
+                            f"op_cost_table: {op.type} input {n!r} "
+                            f"absent (run startup first)")
                     env[n] = aval_of(v)
-        ins = _gather_inputs(op, env)
-        flat, treedef = jax.tree.flatten(ins)
-
-        def one_op(flat_vals, rng):
-            ins2 = jax.tree.unflatten(treedef, flat_vals)
-            ctx = EmitCtx(op, rng=rng, mode=mode)
-            if has_op(op.type):
-                return get_op_info(op.type).emit(ctx, ins2)
-            if is_grad_op_type(op.type) and has_op(base_op_type(op.type)):
-                return _emit_generic_grad(ctx, op, ins2)
-            raise KeyError(op.type)
-
         try:
+            ins = _gather_inputs(op, env)
+            flat, treedef = jax.tree.flatten(ins)
+
+            def one_op(flat_vals, rng):
+                ins2 = jax.tree.unflatten(treedef, flat_vals)
+                ctx = EmitCtx(op, rng=rng, mode=mode)
+                if has_op(op.type):
+                    return get_op_info(op.type).emit(ctx, ins2)
+                if is_grad_op_type(op.type) and has_op(base_op_type(op.type)):
+                    return _emit_generic_grad(ctx, op, ins2)
+                raise KeyError(op.type)
+
             outs = jax.eval_shape(one_op, flat, key_aval)
             _scatter_outputs(op, outs, env)
             ca = jax.jit(one_op).lower(flat, key_aval).cost_analysis()
@@ -178,6 +211,7 @@ def op_cost_table(program=None, feed=None, scope=None, mode="train",
             # control-flow ops (need a live block lowerer), unregistered
             # types, emit failures — count as zero, keep the table going
             ca = {}
+            fallback_outputs(op)
         rows.append({
             "op": f"#{idx} {op.type}",
             "flops": float(ca.get("flops", 0.0)),

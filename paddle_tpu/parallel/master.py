@@ -61,7 +61,40 @@ class TaskQueue:
 
     # -- dataset -------------------------------------------------------------
     def set_dataset(self, chunks: Sequence) -> None:
-        """Partition: one task per chunk (SetDataset :280)."""
+        """Partition: one task per chunk (SetDataset :280).
+
+        Chunks must be JSON values — queue state snapshots through JSON,
+        so non-JSON payloads (numpy arrays, custom objects) are rejected
+        here rather than failing later at snapshot time. Chunks are
+        round-tripped through JSON immediately so read_chunk sees the
+        SAME types before and after a master recovery (tuples become
+        lists up front, not only on restore).
+        """
+        def check_keys(x):
+            # json.dumps silently stringifies non-string dict keys — the
+            # one lossy change allow_nan=False doesn't already reject
+            if isinstance(x, dict):
+                for k, v in x.items():
+                    if not isinstance(k, str):
+                        raise TypeError(
+                            "TaskQueue chunk dicts need string keys "
+                            f"(got {k!r}): JSON stringifies them, so "
+                            "read_chunk would see different keys after "
+                            "a master recovery")
+                    check_keys(v)
+            elif isinstance(x, (list, tuple)):
+                for v in x:
+                    check_keys(v)
+
+        original = list(chunks)
+        check_keys(original)
+        try:
+            chunks = json.loads(json.dumps(original, allow_nan=False))
+        except (TypeError, ValueError) as e:
+            raise TypeError(
+                "TaskQueue chunks must be JSON values (file paths, index "
+                "ranges, lists of records; string dict keys, finite "
+                f"floats): {e}") from e
         with self._lock:
             self._todo = [Task(i, c, self._epoch)
                           for i, c in enumerate(chunks)]
@@ -198,6 +231,17 @@ def master_reader(queue: TaskQueue, read_chunk: Callable[[object], Iterable],
     """Reader over a TaskQueue — the cloud_reader analog: lease a task,
     yield its records, mark finished; a crash mid-chunk simply never
     finishes the lease, and the chunk re-dispatches after the timeout.
+
+    Delivery is **at-least-once**: if a worker dies (or times out) after
+    consuming part of a chunk, the lease expires and the whole chunk
+    re-dispatches, so records of partially-consumed chunks can be
+    yielded again — same contract as the reference master's timeout
+    retry (go/master/service.go:341). Make per-record side effects
+    idempotent, or batch at chunk granularity.
+
+    Only read_chunk's own iteration is guarded: an exception the
+    *consumer* throws into the generator (gen.throw / gen.close)
+    propagates instead of being miscounted as a chunk failure.
     """
 
     def reader():
@@ -214,11 +258,19 @@ def master_reader(queue: TaskQueue, read_chunk: Callable[[object], Iterable],
                 continue
             polls = 0
             try:
-                for record in read_chunk(task.chunk):
-                    yield record
+                it = iter(read_chunk(task.chunk))
             except Exception:
                 queue.task_failed(task.task_id)
                 continue
-            queue.task_finished(task.task_id)
+            while True:
+                try:
+                    record = next(it)
+                except StopIteration:
+                    queue.task_finished(task.task_id)
+                    break
+                except Exception:
+                    queue.task_failed(task.task_id)
+                    break
+                yield record    # consumer exceptions propagate from here
 
     return reader

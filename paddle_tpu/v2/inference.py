@@ -28,18 +28,45 @@ class Inference:
         self._data_types = dict(_data_types)
 
     def infer(self, input: Sequence[tuple], feeding=None, field="value"):
-        feeder = DataFeeder(self._data_types, feeding)
-        # only feed the data layers the pruned program still reads
+        # only feed the data layers the pruned program still reads; restrict
+        # the feeder's data_types BEFORE conversion so the default feeding
+        # map (name -> column index) covers exactly the pruned inputs —
+        # label-less inference rows then need no explicit feeding map, like
+        # the reference whose topology exposes only reachable data layers.
         needed = set()
         for op in self._program.global_block().desc.ops:
             for names in op.inputs.values():
                 needed |= set(names)
-        feed = {k: v for k, v in feeder(list(input)).items() if k in needed}
+        types = {k: v for k, v in self._data_types.items() if k in needed}
+        rows = list(input)
+        # callers may still pass FULL training rows (all declared columns,
+        # label included) — detect by row width and keep the full default
+        # map so column indices don't silently shift onto wrong layers
+        if feeding is None and rows and len(types) != len(self._data_types):
+            width = len(rows[0])
+            if width == len(self._data_types):
+                types = self._data_types
+            elif width != len(types):
+                raise ValueError(
+                    f"infer: rows have {width} columns but the pruned "
+                    f"program needs {len(types)} ({sorted(types)}) and "
+                    f"the topology declares {len(self._data_types)} "
+                    f"({sorted(self._data_types)}); pass an explicit "
+                    "feeding= map")
+        feeder = DataFeeder(types, feeding)
+        feed = {k: v for k, v in feeder(rows).items() if k in needed}
         with fluid.scope_guard(self._params.scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=[v.name for v in self._outputs],
                                  mode="infer")
         outs = [np.asarray(o) for o in outs]
+        if field in ("value", "prob"):
+            pass
+        elif field == "id":     # reference inference.py field='id': argmax
+            outs = [o.argmax(axis=-1) for o in outs]
+        else:
+            raise ValueError(f"infer: unsupported field {field!r} "
+                             "(use 'value', 'prob', or 'id')")
         return outs[0] if len(outs) == 1 else outs
 
 

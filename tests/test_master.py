@@ -254,3 +254,48 @@ def test_snapshot_crc_detects_corruption(tmp_path):
     open(p, "wb").write(bytes(raw))
     with pytest.raises(CheckpointCorrupt):
         TaskQueue.recover(p)
+
+
+def test_set_dataset_rejects_non_json_chunks():
+    import numpy as np
+    import pytest
+
+    q = TaskQueue()
+    with pytest.raises(TypeError, match="JSON values"):
+        q.set_dataset([np.arange(4)])
+
+
+def test_set_dataset_normalizes_tuples_to_lists():
+    """Chunks see the SAME types before and after recovery: tuples are
+    normalized to lists at set_dataset time, not only on restore."""
+    q = TaskQueue()
+    q.set_dataset([(1, 2), (3, 4)])
+    t = q.get_task("w")
+    assert isinstance(t.chunk, list) and t.chunk in ([1, 2], [3, 4])
+
+
+def test_consumer_thrown_exception_propagates():
+    """gen.throw from the consumer must NOT be swallowed as a chunk
+    failure — it propagates out of the reader."""
+    import pytest
+
+    q = TaskQueue(timeout_secs=10)
+    q.set_dataset([["r0", "r1"]])
+    gen = master_reader(q, lambda chunk: chunk)()
+    assert next(gen) == "r0"
+    # an Exception subclass: the old `except Exception` around the yield
+    # swallowed it and miscounted the chunk as failed
+    with pytest.raises(ValueError):
+        gen.throw(ValueError("consumer error"))
+    # the chunk was NOT marked failed by the consumer's exception
+    assert q.counts()["failed"] == 0
+
+
+def test_set_dataset_rejects_lossy_json_round_trip():
+    import pytest
+
+    q = TaskQueue()
+    with pytest.raises(TypeError, match="string keys"):
+        q.set_dataset([{0: "shard-0.rec"}])   # int dict keys stringify
+    with pytest.raises(TypeError, match="JSON values"):
+        q.set_dataset([float("nan")])
