@@ -33,14 +33,22 @@ import numpy as np
 V2_8_RESNET50_IMGS_PER_SEC = 2650.0     # published, whole v2-8 (4 chips)
 BASELINE_PER_CHIP = V2_8_RESNET50_IMGS_PER_SEC / 4.0
 
-# bf16 peak FLOP/s per chip by device kind (dense MXU)
+# bf16 peak FLOP/s per JAX DEVICE by device kind (dense MXU) — the MFU
+# denominator must match what one device actually is per generation:
+#   * v2/v3: jax exposes one device per TensorCore (2 cores/chip), so the
+#     per-DEVICE peak is the per-core 22.5T / 61.5T.  (The r2 table was
+#     right for these but mislabeled them per-chip.)
+#   * v4/v5p: megacore — one device per chip -> 275T / 459T (the r2 table
+#     wrongly halved these).
+#   * v5e/v6e: 1 core per chip -> 197T / 918T.
+# Order matters: "TPU v5 lite" must match before the "TPU v5" prefix.
 PEAK_BY_KIND = {
-    "TPU v2": 22.5e12,       # per chip (2 cores x 11.25)
-    "TPU v3": 61.5e12,
-    "TPU v4": 137.5e12,
+    "TPU v2": 22.5e12,       # per core (2 devices/chip)
+    "TPU v3": 61.5e12,       # per core (2 devices/chip)
+    "TPU v4": 275e12,        # megacore chip
     "TPU v5 lite": 197e12,   # v5e
-    "TPU v5": 229e12,        # v5p
-    "TPU v6 lite": 459e12,
+    "TPU v5": 459e12,        # v5p megacore chip
+    "TPU v6 lite": 918e12,   # v6e (Trillium)
 }
 
 
@@ -133,10 +141,14 @@ def bench_transformer(batch: int, steps: int, trials: int,
     main_prog, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        # packed-full-length recipe: no [b, h, s, s] bias tensors — causal
+        # masking happens inside the flash kernel (the dense biases alone
+        # were ~1/6 of the step's HBM traffic at bs64; BENCH_NOTES.md)
         avg_cost, _, _ = T.transformer(
             src_vocab_size=vocab, trg_vocab_size=vocab,
             max_length=seq_len + 1, dropout_rate=0.1,
-            src_seq_len=seq_len, trg_seq_len=seq_len, fused=True, **cfg)
+            src_seq_len=seq_len, trg_seq_len=seq_len, fused=True,
+            materialize_attn_bias=False, fused_vocab_loss=True, **cfg)
         fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
 
     rng = np.random.RandomState(0)
@@ -146,12 +158,6 @@ def bench_transformer(batch: int, steps: int, trials: int,
         "src_pos": np.tile(np.arange(seq_len, dtype=np.int32), (b, 1)),
         "trg_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
         "trg_pos": np.tile(np.arange(seq_len, dtype=np.int32), (b, 1)),
-        "src_slf_attn_bias": np.zeros(
-            (b, cfg["n_head"], seq_len, seq_len), np.float32),
-        "trg_slf_attn_bias": T.make_attn_bias(
-            [seq_len] * b, seq_len, cfg["n_head"], causal=True),
-        "trg_src_attn_bias": np.zeros(
-            (b, cfg["n_head"], seq_len, seq_len), np.float32),
         "lbl_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
         "lbl_weight": np.ones((b, seq_len), np.float32),
     }
@@ -171,7 +177,7 @@ def main() -> None:
     trials = max(1, int(os.environ.get("BENCH_TRIALS", "2")))
     batches = [int(b) for b in os.environ.get(
         "BENCH_BATCHES", "64,128,256").split(",")]
-    tf_batch = int(os.environ.get("BENCH_TF_BATCH", "16"))
+    tf_batch = int(os.environ.get("BENCH_TF_BATCH", "64"))
     tf_seq = int(os.environ.get("BENCH_TF_SEQ", "256"))
 
     import jax

@@ -164,6 +164,12 @@ class Executor:
         from collections import OrderedDict
 
         self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        # structural classification cache: (program fp, feed names, fetch
+        # names) -> (traced_ops, pre_host, post_host, state_in, state_out).
+        # Re-deriving this walks every op in the block (~thousands after
+        # backward) — measurable per-step Python overhead in the hot loop
+        # (the reference re-walks the block per step; we don't have to)
+        self._cls_cache: "OrderedDict[tuple, Any]" = OrderedDict()
 
     @staticmethod
     def _program_key(program: Program) -> str:
@@ -200,16 +206,16 @@ class Executor:
 
     # -- main entry ---------------------------------------------------------
     @staticmethod
-    def _classify_state(traced_ops, feed, fetch_names, block, scope):
-        """Shared feed/state/fetch dataflow classification (used by run()
-        and cost_analysis so the analyzed step IS the executed step):
-        -> (state_in, state_out, state_vals)."""
+    def _classify_structure(traced_ops, feed_names, fetch_names, block):
+        """Feed/state/fetch dataflow classification — structural, value
+        free, cacheable per (program, feed names, fetch names):
+        -> (state_in, state_out)."""
         written: set = set()
         state_in: List[str] = []
         seen_state: set = set()
         for op in traced_ops:
             for n in op.input_names():
-                if n and n not in written and n not in feed \
+                if n and n not in written and n not in feed_names \
                         and n not in seen_state:
                     seen_state.add(n)
                     state_in.append(n)
@@ -220,9 +226,15 @@ class Executor:
         state_out = [n for n in written
                      if n in persistable or n.startswith("@STATE@")]
         for n in fetch_names:
-            if n not in written and n not in feed and n not in seen_state:
+            if n not in written and n not in feed_names \
+                    and n not in seen_state:
                 seen_state.add(n)
                 state_in.append(n)
+        return state_in, state_out
+
+    @staticmethod
+    def _fetch_state(state_in, traced_ops, fetch_names, scope):
+        """Pull the classified state vars from the scope (per step)."""
         state_vals = {}
         for n in state_in:
             v = scope.find_var(n)
@@ -237,6 +249,15 @@ class Executor:
                     f"absent from the scope — did you run the startup "
                     f"program? (reference executor raises the same way)")
             state_vals[n] = v
+        return state_vals
+
+    def _classify_state(self, traced_ops, feed, fetch_names, block, scope):
+        """Classification + scope pull in one call (cost_analysis uses
+        this so the analyzed step IS the executed step)."""
+        state_in, state_out = self._classify_structure(
+            traced_ops, set(feed), fetch_names, block)
+        state_vals = self._fetch_state(state_in, traced_ops, fetch_names,
+                                       scope)
         return state_in, state_out, state_vals
 
     def cost_analysis(self, program: Optional[Program] = None,
@@ -290,25 +311,43 @@ class Executor:
         desc = program.desc
         block = desc.global_block()
 
-        # host IO ops (save/load) execute in block order relative to the
-        # compiled segment: a `load` prologue before, a `save` epilogue after
-        # (the reference executor runs them inline; an IO op sandwiched
-        # between compute ops would need segment splitting — reject it).
-        traced_ops = [op for op in block.ops if op.type not in HOST_OPS]
-        pre_host, post_host = [], []
-        seen_traced = False
-        for op in block.ops:
-            if op.type in HOST_OPS:
-                (post_host if seen_traced else pre_host).append(op)
-            else:
-                seen_traced = True
-        for op in post_host:
-            idx = block.ops.index(op)
-            if any(o.type not in HOST_OPS for o in block.ops[idx:]):
-                raise NotImplementedError(
-                    "save/load ops interleaved between compute ops are not "
-                    "supported; put IO ops at the block boundary or in their "
-                    "own program")
+        prog_fp = self._program_key(program)
+        cls_key = (prog_fp, tuple(sorted(feed)), tuple(fetch_names))
+        cls = self._cls_cache.get(cls_key)
+        if cls is not None:
+            self._cls_cache.move_to_end(cls_key)
+            traced_ops, pre_host, post_host, state_in, state_out = cls
+        else:
+            # host IO ops (save/load) execute in block order relative to
+            # the compiled segment: a `load` prologue before, a `save`
+            # epilogue after (the reference executor runs them inline; an
+            # IO op sandwiched between compute ops would need segment
+            # splitting — reject it).
+            traced_ops = [op for op in block.ops if op.type not in HOST_OPS]
+            pre_host, post_host = [], []
+            seen_traced = False
+            for op in block.ops:
+                if op.type in HOST_OPS:
+                    (post_host if seen_traced else pre_host).append(op)
+                else:
+                    seen_traced = True
+            for op in post_host:
+                idx = block.ops.index(op)
+                if any(o.type not in HOST_OPS for o in block.ops[idx:]):
+                    raise NotImplementedError(
+                        "save/load ops interleaved between compute ops are "
+                        "not supported; put IO ops at the block boundary or "
+                        "in their own program")
+            # classify vars: feeds come from the feed dict; every other var
+            # read before written (or fetched but never written) must come
+            # from the scope as state.
+            state_in, state_out = self._classify_structure(
+                traced_ops, set(feed), fetch_names, block)
+            self._cls_cache[cls_key] = (traced_ops, pre_host, post_host,
+                                        state_in, state_out)
+            while len(self._cls_cache) > self.CACHE_CAPACITY:
+                self._cls_cache.popitem(last=False)
+
         for op in pre_host:
             self._run_host_op(op, scope)
         if not traced_ops and not fetch_names:
@@ -316,11 +355,8 @@ class Executor:
                 self._run_host_op(op, scope)
             return []
 
-        # classify vars: feeds come from the feed dict; every other var that
-        # is read before written (or fetched but never written) must come from
-        # the scope as state.
-        state_in, state_out, state_vals = self._classify_state(
-            traced_ops, feed, fetch_names, block, scope)
+        state_vals = self._fetch_state(state_in, traced_ops, fetch_names,
+                                       scope)
 
         from ..parallel import mesh as _pmesh
 
@@ -412,6 +448,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._cls_cache.clear()
 
 
 def _is_cpu(place) -> bool:

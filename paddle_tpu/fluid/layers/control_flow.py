@@ -24,12 +24,13 @@ from ..framework import Block, Variable
 from ..layer_helper import LayerHelper
 
 __all__ = [
-    "While", "StaticRNN", "DynamicRNN", "Switch",
+    "While", "StaticRNN", "DynamicRNN", "Switch", "IfElse",
     "increment", "less_than", "less_equal", "greater_than", "greater_equal",
     "equal", "not_equal", "logical_and", "logical_or", "logical_xor",
     "logical_not", "array_write", "array_read", "array_length", "create_array",
     "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
     "array_to_lod_tensor", "shrink_memory", "Print",
+    "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -561,3 +562,118 @@ class Switch:
     def default(self):
         assert self._inside, "default() outside with-Switch"
         return self._case_guard(None)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference layers wrapper over reorder_lod_tensor_by_rank_op.cc:
+    permute a sequence batch into the rank table's (descending-length)
+    order."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     inputs={"X": x, "RankTable": rank_table},
+                     outputs={"Out": out})
+    return out
+
+
+class IfElse:
+    """reference control_flow.py IfElse (:1151): route rows by a boolean
+    mask through a true and a false branch, then merge.
+
+    The reference splits the batch into two *smaller* LoD tensors and runs
+    each branch under a ConditionalBlock (split_lod_tensor_op.cc /
+    conditional_block_op.cc).  Under XLA's static shapes both branches
+    compute over the full batch extent on mask-zeroed rows and
+    merge_lod_tensor selects per row — identical results for the row-wise
+    branch bodies IfElse is defined over, with no dynamic shapes and no
+    divergent control flow (the TPU-native formulation: predication over
+    both branches).
+
+    Usage (reference-compatible)::
+
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(layers.scale(d, scale=2.0))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(d)
+        merged, = ie()
+    """
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond: Variable, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.input_table = {}
+        self.output_table = ([], [])     # (false_outs, true_outs) — ref order
+
+    @contextlib.contextmanager
+    def _block_guard(self, is_true: bool):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("cannot nest IfElse blocks")
+        self.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if is_true
+                       else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+        try:
+            yield
+        except BaseException:
+            self.status = IfElse.OUT_IF_ELSE_BLOCKS
+            raise            # user errors must not be masked by the check
+        else:
+            self.status = IfElse.OUT_IF_ELSE_BLOCKS
+            if not self.output_table[1 if is_true else 0]:
+                raise ValueError("Must set output inside block")
+
+    def true_block(self):
+        return self._block_guard(True)
+
+    def false_block(self):
+        return self._block_guard(False)
+
+    def input(self, x: Variable) -> Variable:
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input() must be called inside a block")
+        if id(x) not in self.input_table:
+            out_true = self.helper.create_tmp_variable(
+                x.dtype, lod_level=x.lod_level)
+            out_false = self.helper.create_tmp_variable(
+                x.dtype, lod_level=x.lod_level)
+            self.helper.append_op(
+                "split_lod_tensor", inputs={"X": x, "Mask": self.cond},
+                outputs={"OutTrue": out_true, "OutFalse": out_false},
+                attrs={"level": 0})
+            self.input_table[id(x)] = (out_true, out_false)
+        out_true, out_false = self.input_table[id(x)]
+        return (out_true
+                if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+                else out_false)
+
+    def output(self, *outs: Variable) -> None:
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output() must be called inside a block")
+        self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0
+        ].extend(outs)
+
+    def __call__(self):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("IfElse::__call__ must be out of sub-blocks")
+        false_outs, true_outs = self.output_table
+        if len(false_outs) != len(true_outs):
+            raise ValueError(
+                "true_block and false_block must set the same number of "
+                "outputs")
+        merged = []
+        for t, f in zip(true_outs, false_outs):
+            out = self.helper.create_tmp_variable(
+                t.dtype, lod_level=t.lod_level)
+            self.helper.append_op(
+                "merge_lod_tensor",
+                inputs={"InTrue": t, "InFalse": f, "Mask": self.cond},
+                outputs={"Out": out}, attrs={"level": 0})
+            merged.append(out)
+        return merged

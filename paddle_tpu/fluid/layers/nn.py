@@ -26,6 +26,7 @@ __all__ = [
     "margin_rank_loss", "log_loss", "conv_shift", "row_conv",
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
     "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
+    "fused_vocab_cross_entropy",
 ]
 
 
@@ -81,8 +82,10 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     """Embedding lookup — reference layers/nn.py embedding:192.  is_sparse
     selects the SelectedRows gradient path (rows+values of the looked-up
     ids only — no dense [vocab, dim] scatter), exactly like the reference's
-    lookup_table_op SelectedRows grad; sgd/adagrad apply it as a row
-    scatter, other optimizers densify."""
+    lookup_table_op SelectedRows grad; sgd/adagrad apply it as an exact row
+    scatter, momentum/adam as lazy row-sparse moment updates (reference
+    ParameterServer2.h:243-344 capability), and the remaining optimizers
+    densify."""
     helper = LayerHelper("embedding", param_attr=param_attr, name=name,
                          main_program=main_program,
                          startup_program=startup_program)
@@ -803,3 +806,23 @@ def max_pool2d_with_index(input, pool_size, pool_stride=None, name=None):
                      {"Out": out, "Mask": mask},
                      {"ksize": list(k), "strides": list(s)})
     return out, mask
+
+
+def fused_vocab_cross_entropy(input, label, vocab_size, chunk=8192,
+                              param_attr=None, name=None):
+    """Streaming projection + softmax + cross-entropy against a [D, V]
+    vocab matrix — same math as ``fc(bias_attr=False)`` +
+    ``softmax_with_cross_entropy`` but the [N, V] logits never touch HBM
+    (chunked online logsumexp; see ops/loss_ops.py
+    fused_vocab_cross_entropy).  Share the projection with an inference
+    head by passing the same ``param_attr`` name to an ``fc``."""
+    helper = LayerHelper("fused_vocab_cross_entropy", param_attr=param_attr,
+                         name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr, shape=[d, vocab_size],
+                                dtype=input.dtype)
+    loss = helper.create_tmp_variable("float32")
+    helper.append_op("fused_vocab_cross_entropy",
+                     {"X": input, "W": w, "Label": label}, {"Loss": loss},
+                     {"chunk": int(chunk)})
+    return loss

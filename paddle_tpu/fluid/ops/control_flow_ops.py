@@ -413,3 +413,62 @@ register(OpInfo("conditional_block", _conditional_block_emit,
                 stop_grad_slots=("Cond",),
                 doc="reference conditional_block_op.cc — sub-block under a "
                     "scalar predicate, lowered to lax.cond"))
+
+
+# ---------------------------------------------------------------------------
+# IfElse split/merge + rank reorder (reference split_lod_tensor_op.cc,
+# merge_lod_tensor_op.cc, reorder_lod_tensor_by_rank_op.cc)
+# ---------------------------------------------------------------------------
+
+@primitive("split_lod_tensor", inputs=["X", "Mask"],
+           outputs=["OutTrue", "OutFalse"])
+def split_lod_tensor(ctx, x, mask):
+    """reference split_lod_tensor_op.cc routes each row (sequence) of X to
+    OutTrue or OutFalse by the boolean Mask — the front half of fluid's
+    IfElse.  Under XLA's static shapes the split keeps full batch extent:
+    each branch sees X with the excluded rows zeroed, and merge_lod_tensor
+    re-selects by the same mask, which is exact for the row-wise branch
+    bodies IfElse is defined over (each output row depends only on its
+    input row; excluded rows are dropped at merge).  Branch-internal
+    cross-row reductions would see zeroed rows — mask-aware reductions are
+    the TPU-native pattern there."""
+    data = x.data if isinstance(x, SeqArray) else x
+    m = jnp.reshape(mask, (-1,)).astype(bool)
+    shape = (-1,) + (1,) * (data.ndim - 1)
+    mb = m.reshape(shape)
+    t = jnp.where(mb, data, jnp.zeros_like(data))
+    f = jnp.where(mb, jnp.zeros_like(data), data)
+    if isinstance(x, SeqArray):
+        zero = jnp.zeros_like(x.lengths)
+        return (SeqArray(t, jnp.where(m, x.lengths, zero)),
+                SeqArray(f, jnp.where(m, zero, x.lengths)))
+    return t, f
+
+
+@primitive("merge_lod_tensor", inputs=["InTrue", "InFalse", "Mask", "X?"])
+def merge_lod_tensor(ctx, in_true, in_false, mask, x):
+    """reference merge_lod_tensor_op.cc: inverse of split_lod_tensor —
+    rows come from InTrue where Mask, InFalse elsewhere (X is only a LoD
+    donor in the reference; lengths ride the SeqArrays here)."""
+    td = in_true.data if isinstance(in_true, SeqArray) else in_true
+    fd = in_false.data if isinstance(in_false, SeqArray) else in_false
+    m = jnp.reshape(mask, (-1,)).astype(bool)
+    mb = m.reshape((-1,) + (1,) * (td.ndim - 1))
+    out = jnp.where(mb, td, fd)
+    if isinstance(in_true, SeqArray) and isinstance(in_false, SeqArray):
+        return SeqArray(out, jnp.where(m, in_true.lengths,
+                                       in_false.lengths))
+    return out
+
+
+@primitive("reorder_lod_tensor_by_rank", inputs=["X", "RankTable"],
+           outputs=["Out"])
+def reorder_lod_tensor_by_rank(ctx, x, rt):
+    """reference reorder_lod_tensor_by_rank_op.cc: permute the batch into
+    the rank table's order (descending length, stable).  On the padded
+    SeqArray layout this is a batch-axis gather; the grad is the inverse
+    gather via the generic vjp."""
+    order = jnp.argsort(-rt.lengths, stable=True)
+    if isinstance(x, SeqArray):
+        return SeqArray(x.data[order], x.lengths[order])
+    return x[order]

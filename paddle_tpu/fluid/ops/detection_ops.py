@@ -174,3 +174,60 @@ def multiclass_nms(ctx, bboxes, scores):
     out = jnp.where(top_scores[:, None] >= score_thresh, out,
                     jnp.full_like(out, -1.0))
     return out
+
+
+@primitive("iou_similarity", inputs=["X", "Y"], outputs=["Out"],
+           no_grad=True)
+def iou_similarity(ctx, x, y):
+    """reference iou_similarity_op.cc: pairwise IoU between every box in
+    X [N, 4] and every box in Y [M, 4] (xmin, ymin, xmax, ymax) -> [N, M]."""
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    area = lambda b: jnp.maximum(b[:, 2] - b[:, 0], 0.0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0.0)
+    ax, ay = area(x), area(y)                       # [N], [M]
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])   # [N, M, 2]
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = ax[:, None] + ay[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@primitive("positive_negative_pair",
+           inputs=["Score", "Label", "QueryID", "AccumulatePositivePair?",
+                   "AccumulateNegativePair?", "AccumulateNeutralPair?",
+                   "Weight?"],
+           outputs=["PositivePair", "NegativePair", "NeutralPair"],
+           no_grad=True)
+def positive_negative_pair(ctx, score, label, query, acc_pos, acc_neg,
+                           acc_neu, weight):
+    """reference positive_negative_pair_op.h: for every pair of items in
+    the same query whose labels differ, weight w = (w_i + w_j)/2; equal
+    scores add w to NeutralPair (and, as in the reference, fall through
+    to NegativePair since (s_i-s_j)*(l_i-l_j) == 0); correctly-ordered
+    pairs add to PositivePair, else NegativePair.  Vectorised as an
+    O(n^2) masked pair matrix instead of the reference's per-query
+    hash-map loop."""
+    column = ctx.attr("column", 0)
+    col = column if column >= 0 else score.shape[1] + column
+    s = score[:, col].astype(jnp.float32)           # [n]
+    l = label.reshape(-1).astype(jnp.float32)
+    q = query.reshape(-1)
+    w = (weight.reshape(-1).astype(jnp.float32)
+         if weight is not None else jnp.ones_like(s))
+    n = s.shape[0]
+    i, j = jnp.triu_indices(n, k=1)
+    valid = (q[i] == q[j]) & (l[i] != l[j])
+    pw = jnp.where(valid, (w[i] + w[j]) * 0.5, 0.0)
+    ds, dl = s[i] - s[j], l[i] - l[j]
+    neu = jnp.sum(jnp.where(ds == 0, pw, 0.0))
+    pos = jnp.sum(jnp.where(ds * dl > 0, pw, 0.0))
+    neg = jnp.sum(pw) - pos
+    if acc_pos is not None:
+        pos = pos + acc_pos.reshape(())
+    if acc_neg is not None:
+        neg = neg + acc_neg.reshape(())
+    if acc_neu is not None:
+        neu = neu + acc_neu.reshape(())
+    return (pos.reshape(1), neg.reshape(1), neu.reshape(1))

@@ -150,3 +150,122 @@ def precision_recall(ctx, probs, indices, labels):
     rec = tp / jnp.maximum(cm.sum(axis=1), 1.0)
     f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
     return jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+
+
+def _chunked_vocab_xent(x2, w, ids, chunk):
+    """Streaming projection+cross-entropy over vocab chunks: never
+    materialises the [N, V] logits (flash-attention-style online
+    logsumexp).  x2 [N, D] activations, w [D, V] master weights, ids [N]
+    int labels -> loss [N] f32.
+
+    The dense composition (fc -> softmax_with_cross_entropy) writes the
+    [N, V] logits, reads them for log-softmax, and writes/reads the [N, V]
+    d_logits in backward — at transformer-bench scale (N=16k, V=32k)
+    that is ~2 GB of HBM round trips per direction on a bandwidth-bound
+    chip (BENCH_NOTES.md).  Here forward keeps only [N] running max/sum
+    and backward recomputes each chunk's logits, fusing d_logits into the
+    dW / dX matmuls — HBM cost drops to O(N*D + D*V) per sweep for one
+    extra logits matmul of MXU work.
+    """
+    n, d = x2.shape
+    v = w.shape[1]
+    # ragged chunking: the (unrolled, static-shape) last chunk simply
+    # carries the remainder, so an indivisible vocab (e.g. a prime 50257)
+    # still streams in `chunk`-sized pieces instead of silently
+    # degenerating to one full-vocab dense pass
+    starts = list(range(0, v, max(1, chunk)))
+    widths = [min(chunk, v - s) for s in starts]
+    n_chunks = len(starts)
+    cast = x2.dtype
+
+    def logits_of(x2, w, i):
+        # takes the *traced* x2/w explicitly: closing over the outer args
+        # would leak tracers out of the custom_vjp scope
+        wc = jax.lax.slice_in_dim(w, starts[i], starts[i] + widths[i],
+                                  axis=1)
+        return jnp.dot(x2, wc.astype(cast),
+                       preferred_element_type=jnp.float32)
+
+    def run(x2, w, ids):
+        """One online sweep -> (loss [N], lse [N]).  The chunk loop is a
+        Python loop (static trip count): unrolled chunks let XLA overlap
+        the matmuls, and — unlike lax.fori_loop — the step's cost
+        analysis counts every chunk, keeping the bench's MFU honest."""
+        m = jnp.full((n,), -jnp.inf, jnp.float32)
+        s = jnp.zeros((n,), jnp.float32)
+        lab = jnp.zeros((n,), jnp.float32)
+        for i in range(n_chunks):
+            logits = logits_of(x2, w, i)
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            s = s * jnp.exp(m - m_new) + jnp.exp(
+                logits - m_new[:, None]).sum(axis=-1)
+            rel = ids - starts[i]
+            in_c = (rel >= 0) & (rel < widths[i])
+            ll = jnp.take_along_axis(
+                logits, jnp.clip(rel, 0, widths[i] - 1)[:, None],
+                axis=1)[:, 0]
+            lab = jnp.where(in_c, ll, lab)
+            m = m_new
+        lse = m + jnp.log(s)
+        return lse - lab, lse
+
+    @jax.custom_vjp
+    def xent(x2, w, ids):
+        return run(x2, w, ids)[0]
+
+    def fwd(x2, w, ids):
+        loss, lse = run(x2, w, ids)
+        return loss, (x2, w, ids, lse)
+
+    def bwd(res, dloss):
+        x2, w, ids, lse = res
+        # d_logits = (softmax - 1[label]) * dloss, recomputed chunkwise
+        # from the saved lse and fused straight into the dW / dX matmuls
+
+        dx = jnp.zeros(x2.shape, jnp.float32)
+        dw_chunks = []
+        for i in range(n_chunks):
+            logits = logits_of(x2, w, i)
+            p = jnp.exp(logits - lse[:, None])
+            rel = ids - starts[i]
+            in_c = (rel >= 0) & (rel < widths[i])
+            onehot = (jnp.clip(rel, 0, widths[i] - 1)[:, None]
+                      == jnp.arange(widths[i])[None, :]) & in_c[:, None]
+            dlog = (p - onehot.astype(jnp.float32)) * dloss[:, None]
+            dlog_c = dlog.astype(cast)
+            wc = jax.lax.slice_in_dim(w, starts[i], starts[i] + widths[i],
+                                      axis=1)
+            dx = dx + jnp.dot(dlog_c, wc.astype(cast).T,
+                              preferred_element_type=jnp.float32)
+            dw_chunks.append(jnp.dot(x2.T, dlog_c,
+                                     preferred_element_type=jnp.float32))
+        dw = jnp.concatenate(dw_chunks, axis=1).astype(w.dtype)
+        return dx.astype(x2.dtype), dw, None
+
+    xent.defvjp(fwd, bwd)
+    return xent(x2, w, ids)
+
+
+@primitive("fused_vocab_cross_entropy", inputs=["X", "W", "Label"],
+           outputs=["Loss"], stop_grad_slots=("Label",))
+def fused_vocab_cross_entropy(ctx, x, w, label):
+    """Streaming fc+softmax+cross-entropy over the vocab axis (chunked
+    online logsumexp; custom vjp recomputes chunk logits in backward).
+    TPU-native supersession of the reference's lookup into a materialised
+    [N, V] softmax (softmax_with_cross_entropy_op.cc at generation-model
+    vocab sizes); exact same math as fc(no bias) + softmax_with_
+    cross_entropy up to f32 accumulation order.
+
+    X [.., D] activations, W [D, V] projection (master dtype), Label
+    [.., 1] or [..] int ids -> Loss [.., 1] f32.
+    """
+    chunk = int(ctx.attr("chunk", 8192))
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    x2 = x.reshape(-1, d)
+    ids = label
+    if ids.ndim and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    ids = ids.reshape(-1).astype(jnp.int32)
+    loss = _chunked_vocab_xent(x2, w, ids, chunk)
+    return loss.reshape(*lead, 1)

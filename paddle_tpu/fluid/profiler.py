@@ -141,6 +141,19 @@ def op_cost_table(program=None, feed=None, scope=None, mode="train",
     env = {n: aval_of(v) for n, v in feed.items()}
     rows = []
     key_aval = jax.eval_shape(lambda: jax.random.key(0))
+    # op-signature cost cache: identical layers repeat the same op with the
+    # same shapes/attrs (a 6-layer transformer re-lowers each op type ~6-18
+    # times); without this the table takes minutes on big programs
+    sig_cache: dict = {}
+
+    def sig_of_op(op, flat):
+        try:
+            avals = tuple(
+                (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", "")))
+                for a in flat)
+            return (op.type, repr(sorted(op.attrs.items())), avals)
+        except Exception:
+            return None
 
     def fallback_outputs(op):
         # when an op can't be emitted in isolation, still register avals
@@ -203,10 +216,23 @@ def op_cost_table(program=None, feed=None, scope=None, mode="train",
 
             outs = jax.eval_shape(one_op, flat, key_aval)
             _scatter_outputs(op, outs, env)
-            ca = jax.jit(one_op).lower(flat, key_aval).cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            ca = dict(ca or {})
+            sig = sig_of_op(op, flat)
+            if sig is not None and sig in sig_cache:
+                ca = sig_cache[sig]
+            else:
+                lowered = jax.jit(one_op).lower(flat, key_aval)
+                ca = lowered.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else None
+                if not ca or not ca.get("flops"):
+                    # CPU PJRT only exposes cost analysis post-compile; a
+                    # silently all-zero table defeats the tool's purpose
+                    ca = lowered.compile().cost_analysis()
+                    if isinstance(ca, (list, tuple)):
+                        ca = ca[0] if ca else None
+                ca = dict(ca or {})
+                if sig is not None:
+                    sig_cache[sig] = ca
         except Exception:
             # control-flow ops (need a live block lowerer), unregistered
             # types, emit failures — count as zero, keep the table going

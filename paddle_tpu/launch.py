@@ -1,0 +1,79 @@
+"""Multi-process launcher — ``python -m paddle_tpu.launch --nprocs N
+script.py [args...]``.
+
+TPU-native analog of the reference's cluster launcher
+(/root/reference/paddle/scripts/cluster_train/paddle.py:1, fabric-over-ssh
+starting one trainer per node with role env vars).  Here every process is
+an equal SPMD worker: the launcher picks a coordinator endpoint, spawns N
+copies of the script with PADDLE_TPU_COORDINATOR / PADDLE_TPU_NPROCS /
+PADDLE_TPU_PROC_ID set, and the script's ``init_distributed()`` call joins
+them into one JAX coordination-service job (parallel/distributed.py).
+
+On a real multi-host TPU pod each host runs its own launcher-less process
+(the TPU runtime supplies the topology); this launcher is for CPU/GPU
+simulation, CI, and single-host many-process runs — the role the
+reference's paddle.py played for its clusters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(nprocs: int, argv, coordinator: str | None = None,
+           env_extra: dict | None = None) -> int:
+    """Spawn ``nprocs`` copies of ``argv``; returns the first non-zero
+    exit code (terminating the rest), else 0."""
+    coordinator = coordinator or f"127.0.0.1:{find_free_port()}"
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["PADDLE_TPU_COORDINATOR"] = coordinator
+        env["PADDLE_TPU_NPROCS"] = str(nprocs)
+        env["PADDLE_TPU_PROC_ID"] = str(rank)
+        procs.append(subprocess.Popen([sys.executable] + list(argv),
+                                      env=env))
+    rc = 0
+    try:
+        for p in procs:
+            code = p.wait()
+            if code != 0 and rc == 0:
+                rc = code
+                for q in procs:
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        raise
+    return rc
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.launch",
+        description="spawn N SPMD worker processes of a training script")
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port (default: a free local port)")
+    ap.add_argument("script", help="python script to run")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args()
+    sys.exit(launch(ns.nprocs, [ns.script] + ns.args, ns.coordinator))
+
+
+if __name__ == "__main__":
+    main()

@@ -33,9 +33,16 @@ def _row_attr(mp_shard):
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head=1, dropout_rate=0.0,
-                         mp_shard=False, fused=False, seq_parallel=False):
+                         mp_shard=False, fused=False, seq_parallel=False,
+                         causal=False):
     """Reference-shape MHA: project, split heads, scaled dot-product with
-    additive bias, merge heads, output projection."""
+    additive bias, merge heads, output projection.
+
+    ``causal=True`` masks future positions *inside* the flash kernel
+    instead of via a materialised [b, h, lq, lk] additive bias — on a
+    bandwidth-bound chip the dense bias tensors are pure HBM traffic
+    (3 biases x 6 layers x fwd+bwd reads; see BENCH_NOTES.md), so the
+    bench/perf path never materialises them."""
     q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
                   num_flatten_dims=2, param_attr=_col_attr(mp_shard))
     k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
@@ -57,9 +64,15 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         # flash/ring kernel path: O(L) memory, no [lq, lk] score tensor;
         # attention-prob dropout happens inside the kernel (hash mask)
         ctx = layers.fused_attention(q, k, v, bias=attn_bias,
+                                     causal=causal,
                                      sm_scale=float(d_key) ** -0.5,
                                      dropout_rate=dropout_rate,
                                      seq_parallel=seq_parallel)
+    elif causal:
+        raise NotImplementedError(
+            "in-graph causal masking without a bias tensor requires the "
+            "fused attention path (fused=True); pass a causal attn_bias "
+            "from make_attn_bias otherwise")
     else:
         q = layers.scale(q, scale=float(d_key) ** -0.5)
         product = layers.matmul(q, k, transpose_y=True)   # [b, h, lq, lk]
@@ -123,11 +136,11 @@ def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
 def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                   n_head, d_key, d_value, d_model, d_inner_hid,
                   dropout_rate=0.0, mp_shard=False, fused=False,
-                  seq_parallel=False):
+                  seq_parallel=False, causal=False):
     slf_attn = multi_head_attention(dec_input, dec_input, dec_input,
                                     slf_attn_bias, d_key, d_value, d_model,
                                     n_head, dropout_rate, mp_shard, fused,
-                                    seq_parallel)
+                                    seq_parallel, causal=causal)
     slf_attn = pre_post_process_layer(dec_input, slf_attn, "dan",
                                       dropout_rate)
     cross = multi_head_attention(slf_attn, enc_output, enc_output,
@@ -142,12 +155,13 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
 def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
             dropout_rate=0.0, mp_shard=False, fused=False,
-            seq_parallel=False):
+            seq_parallel=False, causal=False):
     for _ in range(n_layer):
         dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
                                   dec_enc_attn_bias, n_head, d_key, d_value,
                                   d_model, d_inner_hid, dropout_rate,
-                                  mp_shard, fused, seq_parallel)
+                                  mp_shard, fused, seq_parallel,
+                                  causal=causal)
     return dec_input
 
 
@@ -179,24 +193,42 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                 n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner_hid=2048, dropout_rate=0.1, src_seq_len=32,
                 trg_seq_len=32, mp_shard=False, fused=False,
-                seq_parallel=False):
+                seq_parallel=False, materialize_attn_bias=True,
+                fused_vocab_loss=False):
     """Build the full training graph; returns (avg_cost, predict, feed_vars).
 
     Data vars (dense, static seq lens — bucket on the host side):
       src_word/src_pos [b, slen], trg_word/trg_pos [b, tlen] int64,
       *_attn_bias float32 additive masks, lbl_word [b, tlen] int64,
       lbl_weight [b, tlen] float32 (0 at padding).
+
+    ``materialize_attn_bias=False`` (requires ``fused=True``) drops the
+    three [b, h, lq, lk] bias inputs entirely: decoder self-attention is
+    masked causally inside the flash kernel and src/cross attention run
+    unmasked — the packed-full-length training recipe (sequences packed
+    to seq_len on the host; loss padding still honoured via lbl_weight).
+    On a bandwidth-bound chip the dense biases alone are ~1/6 of the
+    step's HBM traffic (see BENCH_NOTES.md).
     """
     src_word = layers.data("src_word", [src_seq_len], "int64")
     src_pos = layers.data("src_pos", [src_seq_len], "int64")
     trg_word = layers.data("trg_word", [trg_seq_len], "int64")
     trg_pos = layers.data("trg_pos", [trg_seq_len], "int64")
-    src_slf_attn_bias = layers.data(
-        "src_slf_attn_bias", [n_head, src_seq_len, src_seq_len], "float32")
-    trg_slf_attn_bias = layers.data(
-        "trg_slf_attn_bias", [n_head, trg_seq_len, trg_seq_len], "float32")
-    trg_src_attn_bias = layers.data(
-        "trg_src_attn_bias", [n_head, trg_seq_len, src_seq_len], "float32")
+    if materialize_attn_bias:
+        src_slf_attn_bias = layers.data(
+            "src_slf_attn_bias", [n_head, src_seq_len, src_seq_len],
+            "float32")
+        trg_slf_attn_bias = layers.data(
+            "trg_slf_attn_bias", [n_head, trg_seq_len, trg_seq_len],
+            "float32")
+        trg_src_attn_bias = layers.data(
+            "trg_src_attn_bias", [n_head, trg_seq_len, src_seq_len],
+            "float32")
+    else:
+        if not fused:
+            raise ValueError("materialize_attn_bias=False requires "
+                             "fused=True (in-kernel causal masking)")
+        src_slf_attn_bias = trg_slf_attn_bias = trg_src_attn_bias = None
     lbl_word = layers.data("lbl_word", [trg_seq_len], "int64")
     lbl_weight = layers.data("lbl_weight", [trg_seq_len], "float32")
 
@@ -209,20 +241,37 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
     dec_output = decoder(dec_emb, enc_output, trg_slf_attn_bias,
                          trg_src_attn_bias, n_layer, n_head, d_key, d_value,
                          d_model, d_inner_hid, dropout_rate, mp_shard,
-                         fused, seq_parallel)
+                         fused, seq_parallel,
+                         causal=not materialize_attn_bias)
+    from ..fluid import unique_name
+
+    proj_attr = ParamAttr(name=unique_name.generate("vocab_proj_w"),
+                          sharding=(None, "mp") if mp_shard else None)
     predict = layers.fc(input=dec_output, size=trg_vocab_size,
                         num_flatten_dims=2, bias_attr=False,
-                        param_attr=_col_attr(mp_shard))
+                        param_attr=proj_attr)
 
-    cost = layers.softmax_with_cross_entropy(
-        logits=predict, label=layers.reshape(lbl_word, [0, trg_seq_len, 1]))
+    if fused_vocab_loss:
+        # streaming vocab projection+xent: the [b, t, V] logits of
+        # `predict` never materialise on the training path (XLA dead-code
+        # eliminates the unfetched predict fc); weights are shared with
+        # the inference head via proj_attr
+        cost = layers.fused_vocab_cross_entropy(
+            dec_output, layers.reshape(lbl_word, [0, trg_seq_len, 1]),
+            vocab_size=trg_vocab_size, param_attr=proj_attr)
+    else:
+        cost = layers.softmax_with_cross_entropy(
+            logits=predict,
+            label=layers.reshape(lbl_word, [0, trg_seq_len, 1]))
     weighted = layers.elementwise_mul(
         layers.reshape(cost, [0, trg_seq_len]), lbl_weight)
     sum_cost = layers.reduce_sum(weighted)
     token_count = layers.reduce_sum(lbl_weight)
     avg_cost = layers.elementwise_div(sum_cost, token_count)
-    feeds = [src_word, src_pos, trg_word, trg_pos, src_slf_attn_bias,
-             trg_slf_attn_bias, trg_src_attn_bias, lbl_word, lbl_weight]
+    feeds = [src_word, src_pos, trg_word, trg_pos]
+    if materialize_attn_bias:
+        feeds += [src_slf_attn_bias, trg_slf_attn_bias, trg_src_attn_bias]
+    feeds += [lbl_word, lbl_weight]
     return avg_cost, predict, feeds
 
 

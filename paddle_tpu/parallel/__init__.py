@@ -25,7 +25,9 @@ from .mesh import (Mesh, current_mesh, make_mesh, mesh_guard, set_mesh,
 from .distributed import init_distributed
 from .transpiler import DistributeTranspiler
 from .master import Task, TaskQueue, master_reader
+from .master_service import MasterClient, MasterServer
 
 __all__ = ["Mesh", "make_mesh", "mesh_guard", "set_mesh", "current_mesh",
            "feed_sharding", "state_sharding", "init_distributed",
-           "DistributeTranspiler", "Task", "TaskQueue", "master_reader"]
+           "DistributeTranspiler", "Task", "TaskQueue", "master_reader",
+           "MasterClient", "MasterServer"]

@@ -34,6 +34,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if process_id is None:
         process_id = int(os.environ.get("PADDLE_TPU_PROC_ID", "0"))
     if num_processes > 1:
+        try:
+            from jax._src import xla_bridge
+
+            initialized = xla_bridge.backends_are_initialized()
+        except (ImportError, AttributeError):
+            initialized = False   # private API moved: skip the guard
+        if initialized:
+            # initialize() after backend init silently yields a
+            # process_count()==1 job — fail loudly instead (anything that
+            # touched jax.devices()/arrays before this call trips it)
+            raise RuntimeError(
+                "init_distributed() must run before any JAX backend use "
+                "(jax.devices(), array creation, ...): the backends are "
+                "already initialized, so multi-process initialization "
+                "would be silently ignored")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)

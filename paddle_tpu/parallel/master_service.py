@@ -1,0 +1,174 @@
+"""Cross-process master: an HTTP/JSON surface over TaskQueue.
+
+The reference's master is a *service* other processes call over RPC
+(go/master/service.go:89 — GetTask :368 / TaskFinished :411 /
+TaskFailed :455, with etcd discovery).  TaskQueue (master.py) implements
+the accounting; this module makes it reachable from other worker
+processes, so a dying worker's leases really do time out and re-dispatch
+to survivors on other machines — the elasticity the Go master existed
+for.  stdlib http.server + JSON replaces Go RPC + etcd: the control
+plane is low-rate (one lease per chunk), so a thin HTTP surface is the
+TPU-native choice over a bespoke protocol.
+
+Server:  ``MasterServer(queue).start()`` -> address, in the trainer-0 (or
+         any) process.
+Client:  ``MasterClient(address)`` duck-types TaskQueue's worker protocol
+         (get_task/task_finished/task_failed/all_done/counts), so
+         ``master_reader(MasterClient(addr), read_chunk)`` works
+         unchanged in every worker process.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .master import Task, TaskQueue
+
+__all__ = ["MasterServer", "MasterClient"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    queue: TaskQueue = None  # set by MasterServer
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _reply(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(n) or b"{}")
+        except ValueError:
+            return self._reply({"error": "bad json"}, 400)
+        q = self.queue
+        route = self.path.rstrip("/")
+        try:
+            if route == "/get_task":
+                t = q.get_task(req.get("worker", ""))
+                if t is None:
+                    return self._reply({"task": None,
+                                        "all_done": q.all_done()})
+                return self._reply({"task": {"task_id": t.task_id,
+                                             "chunk": t.chunk,
+                                             "epoch": t.epoch}})
+            if route == "/task_finished":
+                return self._reply({"ok": q.task_finished(
+                    int(req["task_id"]))})
+            if route == "/task_failed":
+                return self._reply({"ok": q.task_failed(
+                    int(req["task_id"]))})
+            if route == "/all_done":
+                return self._reply({"all_done": q.all_done()})
+            if route == "/counts":
+                return self._reply(dict(q.counts()))
+            if route == "/set_dataset":
+                q.set_dataset(req["chunks"])
+                return self._reply({"ok": True})
+            if route == "/new_epoch":
+                q.new_epoch()
+                return self._reply({"ok": True})
+            return self._reply({"error": f"unknown route {route}"}, 404)
+        except Exception as e:  # surface queue errors to the caller
+            return self._reply({"error": str(e)}, 500)
+
+
+class MasterServer:
+    """Serve a TaskQueue over HTTP on a background thread."""
+
+    def __init__(self, queue: TaskQueue, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.queue = queue
+        handler = type("BoundHandler", (_Handler,), {"queue": queue})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def start(self) -> str:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.address
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class _RemoteTask:
+    """Client-side task handle with the Task fields master_reader uses."""
+
+    __slots__ = ("task_id", "chunk", "epoch")
+
+    def __init__(self, d):
+        self.task_id = d["task_id"]
+        self.chunk = d["chunk"]
+        self.epoch = d.get("epoch", 0)
+
+
+class MasterClient:
+    """TaskQueue worker-protocol proxy — use from any process."""
+
+    def __init__(self, address: str, worker: str = "",
+                 timeout: float = 30.0):
+        self.address = address
+        self.worker = worker
+        self.timeout = timeout
+
+    def _call(self, route: str, payload=None):
+        req = urllib.request.Request(
+            f"http://{self.address}{route}",
+            data=json.dumps(payload or {}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:  # server-side queue error
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                detail = str(e)
+            raise RuntimeError(f"master: {detail}") from None
+        if isinstance(out, dict) and out.get("error"):
+            raise RuntimeError(f"master: {out['error']}")
+        return out
+
+    # -- TaskQueue worker protocol ------------------------------------------
+    def get_task(self, worker: str = "") -> Optional[Task]:
+        out = self._call("/get_task", {"worker": worker or self.worker})
+        return _RemoteTask(out["task"]) if out.get("task") else None
+
+    def task_finished(self, task_id: int) -> bool:
+        return self._call("/task_finished", {"task_id": task_id})["ok"]
+
+    def task_failed(self, task_id: int) -> bool:
+        return self._call("/task_failed", {"task_id": task_id})["ok"]
+
+    def all_done(self) -> bool:
+        return self._call("/all_done")["all_done"]
+
+    def counts(self):
+        return self._call("/counts")
+
+    def set_dataset(self, chunks) -> None:
+        self._call("/set_dataset", {"chunks": list(chunks)})
+
+    def new_epoch(self) -> None:
+        self._call("/new_epoch")

@@ -183,3 +183,117 @@ def test_fused_dropout_trains():
             losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_causal_in_kernel_matches_dense_bias():
+    """materialize_attn_bias=False (in-kernel causal, no [b,h,s,s] bias
+    feeds — the bench's packed-full-length mode) must match the dense
+    causal-bias program on full-length batches."""
+    from paddle_tpu.fluid import framework
+
+    batch, s = 4, CFG["seq"]
+    rng = np.random.RandomState(0)
+    words = {
+        "src_word": rng.randint(0, CFG["vocab"], (batch, s)).astype(np.int32),
+        "src_pos": np.tile(np.arange(s, dtype=np.int32), (batch, 1)),
+        "trg_word": rng.randint(0, CFG["vocab"], (batch, s)).astype(np.int32),
+        "trg_pos": np.tile(np.arange(s, dtype=np.int32), (batch, 1)),
+        "lbl_word": rng.randint(0, CFG["vocab"], (batch, s)).astype(np.int32),
+        "lbl_weight": np.ones((batch, s), np.float32),
+    }
+    full = np.full((batch,), s)
+    dense_feed = dict(words,
+                      src_slf_attn_bias=T.make_attn_bias(full, s,
+                                                         CFG["heads"]),
+                      trg_slf_attn_bias=T.make_attn_bias(full, s,
+                                                         CFG["heads"],
+                                                         causal=True),
+                      trg_src_attn_bias=T.make_attn_bias(full, s,
+                                                         CFG["heads"]))
+
+    def run(materialize, feed):
+        framework._rng_salt_counter[0] = 0
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            avg_cost, _, _ = T.transformer(
+                src_vocab_size=CFG["vocab"], trg_vocab_size=CFG["vocab"],
+                max_length=CFG["seq"] * 2, n_layer=CFG["layers"],
+                n_head=CFG["heads"], d_key=CFG["d_model"] // CFG["heads"],
+                d_value=CFG["d_model"] // CFG["heads"],
+                d_model=CFG["d_model"], d_inner_hid=CFG["d_model"] * 2,
+                dropout_rate=0.0, src_seq_len=s, trg_seq_len=s,
+                fused=True, materialize_attn_bias=materialize)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):
+                l, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+                losses.append(float(l))
+        return losses
+
+    ref = run(True, dense_feed)
+    got = run(False, words)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    assert got[-1] < got[0]
+
+
+def test_no_bias_requires_fused():
+    with pytest.raises(ValueError):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            T.transformer(src_vocab_size=8, trg_vocab_size=8, max_length=8,
+                          n_layer=1, n_head=1, d_key=4, d_value=4,
+                          d_model=4, d_inner_hid=8, dropout_rate=0.0,
+                          src_seq_len=4, trg_seq_len=4, fused=False,
+                          materialize_attn_bias=False)
+
+
+def test_fused_vocab_loss_matches_dense():
+    """fused_vocab_loss=True (streaming vocab xent, bench path) must match
+    the fc+softmax_with_cross_entropy composition."""
+    from paddle_tpu.fluid import framework
+
+    batch, s = 4, CFG["seq"]
+    rng = np.random.RandomState(0)
+    words = {
+        "src_word": rng.randint(0, CFG["vocab"], (batch, s)).astype(np.int32),
+        "src_pos": np.tile(np.arange(s, dtype=np.int32), (batch, 1)),
+        "trg_word": rng.randint(0, CFG["vocab"], (batch, s)).astype(np.int32),
+        "trg_pos": np.tile(np.arange(s, dtype=np.int32), (batch, 1)),
+        "lbl_word": rng.randint(0, CFG["vocab"], (batch, s)).astype(np.int32),
+        "lbl_weight": np.ones((batch, s), np.float32),
+    }
+
+    def run(fused_vocab):
+        framework._rng_salt_counter[0] = 0
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            avg_cost, _, _ = T.transformer(
+                src_vocab_size=CFG["vocab"], trg_vocab_size=CFG["vocab"],
+                max_length=CFG["seq"] * 2, n_layer=CFG["layers"],
+                n_head=CFG["heads"], d_key=CFG["d_model"] // CFG["heads"],
+                d_value=CFG["d_model"] // CFG["heads"],
+                d_model=CFG["d_model"], d_inner_hid=CFG["d_model"] * 2,
+                dropout_rate=0.0, src_seq_len=s, trg_seq_len=s,
+                fused=True, materialize_attn_bias=False,
+                fused_vocab_loss=fused_vocab)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):
+                l, = exe.run(main, feed=words, fetch_list=[avg_cost])
+                losses.append(float(l))
+        return losses
+
+    ref = run(False)
+    got = run(True)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    assert got[-1] < got[0]

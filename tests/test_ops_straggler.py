@@ -1,0 +1,290 @@
+"""OpTests for the r3 straggler batch (VERDICT r2 missing#5): minus,
+l1_norm, is_empty, assign_value, bilinear_tensor_product,
+proximal_gd/proximal_adagrad, iou_similarity, positive_negative_pair,
+split_lod_tensor/merge_lod_tensor (+ the fluid IfElse layer on top),
+reorder_lod_tensor_by_rank.
+
+Numpy goldens + finite-difference grad checks for the differentiable
+ones — the reference's OpTest contract (tests/op_test.py:212 pattern).
+"""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import SeqArray, make_seq
+from tests.op_test import OpTestCase
+
+
+def _r(*shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+class TestSimpleMath:
+    def test_minus(self):
+        x, y = _r(3, 4), _r(3, 4, seed=1)
+        t = OpTestCase("minus", {"X": x, "Y": y}, {})
+        t.check_output({"Out": x - y})
+        t.check_grad(["X", "Y"])
+
+    def test_l1_norm(self):
+        x = (_r(4, 5) - 0.5).astype(np.float32)
+        t = OpTestCase("l1_norm", {"X": x}, {})
+        t.check_output({"Out": np.abs(x).sum()})
+        t.check_grad(["X"])
+
+    def test_is_empty(self):
+        t = OpTestCase("is_empty", {"X": _r(2, 3)}, {})
+        t.check_output({"Out": np.asarray(False)})
+        t2 = OpTestCase("is_empty", {"X": np.zeros((0, 3), np.float32)}, {})
+        t2.check_output({"Out": np.asarray(True)})
+
+    def test_assign_value(self):
+        t = OpTestCase("assign_value", {},
+                       {"shape": [2, 2], "fp32_values": [1.0, 2.0, 3.0, 4.0]})
+        t.check_output({"Out": np.asarray([[1., 2.], [3., 4.]], np.float32)})
+
+    def test_bilinear_tensor_product(self):
+        b, dx, dy, size = 3, 4, 5, 6
+        x, y = _r(b, dx), _r(b, dy, seed=1)
+        w = _r(size, dx, dy, seed=2)
+        bias = _r(1, size, seed=3)
+        want = np.einsum("bi,kij,bj->bk", x, w, y) + bias
+        t = OpTestCase("bilinear_tensor_product",
+                       {"X": x, "Y": y, "Weight": w, "Bias": bias}, {})
+        t.check_output({"Out": want}, atol=1e-5)
+        t.check_grad(["X", "Y", "Weight"])
+
+
+class TestProximal:
+    def test_proximal_gd(self):
+        p, g = _r(8), (_r(8, seed=1) - 0.5).astype(np.float32)
+        lr = np.asarray([0.1], np.float32)
+        l1, l2 = 0.05, 0.01
+        prox = p - 0.1 * g
+        want = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0)
+                / (1 + 0.1 * l2))
+        t = OpTestCase("proximal_gd",
+                       {"Param": p, "Grad": g, "LearningRate": lr},
+                       {"l1": l1, "l2": l2})
+        t.check_output({"ParamOut": want}, atol=1e-6)
+
+    def test_proximal_gd_no_l1(self):
+        p, g = _r(8), _r(8, seed=1)
+        lr = np.asarray([0.1], np.float32)
+        t = OpTestCase("proximal_gd",
+                       {"Param": p, "Grad": g, "LearningRate": lr},
+                       {"l1": 0.0, "l2": 0.2})
+        t.check_output({"ParamOut": (p - 0.1 * g) / 1.02}, atol=1e-6)
+
+    def test_proximal_adagrad(self):
+        p, m = _r(6), _r(6, seed=1)
+        g = (_r(6, seed=2) - 0.5).astype(np.float32)
+        lr = np.asarray([0.1], np.float32)
+        l1, l2 = 0.03, 0.02
+        mo = m + g * g
+        prox = p - 0.1 * g / np.sqrt(mo)
+        want = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0)
+                / (1 + 0.1 * l2))
+        t = OpTestCase("proximal_adagrad",
+                       {"Param": p, "Moment": m, "Grad": g,
+                        "LearningRate": lr}, {"l1": l1, "l2": l2})
+        t.check_output({"ParamOut": want, "MomentOut": mo}, atol=1e-6)
+
+
+class TestDetectionMetrics:
+    def test_iou_similarity(self):
+        x = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        y = np.asarray([[0, 0, 2, 2], [2, 2, 4, 4], [10, 10, 11, 11]],
+                       np.float32)
+        # IoU(x0,y0)=1; IoU(x0,y1)=0; IoU(x1,y0)=1/7; IoU(x1,y1)=1/7
+        want = np.asarray([[1.0, 0.0, 0.0],
+                           [1 / 7, 1 / 7, 0.0]], np.float32)
+        t = OpTestCase("iou_similarity", {"X": x, "Y": y}, {})
+        t.check_output({"Out": want}, atol=1e-6)
+
+    def test_positive_negative_pair(self):
+        # query 0: scores [3, 1], labels [1, 0] -> ordered right: 1 pos
+        # query 1: scores [1, 2, 2], labels [1, 0, 2]:
+        #   pairs (0,1): (1-2)*(1-0)<0 -> neg
+        #   pairs (0,2): (1-2)*(1-2)>0 -> pos
+        #   pairs (1,2): equal scores  -> neutral AND neg (reference quirk)
+        score = np.asarray([[3.], [1.], [1.], [2.], [2.]], np.float32)
+        label = np.asarray([[1.], [0.], [1.], [0.], [2.]], np.float32)
+        query = np.asarray([[0], [0], [1], [1], [1]], np.int64)
+        t = OpTestCase("positive_negative_pair",
+                       {"Score": score, "Label": label, "QueryID": query}, {})
+        t.check_output({"PositivePair": np.asarray([2.], np.float32),
+                        "NegativePair": np.asarray([2.], np.float32),
+                        "NeutralPair": np.asarray([1.], np.float32)})
+
+    def test_positive_negative_pair_accumulate(self):
+        score = np.asarray([[3.], [1.]], np.float32)
+        label = np.asarray([[1.], [0.]], np.float32)
+        query = np.asarray([[0], [0]], np.int64)
+        t = OpTestCase(
+            "positive_negative_pair",
+            {"Score": score, "Label": label, "QueryID": query,
+             "AccumulatePositivePair": np.asarray([10.], np.float32),
+             "AccumulateNegativePair": np.asarray([20.], np.float32),
+             "AccumulateNeutralPair": np.asarray([30.], np.float32)}, {})
+        t.check_output({"PositivePair": np.asarray([11.], np.float32),
+                        "NegativePair": np.asarray([20.], np.float32),
+                        "NeutralPair": np.asarray([30.], np.float32)})
+
+
+class TestLodSplitMerge:
+    def test_split_then_merge_roundtrip(self):
+        x = _r(4, 3)
+        mask = np.asarray([[1], [0], [1], [0]], np.bool_)
+        t = OpTestCase("split_lod_tensor", {"X": x, "Mask": mask}, {})
+        outs = t.run_all()
+        true_half, false_half = outs["OutTrue"][0], outs["OutFalse"][0]
+        np.testing.assert_allclose(np.asarray(true_half)[[0, 2]], x[[0, 2]])
+        np.testing.assert_allclose(np.asarray(true_half)[[1, 3]], 0)
+        np.testing.assert_allclose(np.asarray(false_half)[[1, 3]], x[[1, 3]])
+        m = OpTestCase("merge_lod_tensor",
+                       {"InTrue": np.asarray(true_half),
+                        "InFalse": np.asarray(false_half), "Mask": mask}, {})
+        m.check_output({"Out": x})
+
+    def test_merge_grad_flows_by_mask(self):
+        tr, fa = _r(4, 2), _r(4, 2, seed=1)
+        mask = np.asarray([[1], [1], [0], [0]], np.bool_)
+        t = OpTestCase("merge_lod_tensor",
+                       {"InTrue": tr, "InFalse": fa, "Mask": mask}, {})
+        t.check_grad(["InTrue", "InFalse"])
+
+    def test_reorder_by_rank(self):
+        """lod_rank_table -> reorder_lod_tensor_by_rank through a real
+        program (rank table values are op-internal RankTable objects)."""
+        seq = make_seq([[1, 2], [3, 4, 5], [6]], dtype=np.float32, bucket=3)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [1], "float32", lod_level=1)
+            table = fluid.layers.lod_rank_table(x)
+            out = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            res, = exe.run(main, feed={"x": seq}, fetch_list=[out],
+                           return_numpy=False)
+        # rank order: lengths [2,3,1] -> descending stable = [1,0,2]
+        assert isinstance(res, SeqArray)
+        np.testing.assert_array_equal(np.asarray(res.lengths), [3, 2, 1])
+        np.testing.assert_allclose(np.asarray(res.data)[0],
+                                   np.asarray(seq.data)[1])
+
+
+class TestIfElseLayer:
+    def test_ifelse_rowwise(self):
+        """mnist-style IfElse: scale rows where cond, pass through rows
+        where not (reference tests/book usage is row-wise like this)."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [3], "float32")
+            limit = fluid.layers.fill_constant([1], "float32", 0.5)
+            cond = fluid.layers.less_than(x=fluid.layers.reduce_mean(
+                x, dim=1, keep_dim=True), y=limit)
+            ie = fluid.layers.IfElse(cond)
+            with ie.true_block():
+                d = ie.input(x)
+                ie.output(fluid.layers.scale(d, scale=2.0))
+            with ie.false_block():
+                d = ie.input(x)
+                ie.output(d)
+            merged, = ie()
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        xv = np.asarray([[0.1, 0.2, 0.3], [0.9, 0.9, 0.9]], np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out, = exe.run(main, feed={"x": xv}, fetch_list=[merged])
+        np.testing.assert_allclose(out[0], xv[0] * 2.0, rtol=1e-6)
+        np.testing.assert_allclose(out[1], xv[1], rtol=1e-6)
+
+    def test_ifelse_propagates_user_errors(self):
+        """An exception inside a branch body must surface as itself, not
+        as the 'Must set output inside block' usage error."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [3], "float32")
+            limit = fluid.layers.fill_constant([1], "float32", 0.5)
+            cond = fluid.layers.less_than(x=fluid.layers.reduce_mean(
+                x, dim=1, keep_dim=True), y=limit)
+            ie = fluid.layers.IfElse(cond)
+            try:
+                with ie.true_block():
+                    ie.input(x)
+                    raise ZeroDivisionError("user bug")
+            except ZeroDivisionError:
+                pass
+            assert ie.status == ie.OUT_IF_ELSE_BLOCKS
+
+    def test_ifelse_requires_output(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [3], "float32")
+            limit = fluid.layers.fill_constant([1], "float32", 0.5)
+            cond = fluid.layers.less_than(x=fluid.layers.reduce_mean(
+                x, dim=1, keep_dim=True), y=limit)
+            ie = fluid.layers.IfElse(cond)
+            try:
+                with ie.true_block():
+                    ie.input(x)
+                raise AssertionError("expected ValueError")
+            except ValueError:
+                pass
+
+
+class TestFusedVocabXent:
+    """Chunked streaming fc+softmax+xent (perf op for the transformer
+    bench) must match the dense composition exactly."""
+
+    def test_matches_dense_composition(self):
+        n, d, v = 6, 8, 12
+        x = (_r(n, d) - 0.5).astype(np.float32)
+        w = (_r(d, v, seed=1) - 0.5).astype(np.float32)
+        ids = np.random.RandomState(2).randint(0, v, (n, 1)).astype(np.int64)
+        logits = x @ w
+        m = logits.max(-1, keepdims=True)
+        lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+        want = (lse[:, 0] - np.take_along_axis(logits, ids, 1)[:, 0])
+        t = OpTestCase("fused_vocab_cross_entropy",
+                       {"X": x, "W": w, "Label": ids}, {"chunk": 4})
+        t.check_output({"Loss": want[:, None]}, atol=1e-5)
+
+    def test_grad_matches_numeric(self):
+        n, d, v = 4, 5, 9
+        x = (_r(n, d) - 0.5).astype(np.float32)
+        w = (_r(d, v, seed=1) - 0.5).astype(np.float32)
+        ids = np.random.RandomState(2).randint(0, v, (n, 1)).astype(np.int64)
+        t = OpTestCase("fused_vocab_cross_entropy",
+                       {"X": x, "W": w, "Label": ids}, {"chunk": 3})
+        t.check_grad(["X", "W"])
+
+    def test_3d_input_and_uneven_chunk(self):
+        b, s, d, v = 2, 3, 4, 10
+        x = (_r(b, s, d) - 0.5).astype(np.float32)
+        w = (_r(d, v, seed=1) - 0.5).astype(np.float32)
+        ids = np.random.RandomState(2).randint(0, v, (b, s, 1)).astype(
+            np.int64)
+        logits = np.einsum("bsd,dv->bsv", x, w)
+        m = logits.max(-1, keepdims=True)
+        lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+        want = lse - np.take_along_axis(logits, ids, -1)
+        # chunk=4 does not divide 10 -> ragged chunks [4, 4, 2]; result
+        # must be identical regardless
+        t = OpTestCase("fused_vocab_cross_entropy",
+                       {"X": x, "W": w, "Label": ids}, {"chunk": 4})
+        t.check_output({"Loss": want}, atol=1e-5)
+
+    def test_ragged_chunk_grad(self):
+        n, d, v = 3, 4, 7          # prime vocab: max raggedness
+        x = (_r(n, d) - 0.5).astype(np.float32)
+        w = (_r(d, v, seed=1) - 0.5).astype(np.float32)
+        ids = np.random.RandomState(2).randint(0, v, (n, 1)).astype(np.int64)
+        t = OpTestCase("fused_vocab_cross_entropy",
+                       {"X": x, "W": w, "Label": ids}, {"chunk": 3})
+        t.check_grad(["X", "W"])

@@ -1,0 +1,189 @@
+"""Perf attribution probe for the ResNet-50 bench (VERDICT r2 next#1).
+
+Answers, with wall-clock on the real chip: where does the MFU gap come
+from?  Three configs, identical math:
+
+  A. framework : bench.py's program through fluid.Executor
+  B. raw-nchw  : hand-written jax train step, same NCHW layout
+  C. raw-nhwc  : same step, NHWC activations + HWIO filters
+
+B-A = executor/framework overhead.  C-B = conv layout cost.  The
+remaining gap to peak is the model/XLA ceiling on this chip.
+
+Run on TPU:  python tools/perf_probe.py [batch] [steps]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+
+def _block_cfgs(depth=50):
+    return {50: [3, 4, 6, 3]}[depth]
+
+
+def init_resnet50(rng, nhwc: bool, num_classes=1000):
+    """Param pytree for ResNet-50 bottleneck. Conv filters OIHW (nchw)
+    or HWIO (nhwc); BN scale/bias/mean/var f32."""
+    import jax
+
+    params = {}
+    keys = iter(jax.random.split(rng, 200))
+
+    def conv(name, cin, cout, k):
+        shape = (k, k, cin, cout) if nhwc else (cout, cin, k, k)
+        fan = cin * k * k
+        params[name + "/w"] = (jax.random.normal(next(keys), shape,
+                                                 np.float32)
+                               * np.sqrt(2.0 / fan))
+        params[name + "/bn_s"] = np.ones((cout,), np.float32)
+        params[name + "/bn_b"] = np.zeros((cout,), np.float32)
+
+    conv("stem", 3, 64, 7)
+    cin = 64
+    for stage, (n_blocks, cout) in enumerate(
+            zip(_block_cfgs(), [64, 128, 256, 512])):
+        for b in range(n_blocks):
+            p = f"s{stage}b{b}"
+            conv(p + "/c1", cin, cout, 1)
+            conv(p + "/c2", cout, cout, 3)
+            conv(p + "/c3", cout, cout * 4, 1)
+            if cin != cout * 4:
+                conv(p + "/sc", cin, cout * 4, 1)
+            cin = cout * 4
+    params["fc/w"] = (jax.random.normal(next(keys), (2048, num_classes),
+                                        np.float32) * 0.01)
+    params["fc/b"] = np.zeros((num_classes,), np.float32)
+    return params
+
+
+def resnet50_apply(params, x, nhwc: bool):
+    import jax
+    import jax.numpy as jnp
+
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+    caxis = 3 if nhwc else 1
+
+    def conv_bn(name, x, stride, pad, relu=True):
+        w = params[name + "/w"].astype(x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, w, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=dn)
+        # batch-stat BN in f32, scale+shift, as the framework does
+        yf = y.astype(jnp.float32)
+        axes = tuple(i for i in range(4) if i != caxis)
+        m = yf.mean(axes, keepdims=True)
+        v = yf.var(axes, keepdims=True)
+        s = params[name + "/bn_s"]
+        b = params[name + "/bn_b"]
+        shape = [1] * 4
+        shape[caxis] = -1
+        yf = (yf - m) * jax.lax.rsqrt(v + 1e-5) * s.reshape(shape) \
+            + b.reshape(shape)
+        y = yf.astype(x.dtype)
+        return jnp.maximum(y, 0) if relu else y
+
+    x = conv_bn("stem", x, 2, 3)
+    window = (1, 3, 3, 1) if nhwc else (1, 1, 3, 3)
+    strides = (1, 2, 2, 1) if nhwc else (1, 1, 2, 2)
+    pads = ((0, 0), (1, 1), (1, 1), (0, 0)) if nhwc else \
+        ((0, 0), (0, 0), (1, 1), (1, 1))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides,
+                              pads)
+    cin = 64
+    for stage, (n_blocks, cout) in enumerate(
+            zip(_block_cfgs(), [64, 128, 256, 512])):
+        for b in range(n_blocks):
+            p = f"s{stage}b{b}"
+            stride = 2 if (b == 0 and stage > 0) else 1
+            y = conv_bn(p + "/c1", x, stride, 0)
+            y = conv_bn(p + "/c2", y, 1, 1)
+            y = conv_bn(p + "/c3", y, 1, 0, relu=False)
+            if cin != cout * 4:
+                sc = conv_bn(p + "/sc", x, stride, 0, relu=False)
+            else:
+                sc = x
+            x = jnp.maximum(y + sc, 0)
+            cin = cout * 4
+    x = x.mean(axis=(1, 2) if nhwc else (2, 3))        # global avg pool
+    logits = x.astype(jnp.float32) @ params["fc/w"] + params["fc/b"]
+    return logits
+
+
+def raw_step_fn(nhwc: bool, momentum=0.9, lr=0.1):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, y):
+        logits = resnet50_apply(params, x, nhwc)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    @jax.jit
+    def step(params, vel, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        new_vel = jax.tree.map(lambda v, g: momentum * v + g, vel, grads)
+        new_params = jax.tree.map(lambda p, v: p - lr * v, params, new_vel)
+        return loss, new_params, new_vel
+
+    return step
+
+
+def time_raw(nhwc: bool, batch: int, steps: int, px=224):
+    import jax
+    import jax.numpy as jnp
+
+    rng = jax.random.PRNGKey(0)
+    params = init_resnet50(rng, nhwc)
+    params = jax.device_put(params)
+    vel = jax.tree.map(jnp.zeros_like, params)
+    shape = (batch, px, px, 3) if nhwc else (batch, 3, px, px)
+    x = jnp.asarray(np.random.RandomState(0).rand(*shape), jnp.bfloat16)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
+    step = raw_step_fn(nhwc)
+    loss, params, vel = step(params, vel, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        loss, params, vel = step(params, vel, x, y)
+    jax.block_until_ready(loss)
+    dt = (time.time() - t0) / steps
+    # FLOPs from XLA's own cost analysis of this exact program
+    lowered = jax.jit(raw_step_fn(nhwc)).lower(params, vel, x, y)
+    ca = lowered.compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    flops = ca.get("flops", 0.0)
+    return batch / dt, flops / dt
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    sys.path.insert(0, ".")
+    from bench import bench_resnet, chip_peak_flops
+
+    peak = chip_peak_flops()
+    print(f"device={jax.devices()[0].device_kind} peak={peak/1e12:.0f}T",
+          flush=True)
+
+    ips, mfu, flops = bench_resnet(batch, steps, 1)
+    print(f"A framework-nchw: {ips:9.1f} img/s  mfu={mfu:.4f} "
+          f"(flops/step={flops/1e9:.1f}G)", flush=True)
+
+    for nhwc, name in [(False, "raw-nchw"), (True, "raw-nhwc")]:
+        ips, fps = time_raw(nhwc, batch, steps)
+        print(f"{'C' if nhwc else 'B'} {name}:      {ips:9.1f} img/s  "
+              f"mfu={fps/peak:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Transformer bench attribution: batch sweep + roofline.
+
+Run: python tools/perf_probe3.py [steps]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+    sys.path.insert(0, ".")
+    from bench import bench_transformer, chip_peak_flops
+
+    peak = chip_peak_flops()
+    print(f"device={jax.devices()[0].device_kind}", flush=True)
+    for b in (16, 32, 64):
+        try:
+            tps, mfu = bench_transformer(b, steps, 1)
+            print(f"transformer bs={b:3d}: {tps:9.0f} tok/s  mfu={mfu:.4f}",
+                  flush=True)
+        except Exception as e:
+            print(f"transformer bs={b}: FAILED {str(e)[:200]}", flush=True)
+            break
+
+    # roofline of the bs=64 step
+    from paddle_tpu import fluid
+    from paddle_tpu.models import transformer as T
+
+    cfg = dict(n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+               d_inner_hid=2048)
+    vocab, seq_len, b = 32768, 256, 64
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        avg_cost, _, _ = T.transformer(
+            src_vocab_size=vocab, trg_vocab_size=vocab,
+            max_length=seq_len + 1, dropout_rate=0.1,
+            src_seq_len=seq_len, trg_seq_len=seq_len, fused=True, **cfg)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
+        "src_pos": np.tile(np.arange(seq_len, dtype=np.int32), (b, 1)),
+        "trg_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
+        "trg_pos": np.tile(np.arange(seq_len, dtype=np.int32), (b, 1)),
+        "src_slf_attn_bias": np.zeros((b, cfg["n_head"], seq_len, seq_len),
+                                      np.float32),
+        "trg_slf_attn_bias": T.make_attn_bias([seq_len] * b, seq_len,
+                                              cfg["n_head"], causal=True),
+        "trg_src_attn_bias": np.zeros((b, cfg["n_head"], seq_len, seq_len),
+                                      np.float32),
+        "lbl_word": rng.randint(1, vocab, (b, seq_len)).astype(np.int32),
+        "lbl_weight": np.ones((b, seq_len), np.float32),
+    }
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ca = exe.cost_analysis(main_prog, feed=feed, fetch_list=[avg_cost])
+    fl = ca.get("flops", 0.0)
+    by = ca.get("bytes accessed", 0.0)
+    t_mxu, t_hbm = fl / peak, by / 819e9
+    print(f"bs64 step: flops={fl/1e9:.0f}G bytes={by/1e9:.2f}GB "
+          f"intensity={fl/max(by,1):.0f}")
+    print(f"  roofline: t_mxu={t_mxu*1e3:.1f}ms t_hbm={t_hbm*1e3:.1f}ms "
+          f"bound={'HBM' if t_hbm > t_mxu else 'MXU'} "
+          f"best mfu={t_mxu/max(t_mxu,t_hbm):.3f}")
+
+
+if __name__ == "__main__":
+    main()
