@@ -1,0 +1,542 @@
+// Native inference engine + C ABI — the TPU build's counterpart of the
+// reference's embedding story: paddle/capi/gradient_machine.h:36
+// (paddle_gradient_machine_create_for_inference), :73 (..._forward) and the
+// C++ model loader paddle/inference/io.h:32 (Load).
+//
+// A saved `save_inference_model` directory (framed JSON ProgramDesc in
+// `__model__` + CRC-framed tensor files per persistable var) is loaded and
+// executed HERE, in plain C++, with no Python anywhere in the process —
+// the test drives this through ctypes from a clean interpreter, but any C
+// program can link it.  Where the reference interpreted a ModelConfig with
+// the gserver layer engine, this walks the (pruned, feed/fetch-annotated)
+// program desc with float32 CPU kernels: the right native analog for
+// host-side/embedded serving.  The TPU serving tier is pjrt_runner.cc
+// (same ABI, StableHLO through the PJRT C API).
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <numeric>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "desc.h"
+
+namespace ptpu {
+namespace {
+
+// -- framing (fluid/io.py frame_bytes: MAGIC2 + payload + crc32le) ---------
+
+const char kMagic2[] = "PDTPU\x02";
+
+std::string read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+std::string unframe(const std::string& data, const std::string& what) {
+  const size_t mlen = 6;
+  if (data.size() < mlen + 4 ||
+      std::memcmp(data.data(), kMagic2, mlen) != 0)
+    throw std::runtime_error(what + ": bad magic/too short");
+  std::string payload = data.substr(mlen, data.size() - mlen - 4);
+  uint32_t want;
+  std::memcpy(&want, data.data() + data.size() - 4, 4);
+  uint32_t got = crc32(0, (const Bytef*)payload.data(), payload.size());
+  if (got != want)
+    throw std::runtime_error(what + ": crc mismatch (corrupt file)");
+  return payload;
+}
+
+// -- tensors ----------------------------------------------------------------
+
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+// fluid/io.py _tensor_bytes: [u32 header_len][json header][raw data]
+Tensor parse_tensor(const std::string& payload, const std::string& what) {
+  if (payload.size() < 4) throw std::runtime_error(what + ": truncated");
+  uint32_t hlen;
+  std::memcpy(&hlen, payload.data(), 4);
+  const std::string header_text = payload.substr(4, hlen);
+  JsonParser jp(header_text);  // parser keeps a reference — must outlive it
+  JsonPtr h = jp.parse();
+  std::string dtype = h->at("dtype")->s;
+  Tensor t;
+  int64_t n = 1;
+  for (auto& e : h->at("shape")->arr) {
+    t.shape.push_back(e->i);
+    n *= e->i;
+  }
+  const char* raw = payload.data() + 4 + hlen;
+  size_t avail = payload.size() - 4 - hlen;
+  t.data.resize(n);
+  if (dtype == "float32") {
+    if (avail < (size_t)n * 4) throw std::runtime_error(what + ": short f32");
+    std::memcpy(t.data.data(), raw, n * 4);
+  } else if (dtype == "float64") {
+    if (avail < (size_t)n * 8) throw std::runtime_error(what + ": short f64");
+    for (int64_t i = 0; i < n; ++i) {
+      double v;
+      std::memcpy(&v, raw + i * 8, 8);
+      t.data[i] = (float)v;
+    }
+  } else if (dtype == "int64" || dtype == "int32") {
+    int w = dtype == "int64" ? 8 : 4;
+    if (avail < (size_t)n * w) throw std::runtime_error(what + ": short int");
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t v = 0;
+      std::memcpy(&v, raw + i * w, w);
+      t.data[i] = (float)v;
+    }
+  } else {
+    throw std::runtime_error(what + ": unsupported dtype " + dtype +
+                             " (native serving engine is float32)");
+  }
+  return t;
+}
+
+// -- kernels ----------------------------------------------------------------
+
+void matmul2d(const float* x, const float* y, float* out, int64_t m,
+              int64_t k, int64_t n) {
+  for (int64_t i = 0; i < m; ++i) {
+    for (int64_t j = 0; j < n; ++j) out[i * n + j] = 0.f;
+    for (int64_t p = 0; p < k; ++p) {
+      float xv = x[i * k + p];
+      if (xv == 0.f) continue;
+      const float* yr = y + p * n;
+      float* orow = out + i * n;
+      for (int64_t j = 0; j < n; ++j) orow[j] += xv * yr[j];
+    }
+  }
+}
+
+struct Engine {
+  ProgramDesc prog;
+  std::map<std::string, Tensor> vars;
+  std::vector<std::string> feed_names, fetch_names;
+  std::vector<Tensor> outputs;
+
+  const BlockDesc& block() const { return prog.blocks.at(0); }
+
+  Tensor& in(const OpDesc& op, const char* slot, int i = 0) {
+    auto it = op.inputs.find(slot);
+    if (it == op.inputs.end() || (int)it->second.size() <= i)
+      throw std::runtime_error(op.type + ": missing input slot " + slot);
+    auto v = vars.find(it->second[i]);
+    if (v == vars.end())
+      throw std::runtime_error(op.type + ": input var " + it->second[i] +
+                               " not computed yet");
+    return v->second;
+  }
+  bool has_in(const OpDesc& op, const char* slot) {
+    auto it = op.inputs.find(slot);
+    return it != op.inputs.end() && !it->second.empty() &&
+           vars.count(it->second[0]);
+  }
+  Tensor& out(const OpDesc& op, const char* slot = "Out", int i = 0) {
+    return vars[op.outputs.at(slot).at(i)];
+  }
+
+  void run_op(const OpDesc& op);
+  void forward();
+};
+
+void Engine::run_op(const OpDesc& op) {
+  const std::string& t = op.type;
+  if (t == "feed" || t == "fetch") return;  // handled by forward()
+  if (t == "mul") {
+    Tensor& x = in(op, "X");
+    Tensor& y = in(op, "Y");
+    int64_t xnum = op.attr_int("x_num_col_dims", 1);
+    int64_t m = 1, k = 1;
+    for (size_t i = 0; i < x.shape.size(); ++i)
+      ((int64_t)i < xnum ? m : k) *= x.shape[i];
+    int64_t k2 = y.shape.at(0), n = y.numel() / k2;
+    if (k != k2)
+      throw std::runtime_error("mul: inner dim mismatch");
+    Tensor r;
+    r.shape.assign(x.shape.begin(), x.shape.begin() + xnum);
+    r.shape.insert(r.shape.end(), y.shape.begin() + 1, y.shape.end());
+    r.data.resize(m * n);
+    matmul2d(x.data.data(), y.data.data(), r.data.data(), m, k, n);
+    out(op) = std::move(r);
+  } else if (t == "elementwise_add" || t == "elementwise_sub" ||
+             t == "elementwise_mul" || t == "elementwise_div") {
+    Tensor& x = in(op, "X");
+    Tensor& y = in(op, "Y");
+    int64_t axis = op.attr_int("axis", -1);
+    int64_t xr = (int64_t)x.shape.size(), yr = (int64_t)y.shape.size();
+    if (axis < 0) axis = xr - yr;
+    int64_t mid = y.numel(), inner = 1;
+    for (int64_t i = axis + yr; i < xr; ++i) inner *= x.shape[i];
+    int64_t outer = x.numel() / (mid * inner);
+    Tensor r;
+    r.shape = x.shape;
+    r.data.resize(x.numel());
+    char k = t[12];  // a/s/m/d — add/sub/mul(div share 'm'? no: 'd')
+    for (int64_t o = 0; o < outer; ++o)
+      for (int64_t mi = 0; mi < mid; ++mi) {
+        float yv = y.data[mi];
+        const float* xp = x.data.data() + (o * mid + mi) * inner;
+        float* rp = r.data.data() + (o * mid + mi) * inner;
+        for (int64_t i = 0; i < inner; ++i)
+          rp[i] = k == 'a' ? xp[i] + yv
+                : k == 's' ? xp[i] - yv
+                : k == 'm' ? xp[i] * yv
+                           : xp[i] / yv;
+      }
+    out(op) = std::move(r);
+  } else if (t == "relu" || t == "tanh" || t == "sigmoid" || t == "exp" ||
+             t == "sqrt" || t == "abs") {
+    Tensor& x = in(op, "X");
+    Tensor r;
+    r.shape = x.shape;
+    r.data.resize(x.numel());
+    for (int64_t i = 0; i < x.numel(); ++i) {
+      float v = x.data[i];
+      r.data[i] = t == "relu"    ? (v > 0 ? v : 0)
+                  : t == "tanh"  ? std::tanh(v)
+                  : t == "sigmoid" ? 1.f / (1.f + std::exp(-v))
+                  : t == "exp"   ? std::exp(v)
+                  : t == "sqrt"  ? std::sqrt(v)
+                                 : std::fabs(v);
+    }
+    out(op) = std::move(r);
+  } else if (t == "softmax") {
+    Tensor& x = in(op, "X");
+    int64_t n = x.shape.back(), rows = x.numel() / n;
+    Tensor r;
+    r.shape = x.shape;
+    r.data.resize(x.numel());
+    for (int64_t i = 0; i < rows; ++i) {
+      const float* xp = x.data.data() + i * n;
+      float* rp = r.data.data() + i * n;
+      float mx = xp[0];
+      for (int64_t j = 1; j < n; ++j) mx = std::max(mx, xp[j]);
+      float s = 0;
+      for (int64_t j = 0; j < n; ++j) s += (rp[j] = std::exp(xp[j] - mx));
+      for (int64_t j = 0; j < n; ++j) rp[j] /= s;
+    }
+    out(op) = std::move(r);
+  } else if (t == "scale") {
+    Tensor& x = in(op, "X");
+    float sc = (float)op.attr_num("scale", 1.0);
+    float b = (float)op.attr_num("bias", 0.0);
+    bool after = op.attr_bool("bias_after_scale", true);
+    Tensor r;
+    r.shape = x.shape;
+    r.data.resize(x.numel());
+    for (int64_t i = 0; i < x.numel(); ++i)
+      r.data[i] = after ? x.data[i] * sc + b : (x.data[i] + b) * sc;
+    out(op) = std::move(r);
+  } else if (t == "reshape") {
+    Tensor& x = in(op, "X");
+    auto shp = op.attr_ints("shape");
+    int64_t known = 1, infer = -1;
+    for (size_t i = 0; i < shp.size(); ++i) {
+      if (shp[i] == 0) shp[i] = x.shape.at(i);
+      if (shp[i] == -1) infer = (int64_t)i;
+      else known *= shp[i];
+    }
+    if (infer >= 0) shp[infer] = x.numel() / known;
+    Tensor r;
+    r.shape = shp;
+    r.data = x.data;
+    out(op) = std::move(r);
+  } else if (t == "transpose") {
+    Tensor& x = in(op, "X");
+    auto perm = op.attr_ints("axis");
+    int64_t rank = (int64_t)x.shape.size();
+    std::vector<int64_t> ns(rank), xstr(rank, 1);
+    for (int64_t i = rank - 2; i >= 0; --i)
+      xstr[i] = xstr[i + 1] * x.shape[i + 1];
+    for (int64_t i = 0; i < rank; ++i) ns[i] = x.shape[perm[i]];
+    Tensor r;
+    r.shape = ns;
+    r.data.resize(x.numel());
+    std::vector<int64_t> idx(rank, 0);
+    for (int64_t lin = 0; lin < x.numel(); ++lin) {
+      int64_t src = 0;
+      for (int64_t i = 0; i < rank; ++i) src += idx[i] * xstr[perm[i]];
+      r.data[lin] = x.data[src];
+      for (int64_t i = rank - 1; i >= 0; --i)
+        if (++idx[i] < ns[i]) break; else idx[i] = 0;
+    }
+    out(op) = std::move(r);
+  } else if (t == "mean") {
+    Tensor& x = in(op, "X");
+    double s = 0;
+    for (auto v : x.data) s += v;
+    Tensor r;
+    r.shape = {};
+    r.data = {(float)(s / std::max<int64_t>(1, x.numel()))};
+    out(op) = std::move(r);
+  } else if (t == "dropout") {
+    // inference semantics: identity (upscale-at-train convention)
+    out(op) = in(op, "X");
+  } else if (t == "batch_norm") {
+    Tensor& x = in(op, "X");
+    Tensor& scale = in(op, "Scale");
+    Tensor& bias = in(op, "Bias");
+    Tensor& mean = in(op, "Mean");
+    Tensor& var = in(op, "Variance");
+    float eps = (float)op.attr_num("epsilon", 1e-5);
+    int64_t c = x.shape.size() >= 2 ? x.shape[1] : x.shape.back();
+    int64_t inner = x.numel() / (x.shape[0] * c);
+    Tensor r;
+    r.shape = x.shape;
+    r.data.resize(x.numel());
+    for (int64_t b = 0; b < x.shape[0]; ++b)
+      for (int64_t ch = 0; ch < c; ++ch) {
+        float inv = 1.f / std::sqrt(var.data[ch] + eps);
+        float sc = scale.data[ch] * inv, sh = bias.data[ch];
+        float mu = mean.data[ch];
+        const float* xp = x.data.data() + (b * c + ch) * inner;
+        float* rp = r.data.data() + (b * c + ch) * inner;
+        for (int64_t i = 0; i < inner; ++i)
+          rp[i] = (xp[i] - mu) * sc + sh;
+      }
+    out(op, "Y") = std::move(r);
+  } else if (t == "conv2d") {
+    Tensor& x = in(op, "Input");
+    Tensor& w = in(op, "Filter");
+    auto st = op.attr_ints("strides");
+    auto pd = op.attr_ints("paddings");
+    auto dil = op.attr_ints("dilations");
+    int64_t g = op.attr_int("groups", 1);
+    if (st.empty()) st = {1, 1};
+    if (pd.empty()) pd = {0, 0};
+    for (auto d : dil)
+      if (d != 1)
+        throw std::runtime_error(
+            "conv2d: dilations != 1 unsupported in the native engine — "
+            "failing loudly instead of computing a dilation-1 conv");
+    int64_t B = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+    int64_t O = w.shape[0], CK = w.shape[1], KH = w.shape[2],
+            KW = w.shape[3];
+    int64_t OH = (H + 2 * pd[0] - KH) / st[0] + 1;
+    int64_t OW = (W + 2 * pd[1] - KW) / st[1] + 1;
+    Tensor r;
+    r.shape = {B, O, OH, OW};
+    r.data.assign(B * O * OH * OW, 0.f);
+    int64_t opg = O / g, cpg = C / g;
+    for (int64_t b = 0; b < B; ++b)
+      for (int64_t o = 0; o < O; ++o) {
+        int64_t gi = o / opg;
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            float acc = 0;
+            for (int64_t ck = 0; ck < CK && ck < cpg; ++ck) {
+              int64_t c = gi * cpg + ck;
+              for (int64_t kh = 0; kh < KH; ++kh) {
+                int64_t ih = oh * st[0] - pd[0] + kh;
+                if (ih < 0 || ih >= H) continue;
+                for (int64_t kw = 0; kw < KW; ++kw) {
+                  int64_t iw = ow * st[1] - pd[1] + kw;
+                  if (iw < 0 || iw >= W) continue;
+                  acc += x.data[((b * C + c) * H + ih) * W + iw] *
+                         w.data[((o * CK + ck) * KH + kh) * KW + kw];
+                }
+              }
+            }
+            r.data[((b * O + o) * OH + oh) * OW + ow] = acc;
+          }
+      }
+    out(op, "Output") = std::move(r);
+  } else if (t == "pool2d") {
+    Tensor& x = in(op, "X");
+    std::string pt = "max";
+    if (op.attrs && op.attrs->get("pooling_type"))
+      pt = op.attrs->get("pooling_type")->s;
+    auto ks = op.attr_ints("ksize");
+    auto st = op.attr_ints("strides");
+    auto pd = op.attr_ints("paddings");
+    bool global_p = op.attr_bool("global_pooling", false);
+    if (op.attr_bool("ceil_mode", false))
+      throw std::runtime_error("pool2d: ceil_mode unsupported in the "
+                               "native engine");
+    int64_t B = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+    if (global_p) {
+      ks = {H, W};
+      st = {H, W};
+      pd = {0, 0};
+    }
+    if (st.empty()) st = {2, 2};
+    if (pd.empty()) pd = {0, 0};
+    int64_t OH = (H + 2 * pd[0] - ks[0]) / st[0] + 1;
+    int64_t OW = (W + 2 * pd[1] - ks[1]) / st[1] + 1;
+    Tensor r;
+    r.shape = {B, C, OH, OW};
+    r.data.resize(B * C * OH * OW);
+    for (int64_t b = 0; b < B; ++b)
+      for (int64_t c = 0; c < C; ++c)
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            float best = -3.4e38f;
+            double sum = 0;
+            int64_t cnt = 0;
+            for (int64_t kh = 0; kh < ks[0]; ++kh) {
+              int64_t ih = oh * st[0] - pd[0] + kh;
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t kw = 0; kw < ks[1]; ++kw) {
+                int64_t iw = ow * st[1] - pd[1] + kw;
+                if (iw < 0 || iw >= W) continue;
+                float v = x.data[((b * C + c) * H + ih) * W + iw];
+                best = std::max(best, v);
+                sum += v;
+                ++cnt;
+              }
+            }
+            r.data[((b * C + c) * OH + oh) * OW + ow] =
+                pt == "max" ? best : (float)(sum / std::max<int64_t>(1, cnt));
+          }
+    out(op) = std::move(r);
+  } else {
+    throw std::runtime_error(
+        "native inference engine: unsupported op '" + t +
+        "' (supported: feed/fetch, mul, elementwise_*, activations, "
+        "softmax, scale, reshape, transpose, mean, dropout, batch_norm, "
+        "conv2d, pool2d — use the PJRT/StableHLO tier for anything XLA "
+        "can run)");
+  }
+}
+
+void Engine::forward() {
+  outputs.clear();
+  for (auto& op : block().ops) run_op(op);
+  for (auto& n : fetch_names) {
+    auto it = vars.find(n);
+    if (it == vars.end())
+      throw std::runtime_error("fetch target " + n + " was not produced");
+    outputs.push_back(it->second);
+  }
+}
+
+Engine* load_engine(const std::string& dir) {
+  auto eng = std::make_unique<Engine>();
+  // __model__ is the raw canonical-JSON desc (desc.py serialize_to_string);
+  // only the tensor files carry the CRC framing
+  eng->prog = parse_program(read_file(dir + "/__model__"));
+  const BlockDesc& b = eng->prog.blocks.at(0);
+  // order by the ops' 'col' attr, NOT block order: save_inference_model
+  // prepends feed ops one at a time, so block order is the REVERSE of
+  // the feeded_var_names/column order the ABI documents
+  std::vector<std::pair<int64_t, std::string>> feeds, fetches;
+  for (auto& op : b.ops) {
+    if (op.type == "feed")
+      feeds.emplace_back(op.attr_int("col", (int64_t)feeds.size()),
+                         op.inputs.at("X").at(0));
+    if (op.type == "fetch")
+      fetches.emplace_back(op.attr_int("col", (int64_t)fetches.size()),
+                           op.inputs.at("X").at(0));
+  }
+  std::sort(feeds.begin(), feeds.end());
+  std::sort(fetches.begin(), fetches.end());
+  for (auto& p : feeds) eng->feed_names.push_back(p.second);
+  for (auto& p : fetches) eng->fetch_names.push_back(p.second);
+  for (auto& kv : b.vars) {
+    if (!kv.second.persistable) continue;
+    std::string path = dir + "/" + kv.first;
+    std::ifstream probe(path);
+    if (!probe) continue;  // e.g. feed/fetch holder vars
+    eng->vars[kv.first] =
+        parse_tensor(unframe(read_file(path), kv.first), kv.first);
+  }
+  return eng.release();
+}
+
+thread_local std::string g_err;
+
+}  // namespace
+}  // namespace ptpu
+
+// ---------------------------------------------------------------------------
+// C ABI — shape mirrors reference capi/gradient_machine.h
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+const char* ptpu_last_error() { return ptpu::g_err.c_str(); }
+
+void* ptpu_create_for_inference(const char* model_dir) {
+  try {
+    return ptpu::load_engine(model_dir);
+  } catch (const std::exception& e) {
+    ptpu::g_err = e.what();
+    return nullptr;
+  }
+}
+
+int ptpu_num_inputs(void* h) {
+  return (int)((ptpu::Engine*)h)->feed_names.size();
+}
+const char* ptpu_input_name(void* h, int i) {
+  return ((ptpu::Engine*)h)->feed_names.at(i).c_str();
+}
+int ptpu_num_outputs(void* h) {
+  return (int)((ptpu::Engine*)h)->fetch_names.size();
+}
+const char* ptpu_output_name(void* h, int i) {
+  return ((ptpu::Engine*)h)->fetch_names.at(i).c_str();
+}
+
+// inputs follow the feed-op column order (ptpu_input_name order).
+int ptpu_forward(void* h, const float* const* inputs,
+                 const int64_t* const* shapes, const int* ndims,
+                 int n_inputs) {
+  auto* eng = (ptpu::Engine*)h;
+  try {
+    if (n_inputs != (int)eng->feed_names.size())
+      throw std::runtime_error("expected " +
+                               std::to_string(eng->feed_names.size()) +
+                               " inputs");
+    for (int i = 0; i < n_inputs; ++i) {
+      ptpu::Tensor t;
+      int64_t n = 1;
+      for (int d = 0; d < ndims[i]; ++d) {
+        t.shape.push_back(shapes[i][d]);
+        n *= shapes[i][d];
+      }
+      t.data.assign(inputs[i], inputs[i] + n);
+      eng->vars[eng->feed_names[i]] = std::move(t);
+    }
+    eng->forward();
+    return 0;
+  } catch (const std::exception& e) {
+    ptpu::g_err = e.what();
+    return 1;
+  }
+}
+
+int ptpu_output_rank(void* h, int i) {
+  return (int)((ptpu::Engine*)h)->outputs.at(i).shape.size();
+}
+const int64_t* ptpu_output_shape(void* h, int i) {
+  return ((ptpu::Engine*)h)->outputs.at(i).shape.data();
+}
+const float* ptpu_output_data(void* h, int i) {
+  return ((ptpu::Engine*)h)->outputs.at(i).data.data();
+}
+
+void ptpu_destroy(void* h) { delete (ptpu::Engine*)h; }
+
+}  // extern "C"

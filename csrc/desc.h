@@ -1,0 +1,99 @@
+// Shared native ProgramDesc model + JSON wire parsing — the header both
+// native TUs (ir.cc: validation/scheduling/liveness; capi.cc: the C
+// inference ABI) build on.  Counterpart of the reference's desc headers
+// (paddle/framework/program_desc.h, block_desc.h, op_desc.h, var_desc.h);
+// the wire format is the canonical JSON of fluid/core/desc.py.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace ptpu {
+
+struct VarDesc {
+  std::string name, type, dtype;
+  std::vector<int64_t> shape;
+  bool has_shape = false;
+  bool persistable = false;
+};
+
+struct OpDesc {
+  std::string type;
+  // slot -> ordered var names
+  std::map<std::string, std::vector<std::string>> inputs, outputs;
+  JsonPtr attrs;  // opaque; block refs = {"__block__": idx}
+
+  std::vector<std::string> all_inputs() const {
+    std::vector<std::string> v;
+    for (auto& kv : inputs) v.insert(v.end(), kv.second.begin(),
+                                     kv.second.end());
+    return v;
+  }
+  std::vector<std::string> all_outputs() const {
+    std::vector<std::string> v;
+    for (auto& kv : outputs) v.insert(v.end(), kv.second.begin(),
+                                      kv.second.end());
+    return v;
+  }
+  std::vector<int> block_attrs() const {
+    std::vector<int> out;
+    if (attrs && attrs->type == Json::OBJECT) {
+      for (auto& kv : attrs->obj) {
+        if (kv.second->type == Json::OBJECT) {
+          auto b = kv.second->get("__block__");
+          if (b && b->type == Json::INT) out.push_back((int)b->i);
+        }
+      }
+    }
+    return out;
+  }
+
+  // attr conveniences for kernel code (capi.cc)
+  int64_t attr_int(const std::string& k, int64_t dflt) const {
+    if (!attrs || attrs->type != Json::OBJECT) return dflt;
+    auto a = attrs->get(k);
+    return (a && a->type == Json::INT) ? a->i : dflt;
+  }
+  double attr_num(const std::string& k, double dflt) const {
+    if (!attrs || attrs->type != Json::OBJECT) return dflt;
+    auto a = attrs->get(k);
+    if (a && a->type == Json::DOUBLE) return a->d;
+    if (a && a->type == Json::INT) return (double)a->i;
+    return dflt;
+  }
+  bool attr_bool(const std::string& k, bool dflt) const {
+    if (!attrs || attrs->type != Json::OBJECT) return dflt;
+    auto a = attrs->get(k);
+    return (a && a->type == Json::BOOL) ? a->b : dflt;
+  }
+  std::vector<int64_t> attr_ints(const std::string& k) const {
+    std::vector<int64_t> out;
+    if (!attrs || attrs->type != Json::OBJECT) return out;
+    auto a = attrs->get(k);
+    if (a && a->type == Json::ARRAY)
+      for (auto& e : a->arr)
+        if (e->type == Json::INT) out.push_back(e->i);
+    return out;
+  }
+};
+
+struct BlockDesc {
+  int idx = 0, parent_idx = -1;
+  std::map<std::string, VarDesc> vars;
+  std::vector<OpDesc> ops;
+};
+
+struct ProgramDesc {
+  int version = 1;
+  std::vector<BlockDesc> blocks;
+};
+
+// defined in ir.cc (one definition; capi.cc links against it)
+ProgramDesc parse_program(const std::string& text);
+std::string reserialize(const std::string& text);
+
+}  // namespace ptpu

@@ -17,59 +17,9 @@
 #include <unordered_map>
 #include <unordered_set>
 
-#include "json.h"
+#include "desc.h"
 
 namespace ptpu {
-
-struct VarDesc {
-  std::string name, type, dtype;
-  std::vector<int64_t> shape;
-  bool has_shape = false;
-  bool persistable = false;
-};
-
-struct OpDesc {
-  std::string type;
-  // slot -> ordered var names
-  std::map<std::string, std::vector<std::string>> inputs, outputs;
-  JsonPtr attrs;  // opaque; block refs = {"__block__": idx}
-
-  std::vector<std::string> all_inputs() const {
-    std::vector<std::string> v;
-    for (auto& kv : inputs) v.insert(v.end(), kv.second.begin(),
-                                     kv.second.end());
-    return v;
-  }
-  std::vector<std::string> all_outputs() const {
-    std::vector<std::string> v;
-    for (auto& kv : outputs) v.insert(v.end(), kv.second.begin(),
-                                      kv.second.end());
-    return v;
-  }
-  std::vector<int> block_attrs() const {
-    std::vector<int> out;
-    if (attrs && attrs->type == Json::OBJECT) {
-      for (auto& kv : attrs->obj) {
-        if (kv.second->type == Json::OBJECT) {
-          auto b = kv.second->get("__block__");
-          if (b && b->type == Json::INT) out.push_back((int)b->i);
-        }
-      }
-    }
-    return out;
-  }
-};
-
-struct BlockDesc {
-  int idx = 0, parent_idx = -1;
-  std::map<std::string, VarDesc> vars;
-  std::vector<OpDesc> ops;
-};
-
-struct ProgramDesc {
-  int version = 1;
-  std::vector<BlockDesc> blocks;
-};
 
 // ---------------------------------------------------------------------------
 // parse / serialize (canonical JSON wire format shared with desc.py)

@@ -290,9 +290,13 @@ def prune_program(program: Program, targets: List[Variable]) -> Program:
 def save_inference_model(dirname: str, feeded_var_names: List[str],
                          target_vars: List[Variable], executor: Executor,
                          main_program: Optional[Program] = None,
-                         scope: Optional[Scope] = None) -> None:
+                         scope: Optional[Scope] = None,
+                         export_stablehlo_module: bool = False,
+                         stablehlo_batch_size: int = 1) -> None:
     """reference io.py:297: prune to the inference slice, record feed/fetch
-    ops, persist program + params."""
+    ops, persist program + params.  ``export_stablehlo_module=True``
+    additionally writes model.stablehlo(.json) for the native PJRT
+    serving tier (csrc/pjrt_runner.cc)."""
     program = main_program or default_main_program()
     pruned = prune_program(program, target_vars)
     block = pruned.global_block()
@@ -308,6 +312,10 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
     with open(os.path.join(dirname, "__model__"), "wb") as f:
         f.write(pruned.serialize_to_string())
     save_persistables(executor, dirname, program, scope=scope)
+    if export_stablehlo_module:
+        export_stablehlo(dirname, pruned, feeded_var_names,
+                         [v.name for v in target_vars], scope=scope,
+                         batch_size=stablehlo_batch_size)
 
 
 def load_inference_model(dirname: str, executor: Executor,
@@ -330,3 +338,71 @@ def get_inference_program(target_vars, main_program=None):
     if not isinstance(target_vars, (list, tuple)):
         target_vars = [target_vars]
     return prune_program(program, target_vars)
+
+
+def export_stablehlo(dirname: str, program, feed_names, fetch_names,
+                     scope=None, batch_size: int = 1) -> None:
+    """Export the inference step as a StableHLO module + meta json — the
+    artifact csrc/pjrt_runner.cc serves through any PJRT C-API plugin
+    (TPU serving with no Python; reference inference/io.h:32 analog).
+
+    Parameters and all other scope state are closed over as module
+    constants, so the exported function takes exactly the feed tensors
+    (at ``batch_size``) and returns the fetch targets.
+    """
+    import jax
+    import numpy as np
+
+    from .executor import Executor, HOST_OPS, global_scope
+    from .lowering import MARKER_OPS, build_step_fn
+
+    scope = scope or global_scope()
+    desc = program.desc
+    block = desc.global_block()
+    feeds = {}
+    metas = []
+    for name in feed_names:
+        vd = block.vars[name]
+        dtype = np.dtype(vd.dtype or "float32")
+        shape = [batch_size if d in (-1, None) else int(d)
+                 for d in (vd.shape or [])]
+        if dtype != np.float32:
+            raise ValueError(
+                f"export_stablehlo: feed {name!r} has dtype {dtype}; the "
+                f"native PJRT runner ABI is float32-only")
+        feeds[name] = jax.ShapeDtypeStruct(tuple(shape), dtype)
+        metas.append({"name": name, "shape": shape, "dtype": str(dtype)})
+    traced_ops = [op for op in block.ops
+                  if op.type not in HOST_OPS and op.type not in MARKER_OPS]
+    exe = Executor(None)
+    state_in, _ = exe._classify_structure(traced_ops, set(feeds),
+                                          fetch_names, block)
+    state_vals = exe._fetch_state(state_in, traced_ops, fetch_names, scope)
+    state_const = {k: np.asarray(v.data if hasattr(v, "lengths") else v)
+                   for k, v in state_vals.items()}
+    step = build_step_fn(desc, 0, list(feed_names), state_in, [],
+                         list(fetch_names), "infer")
+    rng = np.zeros(2, np.int32)
+
+    def infer_fn(*arrays):
+        fd = dict(zip(feed_names, arrays))
+        fetches, _ = step(fd, state_const, rng)
+        return tuple(fetches)
+
+    lowered = jax.jit(infer_fn).lower(*[feeds[n] for n in feed_names])
+    module_text = str(lowered.compiler_ir(dialect="stablehlo"))
+    outs = jax.eval_shape(infer_fn, *[feeds[n] for n in feed_names])
+    for name, o in zip(fetch_names, outs):
+        if np.dtype(o.dtype) != np.float32:
+            raise ValueError(
+                f"export_stablehlo: fetch {name!r} has dtype {o.dtype}; "
+                f"the native PJRT runner ABI is float32-only (cast the "
+                f"fetch target before saving)")
+    meta = {"inputs": metas,
+            "outputs": [{"shape": [int(d) for d in o.shape],
+                         "dtype": str(np.dtype(o.dtype))}
+                        for o in outs]}
+    _atomic_write(os.path.join(dirname, "model.stablehlo"),
+                  module_text.encode())
+    _atomic_write(os.path.join(dirname, "model.stablehlo.json"),
+                  json.dumps(meta).encode())

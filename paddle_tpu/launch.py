@@ -45,15 +45,29 @@ def launch(nprocs: int, argv, coordinator: str | None = None,
         env["PADDLE_TPU_PROC_ID"] = str(rank)
         procs.append(subprocess.Popen([sys.executable] + list(argv),
                                       env=env))
+    import time
+
     rc = 0
     try:
-        for p in procs:
-            code = p.wait()
-            if code != 0 and rc == 0:
-                rc = code
-                for q in procs:
-                    if q.poll() is None:
-                        q.send_signal(signal.SIGTERM)
+        # poll ALL ranks: a crash in any rank must terminate the rest
+        # immediately (a sequential wait on rank 0 would hang forever on
+        # a collective stuck waiting for the dead rank)
+        live = set(range(nprocs))
+        while live:
+            progressed = False
+            for i in sorted(live):
+                code = procs[i].poll()
+                if code is None:
+                    continue
+                live.discard(i)
+                progressed = True
+                if code != 0 and rc == 0:
+                    rc = code
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+            if live and not progressed:
+                time.sleep(0.05)
     except KeyboardInterrupt:
         for q in procs:
             if q.poll() is None:
