@@ -10,5 +10,6 @@ downloading is impossible (zero-egress CI) or PADDLE_TPU_SYNTHETIC=1
 forces it.
 """
 
-from . import (cifar, common, conll05, imdb, imikolov, mnist,  # noqa: F401
-               movielens, uci_housing, wmt16)
+from . import (cifar, common, conll05, flowers, image, imdb,  # noqa: F401
+               imikolov, mnist, movielens, mq2007, sentiment,
+               uci_housing, voc2012, wmt14, wmt16)

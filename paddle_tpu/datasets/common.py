@@ -88,3 +88,11 @@ def download(url: str, module_name: str, md5sum: Optional[str],
                 f"{url}: md5 mismatch (want {md5sum}, got {got})")
     os.replace(tmp, filename)
     return filename
+
+
+def cache_dir(module_name: str) -> str:
+    """DATA_HOME/<module>/ (created) — where download() lands files and
+    where manually-extracted archives (e.g. mq2007's .rar) belong."""
+    d = os.path.join(DATA_HOME, module_name)
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -1,0 +1,93 @@
+"""Oxford 102 flowers — python/paddle/v2/dataset/flowers.py: images from
+102flowers.tgz, labels from imagelabels.mat, split ids from setid.mat;
+readers yield (image chw float32 /255, label 0-based int).
+
+The reference pipes images through its mapper/xmap machinery; here the
+reader applies paddle_tpu.datasets.image.simple_transform directly.
+Synthetic fallback: class-coded color blobs.
+"""
+
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from . import common, image
+
+DATA_URL = ("http://www.robots.ox.ac.uk/~vgg/data/flowers/102/"
+            "102flowers.tgz")
+LABEL_URL = ("http://www.robots.ox.ac.uk/~vgg/data/flowers/102/"
+             "imagelabels.mat")
+SETID_URL = ("http://www.robots.ox.ac.uk/~vgg/data/flowers/102/"
+             "setid.mat")
+DATA_MD5 = "33bfc11892f1e405ca193ae9a9f2a118"
+LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
+SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
+
+# reference flowers.py: train uses 'tstid', test 'trnid' (sic — the
+# published split names are swapped relative to their sizes)
+TRAIN_FLAG, TEST_FLAG, VALID_FLAG = "tstid", "trnid", "valid"
+
+N_CLASSES = 102
+SYN_N = {"train": 256, "test": 64, "valid": 64}
+IMG_SIZE = 32            # synthetic images stay tiny
+
+
+def parse_flowers(data_tar: str, label_mat: str, setid_mat: str,
+                  flag: str, size: int = 224, is_train: bool = False):
+    """Yield (chw float32, 0-based label) for the split `flag`;
+    ``is_train`` applies the reference train_mapper's augmentation
+    (random crop + flip via simple_transform)."""
+    import scipy.io
+
+    labels = scipy.io.loadmat(label_mat)["labels"][0]
+    ids = scipy.io.loadmat(setid_mat)[flag][0]
+    with tarfile.open(data_tar, "r") as f:
+        members = {m.name: m for m in f}
+        for idx in ids:
+            name = f"jpg/image_{int(idx):05d}.jpg"
+            if name not in members:
+                continue
+            raw = f.extractfile(members[name]).read()
+            img = image.load_image_bytes(raw)
+            img = image.simple_transform(img, resize_size=size + 32,
+                                         crop_size=size,
+                                         is_train=is_train)
+            yield img, int(labels[int(idx) - 1]) - 1
+
+
+def _synthetic_reader(split, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(SYN_N[split]):
+            k = rng.randint(0, N_CLASSES)
+            img = rng.rand(3, IMG_SIZE, IMG_SIZE).astype(np.float32) * 0.2
+            img[k % 3] += (k % 17) / 17.0
+            yield img, int(k)
+    return r
+
+
+def _reader(flag, split, seed, is_train=False):
+    if not common.synthetic_only():
+        try:
+            data = common.download(DATA_URL, "flowers", DATA_MD5)
+            label = common.download(LABEL_URL, "flowers", LABEL_MD5)
+            setid = common.download(SETID_URL, "flowers", SETID_MD5)
+            return lambda: parse_flowers(data, label, setid, flag,
+                                         is_train=is_train)
+        except common.DownloadError as e:
+            common.fallback_warning("flowers", str(e))
+    return _synthetic_reader(split, seed)
+
+
+def train():
+    return _reader(TRAIN_FLAG, "train", seed=41, is_train=True)
+
+
+def test():
+    return _reader(TEST_FLAG, "test", seed=42)
+
+
+def valid():
+    return _reader(VALID_FLAG, "valid", seed=43)

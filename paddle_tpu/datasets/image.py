@@ -1,0 +1,100 @@
+"""Image transforms — python/paddle/v2/image.py's API surface
+(load/resize/crop/flip/simple_transform), numpy+PIL instead of the
+reference's cv2: the functions feed the image dataset readers (flowers,
+voc2012) and any user pipeline.
+
+Arrays are HWC uint8/float until ``to_chw``; ``simple_transform``
+finishes as CHW float32 scaled to [0, 1] (with optional mean
+subtraction), the layout the conv stacks expect.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+__all__ = ["load_image_bytes", "load_image", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip",
+           "simple_transform", "load_and_transform"]
+
+
+def load_image_bytes(bytes_data, is_color=True):
+    """Decode an encoded image buffer -> HWC uint8 (H W for gray)."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(bytes_data))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(file_path: str, is_color=True):
+    with open(file_path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Scale so the SHORT side equals `size`, keeping aspect ratio."""
+    from PIL import Image
+
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    img = Image.fromarray(im)
+    return np.asarray(img.resize((new_w, new_h), Image.BILINEAR))
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    """HWC -> CHW (grayscale gains a leading 1-channel axis)."""
+    if im.ndim == 2:
+        im = im[:, :, None]
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color=True) -> np.ndarray:
+    h, w = im.shape[:2]
+    h0 = max(0, (h - size) // 2)
+    w0 = max(0, (w - size) // 2)
+    return im[h0: h0 + size, w0: w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color=True,
+                rng=None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, max(1, h - size + 1))
+    w0 = rng.randint(0, max(1, w - size + 1))
+    return im[h0: h0 + size, w0: w0 + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool = False, is_color=True, mean=None,
+                     rng=None) -> np.ndarray:
+    """resize_short + (random|center) crop (+ random flip when training)
+    + CHW float32 [0,1] (+ mean subtraction) — reference
+    image.py simple_transform."""
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.randint(0, 2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32) / 255.0
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        im -= mean.reshape(-1, 1, 1) if mean.ndim == 1 else mean
+    return im
+
+
+def load_and_transform(filename: str, resize_size: int, crop_size: int,
+                       is_train: bool = False, is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

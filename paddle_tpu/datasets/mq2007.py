@@ -1,0 +1,128 @@
+"""LETOR MQ2007 learning-to-rank — python/paddle/v2/dataset/mq2007.py:
+LETOR-format lines ``rel qid:ID 1:f1 ... 46:f46 # comment`` grouped by
+query; readers yield per the format:
+
+  * ``pointwise``: (relevance_score, feature_vector[46])
+  * ``pairwise``:  (label, better_vector, worse_vector)
+  * ``listwise``:  (relevance_list, feature_matrix)
+
+The reference extracts a .rar (rarfile dependency); this loader parses
+any extracted ``{train,test,vali}.txt`` placed under the cache dir —
+`.rar` has no stdlib extractor, so fetching stays manual there — and
+falls back to a synthetic ranking problem under zero egress.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+URL = ("http://www.bigdatalab.ac.cn/benchmark/upload/download_source/"
+       "7b6dbbe2-842c-11e4-a536-bcaec51b9163_MQ2007.rar")
+MD5 = "7be1640ae95c6408dab0ae7207bdc706"
+
+N_FEATURES = 46
+SYN_QUERIES = {"train": 24, "test": 8, "vali": 8}
+SYN_DOCS = 6
+
+
+def parse_letor_lines(lines):
+    """-> [(query_id, [(rel, feat[46])])] grouped in file order
+    (reference Query._parse_ + QueryList grouping)."""
+    groups = []
+    cur_id, cur = None, []
+    for text in lines:
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", "ignore")
+        body = text.split("#")[0].strip()
+        if not body:
+            continue
+        parts = body.split()
+        if len(parts) != N_FEATURES + 2:
+            continue                     # reference skips malformed rows
+        rel = int(parts[0])
+        qid = int(parts[1].split(":")[1])
+        feat = np.asarray([float(p.split(":")[1]) for p in parts[2:]],
+                          np.float32)
+        if qid != cur_id:
+            if cur:
+                groups.append((cur_id, cur))
+            cur_id, cur = qid, []
+        cur.append((rel, feat))
+    if cur:
+        groups.append((cur_id, cur))
+    return groups
+
+
+def _emit(groups, format):
+    for qid, docs in groups:
+        if format == "pointwise":
+            for rel, feat in docs:
+                yield rel, feat
+        elif format == "pairwise":
+            for i, (ri, fi) in enumerate(docs):
+                for rj, fj in docs[i + 1:]:
+                    if ri == rj:
+                        continue
+                    if ri > rj:
+                        yield np.asarray([1.0], np.float32), fi, fj
+                    else:
+                        yield np.asarray([1.0], np.float32), fj, fi
+        elif format == "listwise":
+            yield (np.asarray([d[0] for d in docs], np.float32),
+                   np.stack([d[1] for d in docs]))
+        else:
+            raise ValueError(f"unknown mq2007 format {format!r}")
+
+
+def _find_extracted(split):
+    """Look for an extracted LETOR text file under the cache dir (the
+    .rar must be unpacked manually — no stdlib rar support)."""
+    base = common.cache_dir("mq2007")
+    for root, _, files in os.walk(base):
+        for f in files:
+            if f.lower() == f"{split}.txt":
+                return os.path.join(root, f)
+    raise common.DownloadError(
+        f"mq2007: no extracted {split}.txt under {base} — the MQ2007 "
+        f"archive is .rar; extract it there manually")
+
+
+def _synthetic_groups(split, seed):
+    rng = np.random.RandomState(seed)
+    groups = []
+    for q in range(SYN_QUERIES[split]):
+        w = rng.rand(N_FEATURES).astype(np.float32)
+        feats = [rng.rand(N_FEATURES).astype(np.float32)
+                 for _ in range(SYN_DOCS)]
+        scores = np.asarray([f @ w for f in feats])
+        # per-query tercile relevance (0..2): guarantees unequal pairs
+        order = scores.argsort()
+        rel = np.empty(SYN_DOCS, np.int64)
+        rel[order] = np.arange(SYN_DOCS) * 3 // SYN_DOCS
+        groups.append((q, [(int(r), f) for r, f in zip(rel, feats)]))
+    return groups
+
+
+def _reader(split, format, seed):
+    if not common.synthetic_only():
+        try:
+            path = _find_extracted(split)
+            with open(path, "rb") as f:
+                groups = parse_letor_lines(f.readlines())
+            return lambda: _emit(groups, format)
+        except common.DownloadError as e:
+            common.fallback_warning("mq2007", str(e))
+    groups = _synthetic_groups(split, seed)
+    return lambda: _emit(groups, format)
+
+
+def train(format="pairwise"):
+    return _reader("train", format, seed=71)
+
+
+def test(format="pairwise"):
+    return _reader("test", format, seed=72)

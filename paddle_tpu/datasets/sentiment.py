@@ -1,0 +1,102 @@
+"""NLTK movie_reviews sentiment — python/paddle/v2/dataset/sentiment.py:
+word ids ordered by corpus frequency, samples interleaved neg/pos, first
+NUM_TRAINING_INSTANCES rows are the train split; readers yield
+(word_id_list, label 0=neg/1=pos).
+
+The corpus zip is parsed directly (pos/neg .txt members) instead of
+going through the nltk corpus API, so the loader has no nltk runtime
+dependency.  Synthetic fallback: polarity-coded id sequences.
+"""
+
+from __future__ import annotations
+
+import re
+import zipfile
+from collections import defaultdict
+
+import numpy as np
+
+from . import common
+
+URL = ("https://raw.githubusercontent.com/nltk/nltk_data/gh-pages/"
+       "packages/corpora/movie_reviews.zip")
+MD5 = "23a2f17b937979b98bb240f1b80e69a5"
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+SYN_VOCAB = 60
+SYN_TRAIN, SYN_TEST = 160, 40
+
+_WORD = re.compile(r"[a-z']+|[.!?,;:]")
+_cache = None
+
+
+def _tokens(text: str):
+    return _WORD.findall(text.lower())
+
+
+def load_sentiment_data(zip_path: str):
+    """-> (rows, word_dict): rows interleaved neg/pos as in the
+    reference's sort_files(); ids ordered by descending corpus
+    frequency."""
+    global _cache
+    if _cache is not None and _cache[0] == zip_path:
+        return _cache[1], _cache[2]
+    freq = defaultdict(int)
+    docs = {"neg": [], "pos": []}
+    with zipfile.ZipFile(zip_path) as z:
+        names = sorted(n for n in z.namelist() if n.endswith(".txt"))
+        for n in names:
+            cat = "neg" if "/neg/" in n else "pos"
+            words = _tokens(z.read(n).decode("utf-8", "ignore"))
+            docs[cat].append(words)
+            for w in words:
+                freq[w] += 1
+    order = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    word_dict = {w: i for i, (w, _) in enumerate(order)}
+    rows = []
+    for neg, pos in zip(docs["neg"], docs["pos"]):
+        rows.append(([word_dict[w] for w in neg], 0))
+        rows.append(([word_dict[w] for w in pos], 1))
+    _cache = (zip_path, rows, word_dict)
+    return rows, word_dict
+
+
+def get_word_dict(zip_path: str = None):
+    if zip_path is None:
+        zip_path = common.download(URL, "sentiment", MD5)
+    _, d = load_sentiment_data(zip_path)
+    return sorted(d.items(), key=lambda kv: kv[1])
+
+
+def _synthetic_reader(n, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            pol = rng.randint(0, 2)
+            lo, hi = (0, SYN_VOCAB // 2) if pol == 0 else \
+                (SYN_VOCAB // 2, SYN_VOCAB)
+            yield rng.randint(lo, hi, rng.randint(4, 12)).tolist(), pol
+    return r
+
+
+def _reader(split, n_syn, seed):
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL, "sentiment", MD5)
+            rows, _ = load_sentiment_data(path)
+            sel = (rows[:NUM_TRAINING_INSTANCES] if split == "train"
+                   else rows[NUM_TRAINING_INSTANCES:NUM_TOTAL_INSTANCES])
+            return lambda: iter(sel)
+        except common.DownloadError as e:
+            common.fallback_warning("sentiment", str(e))
+    return _synthetic_reader(n_syn, seed)
+
+
+def train():
+    return _reader("train", SYN_TRAIN, seed=61)
+
+
+def test():
+    return _reader("test", SYN_TEST, seed=62)

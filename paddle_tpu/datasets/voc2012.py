@@ -1,0 +1,81 @@
+"""PASCAL VOC2012 segmentation — python/paddle/v2/dataset/voc2012.py:
+the trainval tar's ImageSets/Segmentation lists select (JPEGImages jpg,
+SegmentationClass png) pairs; readers yield (image hwc uint8 array,
+label hw uint8 array).
+
+Synthetic fallback: blocky two-class masks.
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+SYN_N = {"trainval": 64, "train": 48, "val": 16}
+SYN_HW = 24
+
+
+def parse_voc2012(tar_path: str, sub_name: str):
+    """Yield (image hwc uint8, label hw uint8) for split `sub_name`
+    (reference reader_creator)."""
+    from PIL import Image
+
+    with tarfile.open(tar_path, "r") as f:
+        members = {m.name: m for m in f.getmembers()}
+        sets = f.extractfile(members[SET_FILE.format(sub_name)])
+        for line in sets:
+            stem = line.decode().strip()
+            if not stem:
+                continue
+            data = f.extractfile(members[DATA_FILE.format(stem)]).read()
+            label = f.extractfile(members[LABEL_FILE.format(stem)]).read()
+            yield (np.array(Image.open(io.BytesIO(data))),
+                   np.array(Image.open(io.BytesIO(label))))
+
+
+def _synthetic_reader(split, seed):
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(SYN_N[split]):
+            img = (rng.rand(SYN_HW, SYN_HW, 3) * 255).astype(np.uint8)
+            label = np.zeros((SYN_HW, SYN_HW), np.uint8)
+            x0, y0 = rng.randint(0, SYN_HW // 2, 2)
+            label[y0: y0 + SYN_HW // 2, x0: x0 + SYN_HW // 2] = \
+                rng.randint(1, 21)
+            yield img, label
+    return r
+
+
+def _reader(sub_name, seed):
+    if not common.synthetic_only():
+        try:
+            path = common.download(VOC_URL, "voc2012", VOC_MD5)
+            return lambda: parse_voc2012(path, sub_name)
+        except common.DownloadError as e:
+            common.fallback_warning("voc2012", str(e))
+    return _synthetic_reader(sub_name, seed)
+
+
+def train():
+    """reference voc2012.train: the 'trainval' list."""
+    return _reader("trainval", seed=51)
+
+
+def test():
+    """reference voc2012.test: the 'train' list."""
+    return _reader("train", seed=52)
+
+
+def val():
+    return _reader("val", seed=53)

@@ -1,0 +1,111 @@
+"""WMT14 fr-en NMT data — python/paddle/v2/dataset/wmt14.py:48-111:
+tarball with src.dict/trg.dict vocabularies and tab-separated parallel
+corpora; readers yield (src_ids, trg_ids, trg_ids_next) with <s>/<e>
+framing, UNK_IDX=2, and the reference's len>80 filter.
+
+Synthetic fallback (zero egress): reversal-task pairs, same framing.
+"""
+
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from . import common
+
+URL_TRAIN = ("http://paddlepaddle.cdn.bcebos.com/demo/"
+             "wmt_shrinked_data/wmt14.tgz")
+MD5_TRAIN = "0791583d57d5beb693b9414c5b36798c"
+
+START, END, UNK = "<s>", "<e>", "<unk>"
+START_ID, END_ID, UNK_IDX = 0, 1, 2
+MAX_LEN = 80
+
+SYN_VOCAB = 100
+TRAIN_N = 2048
+TEST_N = 256
+
+_dict_cache = {}
+
+
+def read_dicts_from_tar(tar_path: str, dict_size: int):
+    """(src_dict, trg_dict) from the members ending src.dict/trg.dict
+    (reference wmt14.py __read_to_dict)."""
+    key = (tar_path, dict_size)
+    if key in _dict_cache:
+        return _dict_cache[key]
+
+    def to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.decode("utf-8", "ignore").strip()] = i
+        return out
+
+    with tarfile.open(tar_path, "r") as f:
+        src_name = [m.name for m in f if m.name.endswith("src.dict")]
+        trg_name = [m.name for m in f if m.name.endswith("trg.dict")]
+        assert len(src_name) == 1 and len(trg_name) == 1
+        out = (to_dict(f.extractfile(src_name[0]), dict_size),
+               to_dict(f.extractfile(trg_name[0]), dict_size))
+    _dict_cache[key] = out
+    return out
+
+
+def parse_wmt14(tar_path: str, member_suffix: str, dict_size: int):
+    """Yield (src_ids, trg_ids, trg_ids_next) from tab-separated parallel
+    members (reference reader_creator)."""
+    src_dict, trg_dict = read_dicts_from_tar(tar_path, dict_size)
+    with tarfile.open(tar_path, "r") as f:
+        names = [m.name for m in f if m.name.endswith(member_suffix)]
+        for name in names:
+            for line in f.extractfile(name):
+                parts = line.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [src_dict.get(w, UNK_IDX)
+                           for w in [START] + parts[0].split() + [END]]
+                trg_ids = [trg_dict.get(w, UNK_IDX)
+                           for w in parts[1].split()]
+                if len(src_ids) > MAX_LEN or len(trg_ids) > MAX_LEN:
+                    continue
+                yield (src_ids, [trg_dict[START]] + trg_ids,
+                       trg_ids + [trg_dict[END]])
+
+
+def _synthetic_reader(n, seed, dict_size):
+    vocab = min(dict_size, SYN_VOCAB)
+
+    def r():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            ln = rng.randint(3, 9)
+            s = rng.randint(3, vocab, ln).tolist()
+            t = list(reversed(s))
+            yield ([START_ID] + s + [END_ID], [START_ID] + t,
+                   t + [END_ID])
+    return r
+
+
+def _reader(suffix, dict_size, n_syn, seed):
+    if not common.synthetic_only():
+        try:
+            path = common.download(URL_TRAIN, "wmt14", MD5_TRAIN)
+            return lambda: parse_wmt14(path, suffix, dict_size)
+        except common.DownloadError as e:
+            common.fallback_warning("wmt14", str(e))
+    return _synthetic_reader(n_syn, seed, dict_size)
+
+
+def train(dict_size: int):
+    return _reader("train/train", dict_size, TRAIN_N, seed=31)
+
+
+def test(dict_size: int):
+    return _reader("test/test", dict_size, TEST_N, seed=32)
+
+
+def gen(dict_size: int):
+    return _reader("gen/gen", dict_size, TEST_N, seed=33)

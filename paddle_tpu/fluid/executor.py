@@ -126,8 +126,14 @@ def _as_feed_value(v):
     """Normalise one feed entry to a device-ready value (int64/f64 narrowed to
     JAX defaults).  Device-resident arrays pass through untouched — feeding a
     jax.Array skips the per-step H2D transfer (device-side input pipelines)."""
+    from .core.lod import NestedSeqArray
+
     if isinstance(v, SeqArray):
         return SeqArray(_as_feed_value(v.data), np.asarray(v.lengths, np.int32))
+    if isinstance(v, NestedSeqArray):
+        return NestedSeqArray(_as_feed_value(v.data),
+                              np.asarray(v.outer_lengths, np.int32),
+                              np.asarray(v.inner_lengths, np.int32))
     if isinstance(v, jax.Array):
         return v
     a = np.asarray(v)
@@ -142,8 +148,12 @@ def _sig_of(v):
     # shape/dtype only — must NOT materialise device arrays (np.asarray on a
     # device value is a D2H transfer; doing that per state var per step would
     # ship every parameter to the host each iteration)
+    from .core.lod import NestedSeqArray
+
     if isinstance(v, SeqArray):
         return ("seq",) + tuple(v.data.shape) + (str(v.data.dtype),)
+    if isinstance(v, NestedSeqArray):
+        return ("nested",) + tuple(v.data.shape) + (str(v.data.dtype),)
     if hasattr(v, "shape") and hasattr(v, "dtype"):
         return tuple(v.shape) + (str(v.dtype),)
     a = np.asarray(v)
@@ -456,6 +466,14 @@ def _is_cpu(place) -> bool:
 
 
 def _to_numpy(v):
+    from .core.lod import NestedSeqArray
+
     if isinstance(v, SeqArray):
         return SeqArray(np.asarray(v.data), np.asarray(v.lengths))
+    if isinstance(v, NestedSeqArray):
+        # keep the level-2 structure: dropping to the dense block would
+        # lose the per-hypothesis lengths beam_search_decode produces
+        return NestedSeqArray(np.asarray(v.data),
+                              np.asarray(v.outer_lengths),
+                              np.asarray(v.inner_lengths))
     return np.asarray(v)

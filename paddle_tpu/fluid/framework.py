@@ -125,6 +125,14 @@ class Variable:
             raise ValueError(f"variable {self.name} has no shape")
         shape = [(_DUMMY_BATCH if d == -1 else d) for d in self.shape]
         np_dt = np.int32 if self.dtype == "int64" else self.dtype
+        if self.lod_level >= 2:
+            from .core.lod import NestedSeqArray
+
+            data = jax.ShapeDtypeStruct(
+                (shape[0], _DUMMY_TIME, _DUMMY_TIME, *shape[1:]), np_dt)
+            outer = jax.ShapeDtypeStruct((shape[0],), np.int32)
+            inner = jax.ShapeDtypeStruct((shape[0], _DUMMY_TIME), np.int32)
+            return NestedSeqArray(data, outer, inner)
         if self.lod_level > 0:
             data = jax.ShapeDtypeStruct((shape[0], _DUMMY_TIME, *shape[1:]), np_dt)
             lens = jax.ShapeDtypeStruct((shape[0],), np.int32)
@@ -332,11 +340,18 @@ class Block:
                 raise RuntimeError(
                     f"shape inference failed for op {desc.type}: {e}") from e
             return
+        from .core.lod import NestedSeqArray
+
         for slot, vals in out_abs.items():
             for var, av in zip(out_vars.get(slot, []), vals):
-                if not isinstance(av, SeqArray) and not hasattr(av, "shape"):
+                if not isinstance(av, (SeqArray, NestedSeqArray)) \
+                        and not hasattr(av, "shape"):
                     continue  # opaque value (RankTable, TensorArray, ...)
-                if isinstance(av, SeqArray):
+                if isinstance(av, NestedSeqArray):
+                    dshape = list(av.data.shape)
+                    shape = [dshape[0]] + dshape[3:]   # drop outer+inner
+                    var.desc.lod_level = max(var.desc.lod_level, 2)
+                elif isinstance(av, SeqArray):
                     dshape = list(av.data.shape)
                     shape = [dshape[0]] + dshape[2:]
                     var.desc.lod_level = max(var.desc.lod_level, 1)

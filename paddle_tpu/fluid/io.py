@@ -259,6 +259,28 @@ def prune_program(program: Program, targets: List[Variable]) -> Program:
     block = pruned.global_block()
     needed = {t.name if isinstance(t, Variable) else str(t) for t in targets}
 
+    # strip training-only ops BEFORE slicing — the reference does this via
+    # OpRole flags in clone(for_test) (framework.py:893).  Without it, a
+    # forward tower built AFTER optimizer.minimize() (e.g. a generation
+    # tower sharing trained parameters) re-captures the whole training
+    # graph: the reverse slice sees the optimizer update as "the writer" of
+    # a needed parameter and chases grads all the way back to the labels.
+    # Train-only ops are exactly those touching an @GRAD-suffixed var
+    # (every grad op and every optimizer update reads one).
+    def _touches_grad(od) -> bool:
+        for ns in list(od.inputs.values()) + list(od.outputs.values()):
+            for n in ns:
+                if n and n.endswith("@GRAD"):
+                    return True
+        return False
+
+    kept_descs = [od for od in block.desc.ops if not _touches_grad(od)]
+    if len(kept_descs) != len(block.desc.ops):
+        kept = {id(od) for od in kept_descs}
+        block.desc.ops = kept_descs
+        block.ops = [op for op in block.ops if id(op.desc) in kept]
+        pruned._bump_version()
+
     keep_idx = None
     from .. import native
 

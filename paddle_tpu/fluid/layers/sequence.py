@@ -8,7 +8,8 @@ from ..framework import Variable
 from ..layer_helper import LayerHelper
 
 __all__ = ["linear_chain_crf", "crf_decoding",
-           "sequence_conv", "sequence_pool", "sequence_first_step",
+           "sequence_conv", "sequence_pool", "nested_sequence_pool",
+           "sequence_first_step",
            "sequence_last_step", "sequence_expand", "sequence_concat",
            "sequence_reshape", "sequence_slice", "sequence_erase",
            "sequence_mask", "warpctc", "edit_distance", "ctc_align",
@@ -87,6 +88,18 @@ def sequence_pool(input, pool_type, name=None):
     helper.append_op("sequence_pool", {"X": input},
                      {"Out": out, "MaxIndex": max_index},
                      {"pooltype": pool_type})
+    return out
+
+
+def nested_sequence_pool(input, pool_type="sum", name=None):
+    """Pool the INNER level of a level-2 sequence batch
+    (paragraph->sentence->words to paragraph->sentence-vectors) —
+    the level-collapsing half of the reference's nested-LoD
+    sequence_pool (sequence_pool_op.cc over a 2-level lod)."""
+    helper = LayerHelper("nested_sequence_pool", name=name)
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op("nested_sequence_pool", {"X": input}, {"Out": out},
+                     {"pool_type": pool_type})
     return out
 
 

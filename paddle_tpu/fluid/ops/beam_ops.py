@@ -76,9 +76,16 @@ def beam_search_decode(ctx, ids_arr, scores_arr, parents_arr):
     (reference beam_search_decode_op.cc).
 
     Array layout (written by the decode loop): index 0 holds the init
-    tokens; index t>=1 holds step t's selected ids/scores/parents.  Returns
-    SentenceIds [B, W, T-1] (init token dropped, end_id padded) and
-    SentenceScores [B, W], beams sorted best-first."""
+    tokens; index t>=1 holds step t's selected ids/scores/parents.
+    Returns SentenceIds as a **NestedSeqArray** — the level-2 structure
+    the reference op emits (each source sentence owns a list of W
+    candidate sequences, each with its own length up to the first
+    end_id; beam_search_decode_op.cc packs exactly this as 2-level
+    LoD) — with data [B, W, T-1] (end_id padded) plus outer lengths
+    (=W candidates per source) and per-candidate inner lengths; and
+    SentenceScores [B, W].  Beams are sorted best-first.  Dense
+    consumers keep working: np.asarray(nested) yields the padded
+    [B, W, T-1] block."""
     end_id = int(ctx.attr("end_id"))
     ids = ids_arr.data          # [T, B, W]
     parents = parents_arr.data  # [T, B, W] int32
@@ -108,7 +115,18 @@ def beam_search_decode(ctx, ids_arr, scores_arr, parents_arr):
     order = jnp.argsort(-final_scores, axis=1)         # [B, W]
     sents = jnp.take_along_axis(sents, order[..., None], axis=1)
     final_scores = jnp.take_along_axis(final_scores, order, axis=1)
-    return sents, final_scores
+
+    # real nested lengths: tokens up to and including the first end_id
+    # (the whole row when no end_id was ever produced)
+    from ..core.lod import NestedSeqArray
+
+    is_end = (sents == end_id)
+    first_end = jnp.argmax(is_end, axis=-1)            # 0 when none
+    any_end = is_end.any(axis=-1)
+    inner = jnp.where(any_end, first_end + 1,
+                      sents.shape[-1]).astype(jnp.int32)
+    outer = jnp.full((B,), W, jnp.int32)
+    return NestedSeqArray(sents, outer, inner), final_scores
 
 
 @primitive("batch_gather", inputs=["X", "Index"], stop_grad_slots=("Index",))

@@ -163,9 +163,11 @@ def lookup_table(ctx, w, ids):
     an XLA scatter-add; the SelectedRows sparse-rows container (reference
     selected_rows.h) is unnecessary on TPU because scatter-add into HBM is
     native.  padding_idx rows emit zeros (reference attr)."""
+    from ..core.lod import NestedSeqArray
+
+    nested = isinstance(ids, NestedSeqArray)
     seq = isinstance(ids, SeqArray)
-    lengths = ids.lengths if seq else None
-    idv = ids.data if seq else ids
+    idv = ids.data if (seq or nested) else ids
     if idv.ndim > 1 and idv.shape[-1] == 1:
         idv = idv.squeeze(-1)
     idv = idv.astype(jnp.int32)
@@ -173,7 +175,9 @@ def lookup_table(ctx, w, ids):
     pad = ctx.attr("padding_idx", None)
     if pad is not None:
         out = jnp.where((idv == pad)[..., None], 0.0, out)
-    return SeqArray(out, lengths) if seq else out
+    if nested:
+        return NestedSeqArray(out, ids.outer_lengths, ids.inner_lengths)
+    return SeqArray(out, ids.lengths) if seq else out
 
 
 @primitive("lookup_table_grad", inputs=["W", "Ids", "Out@GRAD"],
@@ -187,10 +191,11 @@ def lookup_table_grad(ctx, w, ids, og):
     written for huge-vocab tables; the optimizer applies it as a row
     scatter.  Dense mode is the plain scatter-add.
     """
+    from ..core.lod import NestedSeqArray
     from ..core.selected_rows import SelectedRows
 
-    idv = ids.data if isinstance(ids, SeqArray) else ids
-    ogv = og.data if isinstance(og, SeqArray) else og
+    idv = ids.data if isinstance(ids, (SeqArray, NestedSeqArray)) else ids
+    ogv = og.data if isinstance(og, (SeqArray, NestedSeqArray)) else og
     if idv.ndim > 1 and idv.shape[-1] == 1:
         idv = idv.squeeze(-1)
     rows = idv.reshape(-1).astype(jnp.int32)            # [N]

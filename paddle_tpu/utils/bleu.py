@@ -1,0 +1,88 @@
+"""Corpus BLEU — the NMT quality metric BASELINE.md's "BLEU matching
+single-GPU reference" target is scored with (the reference era scored
+generated translations with the standard Papineni corpus BLEU via its
+benchmark tooling; this is that metric, dependency-free).
+
+Standard corpus-level BLEU-4: clipped modified n-gram precision summed
+over the corpus, geometric mean over n=1..4, brevity penalty on corpus
+lengths.  Multi-reference supported (closest reference length, max
+clipping across references).  ``smooth`` adds +1 smoothing (Lin & Och)
+for short/sanity runs where a zero n-gram count would zero the score.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import List, Sequence
+
+__all__ = ["corpus_bleu", "sentence_bleu"]
+
+
+def _ngrams(tokens: Sequence, n: int) -> Counter:
+    return Counter(tuple(tokens[i: i + n])
+                   for i in range(len(tokens) - n + 1))
+
+
+def corpus_bleu(hypotheses: List[Sequence],
+                references: List[List[Sequence]],
+                max_n: int = 4, smooth: bool = False) -> float:
+    """BLEU over a corpus: ``hypotheses[i]`` is a token sequence,
+    ``references[i]`` a list of reference token sequences for it.
+    Tokens may be strings or ids — anything hashable."""
+    if len(hypotheses) != len(references):
+        raise ValueError("hypotheses and references must align")
+    def _is_token_seq(x) -> bool:
+        # a reference is a sequence of tokens; a token is a str/int/...
+        # (anything that is not itself a non-string sequence).  ndarray /
+        # tuple references inside the [[ref, ...]] nesting must NOT be
+        # re-wrapped as single tokens.
+        if isinstance(x, str) or not hasattr(x, "__iter__"):
+            return False
+        first = next(iter(x), None)
+        return first is None or isinstance(first, str) or \
+            not hasattr(first, "__iter__")
+
+    clipped = [0] * max_n
+    totals = [0] * max_n
+    hyp_len = ref_len = 0
+    for hyp, refs in zip(hypotheses, references):
+        if _is_token_seq(refs):      # a bare reference, not a list of them
+            refs = [list(refs)]
+        hyp = list(hyp)
+        hyp_len += len(hyp)
+        # closest reference length (ties -> shorter), per Papineni
+        ref_len += min((abs(len(r) - len(hyp)), len(r))
+                       for r in refs)[1]
+        for n in range(1, max_n + 1):
+            hgrams = _ngrams(hyp, n)
+            if not hgrams:
+                continue
+            max_ref = Counter()
+            for r in refs:
+                for g, c in _ngrams(list(r), n).items():
+                    if c > max_ref[g]:
+                        max_ref[g] = c
+            totals[n - 1] += sum(hgrams.values())
+            clipped[n - 1] += sum(min(c, max_ref[g])
+                                  for g, c in hgrams.items())
+    log_p = 0.0
+    for n in range(max_n):
+        c, t = clipped[n], totals[n]
+        if smooth and n > 0:
+            c, t = c + 1, t + 1
+        if c == 0 or t == 0:
+            return 0.0
+        log_p += math.log(c / t)
+    log_p /= max_n
+    bp = 1.0 if hyp_len > ref_len else (
+        math.exp(1.0 - ref_len / hyp_len) if hyp_len > 0 else 0.0)
+    return bp * math.exp(log_p)
+
+
+def sentence_bleu(hypothesis: Sequence, references: List[Sequence],
+                  max_n: int = 4, smooth: bool = True) -> float:
+    """Single-sentence convenience (smoothed by default — raw BLEU on one
+    sentence is almost always zero)."""
+    return corpus_bleu([hypothesis], [references], max_n=max_n,
+                      smooth=smooth)

@@ -29,8 +29,8 @@ from __future__ import annotations
 from .. import fluid as _fluid
 from ..utils import reader  # composable reader decorators  # noqa: F401
 from ..utils import reader as _reader_mod
-from . import (activation, data_type, event, inference, layer,  # noqa: F401
-               optimizer, parameters, pooling, trainer)
+from . import (activation, attr, data_type, event, inference,  # noqa: F401
+               layer, networks, optimizer, parameters, pooling, trainer)
 
 
 def batch(reader, batch_size, drop_last: bool = False):
@@ -43,7 +43,7 @@ from .. import datasets as dataset  # noqa: F401
 
 __all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
            "data_type", "event", "optimizer", "parameters", "trainer",
-           "inference", "infer", "dataset"]
+           "inference", "infer", "dataset", "networks", "attr"]
 
 _initialized = False
 
@@ -62,4 +62,8 @@ def init(use_gpu: bool = False, trainer_count: int = 1,
     if seed is not None:
         _fluid.default_main_program().random_seed = seed
         _fluid.default_startup_program().random_seed = seed
+        # reset the global rng-salt counter too: without this, random-op
+        # streams (param init, dropout) depend on how many programs were
+        # built earlier in the process — seeded init must be deterministic
+        _fluid.framework._rng_salt_counter[0] = 0
     _initialized = True

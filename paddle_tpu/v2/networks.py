@@ -1,0 +1,97 @@
+"""v2 network compositions — the capability surface of
+python/paddle/trainer_config_helpers/networks.py (simple_lstm,
+bidirectional_lstm, simple_gru, simple_img_conv_pool, VGG conv groups),
+composed from the fluid layer set instead of ModelConfig emission.
+"""
+
+from __future__ import annotations
+
+from ..fluid import layers as flayers
+from ..fluid import nets as fnets
+from . import layer as v2layer
+
+__all__ = ["simple_lstm", "simple_gru", "bidirectional_lstm",
+           "simple_img_conv_pool", "img_conv_group", "vgg_16_network"]
+
+
+def simple_lstm(input, size, reverse=False, act=None, gate_act=None,
+                param_attr=None, bias_attr=None, **kw):
+    """fc(4*size) + lstmemory (reference networks.py simple_lstm):
+    returns the hidden sequence."""
+    proj = flayers.fc(input=input, size=size * 4, bias_attr=False,
+                      num_flatten_dims=1, param_attr=param_attr)
+    return v2layer.lstmemory(proj, size=size, reverse=reverse, act=act,
+                             gate_act=gate_act, bias_attr=bias_attr)
+
+
+def simple_gru(input, size, reverse=False, act=None, gate_act=None,
+               param_attr=None, bias_attr=None, **kw):
+    """fc(3*size) + grumemory (reference networks.py simple_gru)."""
+    proj = flayers.fc(input=input, size=size * 3, bias_attr=False,
+                      num_flatten_dims=1, param_attr=param_attr)
+    return v2layer.grumemory(proj, size=size, reverse=reverse, act=act,
+                             gate_act=gate_act, bias_attr=bias_attr)
+
+
+def bidirectional_lstm(input, size, return_seq=False, **kw):
+    """Forward + backward simple_lstm (reference networks.py
+    bidirectional_lstm): concat of the two hidden sequences when
+    ``return_seq``, else concat of their last steps."""
+    fwd = simple_lstm(input, size)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_seq:
+        return flayers.concat(input=[fwd, bwd], axis=-1)
+    return flayers.concat(
+        input=[flayers.sequence_last_step(fwd),
+               flayers.sequence_last_step(bwd)], axis=-1)
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, pool_type="max", **kw):
+    """conv2d + pool2d (reference networks.py simple_img_conv_pool; the
+    recognize-digits chapter's building block)."""
+    from .layer import _act_name
+
+    conv = flayers.conv2d(input=input, num_filters=num_filters,
+                          filter_size=filter_size, act=_act_name(act))
+    return flayers.pool2d(input=conv, pool_size=pool_size,
+                          pool_stride=pool_stride, pool_type=pool_type)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_filter_size=3,
+                   conv_act=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                   pool_type="max", **kw):
+    """Stacked conv (+BN +dropout) block + one pool — reference
+    networks.py img_conv_group, the VGG building block."""
+    from .layer import _act_name
+
+    return fnets.img_conv_group(
+        input=input, conv_num_filter=conv_num_filter,
+        pool_size=pool_size, conv_filter_size=conv_filter_size,
+        conv_act=_act_name(conv_act),
+        conv_with_batchnorm=conv_with_batchnorm,
+        conv_batchnorm_drop_rate=conv_batchnorm_drop_rate,
+        pool_stride=pool_stride, pool_type=pool_type)
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """VGG-16 (reference networks.py vgg_16_network), fluid-composed."""
+    def block(ipt, n_filter, groups, dropouts):
+        return fnets.img_conv_group(
+            input=ipt, pool_size=2, pool_stride=2,
+            conv_num_filter=[n_filter] * groups, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts, pool_type="max")
+
+    tmp = block(input_image, 64, 2, [0.3, 0])
+    tmp = block(tmp, 128, 2, [0.4, 0])
+    tmp = block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = flayers.dropout(x=tmp, dropout_prob=0.5)
+    tmp = flayers.fc(input=tmp, size=4096, act=None)
+    tmp = flayers.batch_norm(input=tmp, act="relu")
+    tmp = flayers.dropout(x=tmp, dropout_prob=0.5)
+    tmp = flayers.fc(input=tmp, size=4096, act="relu")
+    return flayers.fc(input=tmp, size=num_classes, act="softmax")

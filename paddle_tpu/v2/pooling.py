@@ -7,6 +7,11 @@ class _Pool:
     def __init__(self, name):
         self.name = name
 
+    def __call__(self):
+        # reference scripts write paddle.pooling.Max() (a class they
+        # instantiate); accept both spellings
+        return self
+
 
 Max = _Pool("max")
 Avg = _Pool("average")

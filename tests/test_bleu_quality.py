@@ -1,0 +1,192 @@
+"""BLEU metric + the opt-in real-data quality tier (VERDICT r2
+missing#4 / next#5).
+
+The metric tests always run (incl. parity against nltk's reference
+implementation).  The quality tier trains on REAL downloaded data and
+asserts BASELINE.md's bars — opt-in via PADDLE_TPU_REAL_DATA=1 because
+it needs egress + minutes of compute; offline it skips WITH REASON, it
+never silently passes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils.bleu import corpus_bleu, sentence_bleu
+
+REAL = os.environ.get("PADDLE_TPU_REAL_DATA") == "1"
+real_data = pytest.mark.skipif(
+    not REAL, reason="real-data quality tier is opt-in: set "
+    "PADDLE_TPU_REAL_DATA=1 with network egress (downloads MNIST/WMT)")
+
+
+class TestBleuMetric:
+    def test_perfect_match_is_one(self):
+        hyp = "the cat sat on the mat".split()
+        assert corpus_bleu([hyp], [[hyp]]) == pytest.approx(1.0)
+
+    def test_no_overlap_is_zero(self):
+        assert corpus_bleu([list("abcd")], [[list("wxyz")]]) == 0.0
+
+    def test_clipping(self):
+        # "the the the" vs "the cat": p1 clipped to 1/3, p2 = 0 -> BLEU 0
+        assert corpus_bleu([["the", "the", "the"]],
+                           [[["the", "cat"]]]) == 0.0
+
+    def test_brevity_penalty(self):
+        hyp = "the cat".split()
+        ref = "the cat sat on the mat".split()
+        got = corpus_bleu([hyp], [[ref]], max_n=2)
+        # p1 = 1, p2 = 1, bp = exp(1 - 6/2)
+        assert got == pytest.approx(np.exp(1 - 6 / 2), rel=1e-6)
+
+    def test_matches_nltk_reference_implementation(self):
+        from nltk.translate.bleu_score import corpus_bleu as nltk_bleu
+
+        rng = np.random.RandomState(0)
+        hyps, refs = [], []
+        vocab = [f"w{i}" for i in range(30)]
+        for _ in range(20):
+            n = rng.randint(5, 15)
+            ref = [vocab[i] for i in rng.randint(0, 30, n)]
+            hyp = list(ref)
+            for _ in range(rng.randint(0, 4)):    # corrupt a few tokens
+                hyp[rng.randint(0, len(hyp))] = vocab[rng.randint(0, 30)]
+            hyps.append(hyp)
+            refs.append([ref])
+        ours = corpus_bleu(hyps, refs)
+        theirs = nltk_bleu(refs, hyps)
+        assert ours == pytest.approx(theirs, rel=1e-9)
+
+    def test_multi_reference_clipping_and_length(self):
+        from nltk.translate.bleu_score import corpus_bleu as nltk_bleu
+
+        hyp = "the fast brown fox".split()
+        r1 = "the quick brown fox jumps".split()
+        r2 = "a fast brown fox leapt over".split()
+        ours = corpus_bleu([hyp], [[r1, r2]])
+        theirs = nltk_bleu([[r1, r2]], [hyp])
+        assert ours == pytest.approx(theirs, rel=1e-9)
+
+    def test_sentence_bleu_smoothed_nonzero(self):
+        got = sentence_bleu("the small cat".split(),
+                            ["the tiny cat".split()])
+        assert 0.0 < got < 1.0
+
+    def test_ids_as_tokens(self):
+        assert corpus_bleu([[1, 2, 3, 4]], [[[1, 2, 3, 4]]]) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# real-data quality tier (opt-in)
+# ---------------------------------------------------------------------------
+
+@real_data
+def test_mnist_top1_accuracy_real():
+    """BASELINE.md: 'SGD top-1 parity' — ≥97% test top-1 on real MNIST
+    with the recognize-digits conv net."""
+    import jax
+
+    from paddle_tpu import fluid
+    from paddle_tpu.datasets import mnist
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.layers.data("img", [1, 28, 28], "float32")
+        label = fluid.layers.data("label", [1], "int64")
+        c1 = fluid.nets.simple_img_conv_pool(img, 20, 5, 2, 2, act="relu")
+        c2 = fluid.nets.simple_img_conv_pool(c1, 50, 5, 2, 2, act="relu")
+        pred = fluid.layers.fc(input=c2, size=10, act="softmax")
+        cost = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    train_rows = list(mnist.train()())
+    test_rows = list(mnist.test()())
+    assert len(train_rows) >= 50000, "expected REAL mnist (60k rows)"
+
+    def batches(rows, bs):
+        for i in range(0, len(rows) - bs + 1, bs):
+            chunk = rows[i: i + bs]
+            x = np.stack([r[0].reshape(1, 28, 28) for r in chunk])
+            y = np.asarray([[r[1]] for r in chunk], np.int64)
+            yield x, y
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(3):
+            for x, y in batches(train_rows, 128):
+                exe.run(main, feed={"img": x, "label": y},
+                        fetch_list=[cost])
+        correct = total = 0
+        for x, y in batches(test_rows, 500):
+            p, = exe.run(test_prog, feed={"img": x, "label": y},
+                         fetch_list=[pred], mode="infer")
+            correct += (np.asarray(p).argmax(1) == y[:, 0]).sum()
+            total += len(y)
+    top1 = correct / total
+    print(f"MNIST top-1: {top1:.4f} ({correct}/{total})")
+    assert top1 >= 0.97, top1
+
+
+@real_data
+def test_nmt_bleu_real():
+    """Train the seq2seq model on real WMT16 pairs and record corpus
+    BLEU of greedy decodes (the BASELINE.md 'achieved' number)."""
+    from paddle_tpu import fluid
+    from paddle_tpu.datasets import wmt16
+    from paddle_tpu.fluid.core.lod import make_seq
+    from paddle_tpu.models import machine_translation as mt
+
+    dict_size = 2000
+    rows = []
+    for i, r in enumerate(wmt16.train(dict_size)()):
+        rows.append(r)
+        if i >= 4999:
+            break
+    assert len(rows) >= 1000, "expected real wmt16 data"
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        src = fluid.layers.data("src", [1], "int64", lod_level=1)
+        trg = fluid.layers.data("trg", [1], "int64", lod_level=1)
+        nxt = fluid.layers.data("nxt", [1], "int64", lod_level=1)
+        avg_cost, _ = mt.train_model(src, trg, nxt, dict_size,
+                                     word_dim=64, hidden_dim=128)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        ids_out, _ = mt.decode_model(src, dict_size, word_dim=64,
+                                     hidden_dim=128, beam_size=3,
+                                     max_length=16)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+
+    def batch(rs):
+        return (make_seq([r[0] for r in rs], dtype=np.int64),
+                make_seq([r[2] for r in rs], dtype=np.int64),
+                make_seq([r[1] for r in rs], dtype=np.int64))
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(2):
+            for i in range(0, len(rows) - 32, 32):
+                s, n, t = batch(rows[i: i + 32])
+                exe.run(main, feed={"src": s, "trg": t, "nxt": n},
+                        fetch_list=[avg_cost])
+        hyps, refs = [], []
+        for i in range(0, 512, 32):
+            s, n, _ = batch(rows[i: i + 32])
+            out, = exe.run(main, feed={"src": s}, fetch_list=[ids_out],
+                           return_numpy=False, mode="infer")
+            best = np.asarray(out)[:, 0]        # top beam [B, T]
+            for b in range(best.shape[0]):
+                hyp = [int(w) for w in best[b] if w > 1]   # strip pads
+                ref = [int(w) for w in np.asarray(n.data)[b]
+                       if w > 1]
+                hyps.append(hyp)
+                refs.append([ref])
+    bleu = corpus_bleu(hyps, refs, smooth=True)
+    print(f"NMT corpus BLEU (train-subset decode): {bleu:.4f}")
+    assert bleu > 0.0

@@ -197,3 +197,270 @@ def test_v2_embedding_requires_int_data_layer():
     x = paddle.layer.data(name="xf", type=paddle.data_type.dense_vector(4))
     with pytest.raises(ValueError, match="integer data layer"):
         paddle.layer.embedding(input=x, size=8)
+
+
+# ---------------------------------------------------------------------------
+# r3: recurrent DSL (VERDICT r2 next#4) — sentiment-LSTM and seq2seq
+# v2-style scripts train through SGD.train with an import swap.
+# ---------------------------------------------------------------------------
+
+def _seq_cls_reader(rng, vocab, n=48, max_len=6):
+    """Synthetic 'sentiment': label = whether ids are mostly high.
+    Fixed dataset (generated once) so multi-pass training converges."""
+    data = []
+    for _ in range(n):
+        ln = rng.randint(2, max_len + 1)
+        ids = rng.randint(0, vocab, ln)
+        data.append((ids.tolist(), int(ids.mean() > vocab / 2)))
+
+    def reader():
+        yield from data
+
+    return reader
+
+
+def test_v2_sentiment_lstm_trains():
+    """understand_sentiment-style v2 script: embedding -> simple_lstm ->
+    seq pool -> softmax fc (reference book ch.06 / networks.simple_lstm)."""
+    vocab = 30
+    paddle.init(seed=11)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=16)
+    lstm_h = paddle.networks.simple_lstm(input=emb, size=16)
+    pooled = paddle.layer.pool(input=lstm_h,
+                               pool_type=paddle.pooling.Max())
+    pred = paddle.layer.fc(input=pooled, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+    costs = []
+
+    def handler(ev):
+        if isinstance(ev, paddle.event.EndIteration):
+            costs.append(ev.cost)
+
+    rng = np.random.RandomState(0)
+    trainer.train(reader=paddle.batch(_seq_cls_reader(rng, vocab), 16),
+                  num_passes=16, event_handler=handler,
+                  feeding={"words": 0, "label": 1})
+    assert np.mean(costs[-3:]) < costs[0] * 0.8, costs[::6]
+
+
+def test_v2_recurrent_group_memory_fc():
+    """Vanilla-RNN via recurrent_group + name-linked memory (reference
+    layers.py memory/recurrent_group pattern): the fc named 'rnn_state'
+    updates the memory that reads it one step back."""
+    vocab, hidden = 20, 12
+    paddle.init(seed=5)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=8)
+
+    def step(y):
+        mem = paddle.layer.memory(name="rnn_state", size=hidden)
+        return paddle.layer.fc(input=[y, mem], size=hidden,
+                               act=paddle.activation.Tanh(),
+                               name="rnn_state")
+
+    rnn_out = paddle.layer.recurrent_group(step=step, input=emb)
+    last = paddle.layer.last_seq(rnn_out)
+    pred = paddle.layer.fc(input=last, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+    costs = []
+    rng = np.random.RandomState(1)
+    trainer.train(
+        reader=paddle.batch(_seq_cls_reader(rng, vocab), 16),
+        num_passes=6,
+        event_handler=lambda ev: costs.append(ev.cost)
+        if isinstance(ev, paddle.event.EndIteration) else None,
+        feeding={"words": 0, "label": 1})
+    assert costs[-1] < costs[0], costs[::6]
+
+
+def test_v2_seq2seq_encoder_decoder_trains():
+    """machine_translation-style v2 script: GRU encoder, decoder
+    recurrent_group with encoder context as StaticInput + boot-from-
+    encoder memory, per-step softmax over the target vocab."""
+    src_vocab, trg_vocab, hidden = 16, 18, 10
+    paddle.init(seed=9)
+    src = paddle.layer.data(
+        name="src", type=paddle.data_type.integer_value_sequence(src_vocab))
+    trg = paddle.layer.data(
+        name="trg", type=paddle.data_type.integer_value_sequence(trg_vocab))
+    trg_next = paddle.layer.data(
+        name="trg_next",
+        type=paddle.data_type.integer_value_sequence(trg_vocab))
+
+    src_emb = paddle.layer.embedding(input=src, size=8)
+    enc = paddle.networks.simple_gru(input=src_emb, size=hidden)
+    enc_last = paddle.layer.last_seq(enc)
+
+    trg_emb = paddle.layer.embedding(input=trg, size=8)
+
+    def decoder_step(cur_word, enc_ctx):
+        mem = paddle.layer.memory(name="dec_state", size=hidden,
+                                  boot_layer=enc_last)
+        out = paddle.layer.fc(input=[cur_word, mem, enc_ctx],
+                              size=hidden, act=paddle.activation.Tanh(),
+                              name="dec_state")
+        return out
+
+    dec = paddle.layer.recurrent_group(
+        step=decoder_step,
+        input=[trg_emb, paddle.layer.StaticInput(enc_last)])
+    pred = paddle.layer.fc(input=dec, size=trg_vocab,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=trg_next)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+
+    def reader():
+        rng = np.random.RandomState(2)
+        for _ in range(32):
+            ln = rng.randint(2, 5)
+            s = rng.randint(0, src_vocab, ln).tolist()
+            # toy copy-ish task: target mirrors source mod trg_vocab
+            t = [x % trg_vocab for x in s]
+            yield s, t, t[1:] + [0]
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, 8), num_passes=6,
+        event_handler=lambda ev: costs.append(ev.cost)
+        if isinstance(ev, paddle.event.EndIteration) else None,
+        feeding={"src": 0, "trg": 1, "trg_next": 2})
+    assert costs[-1] < costs[0], costs[::8]
+
+
+def test_v2_bidirectional_lstm():
+    vocab = 24
+    paddle.init(seed=3)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=8)
+    bi = paddle.networks.bidirectional_lstm(input=emb, size=6)
+    pred = paddle.layer.fc(input=bi, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+    costs = []
+    rng = np.random.RandomState(4)
+    trainer.train(
+        reader=paddle.batch(_seq_cls_reader(rng, vocab), 12),
+        num_passes=4,
+        event_handler=lambda ev: costs.append(ev.cost)
+        if isinstance(ev, paddle.event.EndIteration) else None,
+        feeding={"words": 0, "label": 1})
+    assert costs[-1] < costs[0], costs
+
+
+def test_v2_beam_search_generation():
+    """Generation-mode recurrent step via paddle.layer.beam_search
+    (reference layers.py beam_search / GeneratedInput): the trained
+    decoder step generates sequences with beam expansion + decode."""
+    src_vocab, trg_vocab, hidden, emb_dim = 14, 15, 10, 8
+    BOS, EOS = 0, 1
+    paddle.init(seed=13)
+    src = paddle.layer.data(
+        name="src", type=paddle.data_type.integer_value_sequence(src_vocab))
+    trg = paddle.layer.data(
+        name="trg", type=paddle.data_type.integer_value_sequence(trg_vocab))
+    trg_next = paddle.layer.data(
+        name="trg_next",
+        type=paddle.data_type.integer_value_sequence(trg_vocab))
+
+    src_emb = paddle.layer.embedding(input=src, size=emb_dim)
+    enc = paddle.networks.simple_gru(input=src_emb, size=hidden)
+    enc_last = paddle.layer.last_seq(enc)
+
+    dec_fc = paddle.attr.Param(name="gen_dec_fc_w")
+    dec_fc_b = paddle.attr.Param(name="gen_dec_fc_b")
+    out_fc = paddle.attr.Param(name="gen_out_fc_w")
+    out_fc_b = paddle.attr.Param(name="gen_out_fc_b")
+
+    def decoder_step(cur_word, enc_ctx):
+        mem = paddle.layer.memory(name="gen_state", size=hidden,
+                                  boot_layer=enc_last)
+        merged = paddle.layer.concat([cur_word, mem, enc_ctx])
+        h = paddle.layer.fc(input=merged, size=hidden,
+                            act=paddle.activation.Tanh(),
+                            name="gen_state", param_attr=dec_fc,
+                            bias_attr=dec_fc_b)
+        score = paddle.layer.fc(input=h, size=trg_vocab,
+                                act=paddle.activation.Softmax(),
+                                param_attr=out_fc, bias_attr=out_fc_b)
+        return h, score
+
+    # training tower: teacher forcing through the SAME step function
+    trg_emb = paddle.layer.embedding(
+        input=trg, size=emb_dim,
+        param_attr=paddle.attr.Param(name="trg_emb_w"))
+    _, score_seq = paddle.layer.recurrent_group(
+        step=decoder_step,
+        input=[trg_emb, paddle.layer.StaticInput(enc_last)])
+    cost = paddle.layer.classification_cost(input=score_seq,
+                                            label=trg_next)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+
+    def reader():
+        rng = np.random.RandomState(6)
+        for _ in range(24):
+            ln = rng.randint(2, 5)
+            s = rng.randint(2, src_vocab, ln).tolist()
+            t = [x % (trg_vocab - 2) + 2 for x in s]
+            yield s, [BOS] + t, t + [EOS]
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, 8), num_passes=4,
+        event_handler=lambda ev: costs.append(ev.cost)
+        if isinstance(ev, paddle.event.EndIteration) else None,
+        feeding={"src": 0, "trg": 1, "trg_next": 2})
+    assert costs[-1] < costs[0], costs
+
+    # generation tower: same step fn + shared params, beam expansion
+    beam_ids, beam_scores = paddle.layer.beam_search(
+        step=decoder_step,
+        input=[paddle.layer.GeneratedInput(
+                   size=trg_vocab, embedding_name="trg_emb_w",
+                   embedding_size=emb_dim),
+               paddle.layer.StaticInput(enc_last)],
+        bos_id=BOS, eos_id=EOS, beam_size=3, max_length=6)
+
+    inferer = paddle.inference.Inference(
+        output_layer=[beam_ids, beam_scores], parameters=parameters)
+    rows = [([3, 5, 2],), ([4, 2, 6, 7],)]
+    ids_out, scores_out = inferer.infer(input=rows, feeding={"src": 0})
+    ids_out = np.asarray(ids_out)
+    scores_out = np.asarray(scores_out)
+    assert ids_out.shape[0] == 2 and ids_out.shape[1] == 3  # [B, W, T]
+    assert np.isfinite(scores_out).all()
+    # every hypothesis is made of target-vocab ids
+    assert ((ids_out >= 0) & (ids_out < trg_vocab)).all()
