@@ -148,7 +148,8 @@ def bench_transformer(batch: int, steps: int, trials: int,
             src_vocab_size=vocab, trg_vocab_size=vocab,
             max_length=seq_len + 1, dropout_rate=0.1,
             src_seq_len=seq_len, trg_seq_len=seq_len, fused=True,
-            materialize_attn_bias=False, fused_vocab_loss=True, **cfg)
+            materialize_attn_bias=False, fused_vocab_loss=True,
+            amp_dtype="bfloat16", **cfg)
         fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
 
     rng = np.random.RandomState(0)

@@ -45,10 +45,15 @@ def parse_flowers(data_tar: str, label_mat: str, setid_mat: str,
     ids = scipy.io.loadmat(setid_mat)[flag][0]
     with tarfile.open(data_tar, "r") as f:
         members = {m.name: m for m in f}
-        for idx in ids:
-            name = f"jpg/image_{int(idx):05d}.jpg"
-            if name not in members:
-                continue
+        # read in ARCHIVE order: the .tgz stream cannot seek backwards
+        # without re-decompressing from byte 0, so setid-order random
+        # access would re-inflate the ~330 MB archive per image.  Sample
+        # order changes vs the reference; shuffle downstream as usual.
+        wanted = [(members[n].offset, idx, n)
+                  for idx in ids
+                  for n in [f"jpg/image_{int(idx):05d}.jpg"]
+                  if n in members]
+        for _, idx, name in sorted(wanted):
             raw = f.extractfile(members[name]).read()
             img = image.load_image_bytes(raw)
             img = image.simple_transform(img, resize_size=size + 32,

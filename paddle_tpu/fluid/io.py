@@ -266,7 +266,11 @@ def prune_program(program: Program, targets: List[Variable]) -> Program:
     # graph: the reverse slice sees the optimizer update as "the writer" of
     # a needed parameter and chases grads all the way back to the labels.
     # Train-only ops are exactly those touching an @GRAD-suffixed var
-    # (every grad op and every optimizer update reads one).
+    # (every grad op and every optimizer update reads one).  Skipped when
+    # the caller explicitly targets a gradient (debug slices of @GRAD
+    # vars must keep their producers).
+    want_grads = any(n.endswith("@GRAD") for n in needed)
+
     def _touches_grad(od) -> bool:
         for ns in list(od.inputs.values()) + list(od.outputs.values()):
             for n in ns:
@@ -274,7 +278,8 @@ def prune_program(program: Program, targets: List[Variable]) -> Program:
                     return True
         return False
 
-    kept_descs = [od for od in block.desc.ops if not _touches_grad(od)]
+    kept_descs = (block.desc.ops if want_grads else
+                  [od for od in block.desc.ops if not _touches_grad(od)])
     if len(kept_descs) != len(block.desc.ops):
         kept = {id(od) for od in kept_descs}
         block.desc.ops = kept_descs

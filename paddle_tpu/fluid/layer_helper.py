@@ -73,6 +73,15 @@ class LayerHelper:
     def create_parameter(self, attr: ParamAttr, shape, dtype,
                          is_bias: bool = False, default_initializer=None,
                          suffix: Optional[str] = None) -> Parameter:
+        if str(dtype) in ("bfloat16", "float16") and \
+                not getattr(attr, "keep_dtype", False):
+            # master-weight rule: parameters live in f32 regardless of the
+            # activation dtype; the op emitters cast weights down at the
+            # matmul/conv/bias (ops/math_ops.py match_master_dtype), and
+            # optimizer updates run in full precision — the standard TPU
+            # AMP recipe.  ParamAttr(keep_dtype=True) opts a parameter out
+            # (deliberate half-precision storage).
+            dtype = "float32"
         suffix = suffix or ("b" if is_bias else "w")
         name = attr.name or unique_name.generate(f"{self.name}.{suffix}")
         init = (attr.initializer or default_initializer

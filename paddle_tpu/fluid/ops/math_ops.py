@@ -23,6 +23,22 @@ def _flatten_2d(x, num_col_dims: int):
     return x.reshape(lead, -1)
 
 
+def match_master_dtype(x, y):
+    """Master-weight mixed precision — THE shared AMP dtype rule (used
+    by mul/elementwise here and by the conv family in nn_ops): bf16
+    activations X with f32 params Y compute in the activation dtype
+    instead of numpy-promoting everything back to f32.  Same-dtype (and
+    non-float) operands pass through untouched (the reference requires
+    matching dtypes)."""
+    if jnp.issubdtype(x.dtype, jnp.floating) and \
+            jnp.issubdtype(y.dtype, jnp.floating) and x.dtype != y.dtype:
+        y = y.astype(x.dtype)
+    return y
+
+
+_match_master_dtype = match_master_dtype
+
+
 @primitive("mul", inputs=["X", "Y"], seq_transparent=True)
 def mul(ctx, x, y):
     """Projection matmul (reference mul_op.cc): flattens X/Y to 2-D per
@@ -30,7 +46,7 @@ def mul(ctx, x, y):
     xd = ctx.attr("x_num_col_dims", 1)
     yd = ctx.attr("y_num_col_dims", 1)
     x2 = _flatten_2d(x, xd)
-    y2 = _flatten_2d(y, yd)
+    y2 = _flatten_2d(_match_master_dtype(x, y), yd)
     out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
     return out.reshape(*x.shape[:xd], *y.shape[yd:])
 
@@ -62,6 +78,7 @@ def _bcast_to_x(x, y, axis: int):
 def _elementwise(name, fn):
     @primitive(name, inputs=["X", "Y"], seq_transparent=True)
     def _op(ctx, x, y, _fn=fn):
+        y = _match_master_dtype(x, y)   # bf16 act + f32 bias stays bf16
         y = _bcast_to_x(x, y, ctx.attr("axis", -1))
         return _fn(x, y)
     _op.__name__ = name

@@ -17,12 +17,11 @@ from ..core.registry import primitive
 
 
 def _match_conv_dtype(x, w):
-    """Master-weight mixed precision: bf16 activations with f32 params
-    compute in the activation dtype (the MXU-native path); lax.conv
-    rejects mixed operand dtypes."""
-    if x.dtype != w.dtype:
-        w = w.astype(x.dtype)
-    return w
+    """Master-weight mixed precision for convs (lax.conv rejects mixed
+    operand dtypes) — delegates to the shared AMP rule in math_ops."""
+    from .math_ops import match_master_dtype
+
+    return match_master_dtype(x, w)
 
 
 def _conv_pet(x):

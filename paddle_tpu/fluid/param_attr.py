@@ -16,7 +16,8 @@ class ParamAttr:
     def __init__(self, name: Optional[str] = None, initializer=None,
                  learning_rate: float = 1.0, regularizer=None,
                  trainable: bool = True, gradient_clip=None,
-                 sharding: Optional[Sequence[Optional[str]]] = None):
+                 sharding: Optional[Sequence[Optional[str]]] = None,
+                 keep_dtype: bool = False):
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate
@@ -24,6 +25,10 @@ class ParamAttr:
         self.trainable = trainable
         self.gradient_clip = gradient_clip
         self.sharding = sharding
+        # True: store the parameter in the exact dtype requested, opting
+        # out of the master-weight f32 rewrite for bf16/f16 params (e.g.
+        # a deliberately half-precision frozen embedding table)
+        self.keep_dtype = keep_dtype
 
     @staticmethod
     def to_attr(arg) -> "ParamAttr":

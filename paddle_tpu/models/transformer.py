@@ -166,13 +166,19 @@ def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
 
 
 def prepare_embedding(word_ids, pos_ids, vocab_size, max_length, d_model,
-                      dropout_rate=0.0, emb_name=None):
+                      dropout_rate=0.0, emb_name=None, amp_dtype=None):
     word_emb = layers.embedding(
         input=word_ids, size=[vocab_size, d_model],
         param_attr=emb_name)
     word_emb = layers.scale(word_emb, scale=float(d_model) ** 0.5)
     pos_emb = layers.embedding(input=pos_ids, size=[max_length, d_model])
     out = layers.elementwise_add(word_emb, pos_emb)
+    if amp_dtype:
+        # one cast at the activation source: every downstream matmul /
+        # add / norm keeps the activation dtype (master-weight rule in
+        # ops/math_ops.py), halving activation HBM traffic on a
+        # bandwidth-bound chip (BENCH_NOTES.md §2)
+        out = layers.cast(out, amp_dtype)
     if dropout_rate:
         out = layers.dropout(out, dropout_prob=dropout_rate)
     return out
@@ -181,9 +187,9 @@ def prepare_embedding(word_ids, pos_ids, vocab_size, max_length, d_model,
 def wrap_encoder(src_word, src_pos, src_slf_attn_bias, src_vocab_size,
                  max_length, n_layer, n_head, d_key, d_value, d_model,
                  d_inner_hid, dropout_rate=0.0, mp_shard=False, fused=False,
-                 seq_parallel=False):
+                 seq_parallel=False, amp_dtype=None):
     emb = prepare_embedding(src_word, src_pos, src_vocab_size, max_length,
-                            d_model, dropout_rate)
+                            d_model, dropout_rate, amp_dtype=amp_dtype)
     return encoder(emb, src_slf_attn_bias, n_layer, n_head, d_key, d_value,
                    d_model, d_inner_hid, dropout_rate, mp_shard, fused,
                    seq_parallel)
@@ -194,7 +200,7 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                 d_inner_hid=2048, dropout_rate=0.1, src_seq_len=32,
                 trg_seq_len=32, mp_shard=False, fused=False,
                 seq_parallel=False, materialize_attn_bias=True,
-                fused_vocab_loss=False):
+                fused_vocab_loss=False, amp_dtype=None):
     """Build the full training graph; returns (avg_cost, predict, feed_vars).
 
     Data vars (dense, static seq lens — bucket on the host side):
@@ -235,9 +241,11 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
     enc_output = wrap_encoder(src_word, src_pos, src_slf_attn_bias,
                               src_vocab_size, max_length, n_layer, n_head,
                               d_key, d_value, d_model, d_inner_hid,
-                              dropout_rate, mp_shard, fused, seq_parallel)
+                              dropout_rate, mp_shard, fused, seq_parallel,
+                              amp_dtype=amp_dtype)
     dec_emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size,
-                                max_length, d_model, dropout_rate)
+                                max_length, d_model, dropout_rate,
+                                amp_dtype=amp_dtype)
     dec_output = decoder(dec_emb, enc_output, trg_slf_attn_bias,
                          trg_src_attn_bias, n_layer, n_head, d_key, d_value,
                          d_model, d_inner_hid, dropout_rate, mp_shard,

@@ -36,19 +36,20 @@ def corpus_bleu(hypotheses: List[Sequence],
         # a reference is a sequence of tokens; a token is a str/int/...
         # (anything that is not itself a non-string sequence).  ndarray /
         # tuple references inside the [[ref, ...]] nesting must NOT be
-        # re-wrapped as single tokens.
-        if isinstance(x, str) or not hasattr(x, "__iter__"):
-            return False
-        first = next(iter(x), None)
-        return first is None or isinstance(first, str) or \
-            not hasattr(first, "__iter__")
+        # re-wrapped as single tokens.  `x` must already be a list —
+        # probing is by indexing, never by consuming an iterator.
+        if not x:
+            return True
+        first = x[0]
+        return isinstance(first, str) or not hasattr(first, "__iter__")
 
     clipped = [0] * max_n
     totals = [0] * max_n
     hyp_len = ref_len = 0
     for hyp, refs in zip(hypotheses, references):
+        refs = list(refs)            # one-shot iterators: materialise first
         if _is_token_seq(refs):      # a bare reference, not a list of them
-            refs = [list(refs)]
+            refs = [refs]
         hyp = list(hyp)
         hyp_len += len(hyp)
         # closest reference length (ties -> shorter), per Papineni

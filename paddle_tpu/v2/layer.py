@@ -439,8 +439,15 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,
             _rnn_ctx.pop()
         outs_t = out if isinstance(out, (list, tuple)) else (out,)
         pending = [n for n, v in gen_ctx["updated"].items() if v is None]
-        if len(pending) == 1 and len(outs_t) >= 1:
+        if len(pending) == 1 and len(outs_t) == 1:
+            # single-output step + single anonymous memory only — same
+            # rule as recurrent_group; a multi-output step must name the
+            # updating layer or garbage would bind as the state
             gen_ctx["updated"][pending[0]] = outs_t[0]
+        elif pending:
+            raise ValueError(
+                f"beam_search: memories {pending} were never updated — "
+                f"give the updating layer the memory's name (name=...)")
         scores2d = outs_t[-1] if len(outs_t) > 1 else outs_t[0]
         # the step's final output must be the per-word distribution
         cur_score = flayers.reshape(scores2d, [-1, W, gen.size])
