@@ -383,7 +383,8 @@ class Executor:
                tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))
         from ..utils.flags import FLAGS
 
-        compiled, state_sh = self._cache.get(key, (None, None))
+        compiled, state_sh, feed_sh = self._cache.get(key,
+                                                      (None, None, None))
         if compiled is not None:
             self._cache.move_to_end(key)
         if compiled is None:
@@ -412,8 +413,9 @@ class Executor:
                                    in_shardings=(feed_sh, state_sh, rng_sh))
             else:
                 compiled = jax.jit(step, donate_argnums=(1,))
+                feed_sh = None
             self._cache[key] = (compiled, state_sh if mesh is not None
-                                else None)
+                                else None, feed_sh)
             while len(self._cache) > self.CACHE_CAPACITY:
                 self._cache.popitem(last=False)
 
@@ -429,11 +431,46 @@ class Executor:
                         and cur != target:
                     state_vals[n] = jax.device_put(v, target)
 
+        rng_bits = scope.next_rng_bits(program.random_seed)
+        if mesh is not None and jax.process_count() > 1:
+            # multi-host SPMD: jit rejects host numpy under non-trivial
+            # shardings.  Feeds are GLOBAL batches (every process passes
+            # the same array — single-process semantics preserved); each
+            # process materialises only its addressable shards.  This is
+            # where the reference's trainer sharded data across pserver
+            # trainers; per-host input pipelines can still pass
+            # pre-sharded jax.Arrays directly.
+            def _globalize(v, sh, name, what):
+                if isinstance(v, jax.Array) or sh is None:
+                    return v
+                if isinstance(v, SeqArray):
+                    if isinstance(v.data, jax.Array) and \
+                            isinstance(v.lengths, jax.Array):
+                        return v
+                    raise NotImplementedError(
+                        f"multi-host SPMD: {what} {name!r} is a SeqArray "
+                        f"with host-numpy contents; pass BOTH data and "
+                        f"lengths as device arrays (jax.Array) — host "
+                        f"numpy sequence values are single-process only")
+                a = np.asarray(v)
+                return jax.make_array_from_callback(
+                    a.shape, sh, lambda idx: a[idx])
+
+            feed = {n: _globalize(v, (feed_sh or {}).get(n), n, "feed")
+                    for n, v in feed.items()}
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(mesh, PartitionSpec())
+            state_vals = {n: _globalize(v, state_sh.get(n, repl), n,
+                                        "state var")
+                          for n, v in state_vals.items()}
+            rng_bits = _globalize(np.asarray(rng_bits), repl, "__rng__",
+                                  "rng")
+
         from .profiler import record_event
 
         with record_event(f"executor_step/{mode}"):
-            fetches, new_state = compiled(
-                feed, state_vals, scope.next_rng_bits(program.random_seed))
+            fetches, new_state = compiled(feed, state_vals, rng_bits)
             if FLAGS["benchmark"]:
                 jax.block_until_ready(fetches)
         if FLAGS["check_nan_inf"]:

@@ -70,8 +70,11 @@ def feed_sharding(mesh: Mesh, value):
     dp = _dp_axes(mesh)
 
     def leaf(v):
-        arr = np.asarray(v)
-        if dp and arr.ndim >= 1 and arr.shape[0] % mesh.shape[dp[0]] == 0:
+        # shape/dtype attrs only: np.asarray on a process-spanning global
+        # jax.Array raises (non-addressable shards), and pre-sharded
+        # device feeds are exactly the multi-host fast path
+        shape = tuple(getattr(v, "shape", np.asarray(v).shape))
+        if dp and len(shape) >= 1 and shape[0] % mesh.shape[dp[0]] == 0:
             return NamedSharding(mesh, PartitionSpec(dp[0]))
         return NamedSharding(mesh, PartitionSpec())
 
@@ -88,13 +91,14 @@ def state_sharding(mesh: Mesh, value, annotation: Optional[Sequence]):
     first dim divisible by the axis size — preferring the annotated dim —
     or drops out entirely if none divides."""
     def leaf(v, ann):
-        arr = np.asarray(v)
+        shape = tuple(getattr(v, "shape", None) or np.asarray(v).shape)
+        ndim = len(shape)
         if not ann:
             return NamedSharding(mesh, PartitionSpec())
-        ann = (list(ann) + [None] * arr.ndim)[: arr.ndim]
-        spec = [None] * arr.ndim
+        ann = (list(ann) + [None] * ndim)[: ndim]
+        spec = [None] * ndim
         deferred = []
-        for i, (d, ax) in enumerate(zip(arr.shape, ann)):
+        for i, (d, ax) in enumerate(zip(shape, ann)):
             if ax is None:
                 continue
             if isinstance(ax, str) and ax.endswith("?"):
@@ -105,8 +109,8 @@ def state_sharding(mesh: Mesh, value, annotation: Optional[Sequence]):
             if ax not in mesh.axis_names or ax in spec:
                 continue
             size = mesh.shape[ax]
-            for j in [i] + [k for k in range(arr.ndim) if k != i]:
-                if spec[j] is None and arr.shape[j] % size == 0:
+            for j in [i] + [k for k in range(ndim) if k != i]:
+                if spec[j] is None and shape[j] % size == 0:
                     spec[j] = ax
                     break
         return NamedSharding(mesh, PartitionSpec(*spec))
