@@ -164,9 +164,10 @@ def test_nmt_bleu_real():
     exe = fluid.Executor(fluid.TPUPlace(0))
 
     def batch(rs):
+        # wmt16 rows are (src_ids, trg_ids_next, trg_ids_in)
         return (make_seq([r[0] for r in rs], dtype=np.int64),
-                make_seq([r[2] for r in rs], dtype=np.int64),
-                make_seq([r[1] for r in rs], dtype=np.int64))
+                make_seq([r[1] for r in rs], dtype=np.int64),
+                make_seq([r[2] for r in rs], dtype=np.int64))
 
     with fluid.scope_guard(scope):
         exe.run(startup)
@@ -176,9 +177,11 @@ def test_nmt_bleu_real():
                 exe.run(main, feed={"src": s, "trg": t, "nxt": n},
                         fetch_list=[avg_cost])
         hyps, refs = [], []
+        infer_prog = fluid.io.prune_program(main, [ids_out])
         for i in range(0, 512, 32):
             s, n, _ = batch(rows[i: i + 32])
-            out, = exe.run(main, feed={"src": s}, fetch_list=[ids_out],
+            out, = exe.run(infer_prog, feed={"src": s},
+                           fetch_list=[ids_out],
                            return_numpy=False, mode="infer")
             best = np.asarray(out)[:, 0]        # top beam [B, T]
             for b in range(best.shape[0]):
