@@ -120,8 +120,8 @@ def sequence_expand(x, y, name=None):
 
 def sequence_concat(input, name=None):
     helper = LayerHelper("sequence_concat", name=name)
-    out = helper.create_tmp_variable(helper.input_dtype() if isinstance(
-        input, (list, tuple)) else input.dtype, lod_level=1)
+    first = input[0] if isinstance(input, (list, tuple)) else input
+    out = helper.create_tmp_variable(first.dtype, lod_level=1)
     helper.append_op("sequence_concat", {"X": input}, {"Out": out})
     return out
 

@@ -23,7 +23,8 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "max_id", "concat", "dropout", "pool",
            "recurrent_group", "memory", "StaticInput", "lstmemory",
            "grumemory", "last_seq", "first_seq",
-           "beam_search", "GeneratedInput"]
+           "beam_search", "GeneratedInput",
+           "addto", "cos_sim", "seq_concat"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -478,3 +479,30 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,
     return flayers.beam_search_decode(ids=ids_array, scores=scores_array,
                                       parents=parents_array,
                                       end_id=eos_id)
+
+
+def addto(input, act=None, bias_attr=None, **kw):
+    """Elementwise sum of layers (+ optional activation) — reference
+    layers.py addto_layer (the ResNet shortcut join in v2 demos)."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = inputs[0]
+    for other in inputs[1:]:
+        out = flayers.elementwise_add(out, other)
+    act_name = _act_name(act)
+    if act_name:
+        out = getattr(flayers, act_name)(out)
+    return out
+
+
+def cos_sim(a, b, scale=1.0, **kw):
+    """Row-wise cosine similarity — reference layers.py cos_sim."""
+    out = flayers.cos_sim(a, b)
+    if scale != 1.0:
+        out = flayers.scale(out, scale=float(scale))
+    return out
+
+
+def seq_concat(a, b, **kw):
+    """Concatenate two sequences per batch row (reference
+    seq_concat_layer)."""
+    return flayers.sequence_concat(input=[a, b])

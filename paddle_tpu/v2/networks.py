@@ -11,7 +11,8 @@ from ..fluid import nets as fnets
 from . import layer as v2layer
 
 __all__ = ["simple_lstm", "simple_gru", "bidirectional_lstm",
-           "simple_img_conv_pool", "img_conv_group", "vgg_16_network"]
+           "bidirectional_gru", "simple_img_conv_pool",
+           "img_conv_group", "vgg_16_network"]
 
 
 def simple_lstm(input, size, reverse=False, act=None, gate_act=None,
@@ -95,3 +96,15 @@ def vgg_16_network(input_image, num_channels, num_classes=1000):
     tmp = flayers.dropout(x=tmp, dropout_prob=0.5)
     tmp = flayers.fc(input=tmp, size=4096, act="relu")
     return flayers.fc(input=tmp, size=num_classes, act="softmax")
+
+
+def bidirectional_gru(input, size, return_seq=False, **kw):
+    """Forward + backward simple_gru, concatenated (reference
+    networks.py bidirectional_gru)."""
+    fwd = simple_gru(input, size)
+    bwd = simple_gru(input, size, reverse=True)
+    if return_seq:
+        return flayers.concat(input=[fwd, bwd], axis=-1)
+    return flayers.concat(
+        input=[flayers.sequence_last_step(fwd),
+               flayers.sequence_last_step(bwd)], axis=-1)

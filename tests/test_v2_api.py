@@ -464,3 +464,49 @@ def test_v2_beam_search_generation():
     assert np.isfinite(scores_out).all()
     # every hypothesis is made of target-vocab ids
     assert ((ids_out >= 0) & (ids_out < trg_vocab)).all()
+
+
+def test_v2_addto_cos_sim_bigru():
+    """r3 alias batch: addto (ResNet-style join), cos_sim, seq_concat,
+    bidirectional_gru all build and train."""
+    paddle.init(seed=21)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(20))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=8)
+    emb2 = paddle.layer.embedding(input=words, size=8)
+    joined = paddle.layer.addto([emb, emb2],
+                                act=paddle.activation.Relu())
+    both = paddle.layer.seq_concat(joined, emb)
+    bi = paddle.networks.bidirectional_gru(input=both, size=6)
+    pred = paddle.layer.fc(input=bi, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+    rng = np.random.RandomState(8)
+    costs = []
+    trainer.train(
+        reader=paddle.batch(_seq_cls_reader(rng, 20, n=32), 8),
+        num_passes=3,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        feeding={"words": 0, "label": 1})
+    assert np.isfinite(costs).all() and costs[-1] < costs[0] * 1.2
+
+    # cos_sim on two dense layers
+    paddle.init(seed=22)
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(6))
+    b = paddle.layer.data(name="b", type=paddle.data_type.dense_vector(6))
+    sim = paddle.layer.cos_sim(a, b, scale=2.0)
+    params2 = paddle.parameters.create(
+        paddle.layer.mse_cost(input=sim, label=paddle.layer.data(
+            name="t", type=paddle.data_type.dense_vector(1))))
+    out = paddle.infer(output_layer=sim, parameters=params2,
+                       input=[(np.ones(6, np.float32),
+                               np.ones(6, np.float32))],
+                       feeding={"a": 0, "b": 1})
+    np.testing.assert_allclose(np.asarray(out).ravel()[0], 2.0, rtol=1e-5)
