@@ -118,11 +118,14 @@ def sequence_expand(x, y, name=None):
     return out
 
 
-def sequence_concat(input, name=None):
+def sequence_concat(input, axis=0, name=None):
+    """axis=0 (reference default): time-wise join, lengths add; axis=1:
+    feature concat of aligned sequences."""
     helper = LayerHelper("sequence_concat", name=name)
     first = input[0] if isinstance(input, (list, tuple)) else input
     out = helper.create_tmp_variable(first.dtype, lod_level=1)
-    helper.append_op("sequence_concat", {"X": input}, {"Out": out})
+    helper.append_op("sequence_concat", {"X": input}, {"Out": out},
+                     {"axis": int(axis)})
     return out
 
 

@@ -158,11 +158,35 @@ def nested_sequence_pool(ctx, x):
 
 @primitive("sequence_concat", inputs=["X*"])
 def sequence_concat(ctx, xs):
-    """reference sequence_concat_op.cc with axis=1 semantics (feature
-    concat of aligned sequences)."""
+    """reference sequence_concat_op.cc.  axis=0 (default, the reference
+    default): join each row's sequences end-to-end in time — output
+    lengths are the sums; axis=1: feature concat of aligned sequences."""
     assert all(isinstance(v, SeqArray) for v in xs)
-    data = jnp.concatenate([v.data for v in xs], axis=-1)
-    return SeqArray(data, xs[0].lengths)
+    axis = int(ctx.attr("axis", 0))
+    if axis == 1:
+        data = jnp.concatenate([v.data for v in xs], axis=-1)
+        return SeqArray(data, xs[0].lengths)
+    # time-wise join under padding: out[j] picks from the input whose
+    # cumulative-length window contains j (static shapes; per-row gather)
+    total_t = sum(v.data.shape[1] for v in xs)
+    pos = jnp.arange(total_t, dtype=jnp.int32)[None, :]       # [1, T]
+    out = None
+    lengths = jnp.zeros_like(xs[0].lengths)
+    offset = jnp.zeros_like(xs[0].lengths)                    # [b]
+    for v in xs:
+        ln = v.lengths.astype(jnp.int32)
+        rel = pos - offset[:, None]                           # [b, T]
+        in_v = (rel >= 0) & (rel < ln[:, None])
+        idx = jnp.clip(rel, 0, v.data.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            v.data, idx.reshape(idx.shape + (1,) *
+                                (v.data.ndim - 2)), axis=1)
+        mask = in_v.reshape(in_v.shape + (1,) * (v.data.ndim - 2))
+        piece = jnp.where(mask, gathered, 0)
+        out = piece if out is None else out + piece
+        offset = offset + ln
+        lengths = lengths + v.lengths
+    return SeqArray(out, lengths)
 
 
 @primitive("sequence_reshape")

@@ -482,12 +482,20 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,
 
 
 def addto(input, act=None, bias_attr=None, **kw):
-    """Elementwise sum of layers (+ optional activation) — reference
-    layers.py addto_layer (the ResNet shortcut join in v2 demos)."""
+    """Elementwise sum of layers (+ optional bias + activation) —
+    reference layers.py addto_layer:3372 (the ResNet shortcut join in
+    v2 demos).  bias_attr follows the reference contract: None/False =
+    no bias; a ParamAttr/True adds a per-feature bias parameter."""
+    from ..fluid.layer_helper import LayerHelper
+
     inputs = input if isinstance(input, (list, tuple)) else [input]
     out = inputs[0]
     for other in inputs[1:]:
         out = flayers.elementwise_add(out, other)
+    if bias_attr:
+        helper = LayerHelper("addto", bias_attr=bias_attr)
+        out = helper.append_bias_op(out, dim_start=out.lod_level + 1
+                                    if out.lod_level else 1)
     act_name = _act_name(act)
     if act_name:
         out = getattr(flayers, act_name)(out)
@@ -503,6 +511,6 @@ def cos_sim(a, b, scale=1.0, **kw):
 
 
 def seq_concat(a, b, **kw):
-    """Concatenate two sequences per batch row (reference
-    seq_concat_layer)."""
-    return flayers.sequence_concat(input=[a, b])
+    """Concatenate two sequences end-to-end in TIME per batch row
+    (reference seq_concat_layer: output length = len(a)+len(b))."""
+    return flayers.sequence_concat(input=[a, b], axis=0)

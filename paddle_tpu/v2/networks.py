@@ -34,17 +34,25 @@ def simple_gru(input, size, reverse=False, act=None, gate_act=None,
                              gate_act=gate_act, bias_attr=bias_attr)
 
 
-def bidirectional_lstm(input, size, return_seq=False, **kw):
-    """Forward + backward simple_lstm (reference networks.py
-    bidirectional_lstm): concat of the two hidden sequences when
-    ``return_seq``, else concat of their last steps."""
-    fwd = simple_lstm(input, size)
-    bwd = simple_lstm(input, size, reverse=True)
+def _bidirectional(cell, input, size, return_seq):
+    """Shared fwd+bwd composition.  The reversed branch's full-sequence
+    summary sits at the FIRST valid step (the scan un-flips outputs to
+    original time order), so the pooled variant takes last(fwd) +
+    first(bwd) — the reference's last_seq/first_seq pairing."""
+    fwd = cell(input, size)
+    bwd = cell(input, size, reverse=True)
     if return_seq:
         return flayers.concat(input=[fwd, bwd], axis=-1)
     return flayers.concat(
         input=[flayers.sequence_last_step(fwd),
-               flayers.sequence_last_step(bwd)], axis=-1)
+               flayers.sequence_first_step(bwd)], axis=-1)
+
+
+def bidirectional_lstm(input, size, return_seq=False, **kw):
+    """Forward + backward simple_lstm (reference networks.py
+    bidirectional_lstm): concat of the two hidden sequences when
+    ``return_seq``, else concat of their sequence summaries."""
+    return _bidirectional(simple_lstm, input, size, return_seq)
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
@@ -99,12 +107,6 @@ def vgg_16_network(input_image, num_channels, num_classes=1000):
 
 
 def bidirectional_gru(input, size, return_seq=False, **kw):
-    """Forward + backward simple_gru, concatenated (reference
-    networks.py bidirectional_gru)."""
-    fwd = simple_gru(input, size)
-    bwd = simple_gru(input, size, reverse=True)
-    if return_seq:
-        return flayers.concat(input=[fwd, bwd], axis=-1)
-    return flayers.concat(
-        input=[flayers.sequence_last_step(fwd),
-               flayers.sequence_last_step(bwd)], axis=-1)
+    """Forward + backward simple_gru (reference networks.py
+    bidirectional_gru)."""
+    return _bidirectional(simple_gru, input, size, return_seq)

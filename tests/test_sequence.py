@@ -113,3 +113,28 @@ def test_sequence_conv_shapes(fresh_programs):
     assert np.asarray(out.data).shape == (2, 5, 6)
     # padding rows must stay zero
     assert np.abs(np.asarray(out.data)[1, 2:]).sum() == 0
+
+
+def test_sequence_concat_time_axis(fresh_programs):
+    """axis=0 (reference seq_concat_layer default): per-row end-to-end
+    time join, lengths add, padding stays zero."""
+    from paddle_tpu.fluid.core.lod import SeqArray, make_seq
+
+    main, startup, scope = fresh_programs
+    a = fluid.layers.data(name="a", shape=[1], dtype="float32",
+                          lod_level=1)
+    b = fluid.layers.data(name="b", shape=[1], dtype="float32",
+                          lod_level=1)
+    out = fluid.layers.sequence_concat([a, b], axis=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    av = make_seq([[1, 2], [3]], dtype=np.float32)
+    bv = make_seq([[7], [8, 9]], dtype=np.float32, bucket=3)
+    res, = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[out],
+                   return_numpy=False)
+    assert isinstance(res, SeqArray)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [3, 3])
+    d = np.asarray(res.data)
+    np.testing.assert_allclose(d[0][:3], [1, 2, 7])
+    np.testing.assert_allclose(d[1][:3], [3, 8, 9])
+    np.testing.assert_allclose(d[:, 3:], 0)
