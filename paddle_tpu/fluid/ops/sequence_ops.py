@@ -163,6 +163,9 @@ def sequence_concat(ctx, xs):
     lengths are the sums; axis=1: feature concat of aligned sequences."""
     assert all(isinstance(v, SeqArray) for v in xs)
     axis = int(ctx.attr("axis", 0))
+    if axis not in (0, 1):
+        raise ValueError(f"sequence_concat: axis must be 0 (time) or 1 "
+                         f"(feature), got {axis}")
     if axis == 1:
         data = jnp.concatenate([v.data for v in xs], axis=-1)
         return SeqArray(data, xs[0].lengths)

@@ -493,9 +493,15 @@ def addto(input, act=None, bias_attr=None, **kw):
     for other in inputs[1:]:
         out = flayers.elementwise_add(out, other)
     if bias_attr:
+        feat = (out.shape or [None])[-1]
+        if not feat or feat < 0:
+            raise ValueError(
+                "addto(bias_attr=...): cannot infer the feature width "
+                "for the bias parameter from the input shape")
         helper = LayerHelper("addto", bias_attr=bias_attr)
         out = helper.append_bias_op(out, dim_start=out.lod_level + 1
-                                    if out.lod_level else 1)
+                                    if out.lod_level else 1,
+                                    bias_shape=[int(feat)])
     act_name = _act_name(act)
     if act_name:
         out = getattr(flayers, act_name)(out)
