@@ -75,6 +75,8 @@ Tensor parse_tensor(const std::string& payload, const std::string& what) {
   if (payload.size() < 4) throw std::runtime_error(what + ": truncated");
   uint32_t hlen;
   std::memcpy(&hlen, payload.data(), 4);
+  if (payload.size() < 4 + (size_t)hlen)
+    throw std::runtime_error(what + ": header length exceeds payload");
   const std::string header_text = payload.substr(4, hlen);
   JsonParser jp(header_text);  // parser keeps a reference — must outlive it
   JsonPtr h = jp.parse();
@@ -82,7 +84,10 @@ Tensor parse_tensor(const std::string& payload, const std::string& what) {
   Tensor t;
   int64_t n = 1;
   for (auto& e : h->at("shape")->arr) {
+    if (e->i < 0) throw std::runtime_error(what + ": negative dim");
     t.shape.push_back(e->i);
+    if (e->i != 0 && n > ((int64_t)1 << 40) / e->i)
+      throw std::runtime_error(what + ": shape product overflow");
     n *= e->i;
   }
   const char* raw = payload.data() + 4 + hlen;
@@ -102,8 +107,14 @@ Tensor parse_tensor(const std::string& payload, const std::string& what) {
     int w = dtype == "int64" ? 8 : 4;
     if (avail < (size_t)n * w) throw std::runtime_error(what + ": short int");
     for (int64_t i = 0; i < n; ++i) {
-      int64_t v = 0;
-      std::memcpy(&v, raw + i * w, w);
+      int64_t v;
+      if (w == 8) {
+        std::memcpy(&v, raw + i * 8, 8);
+      } else {
+        int32_t v32;  // read at native width so negatives sign-extend
+        std::memcpy(&v32, raw + i * 4, 4);
+        v = v32;
+      }
       t.data[i] = (float)v;
     }
   } else {
@@ -167,15 +178,17 @@ void Engine::run_op(const OpDesc& op) {
     Tensor& x = in(op, "X");
     Tensor& y = in(op, "Y");
     int64_t xnum = op.attr_int("x_num_col_dims", 1);
-    int64_t m = 1, k = 1;
+    int64_t ynum = op.attr_int("y_num_col_dims", 1);
+    int64_t m = 1, k = 1, k2 = 1, n = 1;
     for (size_t i = 0; i < x.shape.size(); ++i)
       ((int64_t)i < xnum ? m : k) *= x.shape[i];
-    int64_t k2 = y.shape.at(0), n = y.numel() / k2;
+    for (size_t i = 0; i < y.shape.size(); ++i)
+      ((int64_t)i < ynum ? k2 : n) *= y.shape[i];
     if (k != k2)
       throw std::runtime_error("mul: inner dim mismatch");
     Tensor r;
     r.shape.assign(x.shape.begin(), x.shape.begin() + xnum);
-    r.shape.insert(r.shape.end(), y.shape.begin() + 1, y.shape.end());
+    r.shape.insert(r.shape.end(), y.shape.begin() + ynum, y.shape.end());
     r.data.resize(m * n);
     matmul2d(x.data.data(), y.data.data(), r.data.data(), m, k, n);
     out(op) = std::move(r);

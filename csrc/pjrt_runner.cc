@@ -228,6 +228,34 @@ void Runner::load(const std::string& model_dir, const std::string& plugin) {
   co.compile_options_size = sizeof(kOpts);
   check(api->PJRT_Client_Compile(&co), "compile");
   exec = co.executable;
+
+  // trust the compiled executable, not the json, for the output count —
+  // a stale/hand-edited meta undercounting outputs would otherwise make
+  // Execute write output buffer pointers past the end of out_bufs
+  PJRT_LoadedExecutable_GetExecutable_Args ge;
+  std::memset(&ge, 0, sizeof(ge));
+  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ge.loaded_executable = exec;
+  check(api->PJRT_LoadedExecutable_GetExecutable(&ge), "get executable");
+  PJRT_Executable_NumOutputs_Args no;
+  std::memset(&no, 0, sizeof(no));
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  no.executable = ge.executable;
+  PJRT_Error* no_err = api->PJRT_Executable_NumOutputs(&no);
+  {
+    // the queried executable is caller-owned — release it before any throw
+    PJRT_Executable_Destroy_Args ed;
+    std::memset(&ed, 0, sizeof(ed));
+    ed.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+    ed.executable = ge.executable;
+    api->PJRT_Executable_Destroy(&ed);
+  }
+  check(no_err, "num outputs");
+  if (no.num_outputs != meta.num_outputs)
+    throw std::runtime_error(
+        "model.stablehlo.json outputs (" + std::to_string(meta.num_outputs) +
+        ") disagree with compiled executable (" +
+        std::to_string(no.num_outputs) + ") — stale meta?");
 }
 
 void Runner::forward(const float* const* inputs) {

@@ -78,17 +78,69 @@ def _emit(groups, format):
             raise ValueError(f"unknown mq2007 format {format!r}")
 
 
+def _auto_extract():
+    """Fetch + unpack an MQ2007 archive when a stdlib-extractable one is
+    reachable.  The official archive is .rar (no stdlib extractor and no
+    unrar/bsdtar in minimal images), so:
+
+      * ``PADDLE_TPU_MQ2007_URL`` may point at any .zip/.tar.gz/.tgz
+        mirror of the LETOR 4.0 MQ2007 folder — fetched and extracted
+        automatically (reference relied on the `rarfile` package +
+        installed unrar, python/paddle/v2/dataset/mq2007.py:40-46);
+      * a manually-downloaded MQ2007.zip/.tar.gz dropped in the cache dir
+        is extracted automatically;
+      * a manually-extracted tree keeps working as before.
+    """
+    base = common.cache_dir("mq2007")
+    url = os.environ.get("PADDLE_TPU_MQ2007_URL")
+    archives = [os.path.join(base, f) for f in os.listdir(base)
+                if f.lower().endswith((".zip", ".tar.gz", ".tgz"))] \
+        if os.path.isdir(base) else []
+    if url and not archives:
+        path = common.download(url, "mq2007", None)
+        archives = [path]
+    for path in archives:
+        marker = path + ".extracted"
+        if os.path.exists(marker):
+            continue
+        # classify by content, not name: a mirror URL with a query string
+        # saves under a basename like 'MQ2007.zip?sig=...' (common.download
+        # keeps the last path segment)
+        import zipfile
+        if path.lower().endswith(".zip") or zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as z:
+                for m in z.namelist():   # refuse traversal/absolute members
+                    p = os.path.normpath(m)
+                    if p.startswith(("..", "/")) or os.path.isabs(p):
+                        raise common.DownloadError(
+                            f"{path}: unsafe archive member {m!r}")
+                z.extractall(base)
+        else:
+            import tarfile
+            with tarfile.open(path) as t:
+                t.extractall(base, filter="data")
+        with open(marker, "w") as f:
+            f.write("ok")
+
+
 def _find_extracted(split):
-    """Look for an extracted LETOR text file under the cache dir (the
-    .rar must be unpacked manually — no stdlib rar support)."""
+    """Locate {split}.txt under the cache dir, auto-extracting any
+    stdlib-readable archive first (the official .rar still needs a manual
+    unpack or a zip/tar mirror via PADDLE_TPU_MQ2007_URL)."""
+    try:
+        _auto_extract()
+    except Exception as e:  # fetch/extract problems -> normal fallback path
+        common.fallback_warning("mq2007", f"archive auto-extract: {e}")
     base = common.cache_dir("mq2007")
     for root, _, files in os.walk(base):
         for f in files:
             if f.lower() == f"{split}.txt":
                 return os.path.join(root, f)
     raise common.DownloadError(
-        f"mq2007: no extracted {split}.txt under {base} — the MQ2007 "
-        f"archive is .rar; extract it there manually")
+        f"mq2007: no extracted {split}.txt under {base} — the official "
+        f"MQ2007 archive is .rar (not stdlib-extractable); drop a .zip/"
+        f".tar.gz there, set PADDLE_TPU_MQ2007_URL to a zip/tar mirror, "
+        f"or extract manually")
 
 
 def _synthetic_groups(split, seed):

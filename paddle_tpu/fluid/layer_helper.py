@@ -83,6 +83,7 @@ class LayerHelper:
             # (deliberate half-precision storage).
             dtype = "float32"
         suffix = suffix or ("b" if is_bias else "w")
+        autonamed = not attr.name      # '' also falls through to generate
         name = attr.name or unique_name.generate(f"{self.name}.{suffix}")
         init = (attr.initializer or default_initializer
                 or attr.default_initializer(is_bias))
@@ -109,6 +110,12 @@ class LayerHelper:
             regularizer=attr.regularizer,
             gradient_clip_attr=attr.gradient_clip,
             sharding=attr.sharding)
+        # re-tracing consumers (v2 beam_search's probe) use this to detect
+        # parameters that CANNOT be shared across traces: a unique_name-
+        # generated name is fresh per trace.  Stored on the object, not in
+        # any global registry — an explicit ParamAttr(name=...) that happens
+        # to equal some older program's generated name must not be flagged.
+        param._autonamed = autonamed
         # mirror into the startup program and emit its init op there
         sb = self.startup_program.global_block()
         sp = sb.create_parameter(

@@ -556,11 +556,15 @@ def _append_channel_bias(helper, pre_bias):
 
 def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
                     seq_parallel=False, impl=None, dropout_rate=0.0,
-                    is_test=False, name=None):
-    """Fused scaled-dot-product attention over [b, h, l, d] tensors — flash
-    attention on one chip, ring attention over an 'sp' mesh axis when
-    ``seq_parallel`` and the active mesh shard the sequence.  O(L) memory,
-    unlike the matmul+softmax composition which materialises [lq, lk].
+                    is_test=False, layout="bhld", name=None):
+    """Fused scaled-dot-product attention — flash attention on one chip,
+    ring attention over an 'sp' mesh axis when ``seq_parallel`` and the
+    active mesh shard the sequence.  O(L) memory, unlike the matmul+softmax
+    composition which materialises [lq, lk].
+    ``layout='bhld'`` takes [b, h, l, d] tensors; ``'blhd'`` takes
+    [b, l, h, d] head-interleaved tensors directly — the Pallas kernels
+    index them in place, so callers skip the split-heads transposes (the
+    last elementwise-traffic tier in BENCH_NOTES §2).
     ``dropout_rate`` applies attention-probability dropout inside the kernel
     (counter-based hash mask, train mode only) — same semantics as the
     softmax→dropout→matmul composition."""
@@ -570,7 +574,8 @@ def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     if bias is not None:
         inputs["Bias"] = bias
     attrs = {"causal": bool(causal), "seq_parallel": bool(seq_parallel),
-             "dropout_rate": float(dropout_rate), "is_test": bool(is_test)}
+             "dropout_rate": float(dropout_rate), "is_test": bool(is_test),
+             "layout": str(layout)}
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
     if impl is not None:

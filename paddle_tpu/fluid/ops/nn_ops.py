@@ -245,6 +245,7 @@ def fused_attention(ctx, q, k, v, bias):
     causal = ctx.attr("causal", False)
     sm_scale = ctx.attr("sm_scale", None)
     impl = ctx.attr("impl", None)
+    layout = ctx.attr("layout", "bhld")
     rate = ctx.attr("dropout_rate", 0.0)
     if ctx.attr("is_test", False) or ctx.mode == "infer":
         rate = 0.0
@@ -258,9 +259,15 @@ def fused_attention(ctx, q, k, v, bias):
     mesh = _pmesh.current_mesh()
     if ctx.attr("seq_parallel", False) and mesh is not None \
             and "sp" in mesh.axis_names:
-        return _ring(mesh, q, k, v, bias=bias, causal=causal,
-                     sm_scale=sm_scale,
-                     dp_axis="dp", mp_axis="mp", sp_axis="sp",
-                     dropout_rate=rate, dropout_seed=seed)
+        if layout == "blhd":  # ring shards the seq axis of [b, h, l, d]
+            q, k, v = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+        out = _ring(mesh, q, k, v, bias=bias, causal=causal,
+                    sm_scale=sm_scale,
+                    dp_axis="dp", mp_axis="mp", sp_axis="sp",
+                    dropout_rate=rate, dropout_seed=seed)
+        if layout == "blhd":
+            out = jnp.transpose(out, (0, 2, 1, 3))
+        return out
     return _flash(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale,
-                  impl=impl, dropout_rate=rate, dropout_seed=seed)
+                  impl=impl, dropout_rate=rate, dropout_seed=seed,
+                  layout=layout)

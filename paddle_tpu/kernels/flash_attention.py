@@ -1,17 +1,31 @@
-"""Flash attention: O(L)-memory fused attention for TPU.
+"""Flash attention: O(L)-memory fused attention for TPU, fwd AND bwd in Pallas.
 
 Forward is a Pallas kernel (MXU matmuls over [block_q, block_k] tiles with an
-online-softmax running (max, sum, accumulator) in VMEM scratch); backward
-recomputes attention blockwise in XLA (`lax.scan` over key blocks), so no
-[Lq, Lk] probability matrix is ever materialised in either direction.
+online-softmax running (max, sum, accumulator) in VMEM scratch) that also
+emits the row logsumexp; backward is two more Pallas kernels (dq, and dk/dv)
+that recompute the probabilities blockwise from q/k and the saved logsumexp —
+no [Lq, Lk] tensor is ever materialised in either direction, and no XLA-side
+recompute pass remains (r3's backward ran the whole forward again in XLA,
+which is why long-sequence MFU collapsed).
+
+Matmuls run in the input dtype (bf16 inputs hit the MXU's native path; the
+old kernel upcast everything to f32, halving throughput), accumulating in
+f32 via preferred_element_type.  The row statistics ride in [block, 128]
+lane-broadcast tiles — the same layout trick the public TPU flash kernels
+use — so no sublane/lane transposes appear anywhere.
 
 This is the TPU-native replacement for what the reference could not do at
 all — its attention-era models build [lq, lk] score tensors explicitly
 (multi_head_attention in the Transformer config helpers); at long context
-that is HBM-quadratic.  Kernel layout follows the public flash-attention
-recipe (see PAPERS.md), written fresh for Pallas tiling constraints.
+that is HBM-quadratic.  Written fresh for Pallas tiling constraints (see
+PAPERS.md for the flash-attention recipe).
 
-Shapes: q [B, H, Lq, D], k/v [B, H, Lk, D], bias [B|1, H|1, Lq, Lk].
+Shapes: layout='bhld' (default) q [B, H, Lq, D], k/v [B, H, Lk, D];
+layout='blhd' accepts q [B, Lq, H, D] etc. so callers skip explicit
+split-heads transpose ops (the kernel view is made at the boundary, where
+XLA fuses the copy into the adjacent projection matmuls; a true
+head-strided BlockSpec is illegal on TPU — d=64 < the 128-lane tile).
+Optional additive bias [B|1, H|1, Lq, Lk].
 """
 
 from __future__ import annotations
@@ -29,6 +43,15 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANES = 128   # stat tiles are [block, LANES] so no sublane transposes occur
+
+# Below this query length the backward runs as the blockwise XLA scan
+# instead of the dq/dkv Pallas kernels: at short L the [bh, lq, 128]
+# logsumexp residual costs more HBM than recomputing the row stats, and
+# XLA can fuse the scan with the surrounding step (measured at s=256:
+# pallas bwd end-to-end was ~12% slower; at L >= 1024 it is 2-4x faster).
+# Tests monkeypatch this to 0 to exercise the kernels at tiny shapes.
+PALLAS_BWD_MIN_L = 1024
 
 __all__ = ["flash_attention"]
 
@@ -38,10 +61,10 @@ def keep_scale(seed_u32, bh, rows, cols, rate):
 
     A murmur3-style finalizer over the *global* (batch*head, query, key)
     position and a traced uint32 seed, in pure uint32 jnp arithmetic — so the
-    identical expression runs inside the Pallas forward kernel and the XLA
-    backward scan, and the two masks match bit-exactly without ever
-    materialising an [Lq, Lk] mask tensor.  Inputs broadcast; returns float32
-    values in {0, 1/(1-rate)} (inverted-dropout scaling).
+    identical expression runs inside the Pallas kernels and the XLA fallback,
+    and the masks match bit-exactly without ever materialising an [Lq, Lk]
+    mask tensor.  Inputs broadcast; returns float32 values in
+    {0, 1/(1-rate)} (inverted-dropout scaling).
     """
     u32 = jnp.uint32
     x = (rows.astype(u32) * u32(0x9E3779B1) +
@@ -81,12 +104,76 @@ def bh_grid(b: int, h: int) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# in-kernel helpers shared by fwd / bwd kernels
+# ---------------------------------------------------------------------------
+
+def _ld(ref):
+    """Read a [rows, d] tile from a [1, rows, d] q/k/v/do/o block ref."""
+    return ref[0]
+
+
+def _st(ref, val):
+    ref[0] = val
+
+
+def _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len):
+    """Apply causal and/or key-padding masks to a [block_q, block_k] score
+    tile using global row/col positions."""
+    if not causal and kv_len is None:
+        return s
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = None
+    if causal:
+        keep = rows >= cols
+    if kv_len is not None:
+        pad_ok = cols < kv_len
+        keep = pad_ok if keep is None else jnp.logical_and(keep, pad_ok)
+    return jnp.where(keep, s, DEFAULT_MASK_VALUE)
+
+
+def _tile_keep_scale(seed_ref, qi, ki, block_q, block_k, rate):
+    rows_g = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols_g = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    # vector-shaped bitcast: Mosaic's tpu.bitcast rejects bare scalars
+    seed_u = jax.lax.bitcast_convert_type(seed_ref[...], jnp.uint32)[0, 0]
+    return keep_scale(seed_u, pl.program_id(0), rows_g, cols_g, rate)
+
+
+def _compiler_params():
+    """Parallel bh/outer grid dims, serial accumulation dim — and a raised
+    scoped-VMEM ceiling: v5e has far more physical VMEM than the default
+    16 MiB scope, and 1024-blocks (the measured fwd+bwd winner at L >= 1k)
+    need ~17-23 MiB once dropout's keep-mask tile joins s/p/dp."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=64 * 1024 * 1024)
+
+
+def _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks):
+    """Static-shape predicate: does tile (qi, ki) contribute at all?
+    Causal tiles strictly above the diagonal and tiles entirely inside the
+    key padding are skipped (their matmuls never issue)."""
+    live = True
+    if causal:
+        live = qi * block_q + block_q - 1 >= ki * block_k
+    if kv_len is not None and kv_len < num_k_blocks * block_k:
+        pad_live = ki * block_k < kv_len
+        live = pad_live if live is True else jnp.logical_and(live, pad_live)
+    return live
+
+
+# ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, num_k_blocks,
+                *, sm_scale, causal, kv_len, block_q, block_k, num_k_blocks,
                 dropout_rate):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -97,34 +184,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: a block whose every column is strictly above the diagonal
-    # contributes nothing — skip its matmuls entirely
-    live = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    live = _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, ...].astype(jnp.float32)          # [bq, D]
-        k = k_ref[0, ...].astype(jnp.float32)          # [bk, D]
-        v = v_ref[0, ...].astype(jnp.float32)          # [bk, D]
+        q = _ld(q_ref)                                 # [bq, D] input dtype
+        k = _ld(k_ref)                                 # [bk, D]
+        v = _ld(v_ref)                                 # [bk, D]
 
+        # MXU matmul in the INPUT dtype (bf16 native path), f32 accumulate
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale                               # [bq, bk]
         if bias_ref is not None:
             s = s + bias_ref[0, ...].astype(jnp.float32)
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        s = _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len)
 
         m_prev = m_scr[...]                        # [bq, 128] (bcast lanes)
         l_prev = l_scr[...]
         m_cur = jnp.max(s, axis=1)[:, None]            # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
         alpha = jnp.exp(m_prev - m_new)                # [bq, 128]
-        p = jnp.exp(s - m_new[:, :1])                  # [bq, bk]
+        p = jnp.exp(s - m_new[:, :1])                  # [bq, bk] f32
         l_new = alpha * l_prev + jnp.broadcast_to(
             jnp.sum(p, axis=1)[:, None], l_prev.shape)
         m_scr[...] = m_new
@@ -132,53 +213,71 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
         if dropout_rate > 0.0:
             # mask the unnormalised probs (l keeps the full softmax sum —
             # dropout acts after normalisation, and /l distributes)
-            rows_g = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols_g = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            # vector-shaped bitcast: Mosaic's tpu.bitcast rejects bare scalars
-            seed_u = jax.lax.bitcast_convert_type(seed_ref[...],
-                                                  jnp.uint32)[0, 0]
-            pd = p * keep_scale(seed_u, pl.program_id(0), rows_g, cols_g,
-                                dropout_rate)
+            pd = p * _tile_keep_scale(seed_ref, qi, ki, block_q, block_k,
+                                      dropout_rate)
         else:
             pd = p
-        pv = jax.lax.dot_general(pd, v, (((1,), (0,)), ((), ())),
+        pv = jax.lax.dot_general(pd.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + pv
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
-        denom = l_scr[...][:, :1]
-        denom = jnp.where(denom == 0.0, 1.0, denom)  # fully-masked rows
-        o_ref[0, ...] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        l_fin = l_scr[...]
+        denom = jnp.where(l_fin == 0.0, 1.0, l_fin)  # fully-masked rows
+        _st(o_ref, (acc_scr[...] / denom[:, :1]).astype(o_ref.dtype))
+        if lse_ref is not None:
+            # +inf on fully-masked rows so bwd's exp(s - lse) underflows to 0
+            lse_ref[0] = jnp.where(l_fin == 0.0, jnp.inf,
+                                   m_scr[...] + jnp.log(denom))
 
 
-def _pallas_forward(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
-                    dropout_rate, interpret):
-    b, h, lq, d = q.shape
-    lk = k.shape[2]
+def _qkv_specs(d, block, which):
+    """BlockSpec for one of q/k/v/do/o on the [B*H, L, D] kernel view.
+    which='q' blocks follow grid dim 1, 'k' follows grid dim 2.  (A true
+    [B, L, H, D]-indexed block spec is illegal on TPU: a one-head block's
+    trailing dims would be (1, d) with d < 128 lanes, which Mosaic rejects
+    — so 'blhd' transposes at the kernel boundary instead, where XLA fuses
+    the copy into the neighbouring projection matmuls.)"""
+    if which == "q":
+        return pl.BlockSpec((1, block, d), lambda bh, qi, ki: (bh, qi, 0))
+    return pl.BlockSpec((1, block, d), lambda bh, qi, ki: (bh, ki, 0))
+
+
+def _flatten_heads(x, layout):
+    """-> [B*H, L, D] kernel view (blhd transposes at this boundary)."""
+    if layout == "blhd":
+        x = jnp.transpose(x, (0, 2, 1, 3))
+    b, h, l, d = x.shape
+    return x.reshape(b * h, l, d)
+
+
+def _bhld_shape(x, layout):
+    """(b, h, l, d) independent of layout."""
+    if layout == "blhd":
+        b, l, h, d = x.shape
+        return b, h, l, d
+    return x.shape
+
+
+def _pallas_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_q,
+                    block_k, dropout_rate, layout, interpret, need_lse):
+    b, h, lq, d = _bhld_shape(q, layout)
+    lk = _bhld_shape(k, layout)[2]
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     assert lq % block_q == 0 and lk % block_k == 0, (lq, lk, block_q, block_k)
     nq, nk = lq // block_q, lk // block_k
     grid = (b * h, nq, nk)
 
-    def q_map(bh, qi, ki):
-        return (bh, qi, 0)
-
-    def kv_map(bh, qi, ki):
-        return (bh, ki, 0)
-
-    q3 = q.reshape(b * h, lq, d)
-    k3 = k.reshape(b * h, lk, d)
-    v3 = v.reshape(b * h, lk, d)
     in_specs = [
-        pl.BlockSpec((1, block_q, d), q_map),
-        pl.BlockSpec((1, block_k, d), kv_map),
-        pl.BlockSpec((1, block_k, d), kv_map),
+        _qkv_specs(d, block_q, "q"),
+        _qkv_specs(d, block_k, "k"),
+        _qkv_specs(d, block_k, "k"),
     ]
-    args = [q3, k3, v3]
+    args = [_flatten_heads(q, layout), _flatten_heads(k, layout),
+            _flatten_heads(v, layout)]
     have_bias = bias is not None
     if have_bias:
         bb, bh_, _, _ = bias.shape
@@ -196,43 +295,274 @@ def _pallas_forward(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
         args.append(jnp.asarray(seed, jnp.float32).reshape(1, 1))
 
     base = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, dropout_rate=dropout_rate)
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        dropout_rate=dropout_rate)
 
     def kernel(q_ref, k_ref, v_ref, *rest):
         rest = list(rest)
         bias_ref = rest.pop(0) if have_bias else None
         seed_ref = rest.pop(0) if have_seed else None
-        o_ref, m_scr, l_scr, acc_scr = rest
-        return base(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+        if need_lse:
+            o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        else:
+            o_ref, m_scr, l_scr, acc_scr = rest
+            lse_ref = None
+        return base(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
                     m_scr, l_scr, acc_scr)
 
     scratch = [
-        pltpu.VMEM((block_q, 128), jnp.float32),   # running max
-        pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
-        pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+        pltpu.VMEM((block_q, LANES), jnp.float32),   # running sum
+        pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
     ]
-    out = pl.pallas_call(
+    out_specs = [_qkv_specs(d, block_q, "q")]
+    out_shape = [jax.ShapeDtypeStruct((b * h, lq, d), q.dtype)]
+    if need_lse:
+        # row stats in lane-broadcast layout: [bh, lq, 128] so the bwd
+        # kernels read [block_q, 128] tiles with no transpose anywhere
+        out_specs.append(pl.BlockSpec((1, block_q, LANES),
+                                      lambda bh, qi, ki: (bh, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, lq, LANES),
+                                              jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), q_map),
-        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        out_specs=out_specs if need_lse else out_specs[0],
+        out_shape=out_shape if need_lse else out_shape[0],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(*args)
-    return out.reshape(b, h, lq, d)
+    if need_lse:
+        out, lse = res
+    else:
+        out, lse = res, None
+    out = out.reshape(b, h, lq, d)
+    if layout == "blhd":
+        out = jnp.transpose(out, (0, 2, 1, 3))
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
-# Blockwise XLA path: reference forward (CPU / fallback) and the backward
+# Pallas backward kernels (dq, then dk/dv) — bias-free path
+# ---------------------------------------------------------------------------
+
+def _delta_tile(o_ref, do_ref):
+    """rowsum(o * do) for this q block, [bq, 1] f32 — computed in-kernel
+    from the o/do tiles (an XLA-side [bh, lq, 128] delta array would cost
+    4x the HBM of re-reading the bf16 o block)."""
+    o = _ld(o_ref).astype(jnp.float32)
+    do = _ld(do_ref).astype(jnp.float32)
+    return jnp.sum(o * do, axis=1)[:, None]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
+               dq_ref, dq_scr,
+               *, sm_scale, causal, kv_len, block_q, block_k, num_k_blocks,
+               dropout_rate):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
+
+    @pl.when(live)
+    def _compute():
+        q = _ld(q_ref)
+        k = _ld(k_ref)
+        v = _ld(v_ref)
+        do = _ld(do_ref)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        s = _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len)
+        p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk] f32
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = dp * _tile_keep_scale(seed_ref, qi, ki, block_q, block_k,
+                                       dropout_rate)
+        ds = p * (dp - _delta_tile(o_ref, do_ref)) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        _st(dq_ref, dq_scr[...].astype(dq_ref.dtype))
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, sm_scale, causal, kv_len, block_q, block_k, num_q_blocks,
+                num_k_blocks, dropout_rate):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
+
+    @pl.when(live)
+    def _compute():
+        q = _ld(q_ref)
+        k = _ld(k_ref)
+        v = _ld(v_ref)
+        do = _ld(do_ref)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        s = _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len)
+        p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk] f32
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _tile_keep_scale(seed_ref, qi, ki, block_q, block_k,
+                                    dropout_rate)
+            pv = p * keep                              # what multiplied v fwd
+            dp = dp * keep
+        else:
+            pv = p
+        # dv += pv^T @ do; dk += ds^T @ q  (contract over the q rows)
+        dv_scr[...] += jax.lax.dot_general(
+            pv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - _delta_tile(o_ref, do_ref)) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        _st(dk_ref, dk_scr[...].astype(dk_ref.dtype))
+        _st(dv_ref, dv_scr[...].astype(dv_ref.dtype))
+
+
+def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
+                     kv_len, block_q, block_k, dropout_rate, layout,
+                     interpret):
+    """dq/dk/dv via two Pallas kernels; lse128 is the forward's [bh, lq, 128]
+    stat output.  delta = rowsum(o * do) is recomputed per-tile inside the
+    kernels from the o/do blocks (cheaper than materialising a lane-broadcast
+    delta array in HBM)."""
+    b, h, lq, d = _bhld_shape(q, layout)
+    lk = _bhld_shape(k, layout)[2]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    nq, nk = lq // block_q, lk // block_k
+
+    stat_spec_q = pl.BlockSpec((1, block_q, LANES),
+                               lambda bh, i, j: (bh, i, 0))
+    stat_spec_kq = pl.BlockSpec((1, block_q, LANES),
+                                lambda bh, ki, qi: (bh, qi, 0))
+    have_seed = dropout_rate > 0.0
+    seed_arr = jnp.asarray(seed, jnp.float32).reshape(1, 1)
+
+    q3 = _flatten_heads(q, layout)
+    k3 = _flatten_heads(k, layout)
+    v3 = _flatten_heads(v, layout)
+    do3 = _flatten_heads(do, layout)
+    o3 = _flatten_heads(out, layout)
+
+    # ---- dq: grid (bh, nq, nk), k-blocks innermost accumulate into scratch
+    dq_specs = [
+        _qkv_specs(d, block_q, "q"),
+        _qkv_specs(d, block_k, "k"),
+        _qkv_specs(d, block_k, "k"),
+        _qkv_specs(d, block_q, "q"),
+        _qkv_specs(d, block_q, "q"),
+        stat_spec_q,
+    ]
+    dq_args = [q3, k3, v3, do3, o3, lse128]
+    if have_seed:
+        dq_specs.append(pl.BlockSpec((1, 1), lambda bh, qi, ki: (0, 0)))
+        dq_args.append(seed_arr)
+
+    dq_base = functools.partial(
+        _dq_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        dropout_rate=dropout_rate)
+
+    def dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest):
+        rest = list(rest)
+        seed_ref = rest.pop(0) if have_seed else None
+        dq_ref, dq_scr = rest
+        return dq_base(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                       seed_ref, dq_ref, dq_scr)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, nq, nk),
+        in_specs=dq_specs,
+        out_specs=_qkv_specs(d, block_q, "q"),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(*dq_args)
+
+    # ---- dk/dv: grid (bh, nk, nq), q-blocks innermost
+    def kv_spec(block):
+        return pl.BlockSpec((1, block, d), lambda bh, ki, qi: (bh, ki, 0))
+
+    def qdo_spec(block):
+        return pl.BlockSpec((1, block, d), lambda bh, ki, qi: (bh, qi, 0))
+
+    dkv_specs = [qdo_spec(block_q), kv_spec(block_k), kv_spec(block_k),
+                 qdo_spec(block_q), qdo_spec(block_q), stat_spec_kq]
+    dkv_args = [q3, k3, v3, do3, o3, lse128]
+    if have_seed:
+        dkv_specs.append(pl.BlockSpec((1, 1), lambda bh, ki, qi: (0, 0)))
+        dkv_args.append(seed_arr)
+
+    dkv_base = functools.partial(
+        _dkv_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
+        block_q=block_q, block_k=block_k, num_q_blocks=nq, num_k_blocks=nk,
+        dropout_rate=dropout_rate)
+
+    def dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest):
+        rest = list(rest)
+        seed_ref = rest.pop(0) if have_seed else None
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        return dkv_base(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                        seed_ref, dk_ref, dv_ref, dk_scr, dv_scr)
+
+    kv_shape = jax.ShapeDtypeStruct((b * h, lk, d), k.dtype)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, nk, nq),
+        in_specs=dkv_specs,
+        out_specs=[kv_spec(block_k), kv_spec(block_k)],
+        out_shape=[kv_shape, kv_shape],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(*dkv_args)
+    dq = dq.reshape(b, h, lq, d)
+    dk = dk.reshape(b, h, lk, d)
+    dv = dv.reshape(b, h, lk, d)
+    if layout == "blhd":
+        dq, dk, dv = (jnp.transpose(x, (0, 2, 1, 3)) for x in (dq, dk, dv))
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Blockwise XLA path: reference forward (CPU / fallback) and the
+# bias-carrying backward (dbias needs the [lq, lk]-shaped output anyway)
 # ---------------------------------------------------------------------------
 
 def _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k, rate):
     """[b,h,lq,block_k] inverted-dropout scale for one key block, using the
-    same global-position hash as the Pallas kernel (bh = b*h + h index)."""
+    same global-position hash as the Pallas kernels (bh = b*h + h index)."""
     bh = bh_grid(b, h)
     rows = lq_rows[None, None, :, None]
     cols = (ki * block_k +
@@ -240,9 +570,10 @@ def _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k, rate):
     return keep_scale(seed_u, bh, rows, cols, rate)
 
 
-def _xla_forward(q, k, v, bias, seed, sm_scale, causal, block_k,
+def _xla_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_k,
                  dropout_rate=0.0):
-    """lax.scan over key blocks with online softmax; returns (out, m, l)."""
+    """lax.scan over key blocks with online softmax; q/k/v in [b,h,l,d].
+    Returns (out, lse) with lse [b,h,lq] (+inf on fully-masked rows)."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
     block_k = min(block_k, lk)
@@ -262,9 +593,11 @@ def _xla_forward(q, k, v, bias, seed, sm_scale, causal, block_k,
         if bias is not None:
             bs = jax.lax.dynamic_slice_in_dim(bias, ki * block_k, block_k, 3)
             s = s + bs.astype(jnp.float32)
+        cols = ki * block_k + jnp.arange(block_k)[None, :]
         if causal:
-            cols = ki * block_k + jnp.arange(block_k)[None, :]
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        if kv_len is not None:
+            s = jnp.where(cols[None, None] < kv_len, s, DEFAULT_MASK_VALUE)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
@@ -284,14 +617,15 @@ def _xla_forward(q, k, v, bias, seed, sm_scale, causal, block_k,
             jnp.zeros((b, h, lq, d), jnp.float32))
     (m, l, acc), _ = jax.lax.scan(step, init, jnp.arange(nk))
     denom = jnp.where(l == 0.0, 1.0, l)
-    return (acc / denom[..., None]).astype(q.dtype), m, l
+    lse = jnp.where(l == 0.0, jnp.inf, m + jnp.log(denom))
+    return (acc / denom[..., None]).astype(q.dtype), lse
 
 
-def _xla_backward(q, k, v, bias, o, do, m, l, seed, sm_scale, causal,
+def _xla_backward(q, k, v, bias, o, do, lse, seed, sm_scale, causal, kv_len,
                   block_k, dropout_rate=0.0):
-    """Recompute p blockwise and accumulate dq/dk/dv (+dbias) — the
-    flash-attention backward; no [Lq, Lk] intermediate, only the dbias
-    *output* (when bias is given) has that shape."""
+    """Recompute p blockwise from the saved lse and accumulate dq/dk/dv
+    (+dbias) — the flash-attention backward; no [Lq, Lk] intermediate, only
+    the dbias *output* (when bias is given) has that shape."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
     block_k = min(block_k, lk)
@@ -302,7 +636,6 @@ def _xla_backward(q, k, v, bias, o, do, m, l, seed, sm_scale, causal,
     # with dropout, o is the *dropped* output, so delta still equals
     # sum_k p_dropped * dp — the identity survives unchanged.
     delta = jnp.sum(o.astype(jnp.float32) * dof, axis=-1)      # [b,h,lq]
-    lse_denom = jnp.where(l == 0.0, 1.0, l)
     rows = jnp.arange(lq)[:, None]
     lq_rows = jnp.arange(lq, dtype=jnp.int32)
     seed_u = _carrier_to_u32(jnp.asarray(seed, jnp.float32)) \
@@ -316,10 +649,12 @@ def _xla_backward(q, k, v, bias, o, do, m, l, seed, sm_scale, causal,
         if bias is not None:
             bs = jax.lax.dynamic_slice_in_dim(bias, ki * block_k, block_k, 3)
             s = s + bs.astype(jnp.float32)
+        cols = ki * block_k + jnp.arange(block_k)[None, :]
         if causal:
-            cols = ki * block_k + jnp.arange(block_k)[None, :]
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - m[..., None]) / lse_denom[..., None]   # [b,h,q,bk]
+        if kv_len is not None:
+            s = jnp.where(cols[None, None] < kv_len, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[..., None])                        # [b,h,q,bk]
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vs.astype(jnp.float32))
         if dropout_rate > 0.0:
             dscale = _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k,
@@ -359,40 +694,96 @@ def _xla_backward(q, k, v, bias, o, do, m, l, seed, sm_scale, causal,
 # Public entry with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _swap_lh(x, layout):
+    """blhd <-> bhld (the (0,2,1,3) transpose is its own inverse)."""
+    return jnp.transpose(x, (0, 2, 1, 3)) if layout == "blhd" else x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10,
+                                                    11, 12))
 def _flash(q, k, v, bias, seed, sm_scale, causal, block_q, block_k, impl,
-           dropout_rate):
-    return _flash_fwd(q, k, v, bias, seed, sm_scale, causal, block_q,
-                      block_k, impl, dropout_rate)[0]
+           dropout_rate, kv_len, layout):
+    # primal-only path: no lse output (saves its HBM write in inference)
+    if impl in ("pallas", "pallas_interpret"):
+        out, _ = _pallas_forward(q, k, v, bias, seed, sm_scale, causal,
+                                 kv_len, block_q, block_k, dropout_rate,
+                                 layout, interpret=(impl ==
+                                                    "pallas_interpret"),
+                                 need_lse=False)
+        return out
+    out, _ = _xla_forward(_swap_lh(q, layout), _swap_lh(k, layout),
+                          _swap_lh(v, layout), bias, seed, sm_scale, causal,
+                          kv_len, block_k, dropout_rate)
+    return _swap_lh(out, layout)
+
+
+def _use_pallas_bwd(impl, bias, q, layout) -> bool:
+    """Static routing: the dq/dkv Pallas kernels serve the bias-free path
+    at long L; short sequences keep the XLA-scan backward (the [bh,lq,128]
+    lse residual costs more than recomputing the stats there, and XLA
+    fuses the scan into the surrounding step)."""
+    if impl not in ("pallas", "pallas_interpret") or bias is not None:
+        return False
+    lq = q.shape[1] if layout == "blhd" else q.shape[2]
+    return lq >= PALLAS_BWD_MIN_L
 
 
 def _flash_fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
-               impl, dropout_rate):
-    if impl == "pallas" or impl == "pallas_interpret":
-        out = _pallas_forward(q, k, v, bias, seed, sm_scale, causal, block_q,
-                              block_k, dropout_rate,
-                              interpret=(impl == "pallas_interpret"))
-        # m/l recomputed in bwd from scratch (cheap vs the matmuls there)
-        m = l = None
+               impl, dropout_rate, kv_len, layout):
+    if impl in ("pallas", "pallas_interpret"):
+        # save the lse residual only when the Pallas backward will read it;
+        # otherwise the XLA backward recomputes the row stats blockwise
+        # (cheaper than the [bh, lq, 128] HBM round-trip at short L)
+        need_lse = _use_pallas_bwd(impl, bias, q, layout)
+        out, lse = _pallas_forward(q, k, v, bias, seed, sm_scale, causal,
+                                   kv_len, block_q, block_k, dropout_rate,
+                                   layout,
+                                   interpret=(impl == "pallas_interpret"),
+                                   need_lse=need_lse)
     else:
-        out, m, l = _xla_forward(q, k, v, bias, seed, sm_scale, causal,
-                                 block_k, dropout_rate)
-    return out, (q, k, v, bias, seed, out, m, l)
+        out, lse = _xla_forward(_swap_lh(q, layout), _swap_lh(k, layout),
+                                _swap_lh(v, layout), bias, seed, sm_scale,
+                                causal, kv_len, block_k, dropout_rate)
+        out = _swap_lh(out, layout)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, impl, dropout_rate,
-               res, do):
-    q, k, v, bias, seed, out, m, l = res
-    if m is None:
-        # recompute m/l WITHOUT dropout: l must be the full softmax sum
-        _, m, l = _xla_forward(q, k, v, bias, seed, sm_scale, causal,
-                               block_k, dropout_rate=0.0)
-    dq, dk, dv, dbias = _xla_backward(q, k, v, bias, out, do, m, l, seed,
-                                      sm_scale, causal, block_k, dropout_rate)
-    return dq, dk, dv, dbias, jnp.zeros((), jnp.float32)
+               kv_len, layout, res, do):
+    q, k, v, bias, seed, out, lse = res
+    if _use_pallas_bwd(impl, bias, q, layout):
+        dq, dk, dv = _pallas_backward(
+            q, k, v, do, out, lse, seed, sm_scale, causal, kv_len, block_q,
+            block_k, dropout_rate, layout,
+            interpret=(impl == "pallas_interpret"))
+        return dq, dk, dv, None, jnp.zeros((), jnp.float32)
+    if lse is None:
+        # pallas fwd that skipped the lse residual: recompute the row stats
+        # blockwise (l must be the FULL softmax sum — dropout off)
+        _, lse = _xla_forward(_swap_lh(q, layout), _swap_lh(k, layout),
+                              _swap_lh(v, layout), bias, seed, sm_scale,
+                              causal, kv_len, block_k, dropout_rate=0.0)
+    dq, dk, dv, dbias = _xla_backward(
+        _swap_lh(q, layout), _swap_lh(k, layout), _swap_lh(v, layout), bias,
+        _swap_lh(out, layout), _swap_lh(do, layout), lse, seed, sm_scale,
+        causal, kv_len, block_k, dropout_rate)
+    return (_swap_lh(dq, layout), _swap_lh(dk, layout),
+            _swap_lh(dv, layout), dbias, jnp.zeros((), jnp.float32))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _default_block(l: int) -> int:
+    """v5e fwd+bwd sweep (BENCH_NOTES §4, r4): 1024-blocks win at every
+    L >= 1024 (larger tiles amortise the softmax VPU work against the
+    d=64-thin matmuls; 2048 exceeds even the raised VMEM scope).  Short
+    sequences keep single-block dispatch."""
+    if l >= 1024 and l % 1024 == 0:
+        return 1024
+    if l >= 1024 and l % 512 == 0:
+        return 512
+    return 256
 
 
 def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
@@ -401,11 +792,15 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                     block_k: Optional[int] = None,
                     impl: Optional[str] = None,
                     dropout_rate: float = 0.0,
-                    dropout_seed=None) -> jax.Array:
-    """Fused attention. q [B,H,Lq,D], k/v [B,H,Lk,D], optional additive bias
-    [B|1, H|1, Lq, Lk] (the fluid attn-bias convention).  impl: 'pallas'
-    (TPU), 'xla' (any backend), 'pallas_interpret' (testing); default picks
-    pallas on TPU, xla elsewhere.
+                    dropout_seed=None,
+                    layout: str = "bhld") -> jax.Array:
+    """Fused attention.  layout='bhld': q [B,H,Lq,D], k/v [B,H,Lk,D];
+    layout='blhd': q [B,Lq,H,D] etc. (head-interleaved — the kernels index
+    it directly, so callers skip the split-heads transposes).  Optional
+    additive bias [B|1, H|1, Lq, Lk] (the fluid attn-bias convention).
+    impl: 'pallas' (TPU fwd+bwd kernels), 'xla' (any backend),
+    'pallas_interpret' (testing); default picks pallas on TPU, xla
+    elsewhere.
 
     dropout_rate > 0 applies attention-probability dropout (inverted
     scaling) inside the kernel via a counter-based hash of the global
@@ -413,18 +808,16 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     dropout_seed: int/uint32 scalar (may be traced), required when
     dropout_rate > 0; same seed ⇒ same mask.
     """
+    if layout not in ("bhld", "blhd"):
+        raise ValueError(f"unknown layout {layout!r}")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    lq = q.shape[1] if layout == "blhd" else q.shape[2]
+    lk = k.shape[1] if layout == "blhd" else k.shape[2]
     if block_q is None:
-        # measured on v5e (BENCH_NOTES §4): 512-blocks are ~18% faster
-        # than 256 once the sequence spans multiple blocks; short
-        # sequences keep 256 (single-block dispatch), and ragged
-        # lengths only upgrade when 512 does not inflate the padding
-        block_q = 512 if (q.shape[2] >= 1024 and
-                          q.shape[2] % 512 == 0) else 256
+        block_q = _default_block(lq)
     if block_k is None:
-        block_k = 512 if (k.shape[2] >= 1024 and
-                          k.shape[2] % 512 == 0) else 256
+        block_k = _default_block(lk)
     if impl is None:
         impl = "pallas" if (pltpu is not None and
                             jax.default_backend() == "tpu") else "xla"
@@ -437,24 +830,32 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
         seed = seed_to_carrier(dropout_seed)
     else:
         seed = jnp.zeros((), jnp.float32)
-    lq, lk = q.shape[2], k.shape[2]
     pq = (-lq) % min(block_q, lq)
     pk = (-lk) % min(block_k, lk)
+    kv_len = None
     if pq or pk:
-        # pad to block multiples; padded keys masked via a synthetic bias
-        # column mask, padded query rows sliced off (their grad is zero)
-        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
-        colmask = jnp.where(jnp.arange(lk + pk) < lk, 0.0,
-                            DEFAULT_MASK_VALUE).astype(jnp.float32)
-        cb = colmask[None, None, None, :]
-        if bias is None:
-            bias = jnp.broadcast_to(cb, (1, 1, lq + pq, lk + pk))
-        else:
-            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pq), (0, pk))) + cb
+        # pad to block multiples: padded KEYS are masked in-kernel by the
+        # static kv_len bound (no synthetic bias tensor — r3 built one and
+        # paid its HBM reads); padded query rows are sliced off (their
+        # cotangent is zero, so they can't contaminate dk/dv)
+        seq_axis = 1 if layout == "blhd" else 2
+        padq = [(0, 0)] * 4
+        padq[seq_axis] = (0, pq)
+        padk = [(0, 0)] * 4
+        padk[seq_axis] = (0, pk)
+        q = jnp.pad(q, padq)
+        k = jnp.pad(k, padk)
+        v = jnp.pad(v, padk)
+        if pk:
+            kv_len = lk
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pq), (0, pk)))
         out = _flash(q, k, v, bias, seed, float(sm_scale), bool(causal),
-                     int(block_q), int(block_k), impl, dropout_rate)
+                     int(block_q), int(block_k), impl, dropout_rate, kv_len,
+                     layout)
+        if layout == "blhd":
+            return out[:, :lq]
         return out[:, :, :lq, :]
     return _flash(q, k, v, bias, seed, float(sm_scale), bool(causal),
-                  int(block_q), int(block_k), impl, dropout_rate)
+                  int(block_q), int(block_k), impl, dropout_rate, kv_len,
+                  layout)

@@ -50,25 +50,40 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
                   num_flatten_dims=2, param_attr=_col_attr(mp_shard))
 
-    def split_heads(x, d_head):
+    def interleave_heads(x, d_head):
         b, l = x.shape[0], x.shape[1]
-        reshaped = layers.reshape(x, [-1 if b == -1 else b, l, n_head,
-                                      d_head])
-        return layers.transpose(reshaped, [0, 2, 1, 3])
+        return layers.reshape(x, [-1 if b == -1 else b, l, n_head, d_head])
+
+    def split_heads(x, d_head):
+        return layers.transpose(interleave_heads(x, d_head), [0, 2, 1, 3])
+
+    if fused:
+        # flash/ring kernel path: O(L) memory, no [lq, lk] score tensor;
+        # attention-prob dropout happens inside the kernel (hash mask).
+        # layout='blhd': the kernel indexes [b, l, h, d] directly, so the
+        # four split/merge-heads transposes (q/k/v in, ctx out — real HBM
+        # round-trips at long L, BENCH_NOTES §2) never exist.
+        q = interleave_heads(q, d_key)      # [b, lq, h, dk]
+        k = interleave_heads(k, d_key)
+        v = interleave_heads(v, d_value)
+        ctx = layers.fused_attention(q, k, v, bias=attn_bias,
+                                     causal=causal,
+                                     sm_scale=float(d_key) ** -0.5,
+                                     dropout_rate=dropout_rate,
+                                     seq_parallel=seq_parallel,
+                                     layout="blhd")
+        b, l = ctx.shape[0], ctx.shape[1]
+        return layers.fc(
+            input=layers.reshape(
+                ctx, [-1 if b == -1 else b, l, n_head * d_value]),
+            size=d_model, bias_attr=False, num_flatten_dims=2,
+            param_attr=_row_attr(mp_shard))
 
     q = split_heads(q, d_key)           # [b, h, lq, dk]
     k = split_heads(k, d_key)
     v = split_heads(v, d_value)
 
-    if fused:
-        # flash/ring kernel path: O(L) memory, no [lq, lk] score tensor;
-        # attention-prob dropout happens inside the kernel (hash mask)
-        ctx = layers.fused_attention(q, k, v, bias=attn_bias,
-                                     causal=causal,
-                                     sm_scale=float(d_key) ** -0.5,
-                                     dropout_rate=dropout_rate,
-                                     seq_parallel=seq_parallel)
-    elif causal:
+    if causal:
         raise NotImplementedError(
             "in-graph causal masking without a bias tensor requires the "
             "fused attention path (fused=True); pass a causal attn_bias "

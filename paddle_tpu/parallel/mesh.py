@@ -73,7 +73,8 @@ def feed_sharding(mesh: Mesh, value):
         # shape/dtype attrs only: np.asarray on a process-spanning global
         # jax.Array raises (non-addressable shards), and pre-sharded
         # device feeds are exactly the multi-host fast path
-        shape = tuple(getattr(v, "shape", np.asarray(v).shape))
+        s = getattr(v, "shape", None)   # () is a valid (0-d) shape — no `or`
+        shape = tuple(s) if s is not None else np.asarray(v).shape
         if dp and len(shape) >= 1 and shape[0] % mesh.shape[dp[0]] == 0:
             return NamedSharding(mesh, PartitionSpec(dp[0]))
         return NamedSharding(mesh, PartitionSpec())
@@ -91,7 +92,8 @@ def state_sharding(mesh: Mesh, value, annotation: Optional[Sequence]):
     first dim divisible by the axis size — preferring the annotated dim —
     or drops out entirely if none divides."""
     def leaf(v, ann):
-        shape = tuple(getattr(v, "shape", None) or np.asarray(v).shape)
+        s = getattr(v, "shape", None)   # () is a valid (0-d) shape — no `or`
+        shape = tuple(s) if s is not None else np.asarray(v).shape
         ndim = len(shape)
         if not ann:
             return NamedSharding(mesh, PartitionSpec())

@@ -294,3 +294,122 @@ def test_ring_dropout_runs_and_differs():
         mesh, q, k, v, dp_axis=None, dropout_rate=0.4,
         dropout_seed=5).sum())(q)
     assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels + layouts (r4: bwd moved from XLA scan to Pallas)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def pallas_bwd(monkeypatch):
+    """Route even tiny shapes through the dq/dkv Pallas kernels (production
+    keeps the XLA-scan backward below PALLAS_BWD_MIN_L)."""
+    import importlib
+    mod = importlib.import_module("paddle_tpu.kernels.flash_attention")
+    monkeypatch.setattr(mod, "PALLAS_BWD_MIN_L", 0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_pallas_grad_matches_naive(causal, pallas_bwd):
+    # bias-free grads route through the dq/dkv Pallas kernels
+    q, k, v = make_qkv(b=1, h=2, lq=32, lk=32, d=8)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=16,
+                               block_k=16, impl="pallas_interpret").sum()
+
+    def loss_naive(q, k, v):
+        return naive_attention(q, k, v, causal=causal).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_flash_pallas_grad_weighted_cotangent(pallas_bwd):
+    # non-uniform do exercises delta = rowsum(o*do) properly
+    q, k, v = make_qkv(b=1, h=2, lq=32, lk=32, d=8)
+    w = jnp.asarray(np.random.RandomState(5).randn(1, 2, 32, 8)
+                    .astype(np.float32))
+
+    def loss(fn):
+        def f(q, k, v):
+            return (fn(q, k, v) * w).sum()
+        return f
+
+    flash = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=8, block_k=8,
+        impl="pallas_interpret"))
+    naive = loss(lambda q, k, v: naive_attention(q, k, v, causal=True))
+    g1 = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_flash_pallas_dropout_grad_matches_xla(pallas_bwd):
+    # in-kernel hash dropout: pallas bwd mask must equal the XLA path's
+    q, k, v = make_qkv(b=1, h=2, lq=32, lk=32, d=8)
+
+    def g(impl):
+        return jax.grad(lambda q, k, v: flash_attention(
+            q, k, v, block_q=16, block_k=16, impl=impl,
+            dropout_rate=0.25, dropout_seed=11).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(g("pallas_interpret"), g("xla")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_pallas_non_divisible_kv_len(pallas_bwd):
+    # padding is masked by the static kv_len bound inside the kernels (no
+    # synthetic bias tensor) — fwd and grad
+    q, k, v = make_qkv(b=1, h=2, lq=40, lk=40, d=8)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                          impl="pallas_interpret")
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    g1 = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=32,
+        impl="pallas_interpret").sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: naive_attention(
+        q, k, v, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas_interpret"])
+def test_flash_blhd_layout_matches_bhld(impl, pallas_bwd):
+    # layout='blhd' takes [b, l, h, d] directly — no split-heads transposes
+    q, k, v = make_qkv(b=2, h=2, lq=32, lk=32, d=8)
+    qt = jnp.transpose(q, (0, 2, 1, 3))        # -> [b, l, h, d]
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = flash_attention(qt, kt, vt, causal=True, block_q=16, block_k=16,
+                          impl=impl, layout="blhd")
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(jnp.transpose(out, (0, 2, 1, 3)), ref,
+                               atol=2e-5, rtol=2e-5)
+
+    g1 = jax.grad(lambda x: flash_attention(
+        x, kt, vt, causal=True, block_q=16, block_k=16, impl=impl,
+        layout="blhd").sum())(qt)
+    g2 = jax.grad(lambda x: naive_attention(x, k, v, causal=True).sum())(q)
+    np.testing.assert_allclose(jnp.transpose(g1, (0, 2, 1, 3)), g2,
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_flash_pallas_rect_blocks_and_lengths(pallas_bwd):
+    # lq != lk and block_q != block_k through the pallas kernels
+    q, k, v = make_qkv(b=1, h=2, lq=32, lk=64, d=8)
+    out = flash_attention(q, k, v, block_q=16, block_k=32,
+                          impl="pallas_interpret")
+    ref = naive_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    g1 = jax.grad(lambda k: flash_attention(
+        q, k, v, block_q=16, block_k=32,
+        impl="pallas_interpret").sum())(k)
+    g2 = jax.grad(lambda k: naive_attention(q, k, v).sum())(k)
+    np.testing.assert_allclose(g1, g2, atol=5e-4, rtol=5e-4)

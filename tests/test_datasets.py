@@ -469,3 +469,34 @@ def test_r3_synthetic_schemas(monkeypatch):
     assert better.shape == (mq2007.N_FEATURES,)
     rel, feat = next(mq2007.train("pointwise")())
     assert feat.shape == (mq2007.N_FEATURES,)
+
+
+def test_mq2007_zip_auto_extract(tmp_path, monkeypatch):
+    """A zip archive dropped in (or fetched into) the cache dir is
+    extracted automatically — the stdlib-extractable path the official
+    .rar cannot offer (r3 VERDICT missing#7)."""
+    import io
+    import zipfile
+
+    from paddle_tpu.datasets import common, mq2007
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    monkeypatch.delenv("PADDLE_TPU_SYNTHETIC", raising=False)  # conftest sets it
+    base = common.cache_dir("mq2007")
+    line = ("2 qid:10 " +
+            " ".join(f"{i+1}:{(i % 5) * 0.1:.1f}" for i in range(46)) +
+            " # doc1\n")
+    line2 = ("0 qid:10 " +
+             " ".join(f"{i+1}:{(i % 7) * 0.05:.2f}" for i in range(46)) +
+             " # doc2\n")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("MQ2007/Fold1/train.txt", line + line2)
+    import os
+    with open(os.path.join(base, "MQ2007.zip"), "wb") as f:
+        f.write(buf.getvalue())
+
+    rows = list(mq2007.train(format="pointwise")())
+    assert len(rows) == 2
+    rel, feat = rows[0]
+    assert rel == 2 and feat.shape == (46,)
