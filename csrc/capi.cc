@@ -13,55 +13,32 @@
 // host-side/embedded serving.  The TPU serving tier is pjrt_runner.cc
 // (same ABI, StableHLO through the PJRT C API).
 
-#include <zlib.h>
-
 #include <algorithm>
 #include <cmath>
 #include <cstring>
-#include <fstream>
 #include <map>
 #include <memory>
 #include <numeric>
-#include <sstream>
 #include <string>
 #include <vector>
 
 #include "desc.h"
+#include "tensor_file.h"
 
 namespace ptpu {
 namespace {
 
-// -- framing (fluid/io.py frame_bytes: MAGIC2 + payload + crc32le) ---------
-
-const char kMagic2[] = "PDTPU\x02";
-
-std::string read_file(const std::string& path) {
-  std::ifstream f(path, std::ios::binary);
-  if (!f) throw std::runtime_error("cannot open " + path);
-  std::ostringstream ss;
-  ss << f.rdbuf();
-  return ss.str();
-}
-
-std::string unframe(const std::string& data, const std::string& what) {
-  const size_t mlen = 6;
-  if (data.size() < mlen + 4 ||
-      std::memcmp(data.data(), kMagic2, mlen) != 0)
-    throw std::runtime_error(what + ": bad magic/too short");
-  std::string payload = data.substr(mlen, data.size() - mlen - 4);
-  uint32_t want;
-  std::memcpy(&want, data.data() + data.size() - 4, 4);
-  uint32_t got = crc32(0, (const Bytef*)payload.data(), payload.size());
-  if (got != want)
-    throw std::runtime_error(what + ": crc mismatch (corrupt file)");
-  return payload;
-}
-
 // -- tensors ----------------------------------------------------------------
+// framing/parse shared with the PJRT runner (tensor_file.h); this engine
+// computes in float32, so the raw dtype-preserved bytes convert here.
+
+using ptpu::read_file;
+using ptpu::unframe;
 
 struct Tensor {
   std::vector<int64_t> shape;
   std::vector<float> data;
+  std::vector<int32_t> lengths;   // per-row valid lengths when a sequence
 
   int64_t numel() const {
     int64_t n = 1;
@@ -70,58 +47,42 @@ struct Tensor {
   }
 };
 
-// fluid/io.py _tensor_bytes: [u32 header_len][json header][raw data]
-Tensor parse_tensor(const std::string& payload, const std::string& what) {
-  if (payload.size() < 4) throw std::runtime_error(what + ": truncated");
-  uint32_t hlen;
-  std::memcpy(&hlen, payload.data(), 4);
-  if (payload.size() < 4 + (size_t)hlen)
-    throw std::runtime_error(what + ": header length exceeds payload");
-  const std::string header_text = payload.substr(4, hlen);
-  JsonParser jp(header_text);  // parser keeps a reference — must outlive it
-  JsonPtr h = jp.parse();
-  std::string dtype = h->at("dtype")->s;
+Tensor from_raw(const ptpu::RawTensor& r, const std::string& what) {
   Tensor t;
-  int64_t n = 1;
-  for (auto& e : h->at("shape")->arr) {
-    if (e->i < 0) throw std::runtime_error(what + ": negative dim");
-    t.shape.push_back(e->i);
-    if (e->i != 0 && n > ((int64_t)1 << 40) / e->i)
-      throw std::runtime_error(what + ": shape product overflow");
-    n *= e->i;
-  }
-  const char* raw = payload.data() + 4 + hlen;
-  size_t avail = payload.size() - 4 - hlen;
+  t.shape = r.shape;
+  t.lengths = r.lengths;
+  int64_t n = t.numel();
   t.data.resize(n);
-  if (dtype == "float32") {
-    if (avail < (size_t)n * 4) throw std::runtime_error(what + ": short f32");
+  const char* raw = r.data.data();
+  if (r.dtype == "float32") {
     std::memcpy(t.data.data(), raw, n * 4);
-  } else if (dtype == "float64") {
-    if (avail < (size_t)n * 8) throw std::runtime_error(what + ": short f64");
+  } else if (r.dtype == "float64") {
     for (int64_t i = 0; i < n; ++i) {
       double v;
       std::memcpy(&v, raw + i * 8, 8);
       t.data[i] = (float)v;
     }
-  } else if (dtype == "int64" || dtype == "int32") {
-    int w = dtype == "int64" ? 8 : 4;
-    if (avail < (size_t)n * w) throw std::runtime_error(what + ": short int");
+  } else if (r.dtype == "int64") {
     for (int64_t i = 0; i < n; ++i) {
       int64_t v;
-      if (w == 8) {
-        std::memcpy(&v, raw + i * 8, 8);
-      } else {
-        int32_t v32;  // read at native width so negatives sign-extend
-        std::memcpy(&v32, raw + i * 4, 4);
-        v = v32;
-      }
+      std::memcpy(&v, raw + i * 8, 8);
+      t.data[i] = (float)v;
+    }
+  } else if (r.dtype == "int32") {
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t v;  // read at native width so negatives sign-extend
+      std::memcpy(&v, raw + i * 4, 4);
       t.data[i] = (float)v;
     }
   } else {
-    throw std::runtime_error(what + ": unsupported dtype " + dtype +
+    throw std::runtime_error(what + ": unsupported dtype " + r.dtype +
                              " (native serving engine is float32)");
   }
   return t;
+}
+
+Tensor parse_tensor(const std::string& payload, const std::string& what) {
+  return from_raw(ptpu::parse_tensor_raw(payload, what), what);
 }
 
 // -- kernels ----------------------------------------------------------------
@@ -423,15 +384,295 @@ void Engine::run_op(const OpDesc& op) {
                 pt == "max" ? best : (float)(sum / std::max<int64_t>(1, cnt));
           }
     out(op) = std::move(r);
+  } else if (t == "lookup_table") {
+    // embedding gather (reference lookup_table_op.cc; fluid emitter
+    // ops/tensor_ops.py lookup_table) — ids values ride the float store
+    // (exact for |id| < 2^24; vocab ids comfortably fit)
+    Tensor& w = in(op, "W");
+    Tensor& ids = in(op, "Ids");
+    int64_t v = w.shape.at(0), d = w.numel() / std::max<int64_t>(1, v);
+    std::vector<int64_t> ish = ids.shape;
+    if (!ish.empty() && ish.back() == 1) ish.pop_back();
+    int64_t n = ids.numel();
+    bool has_pad = op.attrs && op.attrs->get("padding_idx");
+    int64_t pad = op.attr_int("padding_idx", 0);
+    Tensor r;
+    r.shape = ish;
+    r.shape.push_back(d);
+    r.data.assign(n * d, 0.f);
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t idx = (int64_t)ids.data[i];
+      if (has_pad && idx == pad) continue;           // zeros row
+      if (idx < 0 || idx >= v)
+        throw std::runtime_error("lookup_table: id out of range");
+      std::memcpy(r.data.data() + i * d, w.data.data() + idx * d, d * 4);
+    }
+    r.lengths = ids.lengths;
+    out(op) = std::move(r);
+  } else if (t == "sequence_pool") {
+    // reference sequence_pool_op.cc / fluid ops/sequence_ops.py: reduce
+    // the time axis over each row's valid prefix -> dense [batch, ...]
+    Tensor& x = in(op, "X");
+    std::string ptype = op.attr_str("pooltype", "sum");
+    for (auto& c : ptype) c = std::tolower(c);
+    if (x.lengths.empty() || x.shape.size() < 2)
+      throw std::runtime_error("sequence_pool: input is not a sequence");
+    int64_t b = x.shape[0], tt = x.shape[1];
+    int64_t inner = x.numel() / std::max<int64_t>(1, b * tt);
+    Tensor r, idx;
+    r.shape = {b};
+    r.shape.insert(r.shape.end(), x.shape.begin() + 2, x.shape.end());
+    r.data.assign(b * inner, 0.f);
+    idx.shape = r.shape;
+    idx.data.assign(b * inner, 0.f);
+    for (int64_t i = 0; i < b; ++i) {
+      int64_t len = std::min<int64_t>(x.lengths[i], tt);
+      const float* row = x.data.data() + i * tt * inner;
+      float* rp = r.data.data() + i * inner;
+      float* ip = idx.data.data() + i * inner;
+      if (ptype == "last" || ptype == "first") {
+        int64_t at = ptype == "first" ? 0 : std::max<int64_t>(len - 1, 0);
+        std::memcpy(rp, row + at * inner, inner * 4);
+        for (int64_t j = 0; j < inner; ++j) ip[j] = (float)at;
+      } else if (ptype == "max") {
+        for (int64_t j = 0; j < inner; ++j) {
+          float best = -3.4e38f;
+          int64_t bi = 0;
+          for (int64_t s2 = 0; s2 < len; ++s2)
+            if (row[s2 * inner + j] > best) {
+              best = row[s2 * inner + j];
+              bi = s2;
+            }
+          rp[j] = len ? best : 0.f;
+          ip[j] = (float)bi;
+        }
+      } else if (ptype == "sum" || ptype == "average" || ptype == "sqrt") {
+        for (int64_t j = 0; j < inner; ++j) {
+          double acc = 0;
+          for (int64_t s2 = 0; s2 < len; ++s2) acc += row[s2 * inner + j];
+          double div = ptype == "average" ? std::max<int64_t>(len, 1)
+                       : ptype == "sqrt"
+                           ? std::sqrt((double)std::max<int64_t>(len, 1))
+                           : 1.0;
+          rp[j] = (float)(acc / div);
+        }
+      } else {
+        throw std::runtime_error("sequence_pool: unsupported pooltype " +
+                                 ptype);
+      }
+    }
+    out(op) = std::move(r);
+    if (op.outputs.count("MaxIndex")) out(op, "MaxIndex") = std::move(idx);
+  } else if (t == "dynamic_lstm") {
+    // reference lstm_op.cc; math mirrors ops/rnn_ops.py dynamic_lstm:
+    // input [b, t, 4s] pre-projected, gate packing (candidate, in, forget,
+    // out), optional peepholes in the bias tail, masked-carry semantics
+    // (padded steps output zero and keep the carry)
+    Tensor& x = in(op, "Input");
+    Tensor& w = in(op, "Weight");
+    Tensor& bias = in(op, "Bias");
+    int64_t size = w.shape.at(0);
+    bool peep = op.attr_bool("use_peepholes", true);
+    bool rev = op.attr_bool("is_reverse", false);
+    if (x.lengths.empty() || x.shape.size() != 3 ||
+        x.shape[2] != 4 * size)
+      throw std::runtime_error("dynamic_lstm: bad input layout");
+    int64_t b = x.shape[0], tt = x.shape[1];
+    const float* gb = bias.data.data();           // [4s] gate bias
+    const float* w_ic = peep ? gb + 4 * size : nullptr;
+    const float* w_fc = peep ? gb + 5 * size : nullptr;
+    const float* w_oc = peep ? gb + 6 * size : nullptr;
+    Tensor hid, cell;
+    hid.shape = {b, tt, size};
+    cell.shape = {b, tt, size};
+    hid.data.assign(b * tt * size, 0.f);
+    cell.data.assign(b * tt * size, 0.f);
+    hid.lengths = x.lengths;
+    cell.lengths = x.lengths;
+    std::vector<float> h(size), c(size), gates(4 * size);
+    auto sig = [](float v2) { return 1.f / (1.f + std::exp(-v2)); };
+    for (int64_t i = 0; i < b; ++i) {
+      int64_t len = std::min<int64_t>(x.lengths[i], tt);
+      std::fill(h.begin(), h.end(), 0.f);
+      std::fill(c.begin(), c.end(), 0.f);
+      for (int64_t step = 0; step < len; ++step) {
+        int64_t t2 = rev ? len - 1 - step : step;
+        const float* xt = x.data.data() + (i * tt + t2) * 4 * size;
+        // gates = xt + h @ W + bias   (W [s, 4s])
+        for (int64_t g = 0; g < 4 * size; ++g)
+          gates[g] = xt[g] + gb[g];
+        for (int64_t p = 0; p < size; ++p) {
+          float hv = h[p];
+          if (hv == 0.f) continue;
+          const float* wr = w.data.data() + p * 4 * size;
+          for (int64_t g = 0; g < 4 * size; ++g) gates[g] += hv * wr[g];
+        }
+        float* hp = hid.data.data() + (i * tt + t2) * size;
+        float* cp = cell.data.data() + (i * tt + t2) * size;
+        for (int64_t j = 0; j < size; ++j) {
+          float gc = gates[j];                    // candidate first
+          float gi = gates[size + j];
+          float gf = gates[2 * size + j];
+          float go = gates[3 * size + j];
+          if (peep) {
+            gi += w_ic[j] * c[j];
+            gf += w_fc[j] * c[j];
+          }
+          float iv = sig(gi), fv = sig(gf);
+          float cn = fv * c[j] + iv * std::tanh(gc);
+          if (peep) go += w_oc[j] * cn;
+          float hn = sig(go) * std::tanh(cn);
+          c[j] = cn;
+          h[j] = hn;
+          hp[j] = hn;
+          cp[j] = cn;
+        }
+      }
+    }
+    out(op, "Hidden") = std::move(hid);
+    if (op.outputs.count("Cell")) out(op, "Cell") = std::move(cell);
+  } else if (t == "dynamic_gru") {
+    // reference gru_op.cc; math mirrors ops/rnn_ops.py dynamic_gru:
+    // input [b, t, 3s] pre-projected; W = [s, 2s | s]; out = (1-u)h + u*c
+    Tensor& x = in(op, "Input");
+    Tensor& w = in(op, "Weight");
+    int64_t size = w.shape.at(0);
+    bool rev = op.attr_bool("is_reverse", false);
+    if (x.lengths.empty() || x.shape.size() != 3 ||
+        x.shape[2] != 3 * size)
+      throw std::runtime_error("dynamic_gru: bad input layout");
+    int64_t b = x.shape[0], tt = x.shape[1];
+    std::vector<float> zero_bias(3 * size, 0.f);
+    const float* gb = has_in(op, "Bias") ? in(op, "Bias").data.data()
+                                         : zero_bias.data();
+    Tensor hid;
+    hid.shape = {b, tt, size};
+    hid.data.assign(b * tt * size, 0.f);
+    hid.lengths = x.lengths;
+    std::vector<float> h(size), ur(2 * size), cvec(size);
+    auto sig = [](float v2) { return 1.f / (1.f + std::exp(-v2)); };
+    for (int64_t i = 0; i < b; ++i) {
+      int64_t len = std::min<int64_t>(x.lengths[i], tt);
+      std::fill(h.begin(), h.end(), 0.f);
+      for (int64_t step = 0; step < len; ++step) {
+        int64_t t2 = rev ? len - 1 - step : step;
+        const float* xt = x.data.data() + (i * tt + t2) * 3 * size;
+        for (int64_t g = 0; g < 2 * size; ++g) ur[g] = xt[g] + gb[g];
+        for (int64_t p = 0; p < size; ++p) {
+          float hv = h[p];
+          if (hv == 0.f) continue;
+          const float* wr = w.data.data() + p * 3 * size;   // [s, 3s]
+          for (int64_t g = 0; g < 2 * size; ++g) ur[g] += hv * wr[g];
+        }
+        for (int64_t g = 0; g < 2 * size; ++g) ur[g] = sig(ur[g]);
+        // candidate: x_c + (r*h) @ W_c + b_c
+        for (int64_t j = 0; j < size; ++j)
+          cvec[j] = xt[2 * size + j] + gb[2 * size + j];
+        for (int64_t p = 0; p < size; ++p) {
+          float rh = ur[size + p] * h[p];
+          if (rh == 0.f) continue;
+          const float* wr = w.data.data() + p * 3 * size + 2 * size;
+          for (int64_t j = 0; j < size; ++j) cvec[j] += rh * wr[j];
+        }
+        float* hp = hid.data.data() + (i * tt + t2) * size;
+        for (int64_t j = 0; j < size; ++j) {
+          float u = ur[j];
+          float hn = (1.f - u) * h[j] + u * std::tanh(cvec[j]);
+          h[j] = hn;
+          hp[j] = hn;
+        }
+      }
+    }
+    out(op, "Hidden") = std::move(hid);
+  } else if (t == "concat") {
+    auto& names = op.inputs.at("X");
+    std::vector<const Tensor*> xs;
+    for (auto& nm : names) {
+      auto it = vars.find(nm);
+      if (it == vars.end())
+        throw std::runtime_error("concat: input " + nm + " missing");
+      xs.push_back(&it->second);
+    }
+    int64_t axis = op.attr_int("axis", 0);
+    int64_t rank = (int64_t)xs[0]->shape.size();
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= rank)
+      throw std::runtime_error("concat: axis out of range");
+    for (auto* xp : xs) {              // non-axis dims must agree: the
+      if ((int64_t)xp->shape.size() != rank)   // memcpys below trust it
+        throw std::runtime_error("concat: rank mismatch");
+      for (int64_t i2 = 0; i2 < rank; ++i2)
+        if (i2 != axis && xp->shape[i2] != xs[0]->shape[i2])
+          throw std::runtime_error("concat: non-axis dim mismatch");
+    }
+    Tensor r;
+    r.shape = xs[0]->shape;
+    int64_t cat = 0;
+    for (auto* xp : xs) cat += xp->shape.at(axis);
+    r.shape[axis] = cat;
+    int64_t outer = 1, inner = 1;
+    for (int64_t i2 = 0; i2 < axis; ++i2) outer *= r.shape[i2];
+    for (int64_t i2 = axis + 1; i2 < rank; ++i2) inner *= r.shape[i2];
+    r.data.resize(outer * cat * inner);
+    int64_t off = 0;
+    for (auto* xp : xs) {
+      int64_t mid = xp->shape.at(axis);
+      for (int64_t o = 0; o < outer; ++o)
+        std::memcpy(r.data.data() + (o * cat + off) * inner,
+                    xp->data.data() + o * mid * inner,
+                    mid * inner * 4);
+      off += mid;
+    }
+    r.lengths = xs[0]->lengths;
+    out(op) = std::move(r);
+  } else if (t == "sum") {
+    auto& names = op.inputs.at("X");
+    Tensor r;
+    for (auto& nm : names) {
+      auto it = vars.find(nm);
+      if (it == vars.end())
+        throw std::runtime_error("sum: input " + nm + " missing");
+      if (r.data.empty()) {
+        r = it->second;
+      } else {
+        if (it->second.shape != r.shape)
+          throw std::runtime_error("sum: input shape mismatch");
+        for (int64_t i2 = 0; i2 < r.numel(); ++i2)
+          r.data[i2] += it->second.data[i2];
+      }
+    }
+    out(op) = std::move(r);
   } else {
     throw std::runtime_error(
         "native inference engine: unsupported op '" + t +
         "' (supported: feed/fetch, mul, elementwise_*, activations, "
         "softmax, scale, reshape, transpose, mean, dropout, batch_norm, "
-        "conv2d, pool2d — use the PJRT/StableHLO tier for anything XLA "
-        "can run)");
+        "conv2d, pool2d, lookup_table, sequence_pool, dynamic_lstm, "
+        "dynamic_gru, concat, sum — use the PJRT/StableHLO tier for "
+        "anything XLA can run)");
   }
+  // sequence lengths ride along ops that keep the [batch, time] leading
+  // dims (the reference copies lod input->output in these kernels)
+  static const char* kSeqTransparent[] = {
+      "mul", "elementwise_add", "elementwise_sub", "elementwise_mul",
+      "elementwise_div", "relu", "tanh", "sigmoid", "exp", "sqrt", "abs",
+      "softmax", "scale", "dropout"};
+  for (auto* st : kSeqTransparent)
+    if (t == st) {
+      const char* slot = op.inputs.count("X") ? "X" : "Input";
+      if (op.inputs.count(slot) && has_in(op, slot)) {
+        Tensor& x0 = in(op, slot);
+        if (!x0.lengths.empty() && op.outputs.count("Out")) {
+          Tensor& o = out(op);
+          if (!o.shape.empty() && !x0.shape.empty() &&
+              o.shape[0] == x0.shape[0])
+            o.lengths = x0.lengths;
+        }
+      }
+      break;
+    }
 }
+
 
 void Engine::forward() {
   outputs.clear();
@@ -513,9 +754,12 @@ const char* ptpu_output_name(void* h, int i) {
 }
 
 // inputs follow the feed-op column order (ptpu_input_name order).
-int ptpu_forward(void* h, const float* const* inputs,
-                 const int64_t* const* shapes, const int* ndims,
-                 int n_inputs) {
+// `lengths` (nullable, per input) carries sequence row lengths — the
+// reference capi's paddle_arguments_set_sequence_start_positions
+// (capi/arguments.cpp), dense-pair form: padded data + int32 lengths.
+int ptpu_forward_seq(void* h, const float* const* inputs,
+                     const int64_t* const* shapes, const int* ndims,
+                     const int32_t* const* lengths, int n_inputs) {
   auto* eng = (ptpu::Engine*)h;
   try {
     if (n_inputs != (int)eng->feed_names.size())
@@ -530,6 +774,8 @@ int ptpu_forward(void* h, const float* const* inputs,
         n *= shapes[i][d];
       }
       t.data.assign(inputs[i], inputs[i] + n);
+      if (lengths && lengths[i])
+        t.lengths.assign(lengths[i], lengths[i] + t.shape.at(0));
       eng->vars[eng->feed_names[i]] = std::move(t);
     }
     eng->forward();
@@ -540,6 +786,12 @@ int ptpu_forward(void* h, const float* const* inputs,
   }
 }
 
+int ptpu_forward(void* h, const float* const* inputs,
+                 const int64_t* const* shapes, const int* ndims,
+                 int n_inputs) {
+  return ptpu_forward_seq(h, inputs, shapes, ndims, nullptr, n_inputs);
+}
+
 int ptpu_output_rank(void* h, int i) {
   return (int)((ptpu::Engine*)h)->outputs.at(i).shape.size();
 }
@@ -548,6 +800,11 @@ const int64_t* ptpu_output_shape(void* h, int i) {
 }
 const float* ptpu_output_data(void* h, int i) {
   return ((ptpu::Engine*)h)->outputs.at(i).data.data();
+}
+// non-null when output i is a sequence (one int32 length per batch row)
+const int32_t* ptpu_output_lengths(void* h, int i) {
+  auto& t = ((ptpu::Engine*)h)->outputs.at(i);
+  return t.lengths.empty() ? nullptr : t.lengths.data();
 }
 
 void ptpu_destroy(void* h) { delete (ptpu::Engine*)h; }

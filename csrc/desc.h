@@ -70,6 +70,11 @@ struct OpDesc {
     auto a = attrs->get(k);
     return (a && a->type == Json::BOOL) ? a->b : dflt;
   }
+  std::string attr_str(const std::string& k, const std::string& dflt) const {
+    if (!attrs || attrs->type != Json::OBJECT) return dflt;
+    auto a = attrs->get(k);
+    return (a && a->type == Json::STRING) ? a->s : dflt;
+  }
   std::vector<int64_t> attr_ints(const std::string& k) const {
     std::vector<int64_t> out;
     if (!attrs || attrs->type != Json::OBJECT) return out;

@@ -11,9 +11,14 @@
 //
 // Inputs: <model_dir>/model.stablehlo (textual MLIR emitted by
 // fluid.io.save_inference_model(..., export_stablehlo=True)) and
-// model.stablehlo.json ({"inputs": [{name, shape, dtype}], "outputs":
-// [{shape}]}).  Parameters are baked into the module as constants, so
-// forward takes only the user feeds (float32).
+// model.stablehlo.json ({"inputs": [{name, shape, dtype, lod?}],
+// "params": [{name, shape, dtype}], "outputs": [{shape, dtype}]}).
+// Parameters are module ARGUMENTS: each is loaded from the CRC-framed
+// tensor file <model_dir>/<name> (the save_persistables artifact) and
+// uploaded to the device ONCE at create time — so the module text stays
+// small at any model size and re-export is not needed per checkpoint.
+// Feeds are dtype-tagged (float32/int32/int64); sequence feeds appear as
+// a data input plus an int32 "<name>.lengths" input.
 
 #include <dlfcn.h>
 
@@ -25,6 +30,7 @@
 #include <vector>
 
 #include "json.h"
+#include "tensor_file.h"
 #include "xla/pjrt/c/pjrt_c_api.h"
 
 namespace ptpu_pjrt {
@@ -32,33 +38,56 @@ namespace {
 
 thread_local std::string g_err;
 
-std::string read_file(const std::string& path) {
-  std::ifstream f(path, std::ios::binary);
-  if (!f) throw std::runtime_error("cannot open " + path);
-  std::ostringstream ss;
-  ss << f.rdbuf();
-  return ss.str();
+using ptpu::read_file;
+
+PJRT_Buffer_Type dtype_to_pjrt(const std::string& dt) {
+  if (dt == "float32") return PJRT_Buffer_Type_F32;
+  if (dt == "int32") return PJRT_Buffer_Type_S32;
+  if (dt == "int64") return PJRT_Buffer_Type_S64;
+  if (dt == "bfloat16") return PJRT_Buffer_Type_BF16;
+  if (dt == "float64") return PJRT_Buffer_Type_F64;
+  throw std::runtime_error("unsupported dtype " + dt);
 }
 
-struct Meta {
-  std::vector<std::string> in_names;
-  std::vector<std::vector<int64_t>> in_shapes;
-  std::vector<std::string> in_dtypes;
-  size_t num_outputs = 0;
+struct IoSpec {
+  std::string name;
+  std::vector<int64_t> shape;
+  std::string dtype;
 };
+
+struct Meta {
+  std::vector<IoSpec> inputs;
+  std::vector<IoSpec> params;
+  std::vector<IoSpec> outputs;
+};
+
+void parse_iospec(const ptpu::JsonPtr& e, IoSpec* s, bool named) {
+  if (named) s->name = e->at("name")->s;
+  s->dtype = e->get("dtype") ? e->at("dtype")->s : "float32";
+  if (e->get("shape"))
+    for (auto& d : e->at("shape")->arr) s->shape.push_back(d->i);
+}
 
 Meta parse_meta(const std::string& text) {
   ptpu::JsonParser p(text);
   auto root = p.parse();
   Meta m;
   for (auto& e : root->at("inputs")->arr) {
-    m.in_names.push_back(e->at("name")->s);
-    m.in_dtypes.push_back(e->at("dtype")->s);
-    std::vector<int64_t> sh;
-    for (auto& d : e->at("shape")->arr) sh.push_back(d->i);
-    m.in_shapes.push_back(std::move(sh));
+    IoSpec s;
+    parse_iospec(e, &s, true);
+    m.inputs.push_back(std::move(s));
   }
-  m.num_outputs = root->at("outputs")->arr.size();
+  if (root->get("params"))
+    for (auto& e : root->at("params")->arr) {
+      IoSpec s;
+      parse_iospec(e, &s, true);
+      m.params.push_back(std::move(s));
+    }
+  for (auto& e : root->at("outputs")->arr) {
+    IoSpec s;
+    parse_iospec(e, &s, false);
+    m.outputs.push_back(std::move(s));
+  }
   return m;
 }
 
@@ -69,14 +98,18 @@ struct Runner {
   PJRT_Device* device = nullptr;
   PJRT_LoadedExecutable* exec = nullptr;
   Meta meta;
-  // last forward's outputs, copied to host
+  std::vector<PJRT_Buffer*> param_bufs;   // device-resident, upload once
+  // last forward's outputs, copied to host (raw bytes, meta dtype)
   std::vector<std::vector<int64_t>> out_shapes;
-  std::vector<std::vector<float>> out_data;
+  std::vector<std::string> out_dtypes;
+  std::vector<std::vector<char>> out_raw;
 
   ~Runner();
   void check(PJRT_Error* err, const char* what);
   void load(const std::string& model_dir, const std::string& plugin);
-  void forward(const float* const* inputs);
+  PJRT_Buffer* upload(const void* data, const std::string& dtype,
+                      const std::vector<int64_t>& dims, const char* what);
+  void forward(const void* const* inputs);
   void await_event(PJRT_Event* ev, const char* what);
   void destroy_buffer(PJRT_Buffer* b);
 };
@@ -251,38 +284,65 @@ void Runner::load(const std::string& model_dir, const std::string& plugin) {
     api->PJRT_Executable_Destroy(&ed);
   }
   check(no_err, "num outputs");
-  if (no.num_outputs != meta.num_outputs)
+  if (no.num_outputs != meta.outputs.size())
     throw std::runtime_error(
-        "model.stablehlo.json outputs (" + std::to_string(meta.num_outputs) +
+        "model.stablehlo.json outputs (" +
+        std::to_string(meta.outputs.size()) +
         ") disagree with compiled executable (" +
         std::to_string(no.num_outputs) + ") — stale meta?");
+
+  // parameters: read each CRC-framed tensor file, upload once.  A dtype
+  // mismatch between file and meta is a stale-export error, not a cast.
+  param_bufs.reserve(meta.params.size());
+  for (auto& p : meta.params) {
+    ptpu::RawTensor t = ptpu::parse_tensor_raw(
+        ptpu::unframe(read_file(model_dir + "/" + p.name), p.name), p.name);
+    if (t.dtype != p.dtype)
+      throw std::runtime_error(
+          "param " + p.name + ": file dtype " + t.dtype +
+          " != meta dtype " + p.dtype + " (stale export?)");
+    if (t.shape != p.shape)
+      throw std::runtime_error("param " + p.name +
+                               ": file/meta shape mismatch");
+    param_bufs.push_back(
+        upload(t.data.data(), p.dtype, p.shape, p.name.c_str()));
+  }
 }
 
-void Runner::forward(const float* const* inputs) {
-  size_t n = meta.in_names.size();
+PJRT_Buffer* Runner::upload(const void* data, const std::string& dtype,
+                            const std::vector<int64_t>& dims,
+                            const char* what) {
+  PJRT_Client_BufferFromHostBuffer_Args hb;
+  std::memset(&hb, 0, sizeof(hb));
+  hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  hb.client = client;
+  hb.data = data;
+  hb.type = dtype_to_pjrt(dtype);
+  hb.dims = dims.data();
+  hb.num_dims = dims.size();
+  hb.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  hb.device = device;
+  check(api->PJRT_Client_BufferFromHostBuffer(&hb), what);
+  await_event(hb.done_with_host_buffer, what);
+  return hb.buffer;
+}
+
+void Runner::forward(const void* const* inputs) {
+  size_t n = meta.inputs.size();
   std::vector<PJRT_Buffer*> in_bufs(n, nullptr);
-  size_t n_out = meta.num_outputs;
+  size_t n_out = meta.outputs.size();
   std::vector<PJRT_Buffer*> out_bufs(n_out, nullptr);
   BufferGuard in_guard{this, &in_bufs};
   BufferGuard out_guard{this, &out_bufs};
 
-  for (size_t i = 0; i < n; ++i) {
-    PJRT_Client_BufferFromHostBuffer_Args hb;
-    std::memset(&hb, 0, sizeof(hb));
-    hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-    hb.client = client;
-    hb.data = inputs[i];
-    hb.type = PJRT_Buffer_Type_F32;
-    hb.dims = meta.in_shapes[i].data();
-    hb.num_dims = meta.in_shapes[i].size();
-    hb.host_buffer_semantics =
-        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-    hb.device = device;
-    check(api->PJRT_Client_BufferFromHostBuffer(&hb), "h2d");
-    in_bufs[i] = hb.buffer;
-    await_event(hb.done_with_host_buffer, "h2d await");
-  }
-  PJRT_Buffer* const* arg_list = in_bufs.data();
+  for (size_t i = 0; i < n; ++i)
+    in_bufs[i] = upload(inputs[i], meta.inputs[i].dtype,
+                        meta.inputs[i].shape, "h2d");
+  // argument order matches the exported function: params then feeds
+  std::vector<PJRT_Buffer*> args(param_bufs);
+  args.insert(args.end(), in_bufs.begin(), in_bufs.end());
+  PJRT_Buffer* const* arg_list = args.data();
   PJRT_Buffer** out_list = out_bufs.data();
   PJRT_Event* done = nullptr;
 
@@ -297,14 +357,15 @@ void Runner::forward(const float* const* inputs) {
   ex.options = &opts;
   ex.argument_lists = &arg_list;
   ex.num_devices = 1;
-  ex.num_args = n;
+  ex.num_args = args.size();
   ex.output_lists = &out_list;
   ex.device_complete_events = &done;
   check(api->PJRT_LoadedExecutable_Execute(&ex), "execute");
   await_event(done, "execute await");
 
   out_shapes.assign(n_out, {});
-  out_data.assign(n_out, {});
+  out_dtypes.assign(n_out, "");
+  out_raw.assign(n_out, {});
   for (size_t i = 0; i < n_out; ++i) {
     PJRT_Buffer_Dimensions_Args dm;
     std::memset(&dm, 0, sizeof(dm));
@@ -314,14 +375,16 @@ void Runner::forward(const float* const* inputs) {
     out_shapes[i].assign(dm.dims, dm.dims + dm.num_dims);
     int64_t numel = 1;
     for (auto d : out_shapes[i]) numel *= d;
-    out_data[i].resize(numel);
+    out_dtypes[i] = meta.outputs[i].dtype;
+    int64_t w = ptpu::dtype_width(out_dtypes[i]);
+    out_raw[i].resize(numel * w);
 
     PJRT_Buffer_ToHostBuffer_Args th;
     std::memset(&th, 0, sizeof(th));
     th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
     th.src = out_bufs[i];
-    th.dst = out_data[i].data();
-    th.dst_size = numel * sizeof(float);
+    th.dst = out_raw[i].data();
+    th.dst_size = out_raw[i].size();
     check(api->PJRT_Buffer_ToHostBuffer(&th), "d2h");
     await_event(th.event, "d2h await");
   }
@@ -329,6 +392,9 @@ void Runner::forward(const float* const* inputs) {
 }
 
 Runner::~Runner() {
+  if (api)
+    for (auto* b : param_bufs)
+      if (b) destroy_buffer(b);
   if (api && exec) {
     PJRT_LoadedExecutable_Destroy_Args a;
     std::memset(&a, 0, sizeof(a));
@@ -365,17 +431,22 @@ void* ptpu_pjrt_create(const char* model_dir, const char* plugin_path) {
 }
 
 int ptpu_pjrt_num_inputs(void* h) {
-  return (int)((ptpu_pjrt::Runner*)h)->meta.in_names.size();
+  return (int)((ptpu_pjrt::Runner*)h)->meta.inputs.size();
 }
 const char* ptpu_pjrt_input_name(void* h, int i) {
-  return ((ptpu_pjrt::Runner*)h)->meta.in_names.at(i).c_str();
+  return ((ptpu_pjrt::Runner*)h)->meta.inputs.at(i).name.c_str();
+}
+const char* ptpu_pjrt_input_dtype(void* h, int i) {
+  return ((ptpu_pjrt::Runner*)h)->meta.inputs.at(i).dtype.c_str();
 }
 int ptpu_pjrt_num_outputs(void* h) {
-  return (int)((ptpu_pjrt::Runner*)h)->meta.num_outputs;
+  return (int)((ptpu_pjrt::Runner*)h)->meta.outputs.size();
 }
 
-// inputs in model.stablehlo.json order; shapes are fixed at export time
-int ptpu_pjrt_forward(void* h, const float* const* inputs) {
+// dtype-tagged forward: inputs[i] points at data of
+// ptpu_pjrt_input_dtype(h, i), in model.stablehlo.json order; shapes are
+// fixed at export time
+int ptpu_pjrt_forward_ex(void* h, const void* const* inputs) {
   try {
     ((ptpu_pjrt::Runner*)h)->forward(inputs);
     return 0;
@@ -385,14 +456,39 @@ int ptpu_pjrt_forward(void* h, const float* const* inputs) {
   }
 }
 
+// legacy float32-only entry: valid only when every input is float32
+int ptpu_pjrt_forward(void* h, const float* const* inputs) {
+  auto* r = (ptpu_pjrt::Runner*)h;
+  for (auto& s : r->meta.inputs)
+    if (s.dtype != "float32") {
+      ptpu_pjrt::g_err = "input " + s.name + " is " + s.dtype +
+                         ": use ptpu_pjrt_forward_ex";
+      return 1;
+    }
+  return ptpu_pjrt_forward_ex(h, (const void* const*)inputs);
+}
+
 int ptpu_pjrt_output_rank(void* h, int i) {
   return (int)((ptpu_pjrt::Runner*)h)->out_shapes.at(i).size();
 }
 const int64_t* ptpu_pjrt_output_shape(void* h, int i) {
   return ((ptpu_pjrt::Runner*)h)->out_shapes.at(i).data();
 }
+const char* ptpu_pjrt_output_dtype(void* h, int i) {
+  return ((ptpu_pjrt::Runner*)h)->out_dtypes.at(i).c_str();
+}
+const void* ptpu_pjrt_output_bytes(void* h, int i) {
+  return ((ptpu_pjrt::Runner*)h)->out_raw.at(i).data();
+}
+// float32 view of output i (null + error when the output is not f32)
 const float* ptpu_pjrt_output_data(void* h, int i) {
-  return ((ptpu_pjrt::Runner*)h)->out_data.at(i).data();
+  auto* r = (ptpu_pjrt::Runner*)h;
+  if (r->out_dtypes.at(i) != "float32") {
+    ptpu_pjrt::g_err = "output " + std::to_string(i) + " is " +
+                       r->out_dtypes.at(i) + ": use ptpu_pjrt_output_bytes";
+    return nullptr;
+  }
+  return (const float*)r->out_raw.at(i).data();
 }
 
 void ptpu_pjrt_destroy(void* h) { delete (ptpu_pjrt::Runner*)h; }

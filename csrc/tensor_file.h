@@ -1,0 +1,105 @@
+// Shared reader for the checkpoint tensor wire format (fluid/io.py
+// frame_bytes + _tensor_bytes): MAGIC2 framing with a crc32 trailer, then
+// [u32 header_len][json header {dtype, shape, lod, batch}][raw data]
+// (+ int32 lengths tail for lod tensors).  Used by both the desc-walking C
+// inference engine (capi.cc) and the StableHLO/PJRT runner
+// (pjrt_runner.cc), which needs the bytes dtype-preserved for device
+// upload.  Reference analog: the LoDTensor stream deserializer in
+// operators/load_op.cc + framework/lod_tensor.cc (version + dims + dtype +
+// lod + raw bytes).
+
+#ifndef PTPU_TENSOR_FILE_H_
+#define PTPU_TENSOR_FILE_H_
+
+#include <zlib.h>
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace ptpu {
+
+inline std::string read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+// MAGIC2 + payload + crc32le trailer (fluid/io.py frame_bytes)
+inline std::string unframe(const std::string& data, const std::string& what) {
+  static const char kMagic2[] = "PDTPU\x02";
+  const size_t mlen = 6;
+  if (data.size() < mlen + 4 ||
+      std::memcmp(data.data(), kMagic2, mlen) != 0)
+    throw std::runtime_error(what + ": bad magic/too short");
+  std::string payload = data.substr(mlen, data.size() - mlen - 4);
+  uint32_t want;
+  std::memcpy(&want, data.data() + data.size() - 4, 4);
+  uint32_t got = crc32(0, (const Bytef*)payload.data(), payload.size());
+  if (got != want)
+    throw std::runtime_error(what + ": crc mismatch (corrupt file)");
+  return payload;
+}
+
+inline int64_t dtype_width(const std::string& dtype) {
+  if (dtype == "float64" || dtype == "int64") return 8;
+  if (dtype == "float32" || dtype == "int32") return 4;
+  if (dtype == "bfloat16" || dtype == "float16") return 2;
+  if (dtype == "int8" || dtype == "uint8" || dtype == "bool") return 1;
+  throw std::runtime_error("unsupported tensor dtype " + dtype);
+}
+
+struct RawTensor {
+  std::string dtype;
+  std::vector<int64_t> shape;
+  std::string data;              // raw little-endian bytes, dtype-preserved
+  std::vector<int32_t> lengths;  // per-row lengths when lod
+};
+
+// parse one framed-payload tensor, keeping the on-disk dtype
+inline RawTensor parse_tensor_raw(const std::string& payload,
+                                  const std::string& what) {
+  if (payload.size() < 4) throw std::runtime_error(what + ": truncated");
+  uint32_t hlen;
+  std::memcpy(&hlen, payload.data(), 4);
+  if (payload.size() < 4 + (size_t)hlen)
+    throw std::runtime_error(what + ": header length exceeds payload");
+  const std::string header_text = payload.substr(4, hlen);
+  JsonParser jp(header_text);  // parser keeps a reference — must outlive it
+  JsonPtr h = jp.parse();
+  RawTensor t;
+  t.dtype = h->at("dtype")->s;
+  int64_t n = 1;
+  for (auto& e : h->at("shape")->arr) {
+    if (e->i < 0) throw std::runtime_error(what + ": negative dim");
+    t.shape.push_back(e->i);
+    if (e->i != 0 && n > ((int64_t)1 << 40) / e->i)
+      throw std::runtime_error(what + ": shape product overflow");
+    n *= e->i;
+  }
+  int64_t w = dtype_width(t.dtype);
+  size_t avail = payload.size() - 4 - hlen;
+  if (avail < (size_t)(n * w))
+    throw std::runtime_error(what + ": short data");
+  t.data.assign(payload.data() + 4 + hlen, (size_t)(n * w));
+  if (h->get("lod") && h->at("lod")->b) {
+    int64_t batch = h->at("batch")->i;
+    if (avail < (size_t)(n * w) + (size_t)batch * 4)
+      throw std::runtime_error(what + ": short lengths tail");
+    t.lengths.resize(batch);
+    std::memcpy(t.lengths.data(), payload.data() + 4 + hlen + n * w,
+                (size_t)batch * 4);
+  }
+  return t;
+}
+
+}  // namespace ptpu
+
+#endif  // PTPU_TENSOR_FILE_H_

@@ -319,7 +319,8 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
                          main_program: Optional[Program] = None,
                          scope: Optional[Scope] = None,
                          export_stablehlo_module: bool = False,
-                         stablehlo_batch_size: int = 1) -> None:
+                         stablehlo_batch_size: int = 1,
+                         stablehlo_seq_len: int = 32) -> None:
     """reference io.py:297: prune to the inference slice, record feed/fetch
     ops, persist program + params.  ``export_stablehlo_module=True``
     additionally writes model.stablehlo(.json) for the native PJRT
@@ -342,7 +343,8 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
     if export_stablehlo_module:
         export_stablehlo(dirname, pruned, feeded_var_names,
                          [v.name for v in target_vars], scope=scope,
-                         batch_size=stablehlo_batch_size)
+                         batch_size=stablehlo_batch_size,
+                         seq_len=stablehlo_seq_len)
 
 
 def load_inference_model(dirname: str, executor: Executor,
@@ -368,67 +370,138 @@ def get_inference_program(target_vars, main_program=None):
 
 
 def export_stablehlo(dirname: str, program, feed_names, fetch_names,
-                     scope=None, batch_size: int = 1) -> None:
+                     scope=None, batch_size: int = 1,
+                     seq_len: int = 32) -> None:
     """Export the inference step as a StableHLO module + meta json — the
     artifact csrc/pjrt_runner.cc serves through any PJRT C-API plugin
     (TPU serving with no Python; reference inference/io.h:32 analog).
 
-    Parameters and all other scope state are closed over as module
-    constants, so the exported function takes exactly the feed tensors
-    (at ``batch_size``) and returns the fetch targets.
+    Parameters are module ARGUMENTS (meta ``params`` lists them in
+    positional order; the runner loads each from the CRC-framed tensor
+    file ``dirname/<name>`` written by save_persistables and uploads it
+    once at create time) — r3 baked them in as textual-MLIR constants,
+    which capped the tier at toy-model sizes.  Feeds are dtype-tagged
+    (int32/int64 word ids serve natively); a feed whose VarDesc carries a
+    lod_level exports as TWO runner inputs, ``name`` (padded
+    [batch, seq_len, ...] data) and ``name.lengths`` (int32 [batch]) —
+    the dense-pair encoding of the reference capi's
+    sequence_start_positions (capi/arguments.cpp).  SeqArray fetch
+    targets likewise export as a (data, lengths) output pair.
     """
     import jax
     import numpy as np
 
+    from .core.lod import SeqArray
     from .executor import Executor, HOST_OPS, global_scope
     from .lowering import MARKER_OPS, build_step_fn
 
     scope = scope or global_scope()
     desc = program.desc
     block = desc.global_block()
-    feeds = {}
+    feed_specs = []               # flat ShapeDtypeStructs, runner order
     metas = []
+    lod_feeds = set()
     for name in feed_names:
         vd = block.vars[name]
         dtype = np.dtype(vd.dtype or "float32")
-        shape = [batch_size if d in (-1, None) else int(d)
-                 for d in (vd.shape or [])]
-        if dtype != np.float32:
+        if dtype not in (np.dtype(np.float32), np.dtype(np.int32),
+                         np.dtype(np.int64)):
             raise ValueError(
                 f"export_stablehlo: feed {name!r} has dtype {dtype}; the "
-                f"native PJRT runner ABI is float32-only")
-        feeds[name] = jax.ShapeDtypeStruct(tuple(shape), dtype)
-        metas.append({"name": name, "shape": shape, "dtype": str(dtype)})
+                f"native runner ABI serves float32/int32/int64 feeds")
+        if not jax.config.jax_enable_x64:
+            # the lowered module's real input types: jax canonicalizes
+            # 64-bit dtypes away, and the meta must describe the ARTIFACT
+            dtype = {np.dtype(np.int64): np.dtype(np.int32),
+                     np.dtype(np.float64): np.dtype(np.float32)
+                     }.get(dtype, dtype)
+        shape = [int(d) for d in (vd.shape or []) if d not in (-1, None)]
+        if (vd.lod_level or 0) > 0:
+            lod_feeds.add(name)
+            # vd.shape holds PER-STEP feature dims (batch/time are the
+            # -1s filtered above): keep all of them after [batch, time]
+            full = [batch_size, seq_len] + shape
+            feed_specs.append(jax.ShapeDtypeStruct(tuple(full), dtype))
+            feed_specs.append(jax.ShapeDtypeStruct((batch_size,), np.int32))
+            metas.append({"name": name, "shape": full, "dtype": str(dtype),
+                          "lod": True})
+            metas.append({"name": f"{name}.lengths",
+                          "shape": [batch_size], "dtype": "int32"})
+        else:
+            full = [batch_size if d in (-1, None) else int(d)
+                    for d in (vd.shape or [])]
+            feed_specs.append(jax.ShapeDtypeStruct(tuple(full), dtype))
+            metas.append({"name": name, "shape": full, "dtype": str(dtype)})
     traced_ops = [op for op in block.ops
                   if op.type not in HOST_OPS and op.type not in MARKER_OPS]
     exe = Executor(None)
-    state_in, _ = exe._classify_structure(traced_ops, set(feeds),
+    state_in, _ = exe._classify_structure(traced_ops, set(feed_names),
                                           fetch_names, block)
     state_vals = exe._fetch_state(state_in, traced_ops, fetch_names, scope)
-    state_const = {k: np.asarray(v.data if hasattr(v, "lengths") else v)
-                   for k, v in state_vals.items()}
+    # parameters ride as runtime arguments; the rare SeqArray state entry
+    # (no dense tensor file format for the runner) stays a baked constant
+    param_names = sorted(n for n, v in state_vals.items()
+                         if not hasattr(v, "lengths"))
+    state_const = {k: v for k, v in state_vals.items()
+                   if k not in param_names}
+    param_vals = {n: np.asarray(state_vals[n]) for n in param_names}
+    param_metas = []
+    for n in param_names:
+        arr = param_vals[n]
+        param_metas.append({"name": n, "shape": [int(d) for d in arr.shape],
+                            "dtype": str(arr.dtype)})
+        path = os.path.join(dirname, n)
+        if not os.path.exists(path):      # not persistable-saved: write it
+            save_tensor(arr, path)
     step = build_step_fn(desc, 0, list(feed_names), state_in, [],
                          list(fetch_names), "infer")
     rng = np.zeros(2, np.int32)
+    n_params = len(param_names)
 
     def infer_fn(*arrays):
-        fd = dict(zip(feed_names, arrays))
-        fetches, _ = step(fd, state_const, rng)
-        return tuple(fetches)
+        params = dict(zip(param_names, arrays[:n_params]))
+        params.update(state_const)
+        fd = {}
+        i = n_params
+        for name in feed_names:
+            if name in lod_feeds:
+                fd[name] = SeqArray(arrays[i], arrays[i + 1])
+                i += 2
+            else:
+                fd[name] = arrays[i]
+                i += 1
+        fetches, _ = step(fd, params, rng)
+        flat = []
+        for f in fetches:
+            if isinstance(f, SeqArray):
+                flat.append(f.data)
+                flat.append(jnp_asarray_i32(f.lengths))
+            else:
+                flat.append(f)
+        return tuple(flat)
 
-    lowered = jax.jit(infer_fn).lower(*[feeds[n] for n in feed_names])
+    def jnp_asarray_i32(x):
+        import jax.numpy as jnp
+
+        return jnp.asarray(x, jnp.int32)
+
+    args = [jax.ShapeDtypeStruct(param_vals[n].shape, param_vals[n].dtype)
+            for n in param_names] + feed_specs
+    lowered = jax.jit(infer_fn).lower(*args)
     module_text = str(lowered.compiler_ir(dialect="stablehlo"))
-    outs = jax.eval_shape(infer_fn, *[feeds[n] for n in feed_names])
-    for name, o in zip(fetch_names, outs):
-        if np.dtype(o.dtype) != np.float32:
+    outs = jax.eval_shape(infer_fn, *args)
+    out_metas = []
+    fetch_iter = iter(fetch_names)
+    for o in outs:
+        dt = np.dtype(o.dtype)
+        if dt not in (np.dtype(np.float32), np.dtype(np.int32),
+                      np.dtype(np.int64)):
             raise ValueError(
-                f"export_stablehlo: fetch {name!r} has dtype {o.dtype}; "
-                f"the native PJRT runner ABI is float32-only (cast the "
-                f"fetch target before saving)")
-    meta = {"inputs": metas,
-            "outputs": [{"shape": [int(d) for d in o.shape],
-                         "dtype": str(np.dtype(o.dtype))}
-                        for o in outs]}
+                f"export_stablehlo: fetch dtype {dt} unsupported by the "
+                f"native runner ABI (cast the fetch target before saving)")
+        out_metas.append({"shape": [int(d) for d in o.shape],
+                          "dtype": str(dt)})
+    meta = {"inputs": metas, "params": param_metas, "outputs": out_metas}
     _atomic_write(os.path.join(dirname, "model.stablehlo"),
                   module_text.encode())
     _atomic_write(os.path.join(dirname, "model.stablehlo.json"),

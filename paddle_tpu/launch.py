@@ -31,11 +31,30 @@ def find_free_port() -> int:
         return s.getsockname()[1]
 
 
+def _hold_port() -> tuple:
+    """(port, held_socket): pick an ephemeral port and KEEP a
+    SO_REUSEPORT-bound socket on it until the launcher exits.
+
+    A close-then-reuse free-port probe races: between our close and the
+    rank-0 coordinator's bind, the kernel can hand the same ephemeral
+    port to any other process (the r3 collective-test flake).  Holding
+    the socket removes the port from the ephemeral pool, while the
+    coordination service's gRPC server — which sets SO_REUSEPORT on
+    Linux — can still bind alongside the placeholder."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind(("127.0.0.1", 0))
+    return s.getsockname()[1], s
+
+
 def launch(nprocs: int, argv, coordinator: str | None = None,
            env_extra: dict | None = None) -> int:
     """Spawn ``nprocs`` copies of ``argv``; returns the first non-zero
     exit code (terminating the rest), else 0."""
-    coordinator = coordinator or f"127.0.0.1:{find_free_port()}"
+    held = None
+    if coordinator is None:
+        port, held = _hold_port()
+        coordinator = f"127.0.0.1:{port}"
     procs = []
     for rank in range(nprocs):
         env = dict(os.environ)
@@ -45,47 +64,145 @@ def launch(nprocs: int, argv, coordinator: str | None = None,
         env["PADDLE_TPU_PROC_ID"] = str(rank)
         procs.append(subprocess.Popen([sys.executable] + list(argv),
                                       env=env))
-    import time
-
-    rc = 0
     try:
         # poll ALL ranks: a crash in any rank must terminate the rest
         # immediately (a sequential wait on rank 0 would hang forever on
         # a collective stuck waiting for the dead rank)
-        live = set(range(nprocs))
-        while live:
-            progressed = False
-            for i in sorted(live):
-                code = procs[i].poll()
-                if code is None:
-                    continue
-                live.discard(i)
-                progressed = True
-                if code != 0 and rc == 0:
-                    rc = code
-                    for q in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
-            if live and not progressed:
-                time.sleep(0.05)
+        return _monitor(procs)
     except KeyboardInterrupt:
         for q in procs:
             if q.poll() is None:
                 q.send_signal(signal.SIGTERM)
         raise
+    finally:
+        if held is not None:
+            held.close()
+
+
+def _monitor(procs):
+    """Poll all ranks; first non-zero exit terminates the rest."""
+    import time
+
+    rc = 0
+    live = set(range(len(procs)))
+    while live:
+        progressed = False
+        for i in sorted(live):
+            code = procs[i].poll()
+            if code is None:
+                continue
+            live.discard(i)
+            progressed = True
+            if code != 0 and rc == 0:
+                rc = code
+                for q in procs:
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+        if live and not progressed:
+            time.sleep(0.05)
     return rc
+
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def launch_hosts(hosts, nprocs_per_host: int, argv,
+                 coordinator: str | None = None, ssh_cmd: str = "ssh",
+                 env_extra: dict | None = None) -> int:
+    """Multi-host launch — the analog of the reference's ssh cluster
+    launcher (paddle/scripts/cluster_train/paddle.py: fabric-over-ssh,
+    one trainer per node with role env vars).  ``hosts`` is a list of
+    hostnames (repeat a host for multiple slots, or use
+    ``nprocs_per_host``); each remote rank is started through ``ssh host
+    env K=V ... python script`` — the script path must exist on every
+    host (shared filesystem, the reference's assumption too).  Local
+    hosts (localhost/127.0.0.1) spawn directly, so CI exercises the full
+    rank/coordinator wiring without sshd.
+    """
+    import shlex
+
+    hosts = list(hosts)
+    total = len(hosts) * nprocs_per_host
+    held = None
+    if coordinator is None:
+        if all(h in _LOCAL_HOSTS for h in hosts):
+            port, held = _hold_port()
+            coordinator = f"127.0.0.1:{port}"
+        elif hosts[0] in _LOCAL_HOSTS:
+            raise ValueError(
+                "mixed localhost+remote host list needs an explicit "
+                "--coordinator reachable from every host ('localhost' "
+                "would resolve to each remote's own loopback)")
+        else:
+            coordinator = f"{hosts[0]}:29571"
+    procs = []
+    try:
+        for hi, host in enumerate(hosts):
+            for local in range(nprocs_per_host):
+                rank = hi * nprocs_per_host + local
+                envs = {"PADDLE_TPU_COORDINATOR": coordinator,
+                        "PADDLE_TPU_NPROCS": str(total),
+                        "PADDLE_TPU_PROC_ID": str(rank),
+                        "PADDLE_TPU_HOST_ID": str(hi)}
+                envs.update(env_extra or {})
+                if host in _LOCAL_HOSTS:
+                    env = dict(os.environ)
+                    env.update(envs)
+                    procs.append(subprocess.Popen(
+                        [sys.executable] + list(argv), env=env))
+                else:
+                    # ssh joins argv into one remote shell string: quote
+                    # every token or spaces in env values/args re-split
+                    kv = [shlex.quote(f"{k}={v}") for k, v in envs.items()]
+                    remote = [shlex.quote(a)
+                              for a in [sys.executable] + list(argv)]
+                    procs.append(subprocess.Popen(
+                        [ssh_cmd, host, "env"] + kv + remote))
+        return _monitor(procs)
+    except BaseException:
+        # a failed spawn (bad host, missing ssh) or Ctrl-C must not
+        # orphan already-started ranks blocked in collective init
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        raise
+    finally:
+        if held is not None:
+            held.close()
+
+
+def _parse_hosts(spec: str):
+    """"h1,h2,h2" or "@file" (one host per line, '#' comments)."""
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return [ln.strip() for ln in f
+                    if ln.strip() and not ln.strip().startswith("#")]
+    return [h.strip() for h in spec.split(",") if h.strip()]
 
 
 def main() -> None:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.launch",
         description="spawn N SPMD worker processes of a training script")
-    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, default=None,
+                    help="single-host mode: number of local processes")
+    ap.add_argument("--hosts", default=None,
+                    help="multi-host mode: comma list or @hostfile "
+                         "(reference cluster_train/paddle.py analog)")
+    ap.add_argument("--nprocs-per-host", type=int, default=1)
+    ap.add_argument("--ssh", default="ssh", help="remote shell command")
     ap.add_argument("--coordinator", default=None,
-                    help="host:port (default: a free local port)")
+                    help="host:port (default: a free local port, or "
+                         "first-host:29571 for remote hosts)")
     ap.add_argument("script", help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args()
+    if (ns.nprocs is None) == (ns.hosts is None):
+        ap.error("exactly one of --nprocs / --hosts is required")
+    if ns.hosts is not None:
+        sys.exit(launch_hosts(_parse_hosts(ns.hosts), ns.nprocs_per_host,
+                              [ns.script] + ns.args, ns.coordinator,
+                              ssh_cmd=ns.ssh))
     sys.exit(launch(ns.nprocs, [ns.script] + ns.args, ns.coordinator))
 
 
