@@ -474,6 +474,12 @@ void Engine::run_op(const OpDesc& op) {
     int64_t size = w.shape.at(0);
     bool peep = op.attr_bool("use_peepholes", true);
     bool rev = op.attr_bool("is_reverse", false);
+    if (op.attr_str("gate_activation", "sigmoid") != "sigmoid" ||
+        op.attr_str("cell_activation", "tanh") != "tanh" ||
+        op.attr_str("candidate_activation", "tanh") != "tanh")
+      throw std::runtime_error(
+          "dynamic_lstm: non-default activations unsupported in the "
+          "native engine (use the PJRT tier)");
     if (x.lengths.empty() || x.shape.size() != 3 ||
         x.shape[2] != 4 * size)
       throw std::runtime_error("dynamic_lstm: bad input layout");
@@ -538,6 +544,11 @@ void Engine::run_op(const OpDesc& op) {
     Tensor& w = in(op, "Weight");
     int64_t size = w.shape.at(0);
     bool rev = op.attr_bool("is_reverse", false);
+    if (op.attr_str("gate_activation", "sigmoid") != "sigmoid" ||
+        op.attr_str("activation", "tanh") != "tanh")
+      throw std::runtime_error(
+          "dynamic_gru: non-default activations unsupported in the "
+          "native engine (use the PJRT tier)");
     if (x.lengths.empty() || x.shape.size() != 3 ||
         x.shape[2] != 3 * size)
       throw std::runtime_error("dynamic_gru: bad input layout");

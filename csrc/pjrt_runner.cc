@@ -51,6 +51,7 @@ PJRT_Buffer_Type dtype_to_pjrt(const std::string& dt) {
 
 struct IoSpec {
   std::string name;
+  std::string file;    // tensor file (params only; defaults to name)
   std::vector<int64_t> shape;
   std::string dtype;
 };
@@ -63,6 +64,7 @@ struct Meta {
 
 void parse_iospec(const ptpu::JsonPtr& e, IoSpec* s, bool named) {
   if (named) s->name = e->at("name")->s;
+  s->file = e->get("file") ? e->at("file")->s : s->name;
   s->dtype = e->get("dtype") ? e->at("dtype")->s : "float32";
   if (e->get("shape"))
     for (auto& d : e->at("shape")->arr) s->shape.push_back(d->i);
@@ -296,7 +298,7 @@ void Runner::load(const std::string& model_dir, const std::string& plugin) {
   param_bufs.reserve(meta.params.size());
   for (auto& p : meta.params) {
     ptpu::RawTensor t = ptpu::parse_tensor_raw(
-        ptpu::unframe(read_file(model_dir + "/" + p.name), p.name), p.name);
+        ptpu::unframe(read_file(model_dir + "/" + p.file), p.name), p.name);
     if (t.dtype != p.dtype)
       throw std::runtime_error(
           "param " + p.name + ": file dtype " + t.dtype +

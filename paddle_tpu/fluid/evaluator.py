@@ -13,7 +13,8 @@ from .framework import Variable
 from .initializer import ConstantInitializer
 from .layer_helper import LayerHelper
 
-__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator"]
+__all__ = ["Evaluator", "Accuracy", "ChunkEvaluator", "AUC",
+           "DetectionMAP"]
 
 
 class Evaluator:
@@ -123,3 +124,148 @@ def _as_float(helper, int_var):
     helper.append_op("cast", {"X": int_var}, {"Out": out},
                      {"out_dtype": "float32"})
     return out
+
+
+class AUC(Evaluator):
+    """Streaming ROC-AUC (the evaluator OBJECT the reference carried in
+    gserver/evaluators/Evaluator.cpp AucEvaluator; the per-batch `auc`
+    op existed here since r2 but no cross-batch aggregation did).
+
+    Positive-class scores are histogrammed into ``num_thresholds`` bins
+    per batch INSIDE the step (one_hot of the bin index, masked by the
+    label, reduced) and accumulated into persistable state; ``eval()``
+    integrates the trapezoid ROC on the host from the two histograms —
+    the same two-histogram scheme the reference used, expressed as graph
+    ops instead of a CUDA kernel."""
+
+    def __init__(self, input, label, num_thresholds=200, **kwargs):
+        super().__init__("auc_eval", **kwargs)
+        t = int(num_thresholds)
+        self.num_thresholds = t
+        self.stat_pos = self._create_state("stat_pos", "float32", [t])
+        self.stat_neg = self._create_state("stat_neg", "float32", [t])
+        h = self.helper
+        # positive-class probability -> bin in [0, t)
+        pos = layers.slice_last(input) if hasattr(layers, "slice_last")             else layers.split(input, num_or_sections=input.shape[-1],
+                              dim=-1)[-1]
+        binf = layers.scale(pos, scale=float(t - 1))
+        bini = h.create_tmp_variable("int32", stop_gradient=True)
+        h.append_op("cast", {"X": binf}, {"Out": bini},
+                    {"out_dtype": "int32"})
+        onehot = layers.one_hot(bini, depth=t)          # [N, t]
+        labf = _as_float(h, label)
+        is_pos = layers.reshape(labf, [-1, 1])
+        pos_hist = layers.reduce_sum(
+            layers.elementwise_mul(onehot, is_pos), dim=0)
+        neg_hist = layers.reduce_sum(
+            layers.elementwise_mul(
+                onehot, layers.scale(is_pos, scale=-1.0, bias=1.0)), dim=0)
+        h.append_op("elementwise_add",
+                    {"X": self.stat_pos, "Y": pos_hist},
+                    {"Out": self.stat_pos})
+        h.append_op("elementwise_add",
+                    {"X": self.stat_neg, "Y": neg_hist},
+                    {"Out": self.stat_neg})
+
+    def eval(self, executor=None, eval_program=None, scope=None):
+        scope = scope or global_scope()
+        pos = np.asarray(scope.find_var(self.stat_pos.name), np.float64)
+        neg = np.asarray(scope.find_var(self.stat_neg.name), np.float64)
+        # sweep thresholds from high to low: cumulative TP/FP counts
+        tp = np.cumsum(pos[::-1])
+        fp = np.cumsum(neg[::-1])
+        tot_p, tot_n = max(tp[-1], 1e-9), max(fp[-1], 1e-9)
+        tpr = np.concatenate([[0.0], tp / tot_p])
+        fpr = np.concatenate([[0.0], fp / tot_n])
+        return np.array(np.trapz(tpr, fpr), np.float32)
+
+
+class DetectionMAP:
+    """VOC-style detection mean-average-precision (the reference
+    gserver/evaluators had a mAP evaluator object; the matching ops
+    (bipartite_match, multiclass_nms) exist here, and this aggregates
+    their HOST-side outputs — detection mAP is inherently ragged, so
+    accumulation happens outside the compiled step, like the
+    reference's CPU evaluator did).
+
+    Per batch, call ``update(detections, ground_truths)`` with
+      detections:  [[class_id, score, x1, y1, x2, y2], ...] per image
+      ground_truths: [[class_id, x1, y1, x2, y2], ...] per image
+    ``eval()`` returns mAP over classes at ``overlap_threshold`` IoU
+    using the 11-point or area interpolation (``ap_version``)."""
+
+    def __init__(self, overlap_threshold=0.5, ap_version="integral"):
+        assert ap_version in ("integral", "11point")
+        self.overlap_threshold = float(overlap_threshold)
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, *a, **kw):
+        self._dets = []     # (img_idx, cls, score, box)
+        self._gts = []      # (img_idx, cls, box)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(0.0, ix2 - ix1), max(0.0, iy2 - iy1)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, ground_truths):
+        for dets, gts in zip(detections, ground_truths):
+            for d in dets:
+                self._dets.append((self._img, int(d[0]), float(d[1]),
+                                   [float(v) for v in d[2:6]]))
+            for g in gts:
+                self._gts.append((self._img, int(g[0]),
+                                  [float(v) for v in g[1:5]]))
+            self._img += 1
+
+    def _ap(self, rec, prec):
+        if self.ap_version == "11point":
+            return float(np.mean([max([p for r, p in zip(rec, prec)
+                                       if r >= th], default=0.0)
+                                  for th in np.linspace(0, 1, 11)]))
+        # area under the monotone precision envelope
+        mrec = np.concatenate([[0.0], rec, [1.0]])
+        mpre = np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def eval(self, *a, **kw):
+        classes = sorted({c for _, c, _ in self._gts})
+        aps = []
+        for cls in classes:
+            gts = [(i, box) for i, c, box in self._gts if c == cls]
+            npos = len(gts)
+            taken = set()
+            dets = sorted((d for d in self._dets if d[1] == cls),
+                          key=lambda d: -d[2])
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            for k, (img, _, _, box) in enumerate(dets):
+                best, best_j = 0.0, -1
+                for j, (gi, gbox) in enumerate(gts):
+                    if gi != img or j in taken:
+                        continue
+                    ov = self._iou(box, gbox)
+                    if ov > best:
+                        best, best_j = ov, j
+                if best >= self.overlap_threshold and best_j >= 0:
+                    tp[k] = 1
+                    taken.add(best_j)
+                else:
+                    fp[k] = 1
+            if npos == 0:
+                continue
+            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            rec = ctp / npos
+            prec = ctp / np.maximum(ctp + cfp, 1e-9)
+            aps.append(self._ap(rec, prec))
+        return np.array(np.mean(aps) if aps else 0.0, np.float32)

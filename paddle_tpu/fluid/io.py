@@ -448,11 +448,25 @@ def export_stablehlo(dirname: str, program, feed_names, fetch_names,
     param_metas = []
     for n in param_names:
         arr = param_vals[n]
-        param_metas.append({"name": n, "shape": [int(d) for d in arr.shape],
-                            "dtype": str(arr.dtype)})
+        entry = {"name": n, "shape": [int(d) for d in arr.shape],
+                 "dtype": str(arr.dtype)}
+        if not jax.config.jax_enable_x64:
+            # same artifact-vs-declared rule as feeds: the module's arg
+            # type is the canonical 32-bit one; a 64-bit persistable gets
+            # a converted side-file so the runner uploads what the
+            # executable expects (the original checkpoint file untouched)
+            canon = {np.dtype(np.int64): np.dtype(np.int32),
+                     np.dtype(np.float64): np.dtype(np.float32)
+                     }.get(arr.dtype)
+            if canon is not None:
+                arr = arr.astype(canon)
+                entry["dtype"] = str(canon)
+                entry["file"] = f"{n}.stablehlo-cast"
+                save_tensor(arr, os.path.join(dirname, entry["file"]))
+        param_metas.append(entry)
         path = os.path.join(dirname, n)
         if not os.path.exists(path):      # not persistable-saved: write it
-            save_tensor(arr, path)
+            save_tensor(param_vals[n], path)
     step = build_step_fn(desc, 0, list(feed_names), state_in, [],
                          list(fetch_names), "infer")
     rng = np.zeros(2, np.int32)
@@ -491,13 +505,15 @@ def export_stablehlo(dirname: str, program, feed_names, fetch_names,
     module_text = str(lowered.compiler_ir(dialect="stablehlo"))
     outs = jax.eval_shape(infer_fn, *args)
     out_metas = []
-    fetch_iter = iter(fetch_names)
-    for o in outs:
+    for i, o in enumerate(outs):
         dt = np.dtype(o.dtype)
         if dt not in (np.dtype(np.float32), np.dtype(np.int32),
                       np.dtype(np.int64)):
+            # flat output index: SeqArray fetches expand to two outputs,
+            # so fetch_names does not map 1:1 — name what we can
             raise ValueError(
-                f"export_stablehlo: fetch dtype {dt} unsupported by the "
+                f"export_stablehlo: output #{i} (of fetches "
+                f"{list(fetch_names)}) has dtype {dt}, unsupported by the "
                 f"native runner ABI (cast the fetch target before saving)")
         out_metas.append({"shape": [int(d) for d in o.shape],
                           "dtype": str(dt)})

@@ -26,7 +26,7 @@ __all__ = [
     "margin_rank_loss", "log_loss", "conv_shift", "row_conv",
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
     "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
-    "fused_vocab_cross_entropy",
+    "fused_vocab_cross_entropy", "maxout",
 ]
 
 
@@ -587,6 +587,12 @@ def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
 # ---------------------------------------------------------------------------
 # r2 operator batch wrappers (VERDICT missing#7)
 # ---------------------------------------------------------------------------
+
+def maxout(x, groups, name=None):
+    """Channel-group max over NCHW (reference maxout_op.cc)."""
+    return _single_out_layer("maxout", {"X": x},
+                             {"groups": int(groups)}, name=name)
+
 
 def _single_out_layer(op_type, inputs, attrs=None, dtype=None, lod=0,
                       extra_outputs=None, stop_gradient=False, name=None):

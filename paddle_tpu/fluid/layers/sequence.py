@@ -8,7 +8,8 @@ from ..framework import Variable
 from ..layer_helper import LayerHelper
 
 __all__ = ["linear_chain_crf", "crf_decoding",
-           "sequence_conv", "sequence_pool", "nested_sequence_pool",
+           "sequence_conv", "sequence_context", "sequence_pool",
+           "nested_sequence_pool",
            "sequence_first_step",
            "sequence_last_step", "sequence_expand", "sequence_concat",
            "sequence_reshape", "sequence_slice", "sequence_erase",
@@ -79,6 +80,20 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     out = helper.append_bias_op(out, dim_start=2,
                                 bias_shape=[num_filters])
     return helper.append_activation(out)
+
+
+def sequence_context(input, context_length, context_start=None,
+                     name=None):
+    """Sliding context-window concatenation over the time axis (the
+    reference's ContextProjection; zero padding outside the sequence)."""
+    helper = LayerHelper("sequence_context", name=name)
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    helper.append_op("sequence_context", {"X": input}, {"Out": out},
+                     {"context_length": int(context_length),
+                      "context_start": int(context_start)})
+    return out
 
 
 def sequence_pool(input, pool_type, name=None):

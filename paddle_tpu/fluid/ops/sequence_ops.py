@@ -73,6 +73,29 @@ def sequence_softmax(ctx, x):
     return SeqArray(out, x.lengths)
 
 
+@primitive("sequence_context", inputs=["X"])
+def sequence_context(ctx, x):
+    """Context window gather WITHOUT the projection — the reference's
+    ContextProjection (paddle/function/ContextProjectionOp.cpp, surfaced
+    as trainer_config_helpers context_projection:736): for each step,
+    concatenate the [context_length] window of neighbouring steps'
+    features (zero outside the sequence) -> [b, t, ctx_len*d]."""
+    assert isinstance(x, SeqArray)
+    ctx_len = ctx.attr("context_length", 3)
+    ctx_start = ctx.attr("context_start", -((ctx_len - 1) // 2))
+    data = x.data * _mask(x).astype(x.data.dtype)   # zero out padding
+    t = data.shape[1]
+    cols = []
+    for off in range(ctx_start, ctx_start + ctx_len):
+        shifted = jnp.roll(data, -off, axis=1)
+        pos = jnp.arange(t) + off
+        valid = ((pos >= 0) & (pos < t)).reshape(1, t, 1)
+        cols.append(jnp.where(valid, shifted, 0.0))
+    out = jnp.concatenate(cols, axis=-1)
+    out = out * _mask(x).astype(out.dtype)
+    return SeqArray(out, x.lengths)
+
+
 @primitive("sequence_conv", inputs=["X", "Filter"])
 def sequence_conv(ctx, x, w):
     """reference sequence_conv_op.cc / ContextProjection: gather a

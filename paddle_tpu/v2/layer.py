@@ -15,6 +15,7 @@ reference shape:
 from __future__ import annotations
 
 from ..fluid import layers as flayers
+from ..fluid.param_attr import ParamAttr
 from .activation import BaseActivation
 from .data_type import InputType
 
@@ -24,7 +25,9 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "recurrent_group", "memory", "StaticInput", "lstmemory",
            "grumemory", "last_seq", "first_seq",
            "beam_search", "GeneratedInput",
-           "addto", "cos_sim", "seq_concat"]
+           "addto", "cos_sim", "seq_concat",
+           "context_projection", "maxout", "crf", "crf_decoding", "ctc",
+           "conv_projection", "simple_attention"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -340,7 +343,6 @@ def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,
     W = int(beam_size)
 
     from ..fluid import framework as _fw
-    from ..fluid.param_attr import ParamAttr
 
     program = _fw.default_main_program()
 
@@ -542,3 +544,90 @@ def seq_concat(a, b, **kw):
     """Concatenate two sequences end-to-end in TIME per batch row
     (reference seq_concat_layer: output length = len(a)+len(b))."""
     return flayers.sequence_concat(input=[a, b], axis=0)
+
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False, **kw):
+    """Sliding context-window concat (reference layers.py
+    context_projection:736; the building block under text-conv groups).
+    Zero padding outside the sequence; the reference's optional
+    TRAINABLE padding rows are not supported (pass padding_attr=False)."""
+    if padding_attr not in (False, None):
+        raise NotImplementedError(
+            "context_projection: trainable padding (padding_attr) is not "
+            "supported; zero padding is used outside the sequence")
+    return flayers.sequence_context(input, context_length=context_len,
+                                    context_start=context_start)
+
+
+def maxout(input, groups, num_channels=None, **kw):
+    """Channel-group max reduction over NCHW (reference layers.py
+    maxout_layer:5446 / maxout_op.cc)."""
+    return flayers.maxout(input, groups=groups)
+
+
+def crf(input, label, size=None, param_attr=None, **kw):
+    """Linear-chain CRF cost (reference layers.py crf_layer:5672, gserver
+    CRFLayer): emission scores + trained transitions -> mean per-sequence
+    negative log-likelihood, trainable via SGD.train.  ``size`` (the tag
+    count) must equal the emission feature width when given.  Name the
+    transition parameter (param_attr) to share it with crf_decoding."""
+    if size is not None and (input.shape or [None])[-1] not in (None, size):
+        raise ValueError(
+            f"crf: size={size} != emission width {input.shape[-1]}")
+    nll = flayers.linear_chain_crf(input=input, label=label,
+                                   param_attr=ParamAttr.to_attr(param_attr))
+    return flayers.mean(nll)
+
+
+def crf_decoding(input, size=None, label=None, param_attr=None, **kw):
+    """Viterbi decode with the trained CRF transitions (reference
+    layers.py crf_decoding_layer; share via param_attr name)."""
+    return flayers.crf_decoding(input=input, label=label,
+                                param_attr=ParamAttr.to_attr(param_attr))
+
+
+def ctc(input, label, size=None, blank=0, norm_by_times=False, **kw):
+    """CTC cost (reference layers.py ctc_layer:5523 backed by
+    warp-ctc): mean per-sequence CTC loss over unaligned label
+    sequences.  ``blank`` indexes the blank class within the ``size``
+    softmax classes (the reference places it last: size-1)."""
+    if size is not None and (input.shape or [None])[-1] not in (None, size):
+        raise ValueError(
+            f"ctc: size={size} != input class width {input.shape[-1]}")
+    loss = flayers.warpctc(input=input, label=label, blank=int(blank),
+                           norm_by_times=norm_by_times)
+    return flayers.mean(loss)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, **kw):
+    """Bias-free conv2d projection (reference layers.py
+    conv_projection:4759 — the mixed_layer image projection)."""
+    return flayers.conv2d(input=input, num_filters=num_filters,
+                          filter_size=filter_size, stride=stride,
+                          padding=padding, bias_attr=False, act=None)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None, **kw):
+    """Bahdanau additive attention (reference networks.py
+    simple_attention:1400): a_j = v . f(W s_{t-1} + U h_j); weights are
+    a sequence-softmax over e; returns the attention-weighted sum of
+    ``encoded_sequence``.  ``encoded_proj`` is the precomputed U h_j
+    (same convention as the reference: computed once outside the loop)."""
+    proj_size = (encoded_proj.shape or [None])[-1]
+    if not proj_size or proj_size < 0:
+        raise ValueError("simple_attention: cannot infer proj size")
+    transformed = flayers.fc(
+        input=decoder_state, size=int(proj_size), bias_attr=False,
+        param_attr=ParamAttr.to_attr(transform_param_attr))
+    expanded = flayers.sequence_expand(transformed, encoded_proj)
+    combined = getattr(flayers, _act_name(weight_act) or "tanh")(
+        flayers.elementwise_add(expanded, encoded_proj))
+    weight = flayers.fc(input=combined, size=1, bias_attr=False,
+                        param_attr=ParamAttr.to_attr(softmax_param_attr))
+    weight = flayers.sequence_softmax(weight)
+    scaled = flayers.elementwise_mul(encoded_sequence, weight)
+    return flayers.sequence_pool(input=scaled, pool_type="sum")
