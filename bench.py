@@ -147,6 +147,66 @@ def _uncounted_attention_flops(batch: int, s: int, n_layer: int,
     return n_layer * total_fwd * mult
 
 
+# reference K40m ms/batch (benchmark/README.md:35-58) per (model, batch)
+K40M_IMAGE_MS = {
+    ("alexnet", 64): 195, ("alexnet", 128): 334, ("alexnet", 256): 602,
+    ("alexnet", 512): 1629,
+    ("googlenet", 64): 613, ("googlenet", 128): 1149,
+    ("googlenet", 256): 2348,
+    ("smallnet", 64): 10.46, ("smallnet", 128): 18.18,
+    ("smallnet", 256): 33.11, ("smallnet", 512): 63.04,
+}
+
+
+def bench_image_net(model: str, batch: int, steps: int, trials: int,
+                    in_dtype: str = "bfloat16"):
+    """The reference's OTHER headline image benchmarks
+    (benchmark/paddle/image/{alexnet,googlenet,smallnet_mnist_cifar}.py)
+    with the same Momentum(0.9) recipe, vs their K40m ms/batch rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import fluid
+    from paddle_tpu.models import benchmark_nets as B
+
+    build, px, ncls = {
+        "alexnet": (B.alexnet, 227, 1000),
+        "googlenet": (B.googlenet_v1, 224, 1000),
+        "smallnet": (B.smallnet_cifar, 32, 10),
+    }[model]
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        img = fluid.layers.data("img", [3, px, px], in_dtype)
+        label = fluid.layers.data("label", [1], "int64")
+        pred = build(img, class_num=ncls)
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(cost)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": jax.device_put(jnp.asarray(rng.rand(batch, 3, px, px),
+                                          dtype=in_dtype)),
+        "label": jax.device_put(
+            rng.randint(0, ncls, (batch, 1)).astype(np.int32)),
+    }
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        flops = exe.cost_analysis(main_prog, feed=feed,
+                                  fetch_list=[cost]).get("flops", 0.0)
+    dt = _time_steps(exe, main_prog, feed, [cost], scope, steps, trials)
+    out = {"ms_per_batch": round(dt * 1e3, 2),
+           "images_per_sec": round(batch / dt, 1),
+           "mfu": round((flops / dt) / chip_peak_flops(), 4)}
+    base = K40M_IMAGE_MS.get((model, batch))
+    if base:
+        out["k40m_ms_per_batch"] = base
+        out["speedup_vs_k40m"] = round(base / (dt * 1e3), 2)
+    return out
+
+
 def bench_transformer(batch: int, steps: int, trials: int,
                       seq_len: int = 256):
     import jax
@@ -367,6 +427,17 @@ def main() -> None:
             lstm_results[str(hidden)] = {"error": str(e)[:120]}
             print(f"lstm bench h={hidden} failed: {e}", file=sys.stderr)
 
+    image_suite = {}
+    for model in [m for m in os.environ.get(
+            "BENCH_IMAGE_MODELS", "alexnet,googlenet,smallnet").split(",")
+            if m]:
+        b = int(os.environ.get("BENCH_IMAGE_BATCH", "128"))
+        try:
+            image_suite[model] = bench_image_net(model, b, steps, trials)
+        except Exception as e:
+            image_suite[model] = {"error": str(e)[:120]}
+            print(f"image bench {model} failed: {e}", file=sys.stderr)
+
     quality = None
     if os.environ.get("BENCH_SKIP_QUALITY", "") != "1":
         try:
@@ -400,6 +471,9 @@ def main() -> None:
         # reference benchmark/paddle/rnn text classifier (K40m baselines in
         # BASELINE.md rows 22-24): ms/batch + tok/s per hidden size
         "lstm_text_cls": lstm_results,
+        # reference benchmark/paddle/image alexnet/googlenet/smallnet vs
+        # their K40m rows (BASELINE.md:13-18)
+        "image_suite": image_suite,
         # real-data trained quality (None in zero-egress environments)
         "mnist_quality": quality,
         "device": jax.devices()[0].device_kind,

@@ -187,21 +187,28 @@ def _reduce(name, fn):
         tensor."""
         from ..core.lod import SeqArray
 
+        dim = ctx.attr("dim", [0])
+        reduce_all = ctx.attr("reduce_all", False)
+        dim = None if reduce_all else \
+            ((dim,) if isinstance(dim, int) else tuple(dim))
         if isinstance(x, SeqArray):
-            if name != "reduce_sum" or not ctx.attr("reduce_all", False):
+            ndim = x.data.ndim
+            feature_only = dim is not None and all(
+                (d % ndim) >= 2 for d in dim)
+            if feature_only:
+                # reducing FEATURE dims keeps the [batch, time] structure:
+                # per-step reduction, still a sequence (e.g. the dot in
+                # dot_product_attention).  Padding stays padding.
+                out = _fn(x.data, axis=dim,
+                          keepdims=ctx.attr("keep_dim", False))
+                return SeqArray(out, x.lengths)
+            if name != "reduce_sum" or not reduce_all:
                 raise NotImplementedError(
-                    f"{name} with explicit dims on a sequence input is "
+                    f"{name} over the time axis of a sequence input is "
                     f"ill-defined in the padded layout; pool the sequence "
                     f"axis first (sequence_pool)")
             m = x.mask().reshape(x.data.shape[:2] + (1,) * (x.data.ndim - 2))
             x = x.data * m.astype(x.data.dtype)
-        dim = ctx.attr("dim", [0])
-        if ctx.attr("reduce_all", False):
-            dim = None
-        elif isinstance(dim, int):
-            dim = (dim,)
-        else:
-            dim = tuple(dim)
         return _fn(x, axis=dim, keepdims=ctx.attr("keep_dim", False))
     _op.__name__ = name
     return _op

@@ -95,6 +95,15 @@ def _carrier_to_u32(seed_f: jax.Array) -> jax.Array:
     return jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
 
 
+def offsets_carrier(row_off, col_off) -> jax.Array:
+    """(row, col) global block offsets as the f32[2] bit-cast carrier the
+    kernels decode (_off_rc / _tile_rc) — the int analog of
+    seed_to_carrier."""
+    return jax.lax.bitcast_convert_type(
+        jnp.stack([jnp.asarray(row_off, jnp.int32),
+                   jnp.asarray(col_off, jnp.int32)]), jnp.float32)
+
+
 def bh_grid(b: int, h: int) -> jax.Array:
     """[b,h,1,1] flattened batch*head index — MUST match the Pallas grid's
     program_id(0) = b_idx*h + h_idx convention so XLA-side masks equal the
@@ -116,29 +125,40 @@ def _st(ref, val):
     ref[0] = val
 
 
-def _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len):
-    """Apply causal and/or key-padding masks to a [block_q, block_k] score
-    tile using global row/col positions."""
-    if not causal and kv_len is None:
-        return s
+def _tile_rc(off_ref, qi, ki, block_q, block_k):
+    """(rows_global, cols_global, cols_local) position grids for this
+    [block_q, block_k] tile.  off_ref (optional, [1, 2] i32-as-f32
+    carrier) adds DYNAMIC global offsets — how ring attention tells the
+    kernel where its local shard and the currently-held k/v block sit in
+    the full sequence.  Causal masking and the dropout hash key on the
+    GLOBAL positions; key-padding (kv_len) keys on the LOCAL column."""
     rows = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
+    cols_local = cols
+    if off_ref is not None:
+        off = jax.lax.bitcast_convert_type(off_ref[...], jnp.int32)
+        rows = rows + off[0, 0]
+        cols = cols + off[0, 1]
+    return rows, cols, cols_local
+
+
+def _tile_mask(s, rows, cols, cols_local, causal, kv_len):
+    """Causal mask on global positions + key-padding mask on the local
+    column index of a [block_q, block_k] score tile."""
+    if not causal and kv_len is None:
+        return s
     keep = None
     if causal:
         keep = rows >= cols
     if kv_len is not None:
-        pad_ok = cols < kv_len
+        pad_ok = cols_local < kv_len
         keep = pad_ok if keep is None else jnp.logical_and(keep, pad_ok)
     return jnp.where(keep, s, DEFAULT_MASK_VALUE)
 
 
-def _tile_keep_scale(seed_ref, qi, ki, block_q, block_k, rate):
-    rows_g = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    cols_g = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+def _tile_keep_scale(seed_ref, rows_g, cols_g, rate):
     # vector-shaped bitcast: Mosaic's tpu.bitcast rejects bare scalars
     seed_u = jax.lax.bitcast_convert_type(seed_ref[...], jnp.uint32)[0, 0]
     return keep_scale(seed_u, pl.program_id(0), rows_g, cols_g, rate)
@@ -171,8 +191,8 @@ def _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks):
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, off_ref, o_ref,
+                lse_ref, m_scr, l_scr, acc_scr,
                 *, sm_scale, causal, kv_len, block_q, block_k, num_k_blocks,
                 dropout_rate):
     qi = pl.program_id(1)
@@ -184,7 +204,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    live = _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
+    # dynamic offsets (ring shards) defeat the static diagonal skip; the
+    # mask still zeroes dead tiles, they just pay their matmuls
+    live = True if off_ref is not None else _qk_live(
+        qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
 
     @pl.when(live)
     def _compute():
@@ -198,7 +221,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
         s = s * sm_scale                               # [bq, bk]
         if bias_ref is not None:
             s = s + bias_ref[0, ...].astype(jnp.float32)
-        s = _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len)
+        rows, cols, cols_l = _tile_rc(off_ref, qi, ki, block_q, block_k)
+        s = _tile_mask(s, rows, cols, cols_l, causal, kv_len)
 
         m_prev = m_scr[...]                        # [bq, 128] (bcast lanes)
         l_prev = l_scr[...]
@@ -213,8 +237,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
         if dropout_rate > 0.0:
             # mask the unnormalised probs (l keeps the full softmax sum —
             # dropout acts after normalisation, and /l distributes)
-            pd = p * _tile_keep_scale(seed_ref, qi, ki, block_q, block_k,
-                                      dropout_rate)
+            pd = p * _tile_keep_scale(seed_ref, rows, cols, dropout_rate)
         else:
             pd = p
         pv = jax.lax.dot_general(pd.astype(v.dtype), v,
@@ -261,8 +284,9 @@ def _bhld_shape(x, layout):
     return x.shape
 
 
-def _pallas_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_q,
-                    block_k, dropout_rate, layout, interpret, need_lse):
+def _pallas_forward(q, k, v, bias, seed, offsets, sm_scale, causal, kv_len,
+                    block_q, block_k, dropout_rate, layout, interpret,
+                    need_lse):
     b, h, lq, d = _bhld_shape(q, layout)
     lk = _bhld_shape(k, layout)[2]
     block_q = min(block_q, lq)
@@ -293,6 +317,10 @@ def _pallas_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_q,
     if have_seed:
         in_specs.append(pl.BlockSpec((1, 1), lambda bh, qi, ki: (0, 0)))
         args.append(jnp.asarray(seed, jnp.float32).reshape(1, 1))
+    have_off = offsets is not None
+    if have_off:
+        in_specs.append(pl.BlockSpec((1, 2), lambda bh, qi, ki: (0, 0)))
+        args.append(jnp.asarray(offsets, jnp.float32).reshape(1, 2))
 
     base = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
@@ -303,13 +331,14 @@ def _pallas_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_q,
         rest = list(rest)
         bias_ref = rest.pop(0) if have_bias else None
         seed_ref = rest.pop(0) if have_seed else None
+        off_ref = rest.pop(0) if have_off else None
         if need_lse:
             o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
         else:
             o_ref, m_scr, l_scr, acc_scr = rest
             lse_ref = None
-        return base(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
-                    m_scr, l_scr, acc_scr)
+        return base(q_ref, k_ref, v_ref, bias_ref, seed_ref, off_ref,
+                    o_ref, lse_ref, m_scr, l_scr, acc_scr)
 
     scratch = [
         pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
@@ -359,7 +388,7 @@ def _delta_tile(o_ref, do_ref):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
-               dq_ref, dq_scr,
+               off_ref, dq_ref, dq_scr,
                *, sm_scale, causal, kv_len, block_q, block_k, num_k_blocks,
                dropout_rate):
     qi = pl.program_id(1)
@@ -369,7 +398,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
+    live = True if off_ref is not None else _qk_live(
+        qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
 
     @pl.when(live)
     def _compute():
@@ -380,13 +410,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        s = _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len)
+        rows, cols, cols_l = _tile_rc(off_ref, qi, ki, block_q, block_k)
+        s = _tile_mask(s, rows, cols, cols_l, causal, kv_len)
         p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk] f32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            dp = dp * _tile_keep_scale(seed_ref, qi, ki, block_q, block_k,
-                                       dropout_rate)
+            dp = dp * _tile_keep_scale(seed_ref, rows, cols, dropout_rate)
         ds = p * (dp - _delta_tile(o_ref, do_ref)) * sm_scale
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -398,7 +428,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
+                off_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                 *, sm_scale, causal, kv_len, block_q, block_k, num_q_blocks,
                 num_k_blocks, dropout_rate):
     ki = pl.program_id(1)
@@ -409,7 +439,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    live = _qk_live(qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
+    live = True if off_ref is not None else _qk_live(
+        qi, ki, block_q, block_k, causal, kv_len, num_k_blocks)
 
     @pl.when(live)
     def _compute():
@@ -420,13 +451,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        s = _tile_mask(s, qi, ki, block_q, block_k, causal, kv_len)
+        rows, cols, cols_l = _tile_rc(off_ref, qi, ki, block_q, block_k)
+        s = _tile_mask(s, rows, cols, cols_l, causal, kv_len)
         p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk] f32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            keep = _tile_keep_scale(seed_ref, qi, ki, block_q, block_k,
-                                    dropout_rate)
+            keep = _tile_keep_scale(seed_ref, rows, cols, dropout_rate)
             pv = p * keep                              # what multiplied v fwd
             dp = dp * keep
         else:
@@ -446,8 +477,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, seed_ref,
         _st(dv_ref, dv_scr[...].astype(dv_ref.dtype))
 
 
-def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
-                     kv_len, block_q, block_k, dropout_rate, layout,
+def _pallas_backward(q, k, v, do, out, lse128, seed, offsets, sm_scale,
+                     causal, kv_len, block_q, block_k, dropout_rate, layout,
                      interpret):
     """dq/dk/dv via two Pallas kernels; lse128 is the forward's [bh, lq, 128]
     stat output.  delta = rowsum(o * do) is recomputed per-tile inside the
@@ -465,6 +496,9 @@ def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
                                 lambda bh, ki, qi: (bh, qi, 0))
     have_seed = dropout_rate > 0.0
     seed_arr = jnp.asarray(seed, jnp.float32).reshape(1, 1)
+    have_off = offsets is not None
+    off_arr = (jnp.asarray(offsets, jnp.float32).reshape(1, 2)
+               if have_off else None)
 
     q3 = _flatten_heads(q, layout)
     k3 = _flatten_heads(k, layout)
@@ -485,6 +519,9 @@ def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
     if have_seed:
         dq_specs.append(pl.BlockSpec((1, 1), lambda bh, qi, ki: (0, 0)))
         dq_args.append(seed_arr)
+    if have_off:
+        dq_specs.append(pl.BlockSpec((1, 2), lambda bh, qi, ki: (0, 0)))
+        dq_args.append(off_arr)
 
     dq_base = functools.partial(
         _dq_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
@@ -494,9 +531,10 @@ def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
     def dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest):
         rest = list(rest)
         seed_ref = rest.pop(0) if have_seed else None
+        off_ref = rest.pop(0) if have_off else None
         dq_ref, dq_scr = rest
         return dq_base(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                       seed_ref, dq_ref, dq_scr)
+                       seed_ref, off_ref, dq_ref, dq_scr)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -522,6 +560,9 @@ def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
     if have_seed:
         dkv_specs.append(pl.BlockSpec((1, 1), lambda bh, ki, qi: (0, 0)))
         dkv_args.append(seed_arr)
+    if have_off:
+        dkv_specs.append(pl.BlockSpec((1, 2), lambda bh, ki, qi: (0, 0)))
+        dkv_args.append(off_arr)
 
     dkv_base = functools.partial(
         _dkv_kernel, sm_scale=sm_scale, causal=causal, kv_len=kv_len,
@@ -531,9 +572,10 @@ def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
     def dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest):
         rest = list(rest)
         seed_ref = rest.pop(0) if have_seed else None
+        off_ref = rest.pop(0) if have_off else None
         dk_ref, dv_ref, dk_scr, dv_scr = rest
         return dkv_base(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                        seed_ref, dk_ref, dv_ref, dk_scr, dv_scr)
+                        seed_ref, off_ref, dk_ref, dv_ref, dk_scr, dv_scr)
 
     kv_shape = jax.ShapeDtypeStruct((b * h, lk, d), k.dtype)
     dk, dv = pl.pallas_call(
@@ -560,18 +602,29 @@ def _pallas_backward(q, k, v, do, out, lse128, seed, sm_scale, causal,
 # bias-carrying backward (dbias needs the [lq, lk]-shaped output anyway)
 # ---------------------------------------------------------------------------
 
-def _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k, rate):
+def _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k, rate,
+                      col_off=0):
     """[b,h,lq,block_k] inverted-dropout scale for one key block, using the
-    same global-position hash as the Pallas kernels (bh = b*h + h index)."""
+    same global-position hash as the Pallas kernels (bh = b*h + h index);
+    lq_rows are already global, col_off shifts the key positions."""
     bh = bh_grid(b, h)
     rows = lq_rows[None, None, :, None]
-    cols = (ki * block_k +
+    cols = (col_off + ki * block_k +
             jnp.arange(block_k, dtype=jnp.int32))[None, None, None, :]
     return keep_scale(seed_u, bh, rows, cols, rate)
 
 
-def _xla_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_k,
-                 dropout_rate=0.0):
+def _off_rc(offsets):
+    """(row_off, col_off) traced i32 scalars from the f32[2] carrier."""
+    if offsets is None:
+        return jnp.int32(0), jnp.int32(0)
+    off = jax.lax.bitcast_convert_type(
+        jnp.asarray(offsets, jnp.float32).reshape(2), jnp.int32)
+    return off[0], off[1]
+
+
+def _xla_forward(q, k, v, bias, seed, offsets, sm_scale, causal, kv_len,
+                 block_k, dropout_rate=0.0):
     """lax.scan over key blocks with online softmax; q/k/v in [b,h,l,d].
     Returns (out, lse) with lse [b,h,lq] (+inf on fully-masked rows)."""
     b, h, lq, d = q.shape
@@ -579,8 +632,9 @@ def _xla_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_k,
     block_k = min(block_k, lk)
     nk = lk // block_k
     qf = q.astype(jnp.float32)
-    rows = jnp.arange(lq)[:, None]
-    lq_rows = jnp.arange(lq, dtype=jnp.int32)
+    row_off, col_off = _off_rc(offsets)
+    rows = row_off + jnp.arange(lq)[:, None]
+    lq_rows = row_off + jnp.arange(lq, dtype=jnp.int32)
     seed_u = _carrier_to_u32(jnp.asarray(seed, jnp.float32)) \
         if dropout_rate > 0.0 else None
 
@@ -593,11 +647,13 @@ def _xla_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_k,
         if bias is not None:
             bs = jax.lax.dynamic_slice_in_dim(bias, ki * block_k, block_k, 3)
             s = s + bs.astype(jnp.float32)
-        cols = ki * block_k + jnp.arange(block_k)[None, :]
+        cols_l = ki * block_k + jnp.arange(block_k)[None, :]
+        cols = col_off + cols_l
         if causal:
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
         if kv_len is not None:
-            s = jnp.where(cols[None, None] < kv_len, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(cols_l[None, None] < kv_len, s,
+                          DEFAULT_MASK_VALUE)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
@@ -605,7 +661,7 @@ def _xla_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_k,
         l_new = alpha * l_prev + jnp.sum(p, axis=-1)
         if dropout_rate > 0.0:
             pd = p * _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k,
-                                       dropout_rate)
+                                       dropout_rate, col_off)
         else:
             pd = p
         acc = acc * alpha[..., None] + jnp.einsum(
@@ -621,8 +677,8 @@ def _xla_forward(q, k, v, bias, seed, sm_scale, causal, kv_len, block_k,
     return (acc / denom[..., None]).astype(q.dtype), lse
 
 
-def _xla_backward(q, k, v, bias, o, do, lse, seed, sm_scale, causal, kv_len,
-                  block_k, dropout_rate=0.0):
+def _xla_backward(q, k, v, bias, o, do, lse, seed, offsets, sm_scale,
+                  causal, kv_len, block_k, dropout_rate=0.0):
     """Recompute p blockwise from the saved lse and accumulate dq/dk/dv
     (+dbias) — the flash-attention backward; no [Lq, Lk] intermediate, only
     the dbias *output* (when bias is given) has that shape."""
@@ -636,8 +692,9 @@ def _xla_backward(q, k, v, bias, o, do, lse, seed, sm_scale, causal, kv_len,
     # with dropout, o is the *dropped* output, so delta still equals
     # sum_k p_dropped * dp — the identity survives unchanged.
     delta = jnp.sum(o.astype(jnp.float32) * dof, axis=-1)      # [b,h,lq]
-    rows = jnp.arange(lq)[:, None]
-    lq_rows = jnp.arange(lq, dtype=jnp.int32)
+    row_off, col_off = _off_rc(offsets)
+    rows = row_off + jnp.arange(lq)[:, None]
+    lq_rows = row_off + jnp.arange(lq, dtype=jnp.int32)
     seed_u = _carrier_to_u32(jnp.asarray(seed, jnp.float32)) \
         if dropout_rate > 0.0 else None
 
@@ -649,16 +706,18 @@ def _xla_backward(q, k, v, bias, o, do, lse, seed, sm_scale, causal, kv_len,
         if bias is not None:
             bs = jax.lax.dynamic_slice_in_dim(bias, ki * block_k, block_k, 3)
             s = s + bs.astype(jnp.float32)
-        cols = ki * block_k + jnp.arange(block_k)[None, :]
+        cols_l = ki * block_k + jnp.arange(block_k)[None, :]
+        cols = col_off + cols_l
         if causal:
             s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
         if kv_len is not None:
-            s = jnp.where(cols[None, None] < kv_len, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(cols_l[None, None] < kv_len, s,
+                          DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse[..., None])                        # [b,h,q,bk]
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vs.astype(jnp.float32))
         if dropout_rate > 0.0:
             dscale = _block_keep_scale(seed_u, b, h, lq_rows, ki, block_k,
-                                       dropout_rate)
+                                       dropout_rate, col_off)
             dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p * dscale, dof)
             ds_raw = p * (dscale * dp - delta[..., None])       # dbias block
         else:
@@ -699,21 +758,22 @@ def _swap_lh(x, layout):
     return jnp.transpose(x, (0, 2, 1, 3)) if layout == "blhd" else x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10,
-                                                    11, 12))
-def _flash(q, k, v, bias, seed, sm_scale, causal, block_q, block_k, impl,
-           dropout_rate, kv_len, layout):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11,
+                                                    12, 13, 14))
+def _flash(q, k, v, bias, seed, offsets, sm_scale, causal, block_q,
+           block_k, impl, dropout_rate, kv_len, layout, use_offsets):
     # primal-only path: no lse output (saves its HBM write in inference)
+    off = offsets if use_offsets else None
     if impl in ("pallas", "pallas_interpret"):
-        out, _ = _pallas_forward(q, k, v, bias, seed, sm_scale, causal,
+        out, _ = _pallas_forward(q, k, v, bias, seed, off, sm_scale, causal,
                                  kv_len, block_q, block_k, dropout_rate,
                                  layout, interpret=(impl ==
                                                     "pallas_interpret"),
                                  need_lse=False)
         return out
     out, _ = _xla_forward(_swap_lh(q, layout), _swap_lh(k, layout),
-                          _swap_lh(v, layout), bias, seed, sm_scale, causal,
-                          kv_len, block_k, dropout_rate)
+                          _swap_lh(v, layout), bias, seed, off, sm_scale,
+                          causal, kv_len, block_k, dropout_rate)
     return _swap_lh(out, layout)
 
 
@@ -728,47 +788,53 @@ def _use_pallas_bwd(impl, bias, q, layout) -> bool:
     return lq >= PALLAS_BWD_MIN_L
 
 
-def _flash_fwd(q, k, v, bias, seed, sm_scale, causal, block_q, block_k,
-               impl, dropout_rate, kv_len, layout):
+def _flash_fwd(q, k, v, bias, seed, offsets, sm_scale, causal, block_q,
+               block_k, impl, dropout_rate, kv_len, layout, use_offsets):
+    off = offsets if use_offsets else None
     if impl in ("pallas", "pallas_interpret"):
         # save the lse residual only when the Pallas backward will read it;
         # otherwise the XLA backward recomputes the row stats blockwise
         # (cheaper than the [bh, lq, 128] HBM round-trip at short L)
         need_lse = _use_pallas_bwd(impl, bias, q, layout)
-        out, lse = _pallas_forward(q, k, v, bias, seed, sm_scale, causal,
-                                   kv_len, block_q, block_k, dropout_rate,
-                                   layout,
+        out, lse = _pallas_forward(q, k, v, bias, seed, off, sm_scale,
+                                   causal, kv_len, block_q, block_k,
+                                   dropout_rate, layout,
                                    interpret=(impl == "pallas_interpret"),
                                    need_lse=need_lse)
     else:
         out, lse = _xla_forward(_swap_lh(q, layout), _swap_lh(k, layout),
-                                _swap_lh(v, layout), bias, seed, sm_scale,
-                                causal, kv_len, block_k, dropout_rate)
+                                _swap_lh(v, layout), bias, seed, off,
+                                sm_scale, causal, kv_len, block_k,
+                                dropout_rate)
         out = _swap_lh(out, layout)
-    return out, (q, k, v, bias, seed, out, lse)
+    return out, (q, k, v, bias, seed, offsets, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, impl, dropout_rate,
-               kv_len, layout, res, do):
-    q, k, v, bias, seed, out, lse = res
+               kv_len, layout, use_offsets, res, do):
+    q, k, v, bias, seed, offsets, out, lse = res
+    off = offsets if use_offsets else None
+    zero_off = jnp.zeros_like(offsets)   # int-carrier operand: zero cotangent
     if _use_pallas_bwd(impl, bias, q, layout):
         dq, dk, dv = _pallas_backward(
-            q, k, v, do, out, lse, seed, sm_scale, causal, kv_len, block_q,
-            block_k, dropout_rate, layout,
+            q, k, v, do, out, lse, seed, off, sm_scale, causal, kv_len,
+            block_q, block_k, dropout_rate, layout,
             interpret=(impl == "pallas_interpret"))
-        return dq, dk, dv, None, jnp.zeros((), jnp.float32)
+        return (dq, dk, dv, None, jnp.zeros((), jnp.float32), zero_off)
     if lse is None:
         # pallas fwd that skipped the lse residual: recompute the row stats
         # blockwise (l must be the FULL softmax sum — dropout off)
         _, lse = _xla_forward(_swap_lh(q, layout), _swap_lh(k, layout),
-                              _swap_lh(v, layout), bias, seed, sm_scale,
-                              causal, kv_len, block_k, dropout_rate=0.0)
+                              _swap_lh(v, layout), bias, seed, off,
+                              sm_scale, causal, kv_len, block_k,
+                              dropout_rate=0.0)
     dq, dk, dv, dbias = _xla_backward(
         _swap_lh(q, layout), _swap_lh(k, layout), _swap_lh(v, layout), bias,
-        _swap_lh(out, layout), _swap_lh(do, layout), lse, seed, sm_scale,
-        causal, kv_len, block_k, dropout_rate)
+        _swap_lh(out, layout), _swap_lh(do, layout), lse, seed, off,
+        sm_scale, causal, kv_len, block_k, dropout_rate)
     return (_swap_lh(dq, layout), _swap_lh(dk, layout),
-            _swap_lh(dv, layout), dbias, jnp.zeros((), jnp.float32))
+            _swap_lh(dv, layout), dbias, jnp.zeros((), jnp.float32),
+            zero_off)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -793,7 +859,8 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
                     impl: Optional[str] = None,
                     dropout_rate: float = 0.0,
                     dropout_seed=None,
-                    layout: str = "bhld") -> jax.Array:
+                    layout: str = "bhld",
+                    block_offsets=None) -> jax.Array:
     """Fused attention.  layout='bhld': q [B,H,Lq,D], k/v [B,H,Lk,D];
     layout='blhd': q [B,Lq,H,D] etc. (head-interleaved — the kernels index
     it directly, so callers skip the split-heads transposes).  Optional
@@ -807,6 +874,11 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     position — no [Lq, Lk] mask tensor exists in either direction.
     dropout_seed: int/uint32 scalar (may be traced), required when
     dropout_rate > 0; same seed ⇒ same mask.
+
+    block_offsets=(row_off, col_off) (ints, MAY BE TRACED) place this
+    call's q block and k/v block at global sequence positions — ring
+    attention's shards call with (my*Lq_shard, src*Lk_shard) so the
+    causal mask and the dropout hash key on true global coordinates.
     """
     if layout not in ("bhld", "blhd"):
         raise ValueError(f"unknown layout {layout!r}")
@@ -830,6 +902,11 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
         seed = seed_to_carrier(dropout_seed)
     else:
         seed = jnp.zeros((), jnp.float32)
+    use_offsets = block_offsets is not None
+    if use_offsets:
+        offsets = offsets_carrier(*block_offsets)
+    else:
+        offsets = jnp.zeros(2, jnp.float32)
     pq = (-lq) % min(block_q, lq)
     pk = (-lk) % min(block_k, lk)
     kv_len = None
@@ -850,12 +927,12 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
             kv_len = lk
         if bias is not None:
             bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pq), (0, pk)))
-        out = _flash(q, k, v, bias, seed, float(sm_scale), bool(causal),
-                     int(block_q), int(block_k), impl, dropout_rate, kv_len,
-                     layout)
+        out = _flash(q, k, v, bias, seed, offsets, float(sm_scale),
+                     bool(causal), int(block_q), int(block_k), impl,
+                     dropout_rate, kv_len, layout, use_offsets)
         if layout == "blhd":
             return out[:, :lq]
         return out[:, :, :lq, :]
-    return _flash(q, k, v, bias, seed, float(sm_scale), bool(causal),
-                  int(block_q), int(block_k), impl, dropout_rate, kv_len,
-                  layout)
+    return _flash(q, k, v, bias, seed, offsets, float(sm_scale),
+                  bool(causal), int(block_q), int(block_k), impl,
+                  dropout_rate, kv_len, layout, use_offsets)

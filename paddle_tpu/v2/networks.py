@@ -10,9 +10,12 @@ from ..fluid import layers as flayers
 from ..fluid import nets as fnets
 from . import layer as v2layer
 
-__all__ = ["simple_lstm", "simple_gru", "bidirectional_lstm",
+__all__ = ["simple_lstm", "simple_gru", "simple_gru2", "gru_group",
+           "lstmemory_group", "bidirectional_lstm",
            "bidirectional_gru", "simple_img_conv_pool",
-           "img_conv_group", "vgg_16_network"]
+           "img_conv_group", "vgg_16_network", "text_conv_pool",
+           "sequence_conv_pool", "dot_product_attention",
+           "multi_head_attention"]
 
 
 def simple_lstm(input, size, reverse=False, act=None, gate_act=None,
@@ -110,3 +113,89 @@ def bidirectional_gru(input, size, return_seq=False, **kw):
     """Forward + backward simple_gru (reference networks.py
     bidirectional_gru)."""
     return _bidirectional(simple_gru, input, size, return_seq)
+
+
+def gru_group(input, size, reverse=False, act=None, gate_act=None,
+              param_attr=None, bias_attr=None, **kw):
+    """GRU over a PRE-PROJECTED [.., 3*size] sequence — reference
+    networks.py gru_group (the building block simple_gru wraps; exposed
+    for configs that do their own mixing/projection)."""
+    return v2layer.grumemory(input, size=size, reverse=reverse, act=act,
+                             gate_act=gate_act, param_attr=param_attr,
+                             bias_attr=bias_attr)
+
+
+def lstmemory_group(input, size, reverse=False, act=None, gate_act=None,
+                    param_attr=None, bias_attr=None, **kw):
+    """LSTM over a PRE-PROJECTED [.., 4*size] sequence — reference
+    networks.py lstmemory_group."""
+    return v2layer.lstmemory(input, size=size, reverse=reverse, act=act,
+                             gate_act=gate_act, param_attr=param_attr,
+                             bias_attr=bias_attr)
+
+
+def simple_gru2(input, size, reverse=False, act=None, gate_act=None,
+                param_attr=None, bias_attr=None, **kw):
+    """reference networks.py simple_gru2 — same computation as
+    simple_gru with the reference's alternative (grumemory-style)
+    parameter packing; here both packings collapse to the one fluid
+    dynamic_gru layout, so this is simple_gru under the v2 name."""
+    return simple_gru(input, size, reverse=reverse, act=act,
+                      gate_act=gate_act, param_attr=param_attr,
+                      bias_attr=bias_attr)
+
+
+def text_conv_pool(input, context_len, hidden_size, context_start=None,
+                   pool_type=None, fc_act=None, **kw):
+    """Text convolution pooling (reference networks.py
+    sequence_conv_pool/text_conv_pool): context window concat -> fc ->
+    sequence pool."""
+    from .layer import _act_name
+
+    ctx = flayers.sequence_context(input, context_length=context_len,
+                                   context_start=context_start)
+    hidden = flayers.fc(input=ctx, size=hidden_size,
+                        act=_act_name(fc_act) or "tanh")
+    ptype = getattr(pool_type, "name", pool_type) or "max"
+    return flayers.sequence_pool(input=hidden, pool_type=ptype)
+
+
+sequence_conv_pool = text_conv_pool
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, **kw):
+    """reference networks.py dot_product_attention:1417+: weights are a
+    sequence softmax over dot(encoded_j, state); the context is the
+    weighted sum of ``attended_sequence``."""
+    expanded = flayers.sequence_expand(transformed_state, encoded_sequence)
+    dots = flayers.reduce_sum(
+        flayers.elementwise_mul(encoded_sequence, expanded), dim=-1,
+        keep_dim=True)
+    weight = flayers.sequence_softmax(dots)
+    scaled = flayers.elementwise_mul(attended_sequence, weight)
+    return flayers.sequence_pool(input=scaled, pool_type="sum")
+
+
+def multi_head_attention(query, key, value, key_proj_size, value_proj_size,
+                         head_num, attention_type="dot", **kw):
+    """reference networks.py multi_head_attention over SEQUENCES: per
+    head, project key/value (and query for the additive type), attend
+    with dot-product (or additive) weights, concat head contexts.
+    ``query`` is a dense per-sample state; key/value are sequences."""
+    assert key_proj_size % head_num == 0
+    assert value_proj_size % head_num == 0
+    heads = []
+    for _ in range(head_num):
+        k = flayers.fc(input=key, size=key_proj_size // head_num,
+                       bias_attr=False)
+        v = flayers.fc(input=value, size=value_proj_size // head_num,
+                       bias_attr=False)
+        q = flayers.fc(input=query, size=key_proj_size // head_num,
+                       bias_attr=False)
+        if attention_type in ("dot", "dot-product attention"):
+            heads.append(dot_product_attention(k, v, q))
+        else:                               # additive
+            heads.append(v2layer.simple_attention(
+                encoded_sequence=v, encoded_proj=k, decoder_state=q))
+    return flayers.concat(input=heads, axis=-1)

@@ -413,3 +413,111 @@ def test_flash_pallas_rect_blocks_and_lengths(pallas_bwd):
         impl="pallas_interpret").sum())(k)
     g2 = jax.grad(lambda k: naive_attention(q, k, v).sum())(k)
     np.testing.assert_allclose(g1, g2, atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas_interpret"])
+def test_flash_block_offsets(impl, pallas_bwd):
+    """block_offsets place the q/k blocks at global positions: the causal
+    mask and the dropout hash must behave as if the blocks were slices of
+    one long sequence (the contract ring attention relies on)."""
+    full_q, full_k, full_v = make_qkv(b=1, h=2, lq=64, lk=64, d=8)
+    ro, co = 32, 16            # q block = rows 32..63, k block = cols 16..47
+    q = full_q[:, :, 32:64]
+    k = full_k[:, :, 16:48]
+    v = full_v[:, :, 16:48]
+
+    # causal: out == the corresponding tile of the full causal attention
+    # restricted to these keys
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (8 ** -0.5)
+    rows = (ro + jnp.arange(32))[:, None]
+    cols = (co + jnp.arange(32))[None, :]
+    s = jnp.where(rows >= cols, s, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
+                     v.astype(jnp.float32))
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                          impl=impl, block_offsets=(ro, co))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    # grads flow and match the dense reference
+    g1 = jax.grad(lambda k_: flash_attention(
+        q, k_, v, causal=True, block_q=16, block_k=16, impl=impl,
+        block_offsets=(ro, co)).sum())(k)
+
+    def dense(k_):
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k_.astype(jnp.float32)) * (8 ** -0.5)
+        s_ = jnp.where(rows >= cols, s_, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd",
+                          jax.nn.softmax(s_, axis=-1),
+                          v.astype(jnp.float32)).sum()
+
+    g2 = jax.grad(dense)(k)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=5e-4, rtol=5e-4)
+
+    # dropout hash keys on GLOBAL positions: the offset call equals the
+    # corresponding slice semantics of the hash mask
+    outd = flash_attention(q, k, v, block_q=16, block_k=16, impl=impl,
+                           dropout_rate=0.3, dropout_seed=5,
+                           block_offsets=(ro, co))
+    refd = naive_dropout_attention_tile(q, k, v, seed=5, rate=0.3,
+                                        row_off=ro, col_off=co)
+    np.testing.assert_allclose(np.asarray(outd), np.asarray(refd),
+                               atol=2e-5, rtol=2e-5)
+
+
+def naive_dropout_attention_tile(q, k, v, seed, rate, row_off, col_off):
+    from paddle_tpu.kernels.flash_attention import keep_scale
+    b, h, lq, _ = q.shape
+    lk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
+    p = jax.nn.softmax(s, axis=-1)
+    bh = (jnp.arange(b, dtype=jnp.int32)[:, None] * h +
+          jnp.arange(h, dtype=jnp.int32)[None, :])[:, :, None, None]
+    rows = (row_off + jnp.arange(lq, dtype=jnp.int32))[None, None, :, None]
+    cols = (col_off + jnp.arange(lk, dtype=jnp.int32))[None, None, None, :]
+    scale = keep_scale(jnp.uint32(seed), bh, rows, cols, rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", p * scale, v.astype(jnp.float32))
+
+
+def test_ring_flash_chunks_match_unsharded_flash():
+    """The r4 ring (flash kernels per held block, offset masks, lse merge)
+    must equal the UNSHARDED flash kernel bit-for-bit in semantics — same
+    causal mask, same global-position dropout hash — for both values and
+    gradients."""
+    mesh = make_mesh({"sp": 4}, jax.devices()[:4])
+    q, k, v = make_qkv(b=2, h=2, lq=64, lk=64, d=8, seed=3)
+
+    ring = ring_attention_sharded(mesh, q, k, v, causal=True, dp_axis=None,
+                                  dropout_rate=0.25, dropout_seed=42)
+    flat = flash_attention(q, k, v, causal=True, impl="xla",
+                           dropout_rate=0.25, dropout_seed=42)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(flat),
+                               atol=2e-5, rtol=2e-5)
+
+    g_ring = jax.grad(lambda v_: ring_attention_sharded(
+        mesh, q, k, v_, causal=True, dp_axis=None, dropout_rate=0.25,
+        dropout_seed=42).sum())(v)
+    g_flat = jax.grad(lambda v_: flash_attention(
+        q, k, v_, causal=True, impl="xla", dropout_rate=0.25,
+        dropout_seed=42).sum())(v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_flat),
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_ring_non_divisible_shards():
+    """Local shards that don't divide the kernel blocks pad + mask inside
+    the ring (kv_len on local columns, offsets on global ones)."""
+    mesh = make_mesh({"sp": 4}, jax.devices()[:4])
+    q, k, v = make_qkv(b=1, h=2, lq=24, lk=24, d=8, seed=6)  # shards of 6
+    out = ring_attention_sharded(mesh, q, k, v, causal=True, dp_axis=None)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+    g1 = jax.grad(lambda q_: ring_attention_sharded(
+        mesh, q_, k, v, causal=True, dp_axis=None).sum())(q)
+    g2 = jax.grad(lambda q_: naive_attention(q_, k, v, causal=True).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=5e-4, rtol=5e-4)

@@ -309,3 +309,40 @@ def test_bf16_activation_training(fresh_programs):
     losses = _train(main, startup, scope, feeder, avg_cost, steps=25)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.8, losses[::5]
+
+
+def test_benchmark_nets_build_and_smallnet_trains(fresh_programs):
+    """The reference's GPU-benchmark image configs (benchmark/paddle/image
+    alexnet/googlenet/smallnet — the K40m rows in BASELINE.md) build with
+    the right output shapes; the cheap one trains a step end-to-end.
+    (AlexNet/GoogLeNet train on TPU in bench.py's image_suite; full CPU
+    training steps of 224px nets are too slow for unit CI.)"""
+    from paddle_tpu.models import benchmark_nets as B
+
+    for fn, px, ncls in [(B.alexnet, 227, 1000),
+                         (B.googlenet_v1, 224, 1000)]:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            img = fluid.layers.data("img", [3, px, px], "float32")
+            pred = fn(img, class_num=ncls)
+        assert tuple(pred.shape)[-1] == ncls
+
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.layers.data("img", [3, 32, 32], "float32")
+        label = fluid.layers.data("label", [1], "int64")
+        pred = B.smallnet_cifar(img)
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"img": rng.rand(16, 3, 32, 32).astype(np.float32),
+                        "label": rng.randint(0, 10, (16, 1)).astype(
+                            np.int64)},
+            fetch_list=[cost])[0])) for _ in range(8)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

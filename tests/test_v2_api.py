@@ -724,3 +724,106 @@ def test_v2_attention_seq2seq_trains():
         if isinstance(ev, paddle.event.EndIteration) else None,
         feeding={"src": 0, "trg": 1, "trg_next": 2})
     assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+def test_v2_text_conv_pool_and_dot_attention():
+    """networks.py tail: text_conv_pool classifier + dot_product_attention
+    seq2seq both train via SGD.train."""
+    vocab = 18
+    paddle.init(seed=7)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=8)
+    pooled = paddle.networks.text_conv_pool(emb, context_len=3,
+                                            hidden_size=12)
+    pred = paddle.layer.fc(input=pooled, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    # dot-product attention context has attended width; multi-head concat
+    # has value_proj_size — checked on EXECUTED values (static shapes drop
+    # through the pooled chain).  Built BEFORE parameters.create so the
+    # attention projections get initialized too.
+    q = paddle.layer.fc(input=pooled, size=6)
+    ctx = paddle.networks.dot_product_attention(
+        encoded_sequence=paddle.layer.fc(input=emb, size=6,
+                                         bias_attr=False),
+        attended_sequence=paddle.layer.fc(input=emb, size=10,
+                                          bias_attr=False),
+        transformed_state=q)
+    mh = paddle.networks.multi_head_attention(
+        query=q, key=emb, value=emb, key_proj_size=12, value_proj_size=8,
+        head_num=2)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2))
+    rng = np.random.RandomState(9)
+    trainer.train(
+        reader=paddle.batch(_seq_cls_reader(rng, vocab), 8), num_passes=4,
+        feeding={"words": 0, "label": 1})
+
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import make_seq
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng2 = np.random.RandomState(1)
+    seqs = [rng2.randint(0, vocab, (3, 1)) for _ in range(4)]
+    with fluid.scope_guard(parameters.scope):
+        cv, mv = exe.run(
+            fluid.io.get_inference_program([ctx, mh]),
+            feed={"words": make_seq(seqs, dtype=np.int32)},
+            fetch_list=[ctx, mh], mode="infer")
+    assert np.asarray(cv).shape == (4, 10)
+    assert np.asarray(mv).shape == (4, 8)
+
+
+def test_v2_gru_group_matches_simple_gru():
+    """gru_group over a pre-projected sequence computes the SAME values as
+    the underlying fluid dynamic_gru when sharing parameters by name —
+    the reference's group/simple_* equivalence, checked numerically."""
+    vocab = 10
+    paddle.init(seed=4)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    emb = paddle.layer.embedding(
+        input=words, size=6,
+        param_attr=paddle.attr.Param(name="gg_emb"))
+    proj = paddle.layer.fc(input=emb, size=12, bias_attr=False,
+                           param_attr=paddle.attr.Param(name="gg_proj"))
+    out = paddle.networks.gru_group(
+        proj, size=4, param_attr=paddle.attr.Param(name="gg_rec_w"),
+        bias_attr=paddle.attr.Param(name="gg_rec_b"))
+    assert out.lod_level == 1 and tuple(out.shape)[-1] == 4
+
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import make_seq
+
+    ref = fluid.layers.dynamic_gru(
+        input=proj, size=4,
+        param_attr=fluid.ParamAttr(name="gg_rec_w"),
+        bias_attr=fluid.ParamAttr(name="gg_rec_b"))
+
+    out2 = paddle.networks.lstmemory_group(
+        paddle.layer.fc(input=emb, size=16, bias_attr=False), size=4)
+    assert out2.lod_level == 1 and tuple(out2.shape)[-1] == 4
+
+    cost = paddle.layer.mse_cost(
+        input=paddle.layer.pool(out, pool_type=paddle.pooling.Sum()),
+        label=paddle.layer.data(
+            name="y", type=paddle.data_type.dense_vector(4)))
+    parameters = paddle.parameters.create(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2)
+    seqs = [rng.randint(0, vocab, (3, 1)) for _ in range(4)]
+    with fluid.scope_guard(parameters.scope):
+        exe.run(fluid.default_startup_program())
+        a, b = exe.run(
+            fluid.io.get_inference_program([out, ref]),
+            feed={"words": make_seq(seqs, dtype=np.int32)},
+            fetch_list=[out, ref], mode="infer")
+    np.testing.assert_allclose(np.asarray(a.data), np.asarray(b.data),
+                               atol=1e-6)
