@@ -416,6 +416,18 @@ def main() -> None:
         tf_tps, tf_mfu = None, None
         print(f"transformer bench failed: {e}", file=sys.stderr)
 
+    # long-context transformer row (the r4 signature improvement): same
+    # recipe at seq 2048 — BENCH_NOTES §5 carries the full 1k-16k table
+    long_ctx = None
+    if os.environ.get("BENCH_SKIP_LONGCTX", "") != "1":
+        try:
+            lc_tps, lc_mfu = bench_transformer(4, steps, trials, 2048)
+            long_ctx = {"seq_len": 2048, "batch": 4,
+                        "tokens_per_sec": round(lc_tps, 1),
+                        "mfu": round(lc_mfu, 4)}
+        except Exception as e:
+            print(f"long-context bench failed: {e}", file=sys.stderr)
+
     lstm_results = {}
     for hidden in [int(x) for x in os.environ.get(
             "BENCH_LSTM_HIDDEN", "256,512,1280").split(",") if x]:
@@ -472,8 +484,11 @@ def main() -> None:
         # BASELINE.md rows 22-24): ms/batch + tok/s per hidden size
         "lstm_text_cls": lstm_results,
         # reference benchmark/paddle/image alexnet/googlenet/smallnet vs
-        # their K40m rows (BASELINE.md:13-18)
+        # their K40m rows (BASELINE.md:13-18).  smallnet's number is a
+        # dispatch-floor measurement on the tunneled chip (the model is
+        # microseconds of device work).
         "image_suite": image_suite,
+        "transformer_long_context": long_ctx,
         # real-data trained quality (None in zero-egress environments)
         "mnist_quality": quality,
         "device": jax.devices()[0].device_kind,
