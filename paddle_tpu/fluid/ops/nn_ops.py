@@ -174,13 +174,26 @@ def layer_norm(ctx, x, scale, bias):
 def dropout(ctx, x):
     """reference dropout_op.cc.  The mask is derived from the op's salted RNG
     key; the vjp-recomputed backward regenerates the identical mask (see
-    lowering.py) — no mask tensor needs saving."""
+    lowering.py) — no mask tensor needs saving.
+
+    The per-element bits come from the counter-hash the attention kernels
+    use (kernels/flash_attention.keep_scale), seeded by ONE scalar draw
+    from the op's key: a full threefry tensor draw cost ~8% of the
+    Transformer step (measured, BENCH_NOTES §9); the murmur-style
+    finalizer is a handful of fused VPU ops per element and keeps the
+    fwd/bwd-recompute determinism contract unchanged."""
     p = ctx.attr("dropout_prob", 0.5)
     if ctx.attr("is_test", False) or ctx.mode == "infer" or p == 0.0:
         return x, jnp.ones_like(x)
-    keep = jax.random.bernoulli(ctx.rng, 1.0 - p, x.shape)
-    mask = keep.astype(x.dtype)
-    return x * mask / (1.0 - p), jax.lax.stop_gradient(mask)
+    from ...kernels.flash_attention import keep_scale
+
+    seed = jax.random.bits(ctx.rng, (), jnp.uint32)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (x.size, 1), 0)
+    scale = keep_scale(seed, jnp.uint32(0), idx, jnp.int32(0), float(p))
+    scale = scale.reshape(x.shape).astype(x.dtype)
+    # scale is {0, 1/(1-p)} (inverted dropout); Mask keeps the 0/1 view
+    return x * scale, jax.lax.stop_gradient(
+        (scale > 0).astype(x.dtype))
 
 
 @primitive("l2_normalize")
