@@ -177,7 +177,8 @@ class AUC(Evaluator):
         tot_p, tot_n = max(tp[-1], 1e-9), max(fp[-1], 1e-9)
         tpr = np.concatenate([[0.0], tp / tot_p])
         fpr = np.concatenate([[0.0], fp / tot_n])
-        return np.array(np.trapz(tpr, fpr), np.float32)
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz
+        return np.array(trapezoid(tpr, fpr), np.float32)
 
 
 class DetectionMAP:

@@ -178,3 +178,30 @@ class TestLookupPadding:
         exp = w[[0, 2, 0]].copy()
         exp[[0, 2]] = 0.0
         t.check_output({"Out": exp})
+
+
+def test_dropout_hash_statistics(fresh_programs):
+    """The counter-hash dropout op: drop fraction ~= p, inverted scaling
+    preserves the mean, same-step masks are deterministic (fwd/bwd
+    recompute contract), different ops decorrelate."""
+    from paddle_tpu import fluid
+
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4096], "float32")
+        d1 = fluid.layers.dropout(x, dropout_prob=0.3)
+        d2 = fluid.layers.dropout(x, dropout_prob=0.3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((16, 4096), np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        a, b = exe.run(main, feed={"x": xv}, fetch_list=[d1, d2])
+    a, b = np.asarray(a), np.asarray(b)
+    for arr in (a, b):
+        dropped = float((arr == 0).mean())
+        assert abs(dropped - 0.3) < 0.02, dropped
+        # inverted scaling: surviving values are 1/(1-p)
+        assert np.allclose(arr[arr != 0], 1 / 0.7, atol=1e-5)
+        assert abs(arr.mean() - 1.0) < 0.02
+    # two dropout OPS in one step must not share a mask
+    assert not np.array_equal(a == 0, b == 0)
