@@ -249,8 +249,12 @@ def bench_transformer(batch: int, steps: int, trials: int,
                                   fetch_list=[avg_cost]).get("flops", 0.0)
     dt = _time_steps(exe, main_prog, feed, [avg_cost], scope, steps, trials)
     tokens = batch * seq_len * 2          # source + target tokens consumed
-    flops += _uncounted_attention_flops(batch, seq_len, cfg["n_layer"],
-                                        cfg["n_head"], cfg["d_key"])
+    if jax.default_backend() == "tpu":
+        # only the Pallas path hides flops from cost analysis; the XLA
+        # fallback (non-TPU backends) is already counted — adding the
+        # analytic term there would double-count
+        flops += _uncounted_attention_flops(batch, seq_len, cfg["n_layer"],
+                                            cfg["n_head"], cfg["d_key"])
     return tokens / dt, (flops / dt) / chip_peak_flops()
 
 

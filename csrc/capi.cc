@@ -15,6 +15,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <limits>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -443,7 +444,9 @@ void Engine::run_op(const OpDesc& op) {
               best = row[s2 * inner + j];
               bi = s2;
             }
-          rp[j] = len ? best : 0.f;
+          // empty row: the Python tier's masked max yields -inf
+          rp[j] = len ? best
+                      : -std::numeric_limits<float>::infinity();
           ip[j] = (float)bi;
         }
       } else if (ptype == "sum" || ptype == "average" || ptype == "sqrt") {
@@ -484,6 +487,10 @@ void Engine::run_op(const OpDesc& op) {
         x.shape[2] != 4 * size)
       throw std::runtime_error("dynamic_lstm: bad input layout");
     int64_t b = x.shape[0], tt = x.shape[1];
+    if (bias.numel() < (peep ? 7 : 4) * size)
+      throw std::runtime_error("dynamic_lstm: bias too small (need " +
+                               std::to_string((peep ? 7 : 4) * size) +
+                               " values)");
     const float* gb = bias.data.data();           // [4s] gate bias
     const float* w_ic = peep ? gb + 4 * size : nullptr;
     const float* w_fc = peep ? gb + 5 * size : nullptr;
@@ -554,6 +561,8 @@ void Engine::run_op(const OpDesc& op) {
       throw std::runtime_error("dynamic_gru: bad input layout");
     int64_t b = x.shape[0], tt = x.shape[1];
     std::vector<float> zero_bias(3 * size, 0.f);
+    if (has_in(op, "Bias") && in(op, "Bias").numel() < 3 * size)
+      throw std::runtime_error("dynamic_gru: bias too small");
     const float* gb = has_in(op, "Bias") ? in(op, "Bias").data.data()
                                          : zero_bias.data();
     Tensor hid;

@@ -148,7 +148,11 @@ class AUC(Evaluator):
         # positive-class probability -> bin in [0, t)
         pos = layers.slice_last(input) if hasattr(layers, "slice_last")             else layers.split(input, num_or_sections=input.shape[-1],
                               dim=-1)[-1]
-        binf = layers.scale(pos, scale=float(t - 1))
+        # clamp to [0, t-1] BEFORE the cast: out-of-[0,1] scores (logits
+        # passed directly) must land in the edge bins, not vanish as
+        # all-zero one_hot rows (the reference auc op clamps the same way)
+        binf = layers.clip(layers.scale(pos, scale=float(t - 1)),
+                           min=0.0, max=float(t - 1))
         bini = h.create_tmp_variable("int32", stop_gradient=True)
         h.append_op("cast", {"X": binf}, {"Out": bini},
                     {"out_dtype": "int32"})

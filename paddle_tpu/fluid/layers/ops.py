@@ -33,7 +33,9 @@ _UNARY_OPS = [
     "square", "softplus", "softsign", "softshrink", "hard_shrink",
     "hard_sigmoid", "thresholded_relu", "elu", "pow", "stanh", "swish",
     "gelu", "leaky_relu", "brelu", "sign", "softmax", "log_softmax",
-    "maxout", "clip", "clip_by_norm", "sequence_softmax",
+    # maxout lives in nn.py (needs an explicit groups arg; the generic
+    # unary wrapper would swallow it into **attrs-by-position)
+    "clip", "clip_by_norm", "sequence_softmax",
 ]
 
 _globals = globals()

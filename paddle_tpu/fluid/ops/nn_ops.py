@@ -277,7 +277,7 @@ def fused_attention(ctx, q, k, v, bias):
         out = _ring(mesh, q, k, v, bias=bias, causal=causal,
                     sm_scale=sm_scale,
                     dp_axis="dp", mp_axis="mp", sp_axis="sp",
-                    dropout_rate=rate, dropout_seed=seed)
+                    dropout_rate=rate, dropout_seed=seed, impl=impl)
         if layout == "blhd":
             out = jnp.transpose(out, (0, 2, 1, 3))
         return out
