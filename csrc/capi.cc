@@ -483,6 +483,14 @@ void Engine::run_op(const OpDesc& op) {
       throw std::runtime_error(
           "dynamic_lstm: non-default activations unsupported in the "
           "native engine (use the PJRT tier)");
+    for (const char* slot : {"H0", "C0"}) {
+      auto it = op.inputs.find(slot);
+      if (it != op.inputs.end() && !it->second.empty())
+        throw std::runtime_error(
+            std::string("dynamic_lstm: initial state ") + slot +
+            " unsupported in the native engine — the loop always starts "
+            "from zero state (use the PJRT tier)");
+    }
     if (x.lengths.empty() || x.shape.size() != 3 ||
         x.shape[2] != 4 * size)
       throw std::runtime_error("dynamic_lstm: bad input layout");
@@ -556,6 +564,14 @@ void Engine::run_op(const OpDesc& op) {
       throw std::runtime_error(
           "dynamic_gru: non-default activations unsupported in the "
           "native engine (use the PJRT tier)");
+    {
+      auto it = op.inputs.find("H0");
+      if (it != op.inputs.end() && !it->second.empty())
+        throw std::runtime_error(
+            "dynamic_gru: initial state H0 unsupported in the native "
+            "engine — the loop always starts from zero state (use the "
+            "PJRT tier)");
+    }
     if (x.lengths.empty() || x.shape.size() != 3 ||
         x.shape[2] != 3 * size)
       throw std::runtime_error("dynamic_gru: bad input layout");

@@ -46,6 +46,10 @@ PJRT_Buffer_Type dtype_to_pjrt(const std::string& dt) {
   if (dt == "int64") return PJRT_Buffer_Type_S64;
   if (dt == "bfloat16") return PJRT_Buffer_Type_BF16;
   if (dt == "float64") return PJRT_Buffer_Type_F64;
+  if (dt == "float16") return PJRT_Buffer_Type_F16;
+  if (dt == "int8") return PJRT_Buffer_Type_S8;
+  if (dt == "uint8") return PJRT_Buffer_Type_U8;
+  if (dt == "bool") return PJRT_Buffer_Type_PRED;
   throw std::runtime_error("unsupported dtype " + dt);
 }
 
@@ -105,6 +109,7 @@ struct Runner {
   std::vector<std::vector<int64_t>> out_shapes;
   std::vector<std::string> out_dtypes;
   std::vector<std::vector<char>> out_raw;
+  bool out_dtypes_verified = false;  // element-type check latched once
 
   ~Runner();
   void check(PJRT_Error* err, const char* what);
@@ -378,6 +383,30 @@ void Runner::forward(const void* const* inputs) {
     int64_t numel = 1;
     for (auto d : out_shapes[i]) numel *= d;
     out_dtypes[i] = meta.outputs[i].dtype;
+    // Never trust the meta dtype for the d2h byte width: a stale or
+    // hand-edited model.stablehlo.json would silently reinterpret the
+    // bytes.  Verify against the executable's actual element type —
+    // invariant for a compiled executable, so latched after the first
+    // forward rather than paid per call.
+    if (!out_dtypes_verified) {
+      PJRT_Buffer_ElementType_Args et;
+      std::memset(&et, 0, sizeof(et));
+      et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+      et.buffer = out_bufs[i];
+      check(api->PJRT_Buffer_ElementType(&et), "element_type");
+      bool mismatch;
+      try {
+        mismatch = et.type != dtype_to_pjrt(out_dtypes[i]);
+      } catch (const std::exception&) {
+        mismatch = true;  // meta dtype not even mappable
+      }
+      if (mismatch)
+        throw std::runtime_error(
+            "output " + std::to_string(i) + ": meta dtype '" +
+            out_dtypes[i] + "' does not match the compiled buffer's "
+            "element type (" + std::to_string((int)et.type) +
+            ") — regenerate model.stablehlo.json");
+    }
     int64_t w = ptpu::dtype_width(out_dtypes[i]);
     out_raw[i].resize(numel * w);
 
@@ -390,6 +419,7 @@ void Runner::forward(const void* const* inputs) {
     check(api->PJRT_Buffer_ToHostBuffer(&th), "d2h");
     await_event(th.event, "d2h await");
   }
+  out_dtypes_verified = true;
   // in/out buffers are destroyed by the BufferGuards (also on throw)
 }
 

@@ -248,12 +248,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, off_ref, o_ref,
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
         l_fin = l_scr[...]
-        denom = jnp.where(l_fin == 0.0, 1.0, l_fin)  # fully-masked rows
-        _st(o_ref, (acc_scr[...] / denom[:, :1]).astype(o_ref.dtype))
+        m_fin = m_scr[...]
+        # A row is fully masked when l never accumulated (l==0) OR when
+        # its running max never rose above the finite DEFAULT_MASK_VALUE
+        # — in that case every p was exp(0)=1 over masked keys and both
+        # acc and l are finite garbage (real scores cannot reach
+        # MASK/2 ≈ -1.2e38).  Zero the output and poison the lse so the
+        # backward's exp(s - lse) underflows to 0 for those rows.
+        dead = (l_fin == 0.0) | (m_fin <= DEFAULT_MASK_VALUE * 0.5)
+        denom = jnp.where(dead, 1.0, l_fin)
+        out = jnp.where(dead[:, :1], 0.0, acc_scr[...] / denom[:, :1])
+        _st(o_ref, out.astype(o_ref.dtype))
         if lse_ref is not None:
-            # +inf on fully-masked rows so bwd's exp(s - lse) underflows to 0
-            lse_ref[0] = jnp.where(l_fin == 0.0, jnp.inf,
-                                   m_scr[...] + jnp.log(denom))
+            lse_ref[0] = jnp.where(dead, jnp.inf,
+                                   m_fin + jnp.log(denom))
 
 
 def _qkv_specs(d, block, which):
@@ -672,9 +680,15 @@ def _xla_forward(q, k, v, bias, seed, offsets, sm_scale, causal, kv_len,
             jnp.zeros((b, h, lq), jnp.float32),
             jnp.zeros((b, h, lq, d), jnp.float32))
     (m, l, acc), _ = jax.lax.scan(step, init, jnp.arange(nk))
-    denom = jnp.where(l == 0.0, 1.0, l)
-    lse = jnp.where(l == 0.0, jnp.inf, m + jnp.log(denom))
-    return (acc / denom[..., None]).astype(q.dtype), lse
+    # same dead-row contract as the Pallas kernel: rows whose max never
+    # rose above the finite DEFAULT_MASK_VALUE saw only masked keys —
+    # their acc/l are garbage (p=exp(0)=1 over masked scores), so return
+    # output 0 / lse +inf instead
+    dead = (l == 0.0) | (m <= DEFAULT_MASK_VALUE * 0.5)
+    denom = jnp.where(dead, 1.0, l)
+    lse = jnp.where(dead, jnp.inf, m + jnp.log(denom))
+    out = jnp.where(dead[..., None], 0.0, acc / denom[..., None])
+    return out.astype(q.dtype), lse
 
 
 def _xla_backward(q, k, v, bias, o, do, lse, seed, offsets, sm_scale,
@@ -879,6 +893,14 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     call's q block and k/v block at global sequence positions — ring
     attention's shards call with (my*Lq_shard, src*Lk_shard) so the
     causal mask and the dropout hash key on true global coordinates.
+
+    Query rows with ZERO live keys in this call (causal=True with
+    block_offsets placing the whole k/v block strictly after the row)
+    return output 0 and lse +inf — the kernel detects rows whose
+    running max never rose above the finite DEFAULT_MASK_VALUE and
+    zeroes them, so block-wise combiners (ring attention) may fold
+    such calls safely: the +inf lse makes their contribution vanish
+    in the merged softmax.
     """
     if layout not in ("bhld", "blhd"):
         raise ValueError(f"unknown layout {layout!r}")

@@ -48,14 +48,12 @@ def _chunk_fwd(q, k_blk, v_blk, seed_f, offsets, sm_scale, causal, kv_len,
     global offsets.  NOT differentiated: the ring carries its own
     custom_vjp.
 
-    Fully-masked (above-diagonal) blocks: every score is
-    DEFAULT_MASK_VALUE, so the kernel's row max equals it, p = exp(0) = 1
-    per entry, l = nk*bk and lse ~= -2.4e38 + log(l) — a FINITE huge
-    negative, with out = mean(v) garbage.  _merge neutralizes it because
-    exp(lse - m) underflows to exactly 0 against any live partial (and
-    an all-dead row merges to weight 0 via the isneginf sentinel below,
-    which fires only for the kernel's true l==0 -> +inf padding rows).
-    Do NOT branch on finiteness of lse to detect dead blocks."""
+    Fully-masked (above-diagonal) blocks: BOTH kernels now detect rows
+    whose running max never rose above the finite DEFAULT_MASK_VALUE and
+    return out = 0 with lse = +inf (the same convention as true l==0
+    kv_len-padded rows).  The isposinf flip below turns that into -inf,
+    which _merge treats as weight exactly 0 — so dead blocks may be
+    folded in any order and an all-dead row merges to 0."""
     if impl == "pallas":
         out, lse128 = _pallas_forward(
             q, k_blk, v_blk, None, seed_f, offsets, sm_scale, causal,

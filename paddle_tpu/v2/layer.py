@@ -572,7 +572,8 @@ def crf(input, label, size=None, param_attr=None, **kw):
     negative log-likelihood, trainable via SGD.train.  ``size`` (the tag
     count) must equal the emission feature width when given.  Name the
     transition parameter (param_attr) to share it with crf_decoding."""
-    if size is not None and (input.shape or [None])[-1] not in (None, size):
+    if size is not None and (input.shape or [None])[-1] not in (None, -1,
+                                                                size):
         raise ValueError(
             f"crf: size={size} != emission width {input.shape[-1]}")
     nll = flayers.linear_chain_crf(input=input, label=label,
@@ -592,7 +593,8 @@ def ctc(input, label, size=None, blank=0, norm_by_times=False, **kw):
     warp-ctc): mean per-sequence CTC loss over unaligned label
     sequences.  ``blank`` indexes the blank class within the ``size``
     softmax classes (the reference places it last: size-1)."""
-    if size is not None and (input.shape or [None])[-1] not in (None, size):
+    if size is not None and (input.shape or [None])[-1] not in (None, -1,
+                                                                size):
         raise ValueError(
             f"ctc: size={size} != input class width {input.shape[-1]}")
     loss = flayers.warpctc(input=input, label=label, blank=int(blank),

@@ -521,3 +521,34 @@ def test_ring_non_divisible_shards():
     g2 = jax.grad(lambda q_: naive_attention(q_, k, v, causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas_interpret"])
+def test_flash_dead_rows_zero_output(impl):
+    """Dead-row contract (r4 ADVICE): causal + block_offsets placing the
+    whole k/v block strictly after the queries means every row has zero
+    live keys — both impls must return output 0 and lse +inf (observable
+    here as exactly-zero output and zero gradient), not uniform-attention
+    garbage over masked keys."""
+    q, k, v = make_qkv(b=1, h=2, lq=16, lk=16, d=8, seed=9)
+    out = flash_attention(q, k, v, causal=True, impl=impl, block_q=8,
+                          block_k=8, block_offsets=(0, 16))
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+    g = jax.grad(lambda v_: flash_attention(
+        q, k, v_, causal=True, impl=impl, block_q=8, block_k=8,
+        block_offsets=(0, 16)).sum())(v)
+    assert np.all(np.isfinite(np.asarray(g)))
+    np.testing.assert_array_equal(np.asarray(g), 0.0)
+
+    # mixed: kv block straddles the diagonal — live rows still match the
+    # naive softmax over their visible keys, dead rows are zero
+    out2 = flash_attention(q, k, v, causal=True, impl=impl, block_q=8,
+                           block_k=8, block_offsets=(0, 8))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    rows = jnp.arange(16)[:, None]; cols = 8 + jnp.arange(16)[None, :]
+    sm = jnp.where(rows >= cols, s, -jnp.inf)
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jnp.where(rows[None, None] >= 8,
+                               jax.nn.softmax(sm, axis=-1), 0.0), v)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

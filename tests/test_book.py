@@ -338,11 +338,14 @@ def test_benchmark_nets_build_and_smallnet_trains(fresh_programs):
                                  momentum=0.9).minimize(cost)
     exe = fluid.Executor(fluid.CPUPlace())
     rng = np.random.RandomState(0)
+    # one FIXED batch: with a fresh random batch per step the decrease is
+    # marginal (random labels) and can flip under thread-count-dependent
+    # float rounding — memorizing a single batch decreases robustly
+    feed = {"img": rng.rand(16, 3, 32, 32).astype(np.float32),
+            "label": rng.randint(0, 10, (16, 1)).astype(np.int64)}
     with fluid.scope_guard(scope):
         exe.run(startup)
-        losses = [float(np.asarray(exe.run(
-            main, feed={"img": rng.rand(16, 3, 32, 32).astype(np.float32),
-                        "label": rng.randint(0, 10, (16, 1)).astype(
-                            np.int64)},
-            fetch_list=[cost])[0])) for _ in range(8)]
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[cost])[0]))
+                  for _ in range(8)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
