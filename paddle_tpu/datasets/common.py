@@ -38,15 +38,21 @@ def synthetic_only() -> bool:
 _warned = set()
 
 
-def fallback_warning(module: str, why: str) -> None:
-    if module in _warned:
+def fallback_warning(module: str, why: str, tier: str = "synthetic") -> None:
+    key = (module, tier)
+    if key in _warned:
         return
-    _warned.add(module)
-    warnings.warn(
-        f"dataset {module!r}: real data unavailable ({why}); serving the "
-        f"deterministic SYNTHETIC stand-in (same schema, scaled sizes). "
-        f"Set PADDLE_TPU_DATA_HOME to a populated cache for real data.",
-        stacklevel=3)
+    _warned.add(key)
+    if tier == "fixture":
+        msg = (f"dataset {module!r}: full data unavailable ({why}); "
+               f"serving the committed REAL-data fixture tier (smaller, "
+               f"see paddle_tpu/datasets/fixtures/).")
+    else:
+        msg = (f"dataset {module!r}: real data unavailable ({why}); "
+               f"serving the deterministic SYNTHETIC stand-in (same "
+               f"schema, scaled sizes). Set PADDLE_TPU_DATA_HOME to a "
+               f"populated cache for real data.")
+    warnings.warn(msg, stacklevel=3)
 
 
 def md5file(fname: str) -> str:

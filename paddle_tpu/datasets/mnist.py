@@ -1,14 +1,20 @@
 """MNIST — python/paddle/v2/dataset/mnist.py: readers yielding
 (image float32[784] scaled to [-1, 1], label int).
 
-Real data: the classic IDX files (download+md5+cache via common.py);
-falls back to the deterministic synthetic stand-in (class-conditional
-band patterns) when fetching is impossible.
+Three tiers, tried in order (LAST_TIER records which one served):
+  'real'     — the classic IDX files (download+md5+cache via common.py)
+  'fixture'  — REAL handwritten digits committed to the repo: the UCI
+               hand-written digits set bundled with scikit-learn
+               (1500 train / 297 test, upsampled to 28x28 — see
+               tools/make_digits_fixture.py), for zero-egress hosts
+  'synthetic'— deterministic class-conditional band patterns (shape
+               tests only, never a quality measurement)
 """
 
 from __future__ import annotations
 
 import gzip
+import os
 import struct
 
 import numpy as np
@@ -25,8 +31,22 @@ TEST_IMAGE_MD5 = "9fb629c4189551a2d022fa330f9573f3"
 TEST_LABEL_URL = URL_PREFIX + "t10k-labels-idx1-ubyte.gz"
 TEST_LABEL_MD5 = "ec29112dd5afa0611ce80d1b7f02629c"
 
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+FIXTURE_MD5 = {
+    "uci_digits-train-images-idx3-ubyte.gz":
+        "ddd0970c98565cb4ae82f542f9e2532f",
+    "uci_digits-train-labels-idx1-ubyte.gz":
+        "2635b28e63b4644df4348c145a844f47",
+    "uci_digits-test-images-idx3-ubyte.gz":
+        "efae78903cb9f17680938a96fd6f5980",
+    "uci_digits-test-labels-idx1-ubyte.gz":
+        "df2c110846983d62ea503ae1147fce14",
+}
+
 TRAIN_N = 8192    # synthetic sizes (real data serves full size)
 TEST_N = 1024
+
+LAST_TIER = None  # 'real' | 'fixture' | 'synthetic' after train()/test()
 
 
 def parse_idx(image_path: str, label_path: str):
@@ -69,24 +89,51 @@ def _synthetic_reader(n, seed):
     return r
 
 
-def _real_or_synthetic(img_url, img_md5, lbl_url, lbl_md5, n_syn, seed):
+def _fixture_paths(split: str):
+    names = [f"uci_digits-{split}-images-idx3-ubyte.gz",
+             f"uci_digits-{split}-labels-idx1-ubyte.gz"]
+    paths = [os.path.join(FIXTURE_DIR, n) for n in names]
+    for n, p in zip(names, paths):
+        if not os.path.exists(p):
+            raise FileNotFoundError(p)
+        got = common.md5file(p)
+        if got != FIXTURE_MD5[n]:
+            raise IOError(f"fixture {n} md5 {got} != {FIXTURE_MD5[n]} "
+                          "(corrupt checkout?)")
+    return paths
+
+
+def _real_or_synthetic(img_url, img_md5, lbl_url, lbl_md5, n_syn, seed,
+                       split):
+    global LAST_TIER
+    why = "PADDLE_TPU_SYNTHETIC set"
     if not common.synthetic_only():
         try:
             imgs = common.download(img_url, "mnist", img_md5)
             lbls = common.download(lbl_url, "mnist", lbl_md5)
+            LAST_TIER = "real"
             return parse_idx(imgs, lbls)
         except common.DownloadError as e:
-            common.fallback_warning("mnist", str(e))
+            why = str(e)
+        try:
+            imgs, lbls = _fixture_paths(split)
+            common.fallback_warning("mnist", why, tier="fixture")
+            LAST_TIER = "fixture"
+            return parse_idx(imgs, lbls)
+        except (FileNotFoundError, IOError) as e:
+            why = f"{why}; fixture unavailable: {e}"
+    common.fallback_warning("mnist", why)
+    LAST_TIER = "synthetic"
     return _synthetic_reader(n_syn, seed)
 
 
 def train():
     return _real_or_synthetic(TRAIN_IMAGE_URL, TRAIN_IMAGE_MD5,
                               TRAIN_LABEL_URL, TRAIN_LABEL_MD5,
-                              TRAIN_N, seed=1)
+                              TRAIN_N, seed=1, split="train")
 
 
 def test():
     return _real_or_synthetic(TEST_IMAGE_URL, TEST_IMAGE_MD5,
                               TEST_LABEL_URL, TEST_LABEL_MD5,
-                              TEST_N, seed=2)
+                              TEST_N, seed=2, split="test")

@@ -2,14 +2,21 @@
 train/test readers yielding (src_ids, trg_ids_next, trg_ids) triples for
 the machine-translation chapters.
 
-Real data: the tokenized tarball (one tab-separated parallel pair per
-line) with BPE-less word vocabularies built from the train split;
-synthetic reversal-task pairs as the zero-egress fallback (copy/reverse
-is the classic seq2seq sanity task, learnable by the chapter models).
+Three tiers, tried in order (LAST_TIER records which one served):
+  'real'     — the tokenized WMT16 tarball (download+md5+cache) with
+               BPE-less word vocabularies built from the train split
+  'fixture'  — REAL en-de human translations committed to the repo:
+               Unicode CLDR display names composed with each language's
+               CLDR list grammar (see tools/make_cldr_corpus.py) — a
+               smoke-translation corpus for zero-egress hosts
+  'synthetic'— reversal-task pairs (copy/reverse is the classic seq2seq
+               sanity task; never a quality measurement)
 """
 
 from __future__ import annotations
 
+import gzip
+import os
 import tarfile
 from collections import Counter
 
@@ -20,12 +27,20 @@ from . import common
 URL = ("http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz")
 MD5 = "0c38be43600334966403524a40dcd81e"
 
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+FIXTURE_MD5 = {
+    "cldr_ende-train.tsv.gz": "d28daf77b19b288e3eaa4a3035a8e601",
+    "cldr_ende-test.tsv.gz": "22acadc062590c642408aebd814f9964",
+}
+
 START, END, UNK = 0, 1, 2
 START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
 
 SYN_VOCAB = 120
 TRAIN_N = 4096
 TEST_N = 512
+
+LAST_TIER = None  # 'real' | 'fixture' | 'synthetic' after train()/test()
 
 
 _dict_cache = {}
@@ -69,6 +84,52 @@ def parse_pairs(tar_path: str, member: str, src_dict: dict,
     return reader
 
 
+def _fixture_path(split: str) -> str:
+    name = f"cldr_ende-{split}.tsv.gz"
+    p = os.path.join(FIXTURE_DIR, name)
+    if not os.path.exists(p):
+        raise FileNotFoundError(p)
+    got = common.md5file(p)
+    if got != FIXTURE_MD5[name]:
+        raise IOError(f"fixture {name} md5 {got} != {FIXTURE_MD5[name]} "
+                      "(corrupt checkout?)")
+    return p
+
+
+def _fixture_lines(split: str):
+    with gzip.open(_fixture_path(split), "rt", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) >= 2:
+                yield parts[0], parts[1]
+
+
+def build_dict_from_fixture(col: int, size: int) -> dict:
+    key = ("fixture", col, size)
+    if key in _dict_cache:
+        return _dict_cache[key]
+    freq = Counter()
+    for en, de in _fixture_lines("train"):
+        freq.update((en if col == 0 else de).split())
+    d = {START_MARK: START, END_MARK: END, UNK_MARK: UNK}
+    for w, _ in freq.most_common(size - 3):
+        d[w] = len(d)
+    _dict_cache[key] = d
+    return d
+
+
+def _fixture_reader(split: str, src_dict: dict, trg_dict: dict):
+    def reader():
+        for en, de in _fixture_lines(split):
+            src = [src_dict.get(w, UNK) for w in en.split()]
+            trg = [trg_dict.get(w, UNK) for w in de.split()]
+            if not src or not trg:
+                continue
+            yield src, trg + [END], [START] + trg
+
+    return reader
+
+
 def _synthetic_reader(n, seed):
     """Reversal task: target = reversed source over a shared vocab."""
 
@@ -83,18 +144,25 @@ def _synthetic_reader(n, seed):
 
 
 def get_dict(lang: str = "en", dict_size: int = 30000):
+    col = 0 if lang == "en" else 1
     if not common.synthetic_only():
         try:
             path = common.download(URL, "wmt16", MD5)
-            col = 0 if lang == "en" else 1
             return build_dict_from_tar(path, "wmt16/train", col,
                                        dict_size)
-        except common.DownloadError as e:
-            common.fallback_warning("wmt16", str(e))
+        except common.DownloadError:
+            pass
+        try:
+            return build_dict_from_fixture(col, dict_size)
+        except (FileNotFoundError, IOError):
+            pass
     return {f"w{i}": i for i in range(SYN_VOCAB)}
 
 
 def _make(member, n_syn, seed, src_dict_size, trg_dict_size):
+    global LAST_TIER
+    split = member.rsplit("/", 1)[-1]
+    why = "PADDLE_TPU_SYNTHETIC set"
     if not common.synthetic_only():
         try:
             path = common.download(URL, "wmt16", MD5)
@@ -102,9 +170,23 @@ def _make(member, n_syn, seed, src_dict_size, trg_dict_size):
                                         src_dict_size)
             trg_d = build_dict_from_tar(path, "wmt16/train", 1,
                                         trg_dict_size)
+            LAST_TIER = "real"
             return parse_pairs(path, member, src_d, trg_d)
         except common.DownloadError as e:
-            common.fallback_warning("wmt16", str(e))
+            why = str(e)
+        try:
+            _fixture_path(split)   # eager existence+md5 check, not at
+            # first iteration — a broken split file must fall through
+            src_d = build_dict_from_fixture(0, src_dict_size)
+            trg_d = build_dict_from_fixture(1, trg_dict_size)
+            reader = _fixture_reader(split, src_d, trg_d)
+            common.fallback_warning("wmt16", why, tier="fixture")
+            LAST_TIER = "fixture"
+            return reader
+        except (FileNotFoundError, IOError) as e:
+            why = f"{why}; fixture unavailable: {e}"
+    common.fallback_warning("wmt16", why)
+    LAST_TIER = "synthetic"
     return _synthetic_reader(n_syn, seed)
 
 

@@ -26,7 +26,7 @@ __all__ = [
     "margin_rank_loss", "log_loss", "conv_shift", "row_conv",
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
     "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
-    "fused_vocab_cross_entropy", "maxout",
+    "fused_vocab_cross_entropy", "maxout", "squeeze", "unsqueeze",
 ]
 
 
@@ -399,6 +399,24 @@ def transpose(x, perm, name=None):
     out = helper.create_tmp_variable(x.dtype)
     helper.append_op("transpose", {"X": x}, {"Out": out},
                      {"axis": list(perm)})
+    return out
+
+
+def squeeze(input, axes, name=None):
+    """reference squeeze_op.cc — drop size-1 dims at ``axes``."""
+    helper = LayerHelper("squeeze", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("squeeze", {"X": input}, {"Out": out},
+                     {"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    """reference unsqueeze_op.cc — insert size-1 dims at ``axes``."""
+    helper = LayerHelper("unsqueeze", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("unsqueeze", {"X": input}, {"Out": out},
+                     {"axes": list(axes)})
     return out
 
 

@@ -13,8 +13,8 @@ __all__ = ["linear_chain_crf", "crf_decoding",
            "sequence_first_step",
            "sequence_last_step", "sequence_expand", "sequence_concat",
            "sequence_reshape", "sequence_slice", "sequence_erase",
-           "sequence_mask", "warpctc", "edit_distance", "ctc_align",
-           "ctc_greedy_decoder"]
+           "sequence_mask", "sequence_pad", "warpctc", "edit_distance",
+           "ctc_align", "ctc_greedy_decoder"]
 
 
 def warpctc(input, label, blank=0, norm_by_times=False, name=None):
@@ -212,3 +212,14 @@ def crf_decoding(input, param_attr=None, label=None):
         inputs["Label"] = label
     helper.append_op("crf_decoding", inputs, {"ViterbiPath": path})
     return path
+
+
+def sequence_pad(x, name=None):
+    """Sequence batch -> (dense [B, T, ...], mask [B, T]) — the bridge to
+    plain dense ops (batched-matmul attention over encoder states reads
+    the padded data + mask).  Reference sequence_pad_op.cc."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    mask = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("sequence_pad", {"X": x}, {"Out": out, "Mask": mask})
+    return out, mask

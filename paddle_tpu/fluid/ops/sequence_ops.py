@@ -275,3 +275,16 @@ def sequence_mask_op(ctx, lengths):
     maxlen = ctx.attr("maxlen")
     return seq_mask(lengths.reshape(-1), maxlen).astype(
         ctx.attr("out_dtype", "float32"))
+
+
+@primitive("sequence_pad", inputs=["X"], outputs=["Out", "Mask"])
+def sequence_pad_op(ctx, x):
+    """SeqArray -> (dense padded data [B, T, ...], float mask [B, T]).
+
+    The bridge from the LoD world to plain dense ops (reference
+    sequence_pad_op.cc serves the same purpose for LoDTensor): batched
+    attention / matmul consumers read the padded data directly and mask
+    with Mask.  Grad flows through Out back into the sequence; padded
+    positions' grads land on padding and are dropped by construction."""
+    m = seq_mask(x.lengths, x.data.shape[1]).astype(x.data.dtype)
+    return x.data, m

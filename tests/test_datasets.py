@@ -215,16 +215,60 @@ def test_wmt16_parse(tmp_path):
 
 # -- fallback contract ------------------------------------------------------
 
-def test_synthetic_fallback_warns_and_serves(monkeypatch):
-    # unreachable URLs (no egress in CI) -> loud fallback, right schema
+def test_fixture_fallback_warns_and_serves(monkeypatch):
+    # unreachable URLs (no egress in CI) -> committed REAL-data fixture
     common._warned.clear()
     monkeypatch.setenv("PADDLE_TPU_SYNTHETIC", "")
     monkeypatch.setattr(
         mnist, "TRAIN_IMAGE_URL", "file:///nonexistent/i.gz")
+    with pytest.warns(UserWarning, match="fixture"):
+        r = mnist.train()
+    assert mnist.LAST_TIER == "fixture"
+    rows = list(r())
+    assert len(rows) == 1500
+    img, label = next(iter(rows))
+    assert img.shape == (784,) and 0 <= label < 10
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    # all ten classes present in the stratified fixture split
+    assert sorted({lb for _, lb in rows}) == list(range(10))
+
+
+def test_synthetic_fallback_warns_and_serves(monkeypatch):
+    # fixture ALSO unavailable -> loud synthetic fallback, right schema
+    common._warned.clear()
+    monkeypatch.setenv("PADDLE_TPU_SYNTHETIC", "")
+    monkeypatch.setattr(
+        mnist, "TRAIN_IMAGE_URL", "file:///nonexistent/i.gz")
+    monkeypatch.setattr(mnist, "FIXTURE_DIR", "/nonexistent")
     with pytest.warns(UserWarning, match="SYNTHETIC"):
         r = mnist.train()
+    assert mnist.LAST_TIER == "synthetic"
     img, label = next(r())
     assert img.shape == (784,) and 0 <= label < 10
+
+
+def test_wmt16_fixture_tier(monkeypatch):
+    """The committed CLDR corpus serves the wmt16 reader protocol with a
+    shared train-built vocabulary and near-zero test-side UNKs."""
+    common._warned.clear()
+    monkeypatch.setenv("PADDLE_TPU_SYNTHETIC", "")
+    monkeypatch.setattr(
+        wmt16, "URL", "file:///nonexistent/wmt16.tar.gz")
+    wmt16._dict_cache.clear()
+    train_rows = list(wmt16.train(4000)())
+    assert wmt16.LAST_TIER == "fixture"
+    test_rows = list(wmt16.test(4000)())
+    assert len(train_rows) > 6000 and len(test_rows) == 400
+    src, trg_next, trg_in = train_rows[0]
+    assert trg_in[0] == wmt16.START and trg_next[-1] == wmt16.END
+    assert trg_in[1:] == trg_next[:-1]
+    # vocab built from train covers the test combinations (by design
+    # the test split reuses train vocabulary): UNK rate ~0
+    flat = [w for r in test_rows for w in r[0]]
+    assert flat.count(wmt16.UNK) / len(flat) < 0.01
+    d_en = wmt16.get_dict("en", 4000)
+    d_de = wmt16.get_dict("de", 4000)
+    assert len(d_en) > 1000 and len(d_de) > 1000 and d_en != d_de
 
 
 def test_forced_synthetic_env(monkeypatch):

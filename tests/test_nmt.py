@@ -132,3 +132,95 @@ def test_rnn_encoder_decoder_train(fresh_programs):
             first = lv
         last = lv
     assert np.isfinite(last) and last < first * 0.8, (first, last)
+
+
+def test_train_decode_share_parameters(fresh_programs):
+    """Building the decode graph after the train graph must REUSE every
+    parameter by name (ParamAttr contract) — before this guard the beam
+    decoder silently minted fresh untrained fc/lstm weights."""
+    main, startup, scope = fresh_programs
+    src = fluid.layers.data(name="src", shape=[1], dtype="int64",
+                            lod_level=1)
+    trg = fluid.layers.data(name="trg", shape=[1], dtype="int64",
+                            lod_level=1)
+    nxt = fluid.layers.data(name="nxt", shape=[1], dtype="int64",
+                            lod_level=1)
+    from paddle_tpu.fluid.framework import Parameter
+
+    def params():
+        return {n for n, v in main.global_block().vars.items()
+                if isinstance(v, Parameter)}
+
+    mt.train_model(src, trg, nxt, DICT, word_dim=8, hidden_dim=16)
+    before = params()
+    mt.decode_model(src, DICT, word_dim=8, hidden_dim=16, beam_size=2,
+                    topk_size=5, max_length=4)
+    assert params() == before
+    # attention pair shares the same way (its extra att_* params are
+    # created by TRAIN and only reused by decode)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
+        s2 = fluid.layers.data(name="src", shape=[1], dtype="int64",
+                               lod_level=1)
+        t2 = fluid.layers.data(name="trg", shape=[1], dtype="int64",
+                               lod_level=1)
+        n2 = fluid.layers.data(name="nxt", shape=[1], dtype="int64",
+                               lod_level=1)
+        mt.attention_train_model(s2, t2, n2, DICT, word_dim=8,
+                                 hidden_dim=16)
+        before2 = {n for n, v in main2.global_block().vars.items()
+                   if isinstance(v, Parameter)}
+        mt.attention_decode_model(s2, DICT, word_dim=8, hidden_dim=16,
+                                  beam_size=2, topk_size=5, max_length=4)
+        after2 = {n for n, v in main2.global_block().vars.items()
+                  if isinstance(v, Parameter)}
+    assert after2 == before2
+    assert {"att_u.w", "att_w.w", "att_v.w"} <= after2
+
+
+def test_attention_translation_learns_reversal(fresh_programs):
+    """The attention seq2seq (demo/seqToseq shape) learns the reversal
+    task and its beam decode — running on the TRAINED weights — emits
+    mostly-correct reversals (sentence accuracy is too strict for 60
+    steps; per-token overlap is the signal)."""
+    main, startup, scope = fresh_programs
+    src = fluid.layers.data(name="src", shape=[1], dtype="int64",
+                            lod_level=1)
+    trg = fluid.layers.data(name="trg", shape=[1], dtype="int64",
+                            lod_level=1)
+    nxt = fluid.layers.data(name="nxt", shape=[1], dtype="int64",
+                            lod_level=1)
+    avg_cost, _ = mt.attention_train_model(src, trg, nxt, DICT,
+                                           word_dim=16, hidden_dim=32)
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg_cost)
+    ids, scores = mt.attention_decode_model(
+        src, DICT, word_dim=16, hidden_dim=32, beam_size=2, topk_size=6,
+        max_length=6, start_id=START, end_id=END)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    srcs = [rng.randint(2, DICT, rng.randint(3, 5)) for _ in range(16)]
+    sa = make_seq(srcs, dtype=np.int64)
+    ta = make_seq([np.concatenate([[START], s[::-1]]) for s in srcs],
+                  dtype=np.int64)
+    na = make_seq([np.concatenate([s[::-1], [END]]) for s in srcs],
+                  dtype=np.int64)
+    first = last = None
+    for _ in range(120):
+        lv, = exe.run(main, feed={"src": sa, "trg": ta, "nxt": na},
+                      fetch_list=[avg_cost])
+        lv = float(np.asarray(lv))
+        first = lv if first is None else first
+        last = lv
+    assert np.isfinite(last) and last < first * 0.1, (first, last)
+    infer = fluid.io.prune_program(main, [ids])
+    iv, = exe.run(infer, feed={"src": sa}, fetch_list=[ids],
+                  mode="infer")
+    best = np.asarray(iv)[:, 0]
+    hit = total = 0
+    for i, s in enumerate(srcs):
+        want = list(s[::-1])
+        got = [int(w) for w in best[i] if w > 1][:len(want)]
+        hit += sum(a == b for a, b in zip(got, want))
+        total += len(want)
+    assert hit / total > 0.6, f"token accuracy {hit}/{total}"
