@@ -103,27 +103,46 @@ void matmul2d(const float* x, const float* y, float* out, int64_t m,
 }
 
 struct Engine {
-  ProgramDesc prog;
-  std::map<std::string, Tensor> vars;
+  // desc + loaded weights are IMMUTABLE and shared between clones
+  // (ptpu_clone_shared — the analog of the reference's
+  // paddle_gradient_machine_create_shared_param, capi/gradient_machine.h:88):
+  // each clone carries only its own activation map, so N serving threads
+  // share one copy of the model and never contend.
+  std::shared_ptr<const ProgramDesc> prog;
+  std::shared_ptr<const std::map<std::string, Tensor>> params;
+  std::map<std::string, Tensor> vars;   // feeds + activations, per handle
   std::vector<std::string> feed_names, fetch_names;
   std::vector<Tensor> outputs;
 
-  const BlockDesc& block() const { return prog.blocks.at(0); }
+  const BlockDesc& block() const { return prog->blocks.at(0); }
 
   Tensor& in(const OpDesc& op, const char* slot, int i = 0) {
     auto it = op.inputs.find(slot);
     if (it == op.inputs.end() || (int)it->second.size() <= i)
       throw std::runtime_error(op.type + ": missing input slot " + slot);
     auto v = vars.find(it->second[i]);
-    if (v == vars.end())
-      throw std::runtime_error(op.type + ": input var " + it->second[i] +
-                               " not computed yet");
-    return v->second;
+    if (v != vars.end()) return v->second;
+    auto p = params->find(it->second[i]);
+    if (p != params->end())
+      // kernels never mutate inputs (outputs are always fresh tensors),
+      // so handing out a non-const ref to the shared weights is safe
+      return const_cast<Tensor&>(p->second);
+    throw std::runtime_error(op.type + ": input var " + it->second[i] +
+                             " not computed yet");
   }
   bool has_in(const OpDesc& op, const char* slot) {
     auto it = op.inputs.find(slot);
     return it != op.inputs.end() && !it->second.empty() &&
-           vars.count(it->second[0]);
+           (vars.count(it->second[0]) || params->count(it->second[0]));
+  }
+  // name -> tensor across both maps (activations shadow weights), for
+  // kernels that walk variadic input lists directly
+  const Tensor* find_tensor(const std::string& name) const {
+    auto v = vars.find(name);
+    if (v != vars.end()) return &v->second;
+    auto p = params->find(name);
+    if (p != params->end()) return &p->second;
+    return nullptr;
   }
   Tensor& out(const OpDesc& op, const char* slot = "Out", int i = 0) {
     return vars[op.outputs.at(slot).at(i)];
@@ -624,10 +643,10 @@ void Engine::run_op(const OpDesc& op) {
     auto& names = op.inputs.at("X");
     std::vector<const Tensor*> xs;
     for (auto& nm : names) {
-      auto it = vars.find(nm);
-      if (it == vars.end())
+      const Tensor* tp = find_tensor(nm);
+      if (!tp)
         throw std::runtime_error("concat: input " + nm + " missing");
-      xs.push_back(&it->second);
+      xs.push_back(tp);
     }
     int64_t axis = op.attr_int("axis", 0);
     int64_t rank = (int64_t)xs[0]->shape.size();
@@ -665,16 +684,16 @@ void Engine::run_op(const OpDesc& op) {
     auto& names = op.inputs.at("X");
     Tensor r;
     for (auto& nm : names) {
-      auto it = vars.find(nm);
-      if (it == vars.end())
+      const Tensor* it_t = find_tensor(nm);
+      if (!it_t)
         throw std::runtime_error("sum: input " + nm + " missing");
       if (r.data.empty()) {
-        r = it->second;
+        r = *it_t;
       } else {
-        if (it->second.shape != r.shape)
+        if (it_t->shape != r.shape)
           throw std::runtime_error("sum: input shape mismatch");
         for (int64_t i2 = 0; i2 < r.numel(); ++i2)
-          r.data[i2] += it->second.data[i2];
+          r.data[i2] += it_t->data[i2];
       }
     }
     out(op) = std::move(r);
@@ -725,8 +744,9 @@ Engine* load_engine(const std::string& dir) {
   auto eng = std::make_unique<Engine>();
   // __model__ is the raw canonical-JSON desc (desc.py serialize_to_string);
   // only the tensor files carry the CRC framing
-  eng->prog = parse_program(read_file(dir + "/__model__"));
-  const BlockDesc& b = eng->prog.blocks.at(0);
+  eng->prog = std::make_shared<const ProgramDesc>(
+      parse_program(read_file(dir + "/__model__")));
+  const BlockDesc& b = eng->prog->blocks.at(0);
   // order by the ops' 'col' attr, NOT block order: save_inference_model
   // prepends feed ops one at a time, so block order is the REVERSE of
   // the feeded_var_names/column order the ABI documents
@@ -743,14 +763,16 @@ Engine* load_engine(const std::string& dir) {
   std::sort(fetches.begin(), fetches.end());
   for (auto& p : feeds) eng->feed_names.push_back(p.second);
   for (auto& p : fetches) eng->fetch_names.push_back(p.second);
+  auto params = std::make_shared<std::map<std::string, Tensor>>();
   for (auto& kv : b.vars) {
     if (!kv.second.persistable) continue;
     std::string path = dir + "/" + kv.first;
     std::ifstream probe(path);
     if (!probe) continue;  // e.g. feed/fetch holder vars
-    eng->vars[kv.first] =
+    (*params)[kv.first] =
         parse_tensor(unframe(read_file(path), kv.first), kv.first);
   }
+  eng->params = std::move(params);
   return eng.release();
 }
 
@@ -844,5 +866,27 @@ const int32_t* ptpu_output_lengths(void* h, int i) {
 }
 
 void ptpu_destroy(void* h) { delete (ptpu::Engine*)h; }
+
+// Shared-parameter clone — the analog of the reference's
+// paddle_gradient_machine_create_shared_param + the multi_thread example
+// (capi/examples/model_inference/multi_thread/main.c): the clone shares
+// the immutable desc and loaded weights with `h` and owns only its
+// activation map, so each serving thread forwards on its own clone with
+// no synchronization and ~zero extra memory.  Destroy each clone with
+// ptpu_destroy; the weights free when the last holder goes.
+void* ptpu_clone_shared(void* h) {
+  try {
+    auto* src = (ptpu::Engine*)h;
+    auto* eng = new ptpu::Engine();
+    eng->prog = src->prog;
+    eng->params = src->params;
+    eng->feed_names = src->feed_names;
+    eng->fetch_names = src->fetch_names;
+    return eng;
+  } catch (const std::exception& e) {
+    ptpu::g_err = e.what();
+    return nullptr;
+  }
+}
 
 }  // extern "C"

@@ -27,6 +27,7 @@ __all__ = [
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
     "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
     "fused_vocab_cross_entropy", "maxout", "squeeze", "unsqueeze",
+    "hsigmoid", "sampling_id", "bilinear_interp",
 ]
 
 
@@ -399,6 +400,49 @@ def transpose(x, perm, name=None):
     out = helper.create_tmp_variable(x.dtype)
     helper.append_op("transpose", {"X": x}, {"Out": out},
                      {"axis": list(perm)})
+    return out
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid classification cost over the default
+    complete binary tree (reference gserver HierarchicalSigmoidLayer +
+    math/MatrixBitCode SimpleCode) — O(log C) per sample instead of a
+    C-wide softmax.  Returns the per-row cost [B, 1]."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    feat = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_classes - 1, feat], dtype=dtype)
+    inputs = {"X": input, "Label": label, "W": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                    shape=[num_classes - 1], dtype=dtype,
+                                    is_bias=True)
+        inputs["Bias"] = b
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("hsigmoid", inputs, {"Out": out},
+                     {"num_classes": int(num_classes)})
+    return out
+
+
+def sampling_id(x, name=None):
+    """Sample one class id per row from a probability row (reference
+    gserver SamplingIdLayer — generation-time stochastic pick)."""
+    helper = LayerHelper("sampling_id", name=name)
+    out = helper.create_tmp_variable("int32", stop_gradient=True)
+    helper.append_op("sampling_id", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def bilinear_interp(input, out_h, out_w, name=None):
+    """Bilinear upsampling of [B, C, H, W] with the reference's
+    align-corners ratio (gserver BilinearInterpLayer)."""
+    helper = LayerHelper("bilinear_interp", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("bilinear_interp", {"X": input}, {"Out": out},
+                     {"out_h": int(out_h), "out_w": int(out_w)})
     return out
 
 

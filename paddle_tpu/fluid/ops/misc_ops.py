@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.lod import SeqArray
 from ..core.registry import primitive
@@ -378,3 +379,75 @@ def bilinear_tensor_product(ctx, x, y, w, bias):
     if bias is not None:
         out = out + bias.reshape(1, -1)
     return out
+
+
+@primitive("hsigmoid", inputs=["X", "Label", "W", "Bias?"],
+           outputs=["Out"])
+def hsigmoid(ctx, x, label, w, bias):
+    """Hierarchical sigmoid cost over the default complete binary tree —
+    reference gserver/layers/HierarchicalSigmoidLayer.cpp:56 with
+    math/MatrixBitCode.cpp SimpleCode (c = label + num_classes,
+    index(j) = (c >> (j+1)) - 1, bit(j) = (c >> j) & 1,
+    length = floor(log2 c)):
+
+        cost_i = sum_{j < len} softplus(pre_ij) - bit_ij * pre_ij,
+        pre_ij = W[index_ij] . x_i + bias[index_ij], clipped to ±40.
+
+    W is [num_classes - 1, feat]; Out is [B, 1].  All path positions are
+    computed for the maximum code length and masked — no dynamic shapes."""
+    num_classes = int(ctx.attr("num_classes"))
+    lab = label.reshape(-1).astype(jnp.int32)
+    c = lab + num_classes                      # [B]
+    max_len = max(1, int(np.ceil(np.log2(2 * num_classes - 1))))
+    js = jnp.arange(max_len)                   # [D]
+    length = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+    valid = js[None, :] < length[:, None]      # [B, D]
+    idx = jnp.clip((c[:, None] >> (js[None, :] + 1)) - 1, 0,
+                   num_classes - 2)            # [B, D]
+    bit = ((c[:, None] >> js[None, :]) & 1).astype(jnp.float32)
+    rows = w[idx]                              # [B, D, F]
+    pre = jnp.einsum("bdf,bf->bd", rows, x.astype(jnp.float32))
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx]
+    pre = jnp.clip(pre, -40.0, 40.0)
+    per = jax.nn.softplus(pre) - bit * pre
+    cost = jnp.sum(jnp.where(valid, per, 0.0), axis=1, keepdims=True)
+    return cost
+
+
+@primitive("sampling_id", inputs=["X"], no_grad=True)
+def sampling_id(ctx, x):
+    """Sample one class id per row from the row's probability
+    distribution — reference gserver/layers/SamplingIdLayer.cpp (the
+    generation-time stochastic pick).  Out is [B, 1] int32 ids."""
+    logits = jnp.log(jnp.clip(x.astype(jnp.float32), 1e-20, None))
+    ids = jax.random.categorical(ctx.rng, logits, axis=-1)
+    # int32: x64 is disabled framework-wide, int64 would warn + truncate
+    return ids.reshape(-1, 1).astype(jnp.int32)
+
+
+@primitive("bilinear_interp", inputs=["X"])
+def bilinear_interp(ctx, x):
+    """Bilinear upsampling of [B, C, H, W] to (out_h, out_w) with the
+    reference's align-corners mapping ratio = (in-1)/(out-1) —
+    gserver/layers/BilinearInterpLayer.cpp."""
+    out_h = int(ctx.attr("out_h"))
+    out_w = int(ctx.attr("out_w"))
+    b, ch, h, wdt = x.shape
+    ry = (h - 1) / (out_h - 1) if out_h > 1 else 0.0
+    rx = (wdt - 1) / (out_w - 1) if out_w > 1 else 0.0
+    ys = jnp.arange(out_h) * ry
+    xs = jnp.arange(out_w) * rx
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, wdt - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, wdt - 1)
+    wy = (ys - y0).astype(x.dtype)[None, None, :, None]
+    wx = (xs - x0).astype(x.dtype)[None, None, None, :]
+    a = x[:, :, y0[:, None], x0[None, :]]
+    b_ = x[:, :, y0[:, None], x1[None, :]]
+    cc = x[:, :, y1[:, None], x0[None, :]]
+    d = x[:, :, y1[:, None], x1[None, :]]
+    top = a * (1 - wx) + b_ * wx
+    bot = cc * (1 - wx) + d * wx
+    return top * (1 - wy) + bot * wy

@@ -238,3 +238,43 @@ def proximal_adagrad(ctx, p, m, g, lr):
     mo = m + g * g
     prox = _f32(p) - lr * g / jnp.sqrt(mo)
     return _prox_shrink(prox, lr, l1, l2).astype(p.dtype), mo
+
+
+@primitive("average_accumulates",
+           inputs=["Param", "InSum1", "InSum2", "InSum3",
+                   "InNumAccumulates", "InOldNumAccumulates",
+                   "InNumUpdates"],
+           outputs=["OutSum1", "OutSum2", "OutSum3", "OutNumAccumulates",
+                    "OutOldNumAccumulates", "OutNumUpdates"],
+           no_grad=True)
+def average_accumulates(ctx, p, sum1, sum2, sum3, num_acc, old_num_acc,
+                        num_upd):
+    """Windowed parameter-sum maintenance for ModelAverage — the TPU
+    equivalent of reference parameter/AverageOptimizer.h:23 update()/
+    isAverageWindowTooLong() (and the fluid-era average_accumulates op).
+    sum1 holds the running partial window (flushed into sum2 every 16384
+    updates so the fp32 sum keeps precision); when the window is full
+    (num_acc >= min_window and >= min(max_window, num_upd*rate)) the
+    whole partial moves to sum3 and the counters restart.  All branches
+    are computed and selected with where — no host control flow."""
+    rate = float(ctx.attr("average_window", 0.15))
+    min_win = int(ctx.attr("min_average_window", 10000))
+    max_win = int(ctx.attr("max_average_window", 10000))
+    kmax = 16384
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum1 = sum1 + _f32(p)
+    flush = (num_upd % kmax) == 0
+    sum2 = jnp.where(flush, sum2 + sum1, sum2)
+    sum1 = jnp.where(flush, jnp.zeros_like(sum1), sum1)
+    window = jnp.minimum(
+        jnp.asarray(max_win, num_upd.dtype),
+        (num_upd.astype(jnp.float32) * rate).astype(num_upd.dtype))
+    shift = (num_acc >= min_win) & (num_acc >= window)
+    sum3 = jnp.where(shift, sum1 + sum2, sum3)
+    sum1 = jnp.where(shift, jnp.zeros_like(sum1), sum1)
+    sum2 = jnp.where(shift, jnp.zeros_like(sum2), sum2)
+    old_num_acc = jnp.where(shift, num_acc, old_num_acc)
+    num_acc = jnp.where(shift, jnp.zeros_like(num_acc), num_acc)
+    return sum1, sum2, sum3, num_acc, old_num_acc, num_upd

@@ -27,7 +27,10 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "beam_search", "GeneratedInput",
            "addto", "cos_sim", "seq_concat",
            "context_projection", "maxout", "crf", "crf_decoding", "ctc",
-           "conv_projection", "simple_attention"]
+           "conv_projection", "simple_attention",
+           "hsigmoid", "bilinear_interp", "sampling_id", "slope_intercept",
+           "interpolation", "dot_prod", "trans", "clip", "pad",
+           "sum_to_one_norm", "l2_distance", "scale_shift"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -633,3 +636,133 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
     weight = flayers.sequence_softmax(weight)
     scaled = flayers.elementwise_mul(encoded_sequence, weight)
     return flayers.sequence_pool(input=scaled, pool_type="sum")
+
+
+# -- round-5 straggler tail (reference layers.py long tail) -----------------
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, **kw):
+    """Hierarchical sigmoid cost (reference layers.py hsigmoid:4446,
+    gserver HierarchicalSigmoidLayer): O(log C) classification cost over
+    the default complete binary code tree.  Returns the mean cost."""
+    cost = flayers.hsigmoid(input=input, label=label,
+                            num_classes=int(num_classes),
+                            param_attr=ParamAttr.to_attr(param_attr),
+                            bias_attr=(ParamAttr.to_attr(bias_attr)
+                                       if bias_attr is not None else None))
+    out = flayers.mean(cost)
+    _register_named_output(name, out)
+    return out
+
+
+def bilinear_interp(input, out_size_x, out_size_y, name=None, **kw):
+    """Bilinear upsampling (reference layers.py bilinear_interp_layer:
+    gserver BilinearInterpLayer, align-corners ratio).  ``input`` must
+    carry [C, H, W] image geometry (e.g. via reshape)."""
+    out = flayers.bilinear_interp(input, out_h=int(out_size_y),
+                                  out_w=int(out_size_x))
+    _register_named_output(name, out)
+    return out
+
+
+def sampling_id(input, name=None, **kw):
+    """Sample a class id from each row's probability distribution
+    (reference layers.py sampling_id_layer, gserver SamplingIdLayer)."""
+    out = flayers.sampling_id(input)
+    _register_named_output(name, out)
+    return out
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, name=None, **kw):
+    """y = slope * x + intercept (reference layers.py
+    slope_intercept_layer:4822)."""
+    out = flayers.scale(input, scale=float(slope), bias=float(intercept),
+                        bias_after_scale=True)
+    _register_named_output(name, out)
+    return out
+
+
+def interpolation(input, weight, name=None, **kw):
+    """w*a + (1-w)*b with a per-sample scalar weight layer (reference
+    layers.py interpolation_layer:794).  ``input`` is [a, b]; ``weight``
+    is a [B, 1] layer."""
+    a, b = input
+    wa = flayers.elementwise_mul(a, weight)
+    one_minus = flayers.scale(weight, scale=-1.0, bias=1.0,
+                              bias_after_scale=True)
+    wb = flayers.elementwise_mul(b, one_minus)
+    out = flayers.elementwise_add(wa, wb)
+    _register_named_output(name, out)
+    return out
+
+
+def dot_prod(input1, input2, name=None, **kw):
+    """Row-wise dot product -> [B, 1] (reference layers.py
+    dot_prod_layer:4031)."""
+    prod = flayers.elementwise_mul(input1, input2)
+    out = flayers.reduce_sum(prod, dim=-1, keep_dim=True)
+    _register_named_output(name, out)
+    return out
+
+
+def trans(input, name=None, **kw):
+    """Matrix transpose of the [B, D] sample matrix (reference layers.py
+    trans_layer:1727 — TransLayer transposes the batch matrix)."""
+    out = flayers.transpose(input, [1, 0])
+    _register_named_output(name, out)
+    return out
+
+
+def clip(input, min, max, name=None, **kw):  # noqa: A002 (reference names)
+    """Element clip (reference layers.py clip_layer:6447)."""
+    out = flayers.clip(input, min=float(min), max=float(max))
+    _register_named_output(name, out)
+    return out
+
+
+def pad(input, pad_c=None, pad_h=None, pad_w=None, name=None, **kw):
+    """Zero-pad the [C, H, W] image axes (reference layers.py
+    pad_layer:6007).  Each pad_* is a [begin, end] pair."""
+    cfg = [[0, 0]] + [list(p or [0, 0]) for p in (pad_c, pad_h, pad_w)]
+    flat = [v for pair in cfg for v in pair]
+    out = flayers.pad(input, paddings=flat)
+    _register_named_output(name, out)
+    return out
+
+
+def sum_to_one_norm(input, name=None, **kw):
+    """Row-normalise to sum 1 (reference layers.py
+    sum_to_one_norm_layer:6235)."""
+    s = flayers.reduce_sum(input, dim=-1, keep_dim=True)
+    out = flayers.elementwise_div(input, s)
+    _register_named_output(name, out)
+    return out
+
+
+def l2_distance(x, y, name=None, **kw):
+    """Row-wise euclidean distance -> [B, 1] (reference layers.py
+    l2_distance_layer:3621)."""
+    diff = flayers.elementwise_sub(x, y)
+    sq = flayers.elementwise_mul(diff, diff)
+    ssum = flayers.reduce_sum(sq, dim=-1, keep_dim=True)
+    out = flayers.sqrt(ssum)
+    _register_named_output(name, out)
+    return out
+
+
+def scale_shift(input, param_attr=None, bias_attr=None, name=None, **kw):
+    """y = w * x + b with LEARNED scalars (reference layers.py
+    scale_shift_layer:6987)."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("scale_shift",
+                         param_attr=ParamAttr.to_attr(param_attr),
+                         bias_attr=ParamAttr.to_attr(bias_attr))
+    w = helper.create_parameter(helper.param_attr, shape=[1],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                shape=[1], dtype=input.dtype, is_bias=True)
+    scaled = flayers.elementwise_mul(input, w)
+    out = flayers.elementwise_add(scaled, b)
+    _register_named_output(name, out)
+    return out

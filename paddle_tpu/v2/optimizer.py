@@ -9,14 +9,37 @@ from __future__ import annotations
 from ..fluid import optimizer as fopt
 
 __all__ = ["Optimizer", "Momentum", "Adam", "Adamax", "AdaGrad",
-           "DecayedAdaGrad", "AdaDelta", "RMSProp"]
+           "DecayedAdaGrad", "AdaDelta", "RMSProp", "ModelAverage"]
+
+
+class ModelAverage:
+    """v2 parameter-averaging config (reference settings() average_window
+    / ModelAverage in trainer configs, backed by
+    paddle/parameter/AverageOptimizer.h).  Pass as ``model_average=`` to
+    any v2 optimizer; the trainer appends the accumulation ops and
+    exposes ``trainer.model_average`` with apply()/restore()."""
+
+    def __init__(self, average_window: float = 0.15,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000):
+        self.average_window = float(average_window)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+
+    def to_fluid(self, main_program, startup_program):
+        return fopt.ModelAverage(
+            average_window_rate=self.average_window,
+            min_average_window=self.min_average_window,
+            max_average_window=self.max_average_window,
+            main_program=main_program, startup_program=startup_program)
 
 
 class Optimizer:
     """Base: holds the fluid optimizer this v2 config maps to."""
 
-    def __init__(self, fluid_optimizer):
+    def __init__(self, fluid_optimizer, model_average=None):
         self._opt = fluid_optimizer
+        self._model_average = model_average
 
     def to_fluid(self):
         return self._opt
@@ -40,51 +63,60 @@ class Momentum(Optimizer):
                  regularization=None, model_average=None, **kw):
         super().__init__(fopt.Momentum(
             learning_rate=learning_rate, momentum=momentum,
-            regularization=_reg(regularization)))
+            regularization=_reg(regularization)),
+            model_average=model_average)
 
 
 class Adam(Optimizer):
     def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8,
-                 learning_rate=1e-3, regularization=None, **kw):
+                 learning_rate=1e-3, regularization=None,
+                 model_average=None, **kw):
         super().__init__(fopt.Adam(
             learning_rate=learning_rate, beta1=beta1, beta2=beta2,
-            epsilon=epsilon, regularization=_reg(regularization)))
+            epsilon=epsilon, regularization=_reg(regularization)),
+            model_average=model_average)
 
 
 class Adamax(Optimizer):
     def __init__(self, beta1=0.9, beta2=0.999, learning_rate=1e-3,
-                 regularization=None, **kw):
+                 regularization=None, model_average=None, **kw):
         super().__init__(fopt.Adamax(
             learning_rate=learning_rate, beta1=beta1, beta2=beta2,
-            regularization=_reg(regularization)))
+            regularization=_reg(regularization)),
+            model_average=model_average)
 
 
 class AdaGrad(Optimizer):
-    def __init__(self, learning_rate=1e-3, regularization=None, **kw):
+    def __init__(self, learning_rate=1e-3, regularization=None,
+                 model_average=None, **kw):
         super().__init__(fopt.Adagrad(
             learning_rate=learning_rate,
-            regularization=_reg(regularization)))
+            regularization=_reg(regularization)),
+            model_average=model_average)
 
 
 class DecayedAdaGrad(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
-                 regularization=None, **kw):
+                 regularization=None, model_average=None, **kw):
         super().__init__(fopt.DecayedAdagrad(
             learning_rate=learning_rate, decay=rho, epsilon=epsilon,
-            regularization=_reg(regularization)))
+            regularization=_reg(regularization)),
+            model_average=model_average)
 
 
 class AdaDelta(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
-                 regularization=None, **kw):
+                 regularization=None, model_average=None, **kw):
         super().__init__(fopt.Adadelta(
             learning_rate=learning_rate, rho=rho, epsilon=epsilon,
-            regularization=_reg(regularization)))
+            regularization=_reg(regularization)),
+            model_average=model_average)
 
 
 class RMSProp(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
-                 regularization=None, **kw):
+                 regularization=None, model_average=None, **kw):
         super().__init__(fopt.RMSProp(
             learning_rate=learning_rate, rho=rho, epsilon=epsilon,
-            regularization=_reg(regularization)))
+            regularization=_reg(regularization)),
+            model_average=model_average)

@@ -48,6 +48,13 @@ class SGD:
         self.__startup__ = fluid.default_startup_program()
         with fluid.program_guard(self.__topology__, self.__startup__):
             update_equation.to_fluid().minimize(cost)
+        # optional parameter averaging (reference settings average_window
+        # -> AverageOptimizer): accumulation ops join the training step
+        self.model_average = None
+        ma = getattr(update_equation, "_model_average", None)
+        if ma is not None:
+            self.model_average = ma.to_fluid(self.__topology__,
+                                             self.__startup__)
         self.__exe__ = fluid.Executor(fluid.TPUPlace(0))
         self.__initialized__ = False
         # snapshot of the data types at construction (topology frozen now)

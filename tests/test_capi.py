@@ -666,3 +666,129 @@ def test_pjrt_sentiment_lstm_serving(tmp_path):
         np.testing.assert_allclose(got, np.asarray(ref), atol=5e-3)
     finally:
         os.unlink(path)
+
+
+MT_DRIVER = """
+    import ctypes, json, sys, threading
+    import numpy as np
+
+    so, model_dir = sys.argv[1], sys.argv[2]
+    lib = ctypes.CDLL(so)
+    lib.ptpu_create_for_inference.restype = ctypes.c_void_p
+    lib.ptpu_create_for_inference.argtypes = [ctypes.c_char_p]
+    lib.ptpu_clone_shared.restype = ctypes.c_void_p
+    lib.ptpu_clone_shared.argtypes = [ctypes.c_void_p]
+    lib.ptpu_last_error.restype = ctypes.c_char_p
+    lib.ptpu_num_inputs.restype = ctypes.c_int
+    lib.ptpu_num_inputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_num_outputs.restype = ctypes.c_int
+    lib.ptpu_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_output_rank.restype = ctypes.c_int
+    lib.ptpu_output_rank.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_output_shape.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.ptpu_output_shape.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_output_data.restype = ctypes.POINTER(ctypes.c_float)
+    lib.ptpu_output_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_forward.restype = ctypes.c_int
+    lib.ptpu_forward.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.ptpu_destroy.argtypes = [ctypes.c_void_p]
+
+    N_THREADS, N_ITERS = 4, 8
+    base = lib.ptpu_create_for_inference(model_dir.encode())
+    assert base, lib.ptpu_last_error().decode()
+
+    def forward(h, x):
+        n = 1
+        a = np.ascontiguousarray(x, np.float32)
+        s = np.asarray(a.shape, np.int64)
+        in_ptrs = (ctypes.POINTER(ctypes.c_float) * n)(
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        shp = (ctypes.POINTER(ctypes.c_int64) * n)(
+            s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        nds = (ctypes.c_int * n)(a.ndim)
+        rc = lib.ptpu_forward(ctypes.c_void_p(h), in_ptrs, shp, nds, n)
+        assert rc == 0, lib.ptpu_last_error().decode()
+        rank = lib.ptpu_output_rank(ctypes.c_void_p(h), 0)
+        shape = [lib.ptpu_output_shape(ctypes.c_void_p(h), 0)[d]
+                 for d in range(rank)]
+        numel = int(np.prod(shape)) if shape else 1
+        return np.ctypeslib.as_array(
+            lib.ptpu_output_data(ctypes.c_void_p(h), 0),
+            (numel,)).reshape(shape).copy()
+
+    # per-thread deterministic inputs + single-thread expected outputs
+    xs = [np.random.RandomState(100 + t).rand(3, 13).astype(np.float32)
+          for t in range(N_THREADS)]
+    expected = [forward(base, x) for x in xs]
+
+    handles = [base] + [lib.ptpu_clone_shared(ctypes.c_void_p(base))
+                        for _ in range(N_THREADS - 1)]
+    assert all(handles), lib.ptpu_last_error().decode()
+
+    errors = []
+
+    def worker(t):
+        try:
+            for _ in range(N_ITERS):
+                got = forward(handles[t], xs[t])
+                if not np.allclose(got, expected[t], atol=1e-6):
+                    errors.append(f"thread {t}: output mismatch")
+                    return
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"thread {t}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for th in threads: th.start()
+    for th in threads: th.join()
+    assert not errors, errors
+    for h in handles[1:]:
+        lib.ptpu_destroy(ctypes.c_void_p(h))
+    # base still serves correctly after clones are destroyed (weights
+    # shared, not stolen)
+    got = forward(base, xs[0])
+    assert np.allclose(got, expected[0], atol=1e-6)
+    lib.ptpu_destroy(ctypes.c_void_p(base))
+    print("MT_OK")
+"""
+
+
+def test_native_multithread_shared_clone(tmp_path):
+    """ptpu_clone_shared serves N threads concurrently from one loaded
+    model — the reference's paddle_gradient_machine_create_shared_param
+    + multi_thread example (capi/gradient_machine.h:88,
+    capi/examples/model_inference/multi_thread/main.c).  Each thread
+    forwards on its own clone; outputs must match the single-threaded
+    run bit-for-bit (the GIL releases around the ctypes call, so the C
+    engine genuinely runs concurrently)."""
+    import tempfile
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [13], "float32")
+        h1 = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h1, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                                      main_program=main)
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(textwrap.dedent(MT_DRIVER))
+        path = f.name
+    try:
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        out = subprocess.run(
+            [sys.executable, path, SO, str(tmp_path)],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd="/tmp")
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "MT_OK" in out.stdout
+    finally:
+        os.unlink(path)

@@ -1,0 +1,136 @@
+"""ModelAverage (parameter averaging) — reference
+paddle/parameter/AverageOptimizer.h + doc/design/parameter_average.md.
+
+The window bookkeeping is asserted against an exact numpy simulation of
+the documented update rule, and apply()/restore() are asserted to swap
+the averaged weights in and back out of the scope.
+"""
+
+import numpy as np
+
+from paddle_tpu import fluid
+
+
+def _simulate(p0, grads, lr, rate, min_win, max_win):
+    """Numpy twin of sgd + average_accumulates (kmax flush elided: tests
+    stay far below 16384 updates)."""
+    p = p0.copy()
+    s1 = np.zeros_like(p)
+    s2 = np.zeros_like(p)
+    s3 = np.zeros_like(p)
+    n_acc = old_acc = n_upd = 0
+    for g in grads:
+        p = p - lr * g
+        n_upd += 1
+        n_acc += 1
+        s1 = s1 + p
+        window = min(max_win, int(n_upd * rate))
+        if n_acc >= min_win and n_acc >= window:
+            s3 = s1 + s2
+            s1 = np.zeros_like(p)
+            s2 = np.zeros_like(p)
+            old_acc, n_acc = n_acc, 0
+    avg = (s1 + s2 + s3) / max(n_acc + old_acc, 1)
+    return p, avg
+
+
+def test_model_average_matches_simulation(fresh_programs):
+    main, startup, scope = fresh_programs
+    lr, rate, min_win, max_win = 0.1, 1.0, 2, 4
+    x = fluid.layers.data("x", [3], "float32")
+    y = fluid.layers.data("y", [1], "float32")
+    pred = fluid.layers.fc(x, size=1, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name="w"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    model_avg = fluid.optimizer.ModelAverage(
+        average_window_rate=rate, min_average_window=min_win,
+        max_average_window=max_win, main_program=main,
+        startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(4, 3).astype(np.float32)
+    ys = rng.rand(4, 1).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var("w"))
+        grads = []
+        for _ in range(7):
+            w_before = np.asarray(scope.find_var("w"))
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            w_after = np.asarray(scope.find_var("w"))
+            grads.append((w_before - w_after) / lr)   # observed gradient
+        w_raw = np.asarray(scope.find_var("w"))
+        p_sim, avg_sim = _simulate(w0, grads, lr, rate, min_win, max_win)
+        np.testing.assert_allclose(w_raw, p_sim, rtol=1e-5, atol=1e-6)
+        with model_avg.apply(exe):
+            w_avg = np.asarray(scope.find_var("w"))
+            np.testing.assert_allclose(w_avg, avg_sim, rtol=1e-5,
+                                       atol=1e-6)
+            assert not np.allclose(w_avg, w_raw)   # averaging did something
+        w_back = np.asarray(scope.find_var("w"))
+        np.testing.assert_allclose(w_back, w_raw, rtol=0, atol=0)
+        # manual apply without restore, then explicit restore
+        with model_avg.apply(exe, need_restore=False):
+            pass
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var("w")), avg_sim,
+            rtol=1e-5, atol=1e-6)
+        model_avg.restore(exe)
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var("w")), w_raw,
+            rtol=0, atol=0)
+
+
+def test_v2_model_average_on_book_config():
+    """The v2 surface (reference settings ... model_average on the
+    optimizer): a book-style config trains with averaging on, and the
+    averaged weights differ from the raw ones for inference."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init(seed=7)
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(8))
+    y = paddle.layer.data(name="y",
+                          type=paddle.data_type.integer_value(2))
+    h = paddle.layer.fc(input=x, size=16,
+                        act=paddle.activation.Tanh())
+    pred = paddle.layer.fc(input=h, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=y)
+    parameters = paddle.parameters.create(cost)
+    opt = paddle.optimizer.Momentum(
+        learning_rate=0.05, momentum=0.9,
+        model_average=paddle.optimizer.ModelAverage(
+            average_window=1.0, min_average_window=2,
+            max_average_window=6))
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=opt)
+    assert trainer.model_average is not None
+    rng = np.random.RandomState(11)
+
+    def reader():
+        for _ in range(24):
+            v = rng.rand(8).astype(np.float32)
+            yield v, int(v.sum() > 4.0)
+
+    trainer.train(reader=paddle.batch(reader, 8), num_passes=3,
+                  feeding={"x": 0, "y": 1})
+    scope = parameters.scope
+    exe = trainer.__exe__
+    with fluid.scope_guard(scope):
+        from paddle_tpu.fluid.framework import Parameter
+
+        prog = trainer.__topology__
+        pnames = [n for n, v in prog.global_block().vars.items()
+                  if isinstance(v, Parameter)]
+        raw = {n: np.asarray(scope.find_var(n)) for n in pnames}
+        with trainer.model_average.apply(exe):
+            avg = {n: np.asarray(scope.find_var(n))
+                   for n in pnames}
+        back = {n: np.asarray(scope.find_var(n))
+                for n in pnames}
+    changed = any(not np.allclose(raw[n], avg[n]) for n in pnames)
+    assert changed, "averaging should move at least one weight"
+    for n in pnames:
+        np.testing.assert_array_equal(raw[n], back[n])

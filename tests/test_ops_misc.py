@@ -10,6 +10,7 @@ ones — the reference's OpTest contract.
 import numpy as np
 import pytest
 
+from paddle_tpu import fluid
 from paddle_tpu.fluid import SeqArray, make_seq
 from tests.op_test import OpTestCase
 
@@ -305,3 +306,84 @@ def test_spp_tiny_map_no_inf():
                    {"pyramid_height": 3})
     out = np.asarray(t.run_single())
     assert np.isfinite(out).all()
+
+
+def test_hsigmoid_matches_bitcode_reference(fresh_programs):
+    """hsigmoid vs a per-sample numpy walk of the reference SimpleCode
+    tree (math/MatrixBitCode.cpp: c = label + C, index=(c>>(j+1))-1,
+    bit=(c>>j)&1, len=floor(log2 c))."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [6], "float32")
+    lbl = fluid.layers.data("lbl", [1], "int64")
+    cost = fluid.layers.hsigmoid(x, lbl, num_classes=5,
+                                 param_attr=fluid.ParamAttr(name="hs_w"),
+                                 bias_attr=fluid.ParamAttr(name="hs_b"))
+    loss = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, 6).astype(np.float32)
+    ls = np.array([[0], [1], [3], [4]], np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        W = np.asarray(scope.find_var("hs_w"))
+        B = np.asarray(scope.find_var("hs_b"))
+        c0, = exe.run(main, feed={"x": xs, "lbl": ls}, fetch_list=[cost])
+
+        def naive(xi, li):
+            c = li + 5
+            out = 0.0
+            for j in range(int(np.floor(np.log2(c)))):
+                idx = (c >> (j + 1)) - 1
+                bit = (c >> j) & 1
+                pre = np.clip(W[idx] @ xi + B[idx], -40, 40)
+                out += np.log1p(np.exp(pre)) - bit * pre
+            return out
+
+        want = np.array([[naive(xs[i], int(ls[i, 0]))] for i in range(4)])
+        np.testing.assert_allclose(np.asarray(c0), want, rtol=1e-5,
+                                   atol=1e-6)
+        # trains: loss decreases on a fixed batch
+        vals = [float(np.asarray(exe.run(main, feed={"x": xs, "lbl": ls},
+                                         fetch_list=[loss])[0]))
+                for _ in range(25)]
+        assert vals[-1] < vals[0]
+
+
+def test_bilinear_interp_align_corners(fresh_programs):
+    main, startup, scope = fresh_programs
+    img = fluid.layers.data("img", [1, 2, 3], "float32")
+    up = fluid.layers.bilinear_interp(img, out_h=4, out_w=6)
+    g = fluid.layers.mean(up)
+    exe = fluid.Executor(fluid.CPUPlace())
+    im = np.random.RandomState(1).rand(2, 1, 2, 3).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        u, = exe.run(main, feed={"img": im}, fetch_list=[up])
+    u = np.asarray(u)
+    assert u.shape == (2, 1, 4, 6)
+    # align-corners mapping keeps the four corners exactly
+    np.testing.assert_allclose(u[:, :, 0, 0], im[:, :, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(u[:, :, 0, -1], im[:, :, 0, -1], rtol=1e-6)
+    np.testing.assert_allclose(u[:, :, -1, 0], im[:, :, -1, 0], rtol=1e-6)
+    np.testing.assert_allclose(u[:, :, -1, -1], im[:, :, -1, -1],
+                               rtol=1e-6)
+    # interior row 1 (y = 1/3 between the input rows) at column 0
+    want = im[:, :, 0, 0] + (im[:, :, 1, 0] - im[:, :, 0, 0]) / 3.0
+    np.testing.assert_allclose(u[:, :, 1, 0], want, rtol=1e-5)
+
+
+def test_sampling_id_distribution(fresh_programs):
+    main, startup, scope = fresh_programs
+    probs = fluid.layers.data("probs", [4], "float32")
+    sid = fluid.layers.sampling_id(probs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    pr = np.tile(np.array([[0.05, 0.05, 0.8, 0.1]], np.float32), (256, 1))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        s1, = exe.run(main, feed={"probs": pr}, fetch_list=[sid])
+    s1 = np.asarray(s1)
+    assert s1.shape == (256, 1)
+    assert set(np.unique(s1)) <= {0, 1, 2, 3}
+    frac = (s1.ravel() == 2).mean()
+    assert 0.6 < frac < 0.95, frac

@@ -827,3 +827,65 @@ def test_v2_gru_group_matches_simple_gru():
             fetch_list=[out, ref], mode="infer")
     np.testing.assert_allclose(np.asarray(a.data), np.asarray(b.data),
                                atol=1e-6)
+
+
+def test_v2_straggler_layers_compute_and_train():
+    """Round-5 straggler tail (COMPAT.md): slope_intercept / dot_prod /
+    sum_to_one_norm / clip / l2_distance / interpolation compute the
+    documented math, and a config using scale_shift + hsigmoid trains."""
+    paddle.init(seed=31)
+    from paddle_tpu import fluid
+
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(4))
+    b = paddle.layer.data(name="b", type=paddle.data_type.dense_vector(4))
+    w = paddle.layer.data(name="w", type=paddle.data_type.dense_vector(1))
+    si = paddle.layer.slope_intercept(a, slope=2.0, intercept=1.0)
+    dp = paddle.layer.dot_prod(a, b)
+    s1 = paddle.layer.sum_to_one_norm(a)
+    cl = paddle.layer.clip(a, min=0.25, max=0.5)
+    l2 = paddle.layer.l2_distance(a, b)
+    ip = paddle.layer.interpolation([a, b], w)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    av = np.array([[0.1, 0.2, 0.3, 0.4]], np.float32)
+    bv = np.array([[0.4, 0.3, 0.2, 0.1]], np.float32)
+    wv = np.array([[0.25]], np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        o = exe.run(fluid.default_main_program(),
+                    feed={"a": av, "b": bv, "w": wv},
+                    fetch_list=[si, dp, s1, cl, l2, ip])
+    si_v, dp_v, s1_v, cl_v, l2_v, ip_v = (np.asarray(x) for x in o)
+    np.testing.assert_allclose(si_v, av * 2 + 1, rtol=1e-6)
+    np.testing.assert_allclose(dp_v, (av * bv).sum(-1, keepdims=True),
+                               rtol=1e-6)
+    np.testing.assert_allclose(s1_v, av / av.sum(), rtol=1e-6)
+    np.testing.assert_allclose(cl_v, np.clip(av, 0.25, 0.5), rtol=1e-6)
+    np.testing.assert_allclose(
+        l2_v, np.sqrt(((av - bv) ** 2).sum(-1, keepdims=True)), rtol=1e-6)
+    np.testing.assert_allclose(ip_v, 0.25 * av + 0.75 * bv, rtol=1e-6)
+
+    # hsigmoid + scale_shift config trains end-to-end via SGD.train
+    paddle.init(seed=32)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    y = paddle.layer.data(name="y", type=paddle.data_type.integer_value(6))
+    h = paddle.layer.fc(input=x, size=12, act=paddle.activation.Tanh())
+    h2 = paddle.layer.scale_shift(h)
+    cost = paddle.layer.hsigmoid(input=h2, label=y, num_classes=6)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+    rng = np.random.RandomState(5)
+
+    def reader():
+        for _ in range(32):
+            v = rng.rand(8).astype(np.float32)
+            yield v, int(v[0] * 6) % 6
+
+    costs = []
+    trainer.train(reader=paddle.batch(reader, 8), num_passes=6,
+                  event_handler=lambda ev: costs.append(ev.cost)
+                  if isinstance(ev, paddle.event.EndIteration) else None,
+                  feeding={"x": 0, "y": 1})
+    assert np.isfinite(costs).all() and costs[-1] < costs[0]

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Determinism loop-runner (r4 VERDICT next#5): run a test target N times
+# consecutively and stop on the first failure.
+#
+#   tools/loop_tests.sh [N] [pytest target...]
+#
+# Defaults: 10 iterations of tests/test_distributed_multiproc.py (the
+# file whose launcher-collective test flaked mid-round-4 before the
+# SO_REUSEPORT port-race fix in commit 4ee26da).
+set -u
+N="${1:-10}"
+shift || true
+TARGET=("${@:-tests/test_distributed_multiproc.py}")
+cd "$(dirname "$0")/.."
+pass=0
+for i in $(seq 1 "$N"); do
+    echo "=== run $i/$N: ${TARGET[*]} ==="
+    if ! python -m pytest "${TARGET[@]}" -q -p no:cacheprovider; then
+        echo "FAILED on run $i/$N"
+        exit 1
+    fi
+    pass=$((pass + 1))
+done
+echo "ALL GREEN: $pass/$N consecutive runs"
