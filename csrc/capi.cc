@@ -733,10 +733,11 @@ void Engine::forward() {
   outputs.clear();
   for (auto& op : block().ops) run_op(op);
   for (auto& n : fetch_names) {
-    auto it = vars.find(n);
-    if (it == vars.end())
+    // both maps: a fetch target may be a loaded parameter passed through
+    const Tensor* t = find_tensor(n);
+    if (!t)
       throw std::runtime_error("fetch target " + n + " was not produced");
-    outputs.push_back(it->second);
+    outputs.push_back(*t);
   }
 }
 

@@ -648,7 +648,8 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     cost = flayers.hsigmoid(input=input, label=label,
                             num_classes=int(num_classes),
                             param_attr=ParamAttr.to_attr(param_attr),
-                            bias_attr=(ParamAttr.to_attr(bias_attr)
+                            bias_attr=(False if bias_attr is False else
+                                       ParamAttr.to_attr(bias_attr)
                                        if bias_attr is not None else None))
     out = flayers.mean(cost)
     _register_named_output(name, out)
