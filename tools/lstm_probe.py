@@ -1,0 +1,152 @@
+"""Fused-scan Pallas LSTM cell probe (r4 VERDICT next#6).
+
+The training LSTM runs as a lax.scan whose serial per-step cost is
+latency-bound: each step is a small [B,H]x[H,4H] matmul that re-fetches
+the recurrent weight from HBM and pays kernel-chain latency (~20us/step
+measured at h=256 — far above the ~1us the matmul itself needs).  This
+probe implements the whole forward time loop as ONE Pallas kernel (grid
+over T serial, weight + carry resident in VMEM) and times it against the
+XLA scan forward on identical inputs — the measurement that decides
+whether a full fwd+bwd fused kernel is worth building.
+
+    python tools/lstm_probe.py --h 256 --b 128 --t 100
+"""
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def sync(x):
+    float(jnp.asarray(x).reshape(-1)[0].astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused forward: grid (T,), x_proj streamed per step, h/c in VMEM
+# ---------------------------------------------------------------------------
+
+def _cell_kernel(xp_ref, wh_ref, h_seq_ref, h_scr, c_scr, *, hidden):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = jnp.zeros_like(h_scr)
+        c_scr[...] = jnp.zeros_like(c_scr)
+
+    h = h_scr[...]
+    c = c_scr[...]
+    gates = xp_ref[0] + jax.lax.dot_general(
+        h, wh_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    gc = jnp.tanh(gates[:, :hidden])
+    gi = jax.nn.sigmoid(gates[:, hidden:2 * hidden])
+    gf = jax.nn.sigmoid(gates[:, 2 * hidden:3 * hidden])
+    go = jax.nn.sigmoid(gates[:, 3 * hidden:])
+    c_new = gf * c + gi * gc
+    h_new = go * jnp.tanh(c_new)
+    h_scr[...] = h_new
+    c_scr[...] = c_new
+    h_seq_ref[0] = h_new.astype(h_seq_ref.dtype)
+
+
+def pallas_lstm_fwd(x_proj, w_h, hidden):
+    """x_proj [B, T, 4H] (input projection + bias precomputed),
+    w_h [H, 4H] -> h sequence [B, T, H]; zero initial state."""
+    b, t, _ = x_proj.shape
+    xp = jnp.swapaxes(x_proj, 0, 1)        # [T, B, 4H] streamed per step
+    kernel = functools.partial(_cell_kernel, hidden=hidden)
+    h_seq = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[pl.BlockSpec((1, b, 4 * hidden), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((hidden, 4 * hidden), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, b, hidden), x_proj.dtype),
+        scratch_shapes=[pltpu.VMEM((b, hidden), jnp.float32),
+                        pltpu.VMEM((b, hidden), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(xp, w_h)
+    return jnp.swapaxes(h_seq, 0, 1)
+
+
+def xla_lstm_fwd(x_proj, w_h, hidden):
+    xp = jnp.swapaxes(x_proj, 0, 1)
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + jnp.matmul(h, w_h,
+                                preferred_element_type=jnp.float32
+                                ).astype(xt.dtype)
+        gc = jnp.tanh(gates[:, :hidden])
+        gi = jax.nn.sigmoid(gates[:, hidden:2 * hidden])
+        gf = jax.nn.sigmoid(gates[:, 2 * hidden:3 * hidden])
+        go = jax.nn.sigmoid(gates[:, 3 * hidden:])
+        c_new = gf * c + gi * gc
+        h_new = go * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    b = x_proj.shape[0]
+    init = (jnp.zeros((b, hidden), jnp.float32),
+            jnp.zeros((b, hidden), jnp.float32))
+    _, hs = jax.lax.scan(step, init, xp)
+    return jnp.swapaxes(hs, 0, 1)
+
+
+def bench(fn, x_proj, w_h, hidden, iters=50):
+    def chained(xp, w):
+        def body(_, carry):
+            out = fn(carry, w, hidden)
+            # feed a slice back so iterations chain (defeats DCE/overlap)
+            return carry + 1e-6 * jnp.pad(
+                out, ((0, 0), (0, 0), (0, 3 * hidden)))
+        return jax.lax.fori_loop(0, iters, body, xp)
+
+    f = jax.jit(chained)
+    sync(f(x_proj, w_h))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync(f(x_proj, w_h))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--h", type=int, default=256)
+    ap.add_argument("--b", type=int, default=128)
+    ap.add_argument("--t", type=int, default=100)
+    args = ap.parse_args()
+    h, b, t = args.h, args.b, args.t
+    r = np.random.RandomState(0)
+    x_proj = jnp.asarray(r.randn(b, t, 4 * h) * 0.1, jnp.float32)
+    w_h = jnp.asarray(r.randn(h, 4 * h) * 0.05, jnp.float32)
+
+    ref = xla_lstm_fwd(x_proj, w_h, h)
+    got = pallas_lstm_fwd(x_proj, w_h, h)
+    err = float(jnp.max(jnp.abs(ref - got)))
+    print(f"parity max|diff| = {err:.2e}")
+    assert err < 1e-4, err
+
+    dt_x = bench(xla_lstm_fwd, x_proj, w_h, h)
+    dt_p = bench(pallas_lstm_fwd, x_proj, w_h, h)
+    us_x = dt_x * 1e6 / t
+    us_p = dt_p * 1e6 / t
+    print(f"h={h} b={b} t={t}  xla-scan fwd {dt_x*1e3:7.3f} ms "
+          f"({us_x:5.2f} us/step) | pallas fused {dt_p*1e3:7.3f} ms "
+          f"({us_p:5.2f} us/step)  -> {dt_x/dt_p:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
