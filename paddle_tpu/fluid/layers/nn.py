@@ -27,7 +27,7 @@ __all__ = [
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
     "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
     "fused_vocab_cross_entropy", "maxout", "squeeze", "unsqueeze",
-    "hsigmoid", "sampling_id", "bilinear_interp",
+    "hsigmoid", "sampling_id", "bilinear_interp", "prelu",
 ]
 
 
@@ -443,6 +443,31 @@ def bilinear_interp(input, out_h, out_w, name=None):
     out = helper.create_tmp_variable(input.dtype)
     helper.append_op("bilinear_interp", {"X": input}, {"Out": out},
                      {"out_h": int(out_h), "out_w": int(out_w)})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """Parametric ReLU with a LEARNED negative slope (reference gserver
+    ParameterReluLayer / trainer_config_helpers prelu_layer).  mode:
+    'all' one shared alpha, 'channel' one per channel (NCHW dim 1),
+    'element' one per feature element."""
+    from ..initializer import ConstantInitializer
+
+    helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1]]
+    elif mode == "element":
+        shape = list(x.shape[1:])
+    else:
+        raise ValueError(f"prelu: unknown mode {mode!r}")
+    alpha = helper.create_parameter(
+        helper.param_attr, shape=shape, dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+    helper.append_op("prelu", {"X": x, "Alpha": alpha}, {"Out": out},
+                     {"mode": mode})
     return out
 
 

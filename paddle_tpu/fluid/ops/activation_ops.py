@@ -67,7 +67,12 @@ def brelu(ctx, x):
 
 @primitive("prelu", inputs=["X", "Alpha"], seq_transparent=True)
 def prelu(ctx, x, alpha):
-    """reference prelu_op.cc — learnable slope."""
+    """reference prelu_op.cc / gserver ParameterReluLayer — learnable
+    negative slope.  mode 'channel' aligns a [C] alpha with NCHW dim 1
+    (plain trailing-axis broadcast would hit W); 'all'/'element' rely on
+    numpy broadcasting ([1] and feature-shaped alphas)."""
+    if ctx.attr("mode", "all") == "channel" and x.ndim >= 2:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
     return jnp.where(x > 0, x, alpha * x)
 
 

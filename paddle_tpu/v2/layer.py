@@ -30,7 +30,7 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "conv_projection", "simple_attention",
            "hsigmoid", "bilinear_interp", "sampling_id", "slope_intercept",
            "interpolation", "dot_prod", "trans", "clip", "pad",
-           "sum_to_one_norm", "l2_distance", "scale_shift"]
+           "sum_to_one_norm", "l2_distance", "scale_shift", "prelu"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -765,5 +765,35 @@ def scale_shift(input, param_attr=None, bias_attr=None, name=None, **kw):
                                 shape=[1], dtype=input.dtype, is_bias=True)
     scaled = flayers.elementwise_mul(input, w)
     out = flayers.elementwise_add(scaled, b)
+    _register_named_output(name, out)
+    return out
+
+
+def prelu(input, partial_sum=1, channel_shared=None, param_attr=None,
+          name=None, **kw):
+    """Parametric ReLU (reference layers.py prelu_layer:6683, gserver
+    ParameterReluLayer).  The reference's default (partial_sum=1) is one
+    learned alpha PER ELEMENT; ``channel_shared=True`` is one shared
+    alpha; a ``partial_sum`` equal to a channel's spatial extent shares
+    per channel.  Other partial_sum groupings are rejected rather than
+    silently approximated."""
+    if channel_shared:
+        mode = "all"
+    elif partial_sum == 1:
+        mode = "element"
+    else:
+        shape = input.shape or []
+        spatial = 1
+        for d in shape[2:]:
+            spatial *= max(int(d), 1)
+        if len(shape) >= 3 and partial_sum == spatial:
+            mode = "channel"
+        else:
+            raise ValueError(
+                f"prelu: partial_sum={partial_sum} grouping is not "
+                f"supported (use 1 = per-element, channel_shared=True, "
+                f"or the per-channel spatial extent {spatial})")
+    out = flayers.prelu(input, mode=mode,
+                        param_attr=ParamAttr.to_attr(param_attr))
     _register_named_output(name, out)
     return out

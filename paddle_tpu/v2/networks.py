@@ -10,7 +10,8 @@ from ..fluid import layers as flayers
 from ..fluid import nets as fnets
 from . import layer as v2layer
 
-__all__ = ["simple_lstm", "simple_gru", "simple_gru2", "gru_group",
+__all__ = ["img_conv_bn_pool", "img_separable_conv", "small_vgg",
+           "simple_lstm", "simple_gru", "simple_gru2", "gru_group",
            "lstmemory_group", "bidirectional_lstm",
            "bidirectional_gru", "simple_img_conv_pool",
            "img_conv_group", "vgg_16_network", "text_conv_pool",
@@ -89,18 +90,11 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_filter_size=3,
 
 def vgg_16_network(input_image, num_channels, num_classes=1000):
     """VGG-16 (reference networks.py vgg_16_network), fluid-composed."""
-    def block(ipt, n_filter, groups, dropouts):
-        return fnets.img_conv_group(
-            input=ipt, pool_size=2, pool_stride=2,
-            conv_num_filter=[n_filter] * groups, conv_filter_size=3,
-            conv_act="relu", conv_with_batchnorm=True,
-            conv_batchnorm_drop_rate=dropouts, pool_type="max")
-
-    tmp = block(input_image, 64, 2, [0.3, 0])
-    tmp = block(tmp, 128, 2, [0.4, 0])
-    tmp = block(tmp, 256, 3, [0.4, 0.4, 0])
-    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
-    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = _vgg_block(input_image, 64, 2, [0.3, 0])
+    tmp = _vgg_block(tmp, 128, 2, [0.4, 0])
+    tmp = _vgg_block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = _vgg_block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = _vgg_block(tmp, 512, 3, [0.4, 0.4, 0])
     tmp = flayers.dropout(x=tmp, dropout_prob=0.5)
     tmp = flayers.fc(input=tmp, size=4096, act=None)
     tmp = flayers.batch_norm(input=tmp, act="relu")
@@ -199,3 +193,59 @@ def multi_head_attention(query, key, value, key_proj_size, value_proj_size,
             heads.append(v2layer.simple_attention(
                 encoded_sequence=v, encoded_proj=k, decoder_state=q))
     return flayers.concat(input=heads, axis=-1)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     pool_stride, act=None, pool_type="max",
+                     conv_stride=1, conv_padding=0, groups=1, **kw):
+    """conv2d + batch_norm + pool2d (reference networks.py
+    img_conv_bn_pool:231, incl. its conv_stride/conv_padding/groups)."""
+    from .layer import _act_name
+
+    conv = flayers.conv2d(input=input, num_filters=num_filters,
+                          filter_size=filter_size, stride=conv_stride,
+                          padding=conv_padding, groups=groups, act=None)
+    bn = flayers.batch_norm(input=conv, act=_act_name(act))
+    return flayers.pool2d(input=bn, pool_size=pool_size,
+                          pool_stride=pool_stride, pool_type=pool_type)
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, act=None,
+                       depth_multiplier=1, **kw):
+    """Depthwise + pointwise convolution pair (reference networks.py
+    img_separable_conv) via conv2d groups."""
+    from .layer import _act_name
+
+    depth = flayers.conv2d(input=input,
+                           num_filters=num_channels * depth_multiplier,
+                           filter_size=filter_size, stride=stride,
+                           padding=padding, groups=num_channels, act=None)
+    return flayers.conv2d(input=depth, num_filters=num_out_channels,
+                          filter_size=1, act=_act_name(act))
+
+
+def _vgg_block(ipt, n_filter, groups, dropouts):
+    """The shared VGG conv block (conv(+bn+dropout)xN + pool)."""
+    return fnets.img_conv_group(
+        input=ipt, pool_size=2, pool_stride=2,
+        conv_num_filter=[n_filter] * groups, conv_filter_size=3,
+        conv_act="relu", conv_with_batchnorm=True,
+        conv_batchnorm_drop_rate=dropouts, pool_type="max")
+
+
+def small_vgg(input_image, num_channels, num_classes=1000, **kw):
+    """The scaled-down VGG of the image demos (reference networks.py
+    small_vgg:517: four conv blocks 64/128/256/512 + stride-2 pool +
+    dropout + fc-512 + bn + softmax head)."""
+    tmp = _vgg_block(input_image, 64, 2, [0.3, 0])
+    tmp = _vgg_block(tmp, 128, 2, [0.4, 0])
+    tmp = _vgg_block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = _vgg_block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = flayers.pool2d(input=tmp, pool_size=2, pool_stride=2,
+                         pool_type="max")
+    tmp = flayers.dropout(x=tmp, dropout_prob=0.5)
+    tmp = flayers.fc(input=tmp, size=512, act=None)
+    tmp = flayers.dropout(x=tmp, dropout_prob=0.5)
+    tmp = flayers.batch_norm(input=tmp, act="relu")
+    return flayers.fc(input=tmp, size=num_classes, act="softmax")

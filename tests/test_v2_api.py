@@ -889,3 +889,67 @@ def test_v2_straggler_layers_compute_and_train():
                   if isinstance(ev, paddle.event.EndIteration) else None,
                   feeding={"x": 0, "y": 1})
     assert np.isfinite(costs).all() and costs[-1] < costs[0]
+
+
+def test_v2_prelu_and_conv_network_helpers():
+    """prelu (channel mode aligned to NCHW dim 1) + img_conv_bn_pool /
+    img_separable_conv / small_vgg network helpers (COMPAT.md rows)."""
+    from paddle_tpu import fluid
+
+    paddle.init(seed=13)
+    main, startup = (fluid.default_main_program(),
+                     fluid.default_startup_program())
+    scope = fluid.Scope()
+    x = fluid.layers.data("x", [3, 4, 4], "float32")
+    y1 = fluid.layers.prelu(x, mode="channel",
+                            param_attr=fluid.ParamAttr(name="alpha"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        al = np.asarray(scope.find_var("alpha"))
+        o, = exe.run(main, feed={"x": xs}, fetch_list=[y1])
+    np.testing.assert_allclose(
+        np.asarray(o), np.where(xs > 0, xs, al.reshape(1, 3, 1, 1) * xs),
+        rtol=1e-6)
+
+    paddle.init(seed=14)
+    img = paddle.layer.data(
+        name="img", type=paddle.data_type.dense_vector(3 * 16 * 16))
+    r = fluid.layers.reshape(img, [-1, 3, 16, 16])
+    c1 = paddle.networks.img_conv_bn_pool(
+        r, filter_size=3, num_filters=4, pool_size=2, pool_stride=2,
+        act=paddle.activation.Relu())
+    c2 = paddle.networks.img_separable_conv(
+        c1, num_channels=4, num_out_channels=8, filter_size=3, padding=1,
+        act=paddle.activation.Relu())
+    p1 = paddle.layer.prelu(c2)
+    lab = paddle.layer.data(name="lab",
+                            type=paddle.data_type.integer_value(2))
+    pred = paddle.layer.fc(input=p1, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=lab)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2))
+    rng = np.random.RandomState(4)
+
+    def reader():
+        for _ in range(8):
+            v = rng.rand(3 * 16 * 16).astype(np.float32)
+            yield v, int(v.mean() > 0.5)
+
+    costs = []
+    tr.train(reader=paddle.batch(reader, 4), num_passes=3,
+             event_handler=lambda ev: costs.append(ev.cost)
+             if isinstance(ev, paddle.event.EndIteration) else None,
+             feeding={"img": 0, "lab": 1})
+    assert np.isfinite(costs).all()
+
+    paddle.init(seed=15)
+    img2 = paddle.layer.data(
+        name="i2", type=paddle.data_type.dense_vector(3 * 32 * 32))
+    r2 = fluid.layers.reshape(img2, [-1, 3, 32, 32])
+    out = paddle.networks.small_vgg(r2, num_channels=3, num_classes=10)
+    assert tuple(out.shape)[-1] == 10
