@@ -30,7 +30,10 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "conv_projection", "simple_attention",
            "hsigmoid", "bilinear_interp", "sampling_id", "slope_intercept",
            "interpolation", "dot_prod", "trans", "clip", "pad",
-           "sum_to_one_norm", "l2_distance", "scale_shift", "prelu"]
+           "sum_to_one_norm", "l2_distance", "scale_shift", "prelu",
+           "factorization_machine", "huber_regression_cost",
+           "huber_classification_cost", "repeat", "power", "out_prod",
+           "gated_unit"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -795,5 +798,136 @@ def prelu(input, partial_sum=1, channel_shared=None, param_attr=None,
                 f"or the per-channel spatial extent {spatial})")
     out = flayers.prelu(input, mode=mode,
                         param_attr=ParamAttr.to_attr(param_attr))
+    _register_named_output(name, out)
+    return out
+
+
+def factorization_machine(input, factor_size, act=None, param_attr=None,
+                          name=None, **kw):
+    """Second-order Factorization Machine term (reference layers.py
+    factorization_machine:7468): y = sum_{i<j} <v_i, v_j> x_i x_j,
+    computed as 0.5 * sum_k ((x V)_k^2 - (x^2) (V^2)_k) — one [B,1]
+    interaction score per row (pair the reference's way with an fc for
+    the linear term, e.g. in a CTR head)."""
+    from ..fluid.layer_helper import LayerHelper
+
+    feat = (input.shape or [None, None])[-1]
+    if not feat or feat < 0:
+        raise ValueError("factorization_machine: input width must be "
+                         "static (got dynamic)")
+    helper = LayerHelper("factorization_machine",
+                         param_attr=ParamAttr.to_attr(param_attr))
+    v = helper.create_parameter(helper.param_attr,
+                                shape=[int(feat), int(factor_size)],
+                                dtype="float32")
+    xv = flayers.matmul(input, v)                       # [B, K]
+    sq_sum = flayers.elementwise_mul(xv, xv)
+    x2 = flayers.elementwise_mul(input, input)
+    v2 = flayers.elementwise_mul(v, v)
+    sum_sq = flayers.matmul(x2, v2)                     # [B, K]
+    diff = flayers.elementwise_sub(sq_sum, sum_sq)
+    out = flayers.scale(flayers.reduce_sum(diff, dim=-1, keep_dim=True),
+                        scale=0.5)
+    if act is not None:
+        out = getattr(flayers, _act_name(act))(out)
+    _register_named_output(name, out)
+    return out
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, **kw):
+    """Huber regression loss (reference layers.py
+    huber_regression_cost:6214, huber_loss op): 0.5 r^2 within
+    ``delta``, delta*(|r| - delta/2) outside; batch mean."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("huber_regression_cost")
+    resid = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    loss = helper.create_tmp_variable(input.dtype)
+    helper.append_op("huber_loss", {"X": input, "Y": label},
+                     {"Residual": resid, "Out": loss},
+                     {"delta": float(delta)})
+    out = flayers.mean(loss)
+    _register_named_output(name, out)
+    return out
+
+
+def huber_classification_cost(input, label, name=None, **kw):
+    """Modified Huber loss for ±1 binary labels (reference layers.py
+    huber_classification_cost:6255): max(0, 1-yf)^2 when yf >= -1, else
+    -4yf; ``label`` is a 0/1 integer layer (mapped to ±1), batch mean."""
+    yf = flayers.elementwise_mul(
+        flayers.scale(flayers.cast(label, "float32"), scale=2.0,
+                      bias=-1.0, bias_after_scale=True),
+        input)
+    hinge = flayers.clip(
+        flayers.scale(yf, scale=-1.0, bias=1.0, bias_after_scale=True),
+        min=0.0, max=2.0)                 # max(0, 1-yf) capped at yf=-1
+    quad = flayers.elementwise_mul(hinge, hinge)
+    lin = flayers.scale(yf, scale=-4.0)
+    in_quad = flayers.cast(
+        flayers.greater_equal(yf, flayers.fill_constant(
+            shape=[1], dtype="float32", value=-1.0)), "float32")
+    keep = flayers.elementwise_add(
+        flayers.elementwise_mul(in_quad, quad),
+        flayers.elementwise_mul(
+            flayers.scale(in_quad, scale=-1.0, bias=1.0,
+                          bias_after_scale=True), lin))
+    out = flayers.mean(keep)
+    _register_named_output(name, out)
+    return out
+
+
+def repeat(input, num_repeats, as_row_vector=True, name=None, **kw):
+    """Repeat each sample's features (reference layers.py
+    repeat_layer:1911): as_row_vector tiles the whole vector
+    [a b, a b, ...]; otherwise each element repeats in place
+    [a a ..., b b ...]."""
+    feat = (input.shape or [None, None])[-1]
+    if not feat or feat < 0:
+        raise ValueError("repeat: input width must be static")
+    n = int(num_repeats)
+    if as_row_vector:
+        out = flayers.reshape(
+            flayers.expand(flayers.reshape(input, [-1, 1, feat]),
+                           [1, n, 1]), [-1, n * feat])
+    else:
+        out = flayers.reshape(
+            flayers.expand(flayers.reshape(input, [-1, feat, 1]),
+                           [1, 1, n]), [-1, feat * n])
+    _register_named_output(name, out)
+    return out
+
+
+def power(input, weight, name=None, **kw):
+    """y = x^w with a per-sample scalar weight layer (reference
+    layers.py power_layer:2526)."""
+    out = flayers.elementwise_pow(input, weight)
+    _register_named_output(name, out)
+    return out
+
+
+def out_prod(input1, input2, name=None, **kw):
+    """Per-sample outer product -> [B, M*N] (reference layers.py
+    out_prod_layer:4063)."""
+    m = (input1.shape or [None, None])[-1]
+    n = (input2.shape or [None, None])[-1]
+    if not m or m < 0 or not n or n < 0:
+        raise ValueError("out_prod: input widths must be static")
+    prod = flayers.matmul(flayers.reshape(input1, [-1, int(m), 1]),
+                          flayers.reshape(input2, [-1, 1, int(n)]))
+    out = flayers.reshape(prod, [-1, int(m) * int(n)])
+    _register_named_output(name, out)
+    return out
+
+
+def gated_unit(input, size, act=None, gate_param_attr=None,
+               param_attr=None, name=None, **kw):
+    """Gated linear unit (reference layers.py gated_unit_layer:7209):
+    act(fc(x)) * sigmoid(fc_gate(x))."""
+    value = flayers.fc(input=input, size=size, act=_act_name(act),
+                       param_attr=ParamAttr.to_attr(param_attr))
+    gate = flayers.fc(input=input, size=size, act="sigmoid",
+                      param_attr=ParamAttr.to_attr(gate_param_attr))
+    out = flayers.elementwise_mul(value, gate)
     _register_named_output(name, out)
     return out

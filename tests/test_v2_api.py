@@ -953,3 +953,119 @@ def test_v2_prelu_and_conv_network_helpers():
     r2 = fluid.layers.reshape(img2, [-1, 3, 32, 32])
     out = paddle.networks.small_vgg(r2, num_channels=3, num_classes=10)
     assert tuple(out.shape)[-1] == 10
+
+
+def test_v2_factorization_machine():
+    """FM second-order term matches the O(n^2) pair sum on a toy input
+    and trains inside a CTR-style head (COMPAT.md row 106)."""
+    from paddle_tpu import fluid
+
+    paddle.init(seed=21)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(5))
+    fm = paddle.layer.factorization_machine(
+        x, factor_size=3, param_attr=paddle.attr.Param(name="fm_v"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xs = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        V = np.asarray(scope.find_var("fm_v"))
+        o, = exe.run(fluid.default_main_program(), feed={"x": xs},
+                     fetch_list=[fm])
+    want = np.zeros((4, 1), np.float32)
+    for b in range(4):
+        for i in range(5):
+            for j in range(i + 1, 5):
+                want[b, 0] += V[i] @ V[j] * xs[b, i] * xs[b, j]
+    np.testing.assert_allclose(np.asarray(o), want, rtol=1e-4, atol=1e-6)
+
+    # trains: FM + linear term as a CTR head
+    paddle.init(seed=22)
+    x2 = paddle.layer.data(name="x2",
+                           type=paddle.data_type.dense_vector(8))
+    y2 = paddle.layer.data(name="y2",
+                           type=paddle.data_type.integer_value(2))
+    fm2 = paddle.layer.factorization_machine(x2, factor_size=4)
+    lin = paddle.layer.fc(input=x2, size=1)
+    both = paddle.layer.concat([fm2, lin])
+    pred = paddle.layer.fc(input=both, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=y2)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+    rng = np.random.RandomState(6)
+
+    def reader():
+        for _ in range(24):
+            v = rng.rand(8).astype(np.float32)
+            yield v, int((v[0] * v[1]) > 0.25)   # an interaction label
+
+    costs = []
+    tr.train(reader=paddle.batch(reader, 8), num_passes=6,
+             event_handler=lambda ev: costs.append(ev.cost)
+             if isinstance(ev, paddle.event.EndIteration) else None,
+             feeding={"x2": 0, "y2": 1})
+    assert np.isfinite(costs).all() and costs[-1] < costs[0]
+
+
+def test_v2_cost_and_shape_wrappers():
+    """huber costs / repeat / power / out_prod / gated_unit
+    (COMPAT.md rows 27, 31, 59, 85, 86, 94) compute the documented
+    math."""
+    from paddle_tpu import fluid
+
+    paddle.init(seed=41)
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(3))
+    b = paddle.layer.data(name="b", type=paddle.data_type.dense_vector(3))
+    w = paddle.layer.data(name="w", type=paddle.data_type.dense_vector(1))
+    yl = paddle.layer.data(name="yl", type=paddle.data_type.integer_value(2))
+    pr = paddle.layer.data(name="pr", type=paddle.data_type.dense_vector(1))
+    rep_r = paddle.layer.repeat(a, 2, as_row_vector=True)
+    rep_e = paddle.layer.repeat(a, 2, as_row_vector=False)
+    pw = paddle.layer.power(a, w)
+    op = paddle.layer.out_prod(a, b)
+    hr = paddle.layer.huber_regression_cost(pr, w, delta=1.0)
+    hc = paddle.layer.huber_classification_cost(pr, yl)
+    gu = paddle.layer.gated_unit(a, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    av = np.array([[1., 2., 3.]], np.float32)
+    bv = np.array([[4., 5., 6.]], np.float32)
+    wv = np.array([[2.0]], np.float32)
+    prv = np.array([[0.5]], np.float32)
+    ylv = np.array([[1]], np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        o = exe.run(fluid.default_main_program(),
+                    feed={"a": av, "b": bv, "w": wv, "pr": prv,
+                          "yl": ylv},
+                    fetch_list=[rep_r, rep_e, pw, op, hr, hc, gu])
+    rr, re, pwv, opv, hrv, hcv, guv = (np.asarray(x) for x in o)
+    np.testing.assert_allclose(rr, [[1, 2, 3, 1, 2, 3]], rtol=1e-6)
+    np.testing.assert_allclose(re, [[1, 1, 2, 2, 3, 3]], rtol=1e-6)
+    np.testing.assert_allclose(pwv, av ** 2.0, rtol=1e-6)
+    np.testing.assert_allclose(opv, np.outer(av, bv).reshape(1, 9),
+                               rtol=1e-6)
+    # huber classification: y=+1, f=0.5 -> yf=0.5 >= -1 -> (1-0.5)^2
+    np.testing.assert_allclose(hcv, [0.25], rtol=1e-5)
+    # huber regression delta=1: r = w - pr = 1.5 > delta -> 1*(1.5-0.5)
+    np.testing.assert_allclose(hrv, [1.0], rtol=1e-5)
+    assert guv.shape == (1, 4)
+    # delta=2 branch shapes: |r|=1.5 <= 2 -> 0.5*1.5^2 = 1.125
+    hr2 = paddle.layer.huber_regression_cost(pr, w, delta=2.0)
+    with fluid.scope_guard(scope):
+        o3, = exe.run(fluid.default_main_program(),
+                      feed={"a": av, "b": bv, "w": wv, "pr": prv,
+                            "yl": ylv},
+                      fetch_list=[hr2])
+    np.testing.assert_allclose(np.asarray(o3), [1.125], rtol=1e-5)
+    # the -4yf branch: y=0 (mapped -1), f=3 -> yf=-3 < -1 -> 12
+    with fluid.scope_guard(scope):
+        o2, = exe.run(fluid.default_main_program(),
+                      feed={"a": av, "b": bv, "w": wv,
+                            "pr": np.array([[3.0]], np.float32),
+                            "yl": np.array([[0]], np.int64)},
+                      fetch_list=[hc])
+    np.testing.assert_allclose(np.asarray(o2), [12.0], rtol=1e-5)
