@@ -14,7 +14,7 @@ __all__ = ["linear_chain_crf", "crf_decoding",
            "sequence_last_step", "sequence_expand", "sequence_concat",
            "sequence_reshape", "sequence_slice", "sequence_erase",
            "sequence_mask", "sequence_pad", "warpctc", "edit_distance",
-           "ctc_align", "ctc_greedy_decoder"]
+           "ctc_align", "ctc_greedy_decoder", "lambda_rank_cost"]
 
 
 def warpctc(input, label, blank=0, norm_by_times=False, name=None):
@@ -223,3 +223,13 @@ def sequence_pad(x, name=None):
     mask = helper.create_tmp_variable(x.dtype, stop_gradient=True)
     helper.append_op("sequence_pad", {"X": x}, {"Out": out, "Mask": mask})
     return out, mask
+
+
+def lambda_rank_cost(score, label, ndcg_num=5, name=None):
+    """LambdaRank cost per query sequence (reference gserver LambdaCost;
+    see ops/loss_ops.py lambda_rank_cost for the math) -> [B, 1]."""
+    helper = LayerHelper("lambda_rank_cost", name=name)
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("lambda_rank_cost", {"Score": score, "Label": label},
+                     {"Out": out}, {"ndcg_num": int(ndcg_num)})
+    return out

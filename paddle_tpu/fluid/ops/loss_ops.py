@@ -269,3 +269,60 @@ def fused_vocab_cross_entropy(ctx, x, w, label):
     ids = ids.reshape(-1).astype(jnp.int32)
     loss = _chunked_vocab_xent(x2, w, ids, chunk)
     return loss.reshape(*lead, 1)
+
+
+@primitive("lambda_rank_cost", inputs=["Score", "Label"],
+           stop_grad_slots=("Label",))
+def lambda_rank_cost(ctx, score, label):
+    """LambdaRank cost (reference gserver CostLayer.cpp LambdaCost /
+    trainer_config_helpers lambda_cost:6010) as the LambdaLoss
+    formulation: per query (= one sequence),
+
+        cost = sum_{i,j: l_i > l_j} |dNDCG_ij| * log(1 + exp(-(s_i-s_j)))
+
+    whose gradient in s is exactly the classic lambda_ij weighting.
+    dNDCG_ij (stop-gradient) swaps documents i and j in the CURRENT
+    score ranking with NDCG truncated at ``ndcg_num``, normalised by the
+    ideal DCG.  The reference computes forward NDCG and hand-writes the
+    lambda backward; optimizing this loss yields the same update
+    direction and gives autodiff/SPMD for free.  Inputs are [B, T, 1]
+    sequences (padded + lengths); output is the per-query cost [B, 1]."""
+    assert isinstance(score, SeqArray), "lambda_rank_cost expects sequences"
+    ndcg_num = int(ctx.attr("ndcg_num", 5))
+    s = score.data.reshape(score.data.shape[0], -1)          # [B, T]
+    lab = label.data if isinstance(label, SeqArray) else label
+    l = lab.reshape(lab.shape[0], -1).astype(jnp.float32)    # [B, T]
+    b, t = s.shape
+    mask = (jnp.arange(t)[None, :] <
+            score.lengths[:, None]).astype(jnp.float32)      # [B, T]
+
+    neg = jnp.float32(-1e30)
+    s_rank = jnp.where(mask > 0, s, neg)
+    # rank of each doc under the model scores (0 = best), padding last
+    order = jnp.argsort(-s_rank, axis=1)
+    ranks = jnp.argsort(order, axis=1).astype(jnp.float32)   # [B, T]
+    gain = jnp.exp2(l) - 1.0
+    disc = jnp.where(ranks < ndcg_num,
+                     1.0 / jnp.log2(2.0 + ranks), 0.0) * mask
+    # ideal DCG: labels sorted descending (padding excluded)
+    l_sorted = -jnp.sort(-jnp.where(mask > 0, l, neg), axis=1)
+    ideal_pos = jnp.arange(t, dtype=jnp.float32)[None, :]
+    ideal_disc = jnp.where(
+        (ideal_pos < ndcg_num) & (l_sorted > neg / 2),
+        1.0 / jnp.log2(2.0 + ideal_pos), 0.0)
+    max_dcg = jnp.sum((jnp.exp2(jnp.where(l_sorted > neg / 2, l_sorted,
+                                          0.0)) - 1.0) * ideal_disc,
+                      axis=1, keepdims=True)                 # [B, 1]
+    safe_max = jnp.where(max_dcg > 0, max_dcg, 1.0)
+
+    dg = gain[:, :, None] - gain[:, None, :]                 # [B, T, T]
+    dd = disc[:, :, None] - disc[:, None, :]
+    dndcg = jax.lax.stop_gradient(
+        jnp.abs(dg * dd) / safe_max[:, :, None])
+    pair_live = ((l[:, :, None] > l[:, None, :]) &
+                 (mask[:, :, None] * mask[:, None, :] > 0) &
+                 (max_dcg[:, :, None] > 0))
+    diff = s[:, :, None] - s[:, None, :]
+    pair_cost = jnp.where(pair_live,
+                          dndcg * jnp.logaddexp(0.0, -diff), 0.0)
+    return jnp.sum(pair_cost, axis=(1, 2)).reshape(b, 1)

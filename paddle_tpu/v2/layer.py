@@ -33,7 +33,7 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "sum_to_one_norm", "l2_distance", "scale_shift", "prelu",
            "factorization_machine", "huber_regression_cost",
            "huber_classification_cost", "repeat", "power", "out_prod",
-           "gated_unit"]
+           "gated_unit", "lambda_cost"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -929,5 +929,18 @@ def gated_unit(input, size, act=None, gate_param_attr=None,
     gate = flayers.fc(input=input, size=size, act="sigmoid",
                       param_attr=ParamAttr.to_attr(gate_param_attr))
     out = flayers.elementwise_mul(value, gate)
+    _register_named_output(name, out)
+    return out
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
+                **kw):
+    """LambdaRank cost (reference layers.py lambda_cost:6010, gserver
+    LambdaCost): ``input`` is the model's per-document score sequence,
+    ``score`` the ground-truth relevance sequence; mean over queries.
+    ``max_sort_size`` is accepted for signature parity (the full sort is
+    always used — it was a CPU-time knob in the reference)."""
+    cost = flayers.lambda_rank_cost(input, score, ndcg_num=int(NDCG_num))
+    out = flayers.mean(cost)
     _register_named_output(name, out)
     return out

@@ -138,3 +138,97 @@ def test_sequence_concat_time_axis(fresh_programs):
     np.testing.assert_allclose(d[0][:3], [1, 2, 7])
     np.testing.assert_allclose(d[1][:3], [3, 8, 9])
     np.testing.assert_allclose(d[:, 3:], 0)
+
+
+def test_lambda_rank_cost_matches_naive_and_trains(fresh_programs):
+    """LambdaRank cost (reference gserver LambdaCost) — value parity
+    against an O(n^2) numpy pair loop, and training a linear scorer on
+    mq2007-style features improves NDCG@3."""
+    main, startup, scope = fresh_programs
+    sc = fluid.layers.data("sc", [1], "float32", lod_level=1)
+    lb = fluid.layers.data("lb", [1], "float32", lod_level=1)
+    cost = fluid.layers.lambda_rank_cost(sc, lb, ndcg_num=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    seqs_s = [rng.randn(5, 1).astype(np.float32),
+              rng.randn(3, 1).astype(np.float32)]
+    seqs_l = [np.array([[2], [0], [1], [0], [2]], np.float32),
+              np.array([[1], [0], [0]], np.float32)]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"sc": make_seq(seqs_s),
+                                   "lb": make_seq(seqs_l)},
+                       fetch_list=[cost])
+
+    def naive(s, l, K=3):
+        s, l = s.ravel(), l.ravel()
+        n = len(s)
+        order = np.argsort(-s)
+        ranks = np.argsort(order)
+        gain = 2.0 ** l - 1
+        disc = np.where(ranks < K, 1 / np.log2(2 + ranks), 0.0)
+        ideal = np.sort(l)[::-1]
+        maxdcg = sum((2.0 ** ideal[r] - 1) / np.log2(2 + r)
+                     for r in range(min(K, n)))
+        if maxdcg <= 0:
+            return 0.0
+        out = 0.0
+        for i in range(n):
+            for j in range(n):
+                if l[i] > l[j]:
+                    dn = abs((gain[i] - gain[j]) *
+                             (disc[i] - disc[j])) / maxdcg
+                    out += dn * np.log1p(np.exp(-(s[i] - s[j])))
+        return out
+
+    want = np.array([[naive(seqs_s[0], seqs_l[0])],
+                     [naive(seqs_s[1], seqs_l[1])]])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+
+    # training: linear scorer over 4 features; relevance = x @ w_true
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = fluid.Scope()
+    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32", lod_level=1)
+        rel = fluid.layers.data("rel", [1], "float32", lod_level=1)
+        score = fluid.layers.fc(input=x, size=1, bias_attr=False)
+        # through the v2 wrapper so its Score/Label wiring is covered
+        import paddle_tpu.v2 as _p2
+
+        c2 = _p2.layer.lambda_cost(input=score, score=rel, NDCG_num=3)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(c2)
+    w_true = np.array([1.0, -0.5, 0.3, 0.8], np.float32)
+    qs, rels = [], []
+    for _ in range(8):
+        docs = rng.randn(6, 4).astype(np.float32)
+        r = (docs @ w_true)
+        lvl = np.digitize(r, np.quantile(r, [0.5, 0.85])).astype(
+            np.float32).reshape(-1, 1)
+        qs.append(docs)
+        rels.append(lvl)
+
+    def ndcg3(w):
+        total = 0.0
+        for docs, lvl in zip(qs, rels):
+            s = docs @ w
+            order = np.argsort(-s.ravel())
+            dcg = sum((2 ** lvl.ravel()[order[r]] - 1) / np.log2(2 + r)
+                      for r in range(3))
+            ideal = np.sort(lvl.ravel())[::-1]
+            idcg = sum((2 ** ideal[r] - 1) / np.log2(2 + r)
+                       for r in range(3))
+            total += dcg / max(idcg, 1e-9)
+        return total / len(qs)
+
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        w0 = np.asarray(scope2.find_var("fc_0.w_0")).ravel().copy()
+        for _ in range(60):
+            exe2.run(main2, feed={"x": make_seq(qs),
+                                  "rel": make_seq(rels)},
+                     fetch_list=[c2])
+        w1 = np.asarray(scope2.find_var("fc_0.w_0")).ravel()
+    assert ndcg3(w1) > ndcg3(w0) + 0.1, (ndcg3(w0), ndcg3(w1))
+    assert ndcg3(w1) > 0.85, ndcg3(w1)
