@@ -28,6 +28,7 @@ __all__ = [
     "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
     "fused_vocab_cross_entropy", "maxout", "squeeze", "unsqueeze",
     "hsigmoid", "sampling_id", "bilinear_interp", "prelu",
+    "ssd_loss",
 ]
 
 
@@ -924,3 +925,24 @@ def fused_vocab_cross_entropy(input, label, vocab_size, chunk=8192,
                      {"X": input, "W": w, "Label": label}, {"Loss": loss},
                      {"chunk": int(chunk)})
     return loss
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box_var,
+             overlap_threshold=0.5, neg_pos_ratio=3.0,
+             background_label=0, name=None):
+    """SSD MultiBox training loss (reference gserver MultiBoxLossLayer +
+    fluid ssd_loss): smooth-L1 on matched priors + mined softmax
+    confidence loss, per-image [B, 1].  ``prior_box_var`` is the
+    (boxes, variances) pair prior_box returns."""
+    helper = LayerHelper("ssd_loss", name=name)
+    pb, pv = prior_box_var
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("ssd_loss",
+                     {"Location": location, "Confidence": confidence,
+                      "GTBox": gt_box, "GTLabel": gt_label,
+                      "PriorBox": pb, "PriorVar": pv},
+                     {"Out": out},
+                     {"overlap_threshold": float(overlap_threshold),
+                      "neg_pos_ratio": float(neg_pos_ratio),
+                      "background_label": int(background_label)})
+    return out

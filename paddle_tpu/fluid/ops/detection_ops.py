@@ -231,3 +231,123 @@ def positive_negative_pair(ctx, score, label, query, acc_pos, acc_neg,
     if acc_neu is not None:
         neu = neu + acc_neu.reshape(())
     return (pos.reshape(1), neg.reshape(1), neu.reshape(1))
+
+
+def _pairwise_iou(a, b):
+    """[n,4] x [m,4] xyxy boxes -> [n, m] IoU."""
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    aa = (jnp.maximum(a[:, 2] - a[:, 0], 0) *
+          jnp.maximum(a[:, 3] - a[:, 1], 0))
+    ba = (jnp.maximum(b[:, 2] - b[:, 0], 0) *
+          jnp.maximum(b[:, 3] - b[:, 1], 0))
+    return inter / jnp.maximum(aa[:, None] + ba[None, :] - inter, 1e-10)
+
+
+@primitive("ssd_loss",
+           inputs=["Location", "Confidence", "GTBox", "GTLabel",
+                   "PriorBox", "PriorVar"],
+           stop_grad_slots=("GTBox", "GTLabel", "PriorBox", "PriorVar"))
+def ssd_loss(ctx, loc, conf, gt_box, gt_label, prior, prior_var):
+    """SSD MultiBox loss (reference gserver/layers/MultiBoxLossLayer.h:29
+    and the fluid-era ssd_loss): smooth-L1 location loss on matched
+    priors + softmax confidence loss with 3:1 hard negative mining,
+    normalised by the positive count.
+
+    Location [B, P, 4] predicted encodings; Confidence [B, P, C] logits;
+    GTBox [B, G, 4] + GTLabel [B, G, 1] ground truth as padded sequences
+    (lengths mask the G axis); PriorBox/PriorVar [P, 4] from prior_box.
+    Matching = per-gt greedy best prior (bipartite round) topped up with
+    per-prior best gt at overlap >= threshold; encodings use the prior
+    variances (the SSD convention).  Out is [B, 1]."""
+    from ..core.lod import SeqArray
+
+    thresh = float(ctx.attr("overlap_threshold", 0.5))
+    neg_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    bg = int(ctx.attr("background_label", 0))
+
+    # prior_box emits [fh, fw, n_priors, 4]; the loss works on the
+    # flattened [P, 4] prior list (P must match Location/Confidence)
+    prior = prior.reshape(-1, 4)
+    prior_var = prior_var.reshape(-1, 4)
+    gb = gt_box.data if isinstance(gt_box, SeqArray) else gt_box
+    gl = gt_label.data if isinstance(gt_label, SeqArray) else gt_label
+    g_len = (gt_box.lengths if isinstance(gt_box, SeqArray)
+             else jnp.full((gb.shape[0],), gb.shape[1], jnp.int32))
+    gl = gl.reshape(gl.shape[0], -1).astype(jnp.int32)        # [B, G]
+    b, p, _ = loc.shape
+    g = gb.shape[1]
+
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    pw = jnp.maximum(prior[:, 2] - prior[:, 0], 1e-8)
+    ph = jnp.maximum(prior[:, 3] - prior[:, 1], 1e-8)
+
+    def one(loc_i, conf_i, gb_i, gl_i, glen_i):
+        gmask = jnp.arange(g) < glen_i                         # [G]
+        iou = _pairwise_iou(gb_i, prior)                       # [G, P]
+        iou = jnp.where(gmask[:, None], iou, -1.0)
+
+        # per-gt greedy bipartite: each live gt claims its best prior.
+        # Unlike the generic bipartite_match op (which accepts any
+        # best-distance including 0), a claim here requires IoU > 0 —
+        # a gt with no overlapping prior trains only the conf head.
+        NEG = jnp.float32(-1e30)
+
+        def claim(state, _):
+            d, match = state
+            flat = jnp.argmax(d)
+            r, c = flat // p, flat % p
+            live = d[r, c] > 0
+            match = jnp.where(live, match.at[c].set(r), match)
+            d = jnp.where(live, d.at[r, :].set(NEG).at[:, c].set(NEG), d)
+            return (d, match), None
+
+        (_, match), _ = jax.lax.scan(
+            claim, (iou, jnp.full((p,), -1, jnp.int32)), None,
+            length=min(g, p))
+        # top-up: unmatched priors take their best gt at IoU >= thresh
+        best_gt = jnp.argmax(iou, axis=0).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=0)
+        match = jnp.where((match < 0) & (best_iou >= thresh), best_gt,
+                          match)
+        pos = match >= 0                                       # [P]
+        npos = jnp.sum(pos)
+
+        midx = jnp.clip(match, 0, g - 1)
+        mb = gb_i[midx]                                        # [P, 4]
+        gcx = (mb[:, 0] + mb[:, 2]) / 2
+        gcy = (mb[:, 1] + mb[:, 3]) / 2
+        gw = jnp.maximum(mb[:, 2] - mb[:, 0], 1e-8)
+        gh = jnp.maximum(mb[:, 3] - mb[:, 1], 1e-8)
+        tgt = jnp.stack([
+            (gcx - pcx) / pw / prior_var[:, 0],
+            (gcy - pcy) / ph / prior_var[:, 1],
+            jnp.log(gw / pw) / prior_var[:, 2],
+            jnp.log(gh / ph) / prior_var[:, 3]], axis=-1)      # [P, 4]
+        diff = loc_i - jax.lax.stop_gradient(tgt)
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(-1)
+        loc_loss = jnp.sum(jnp.where(pos, sl1, 0.0))
+
+        # conf CE per prior: matched gt's label, else background
+        lbl = jnp.where(pos, gl_i[midx], bg)                   # [P]
+        logz = jax.nn.logsumexp(conf_i, axis=-1)
+        ce = logz - jnp.take_along_axis(
+            conf_i, lbl[:, None], axis=-1)[:, 0]               # [P]
+        # hard negative mining: top (neg_ratio * npos) negatives by CE
+        neg_ce = jnp.where(pos, -1.0, ce)
+        order = jnp.argsort(-neg_ce)
+        rank = jnp.argsort(order)
+        n_neg = jnp.minimum(
+            (neg_ratio * npos).astype(jnp.int32), jnp.sum(~pos))
+        neg_keep = (~pos) & (rank < n_neg)
+        conf_loss = jnp.sum(jnp.where(pos | neg_keep, ce, 0.0))
+        denom = jnp.maximum(npos.astype(jnp.float32), 1.0)
+        return (loc_loss + conf_loss) / denom
+
+    out = jax.vmap(one)(loc, conf, gb, gl, g_len)
+    return out.reshape(b, 1)

@@ -33,7 +33,7 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "sum_to_one_norm", "l2_distance", "scale_shift", "prelu",
            "factorization_machine", "huber_regression_cost",
            "huber_classification_cost", "repeat", "power", "out_prod",
-           "gated_unit", "lambda_cost"]
+           "gated_unit", "lambda_cost", "multibox_loss"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -941,6 +941,33 @@ def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
     ``max_sort_size`` is accepted for signature parity (the full sort is
     always used — it was a CPU-time knob in the reference)."""
     cost = flayers.lambda_rank_cost(input, score, ndcg_num=int(NDCG_num))
+    out = flayers.mean(cost)
+    _register_named_output(name, out)
+    return out
+
+
+def multibox_loss(input_loc, input_conf, priorbox, gt_box, gt_label,
+                  num_classes=None, overlap_threshold=0.5,
+                  neg_pos_ratio=3.0, background_id=0, name=None, **kw):
+    """SSD MultiBox training loss (reference layers.py
+    multibox_loss_layer:1232, gserver MultiBoxLossLayer).  ``input_loc``
+    [B,P,4] offsets, ``input_conf`` [B,P,C] logits, ``priorbox`` the
+    (boxes, variances) pair from fluid prior_box, ``gt_box``/``gt_label``
+    ground-truth sequences (the reference packed both into one label
+    layer; here they are explicit).  ``num_classes`` is validated
+    against the confidence head's class dim when both are static.
+    Mean per-image loss."""
+    conf_c = (input_conf.shape or [None])[-1]
+    if num_classes is not None and conf_c and conf_c > 0 \
+            and int(conf_c) != int(num_classes):
+        raise ValueError(
+            f"multibox_loss: num_classes={num_classes} != confidence "
+            f"head's class dim {conf_c}")
+    cost = flayers.ssd_loss(input_loc, input_conf, gt_box, gt_label,
+                            priorbox,
+                            overlap_threshold=overlap_threshold,
+                            neg_pos_ratio=neg_pos_ratio,
+                            background_label=background_id)
     out = flayers.mean(cost)
     _register_named_output(name, out)
     return out

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from op_test import OpTestCase
+from paddle_tpu import fluid
 from paddle_tpu.fluid import make_seq
 
 R = np.random.RandomState(11)
@@ -205,3 +206,102 @@ def test_dropout_hash_statistics(fresh_programs):
         assert abs(arr.mean() - 1.0) < 0.02
     # two dropout OPS in one step must not share a mask
     assert not np.array_equal(a == 0, b == 0)
+
+
+def test_ssd_loss_matching_and_mining(fresh_programs):
+    """ssd_loss (reference MultiBoxLossLayer): a prior exactly on a gt
+    box with the right class and perfect offsets gives near-zero loc
+    loss and only mined-negative conf loss; shifting the prediction
+    raises the loss; a no-gt image contributes only background conf
+    loss (denom clamps at 1)."""
+    main, startup, scope = fresh_programs
+    P, C, G = 4, 3, 2
+    loc = fluid.layers.data("loc", [P, 4], "float32")
+    conf = fluid.layers.data("conf", [P, C], "float32")
+    gtb = fluid.layers.data("gtb", [4], "float32", lod_level=1)
+    gtl = fluid.layers.data("gtl", [1], "int64", lod_level=1)
+    pb = fluid.layers.data("pb", [4], "float32")
+    pv = fluid.layers.data("pv", [4], "float32")
+    # feed priors as plain dense vars through the (boxes, var) pair
+    cost = fluid.layers.ssd_loss(loc, conf, gtb, gtl, (pb, pv),
+                                 overlap_threshold=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    priors = np.array([[0.0, 0.0, 0.4, 0.4],
+                       [0.5, 0.5, 0.9, 0.9],
+                       [0.1, 0.5, 0.5, 0.9],
+                       [0.6, 0.0, 1.0, 0.4]], np.float32)
+    pvars = np.full((4, 4), 0.1, np.float32)
+    # image 0: one gt exactly on prior 0, class 1; image 1: no gt
+    gt_boxes = [np.array([[0.0, 0.0, 0.4, 0.4]], np.float32),
+                np.zeros((0, 4), np.float32)]
+    gt_labels = [np.array([[1]], np.int64),
+                 np.zeros((0, 1), np.int64)]
+    # perfect prediction for prior 0: offsets 0; high conf class 1 for
+    # prior 0, high background conf elsewhere
+    loc_v = np.zeros((2, P, 4), np.float32)
+    conf_v = np.zeros((2, P, C), np.float32)
+    conf_v[0, 0, 1] = 6.0
+    conf_v[:, 1:, 0] = 6.0
+    conf_v[1, :, 0] = 6.0
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"loc": loc_v, "conf": conf_v,
+                "gtb": make_seq(gt_boxes, max_len=G),
+                "gtl": make_seq(gt_labels, dtype=np.int64, max_len=G),
+                "pb": priors, "pv": pvars}
+        c0, = exe.run(main, feed=feed, fetch_list=[cost])
+        # shift prior-0's predicted offsets away from the target
+        loc_bad = loc_v.copy()
+        loc_bad[0, 0] = 3.0
+        feed_bad = dict(feed, loc=loc_bad)
+        c1, = exe.run(main, feed=feed_bad, fetch_list=[cost])
+    c0, c1 = np.asarray(c0), np.asarray(c1)
+    assert c0.shape == (2, 1)
+    # perfect match: tiny loss (only the mined negatives' small CE)
+    assert 0.0 < c0[0, 0] < 0.2, c0
+    # the no-gt image: finite small background-only loss
+    assert 0.0 <= c0[1, 0] < 0.2, c0
+    # worse localisation strictly increases image-0 loss
+    assert c1[0, 0] > c0[0, 0] + 1.0, (c0, c1)
+
+
+def test_ssd_loss_trains_from_prior_box(fresh_programs):
+    """The documented prior_box -> ssd_loss path end-to-end: loc/conf
+    heads are fc layers, priors come from the REAL prior_box op (4-d
+    [fh, fw, n, 4] output), and minimizing the mean loss decreases it —
+    gradients flow to both heads."""
+    main, startup, scope = fresh_programs
+    img = fluid.layers.data("img", [3, 8, 8], "float32")
+    feat = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                               padding=1, act="relu")        # [B,4,8,8]
+    pbv = fluid.layers.prior_box(feat, img, min_sizes=[2.0],
+                                 aspect_ratios=[1.0])
+    # flatten the feature map into per-prior heads
+    flat = fluid.layers.reshape(feat, [-1, 4 * 8 * 8])
+    P = 8 * 8  # one prior per cell with a single size/ratio
+    loc = fluid.layers.reshape(
+        fluid.layers.fc(flat, size=P * 4), [-1, P, 4])
+    conf = fluid.layers.reshape(
+        fluid.layers.fc(flat, size=P * 3), [-1, P, 3])
+    gtb = fluid.layers.data("gtb", [4], "float32", lod_level=1)
+    gtl = fluid.layers.data("gtl", [1], "int64", lod_level=1)
+    cost = fluid.layers.mean(
+        fluid.layers.ssd_loss(loc, conf, gtb, gtl, pbv))
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(2, 3, 8, 8).astype(np.float32),
+            "gtb": make_seq([np.array([[0.1, 0.1, 0.4, 0.4]], np.float32),
+                             np.array([[0.5, 0.5, 0.9, 0.9],
+                                       [0.0, 0.6, 0.3, 0.95]],
+                                      np.float32)]),
+            "gtl": make_seq([np.array([[1]], np.int64),
+                             np.array([[2], [1]], np.int64)],
+                            dtype=np.int64)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[cost])[0]))
+                for _ in range(25)]
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0] * 0.8, (vals[0], vals[-1])
