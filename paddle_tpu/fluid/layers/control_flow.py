@@ -362,9 +362,12 @@ class _RNNBuilder:
         else:
             shape = list(x.shape or [])
             inner_shape = [shape[0]] + shape[2:]  # drop the time axis
+        # a nested (level-2) input steps its OUTER axis: each step sees one
+        # sub-sequence, i.e. a level-1 sequence (SubsequenceInput semantics)
+        inner_lod = max((x.lod_level or 0) - 1, 0)
         inner = self.sub_block.create_var(
             name=unique_name.generate(f"{self.helper.name}.step_in"),
-            dtype=x.dtype, shape=inner_shape)
+            dtype=x.dtype, shape=inner_shape, lod_level=inner_lod)
         self.step_inputs.append((x, inner))
         return inner
 
@@ -435,7 +438,8 @@ class _RNNBuilder:
         for o in self.outputs_inner:
             oshape = list(o.shape or [])
             if seq:
-                outer_shape, lod = oshape, 1
+                # a sequence-valued step output stacks to a nested sequence
+                outer_shape, lod = oshape, 1 + (o.lod_level or 0)
             else:
                 outer_shape = [oshape[0] if oshape else -1, t_dim] + oshape[1:]
                 lod = 0

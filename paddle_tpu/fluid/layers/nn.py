@@ -28,7 +28,8 @@ __all__ = [
     "bipartite_match", "multiclass_nms", "max_pool2d_with_index",
     "fused_vocab_cross_entropy", "maxout", "squeeze", "unsqueeze",
     "hsigmoid", "sampling_id", "bilinear_interp", "prelu",
-    "ssd_loss",
+    "ssd_loss", "conv3d", "pool3d", "selective_fc", "scale_sub_region",
+    "cross_entropy_with_selfnorm", "cross_entropy_over_beam",
 ]
 
 
@@ -627,6 +628,122 @@ def _pair(x):
     if isinstance(x, (list, tuple)):
         return list(x)
     return [x, x]
+
+
+def _triple(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x, x, x]
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, groups=1,
+           dilation=1, param_attr=None, bias_attr=None, act=None,
+           name=None):
+    """3-D convolution (NCDHW) — capability of the reference's
+    Conv3DLayer.cpp / DSL img_conv3d_layer; one lax.conv_general_dilated
+    (see ops/nn_ops.py conv3d)."""
+    from ..initializer import NormalInitializer
+
+    helper = LayerHelper("conv3d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    stride, padding = _triple(stride), _triple(padding)
+    dilation, fsize = _triple(dilation), _triple(filter_size)
+    num_channels = input.shape[1]
+    filter_shape = [num_filters, num_channels // groups] + list(fsize)
+    import numpy as np
+
+    std = (2.0 / (np.prod(fsize) * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, float(std)))
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op("conv3d", {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": dilation, "groups": groups})
+    pre_act = _append_channel_bias(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None):
+    """3-D pooling (NCDHW) — reference Pool3DLayer.cpp / DSL
+    img_pool3d_layer."""
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("pool3d", {"X": input}, {"Out": out},
+                     {"pooling_type": pool_type,
+                      "ksize": _triple(pool_size),
+                      "strides": _triple(pool_stride),
+                      "paddings": _triple(pool_padding),
+                      "global_pooling": global_pooling,
+                      "ceil_mode": ceil_mode})
+    return out
+
+
+def selective_fc(input, size, select=None, act=None, param_attr=None,
+                 bias_attr=None, name=None):
+    """Selective fc — reference SelectiveFullyConnectedLayer.cpp / DSL
+    selective_fc_layer: with ``select`` ([B, k] column ids, -1 padded)
+    only the selected output columns are computed ([B, k] dense);
+    without it this is exactly ``fc``."""
+    if select is None:
+        return fc(input, size, act=act, param_attr=param_attr,
+                  bias_attr=bias_attr, name=name)
+    helper = LayerHelper("selective_fc", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    in_features = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[in_features, size], dtype=dtype)
+    inputs = {"X": input, "W": w, "Select": select}
+    if helper.bias_attr is not None:
+        inputs["Bias"] = helper.create_parameter(
+            helper.bias_attr, shape=[size], dtype=dtype, is_bias=True)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("selective_fc", inputs, {"Out": out})
+    return helper.append_activation(out)
+
+
+def scale_sub_region(input, indices, value, name=None):
+    """Scale a per-sample CHW sub-region by ``value`` — reference
+    function/ScaleSubRegionOp.cpp / DSL scale_sub_region_layer.
+    ``indices`` [B, 6] 1-based inclusive [c0, c1, h0, h1, w0, w1]."""
+    helper = LayerHelper("scale_sub_region", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("scale_sub_region",
+                     {"X": input, "Indices": indices}, {"Out": out},
+                     {"value": float(value)})
+    return out
+
+
+def cross_entropy_over_beam(beams, name=None):
+    """Learning-to-search beam cost (reference CrossEntropyOverBeam.cpp;
+    see ops/loss_ops.py for the math).  ``beams`` is a list of
+    (candidate_scores, selected_ids, gold) triples, one per beam
+    expansion -> [B, 1] per-sequence cost."""
+    helper = LayerHelper("cross_entropy_over_beam", name=name)
+    out = helper.create_tmp_variable("float32")
+    helper.append_op("cross_entropy_over_beam",
+                     {"Scores": [b[0] for b in beams],
+                      "Ids": [b[1] for b in beams],
+                      "Gold": [b[2] for b in beams]},
+                     {"Out": out})
+    return out
+
+
+def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha=0.1,
+                                name=None):
+    """Self-normalized CE on unnormalized positive scores — reference
+    CostLayer.cpp:113 (see ops/loss_ops.py) -> [B, 1] per-row cost."""
+    helper = LayerHelper("cross_entropy_with_selfnorm", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("cross_entropy_with_selfnorm",
+                     {"X": input, "Label": label}, {"Out": out},
+                     {"softmax_selfnorm_alpha": float(softmax_selfnorm_alpha)})
+    return out
 
 
 def _append_channel_bias(helper, pre_bias):

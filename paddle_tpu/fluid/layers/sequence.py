@@ -14,7 +14,8 @@ __all__ = ["linear_chain_crf", "crf_decoding",
            "sequence_last_step", "sequence_expand", "sequence_concat",
            "sequence_reshape", "sequence_slice", "sequence_erase",
            "sequence_mask", "sequence_pad", "warpctc", "edit_distance",
-           "ctc_align", "ctc_greedy_decoder", "lambda_rank_cost"]
+           "ctc_align", "ctc_greedy_decoder", "lambda_rank_cost",
+           "kmax_seq_score", "sub_nested_seq"]
 
 
 def warpctc(input, label, blank=0, norm_by_times=False, name=None):
@@ -223,6 +224,34 @@ def sequence_pad(x, name=None):
     mask = helper.create_tmp_variable(x.dtype, stop_gradient=True)
     helper.append_op("sequence_pad", {"X": x}, {"Out": out, "Mask": mask})
     return out, mask
+
+
+def kmax_seq_score(input, beam_size=1, name=None):
+    """Top-``beam_size`` positions per (sub-)sequence of width-1 scores,
+    -1 padded — reference KmaxSeqScoreLayer.cpp / DSL
+    kmax_sequence_score_layer.  Level-1 input -> dense [B, beam];
+    nested input -> sequence over the outer axis (one row per
+    sub-sequence, matching the reference's numSubSequences rows)."""
+    helper = LayerHelper("kmax_seq_score", name=name)
+    out = helper.create_tmp_variable(
+        "float32", lod_level=max(input.lod_level - 1, 0),
+        stop_gradient=True)
+    helper.append_op("kmax_seq_score", {"X": input}, {"Out": out},
+                     {"beam_size": int(beam_size)})
+    return out
+
+
+def sub_nested_seq(input, selected_indices, name=None):
+    """Select whole sub-sequences of a nested sequence by per-row index
+    lists ([B, k], -1 ends a row) — reference
+    SubNestedSequenceLayer.cpp / DSL sub_nested_seq_layer.  Output keeps
+    lod_level 2 (the selected sub-sequences, reindexed)."""
+    helper = LayerHelper("sub_nested_seq", name=name)
+    out = helper.create_tmp_variable(input.dtype, lod_level=2)
+    helper.append_op("sub_nested_seq",
+                     {"X": input, "Selection": selected_indices},
+                     {"Out": out})
+    return out
 
 
 def lambda_rank_cost(score, label, ndcg_num=5, name=None):

@@ -305,19 +305,35 @@ def _recurrent_common(ctx, ins, masked: bool):
     auto_init = op.attr("auto_init_states", [])  # specs for zero-init states
     reverse = bool(op.attr("is_reverse", False))
 
+    from ..core.lod import NestedSeqArray
+
     xs = ins.get("X", [])
     p_env = dict(zip(op.input("P"), ins.get("P", [])))
     lengths = None
     datas = []
     for x in xs:
-        if isinstance(x, SeqArray):
+        if isinstance(x, NestedSeqArray):
+            # SubsequenceInput (reference RecurrentGradientMachine's
+            # recurrent-over-subsequences): the scan steps the OUTER axis
+            # and each step sees one whole sub-sequence as a level-1
+            # SeqArray.  lax.scan slices pytrees leaf-wise, so a SeqArray
+            # whose leaves lead with the outer axis ([N,B,M,*f] data,
+            # [N,B] lengths) is sliced to exactly the per-step SeqArray.
+            lengths = x.outer_lengths if lengths is None else lengths
+            datas.append(SeqArray(jnp.swapaxes(x.data, 0, 1),
+                                  jnp.swapaxes(x.inner_lengths, 0, 1)))
+        elif isinstance(x, SeqArray):
             lengths = x.lengths if lengths is None else lengths
             datas.append(jnp.swapaxes(x.data, 0, 1))      # [T, B, ...]
         else:
             datas.append(jnp.swapaxes(x, 0, 1))
-    T, batch = datas[0].shape[0], datas[0].shape[1]
-    dtype = datas[0].dtype if jnp.issubdtype(datas[0].dtype, jnp.floating) \
-        else jnp.float32
+
+    def _lead(d):
+        return d.data if isinstance(d, SeqArray) else d
+
+    T, batch = _lead(datas[0]).shape[0], _lead(datas[0]).shape[1]
+    d0 = _lead(datas[0]).dtype
+    dtype = d0 if jnp.issubdtype(d0, jnp.floating) else jnp.float32
 
     inits = list(ins.get("InitStates", []))
     carries = []
@@ -331,12 +347,14 @@ def _recurrent_common(ctx, ins, masked: bool):
     carries = tuple(carries)
 
     if masked and lengths is not None:
-        mask = jnp.swapaxes(SeqArray(datas[0].swapaxes(0, 1),
-                                     lengths).mask(dtype), 0, 1)  # [T, B]
+        from ..core.lod import seq_mask
+
+        mask = jnp.swapaxes(seq_mask(lengths, T).astype(dtype), 0, 1)  # [T,B]
     else:
         mask = jnp.ones((T, batch), dtype)
     if reverse:
-        datas = [d[::-1] for d in datas]
+        datas = [jax.tree_util.tree_map(lambda d: d[::-1], d)
+                 if isinstance(d, SeqArray) else d[::-1] for d in datas]
         mask = mask[::-1]
 
     def step(carry, slices):
@@ -353,13 +371,33 @@ def _recurrent_common(ctx, ins, masked: bool):
                 for n, o in zip(new_carry, carry))
         outs = tuple(env[n] for n in out_names)
         if masked:
-            outs = tuple(o * mt.reshape((-1,) + (1,) * (o.ndim - 1))
-                         for o in outs)
+            def _m(o):
+                if isinstance(o, SeqArray):   # per-step sequence output
+                    return SeqArray(
+                        o.data * mt.reshape((-1,) + (1,) * (o.data.ndim - 1)),
+                        (o.lengths * mt.astype(o.lengths.dtype)).astype(
+                            o.lengths.dtype))
+                return o * mt.reshape((-1,) + (1,) * (o.ndim - 1))
+            outs = tuple(_m(o) for o in outs)
         return new_carry, outs
 
     final, outs = jax.lax.scan(step, carries, (tuple(datas), mask))
     stacked = []
     for o in outs:
+        if isinstance(o, SeqArray):
+            # per-step sequence outputs stack to a nested sequence:
+            # leaves carry [T, B, ...]; reattach outer structure
+            from ..core.lod import NestedSeqArray
+
+            od, ol = o.data, o.lengths
+            if reverse:
+                od, ol = od[::-1], ol[::-1]
+            outer = lengths if lengths is not None else jnp.full(
+                (batch,), T, jnp.int32)   # unmasked: every step is valid
+            stacked.append(NestedSeqArray(
+                jnp.swapaxes(od, 0, 1), outer,
+                jnp.swapaxes(ol, 0, 1)))
+            continue
         o = o[::-1] if reverse else o
         o = jnp.swapaxes(o, 0, 1)                 # [B, T, ...]
         stacked.append(SeqArray(o, lengths) if (masked and lengths is not None)

@@ -60,6 +60,143 @@ def softmax_with_cross_entropy(ctx, logits, label):
     return jnp.exp(logp), loss
 
 
+@primitive("cross_entropy_with_selfnorm", inputs=["X", "Label"],
+           stop_grad_slots=("Label",))
+def cross_entropy_with_selfnorm(ctx, x, label):
+    """Self-normalized CE — reference gserver/layers/CostLayer.cpp:113
+    (MultiClassCrossEntropyWithSelfNorm, DSL cross_entropy_with_selfnorm):
+    X holds UNNORMALIZED positive scores; per row,
+    -log x[label] + log Z + alpha*log(Z)^2 with Z = rowsum(X).  The alpha
+    term drives Z toward 1 so serving can skip the normalization.  jax's
+    gradient equals the hand-written backwardImp (:127): onehot(-1/x_l)
+    + (1 + 2*alpha*logZ)/Z."""
+    alpha = ctx.attr("softmax_selfnorm_alpha", 0.1)
+    z = x.sum(axis=-1, keepdims=True)
+    logz = jnp.log(jnp.clip(z, 1e-8, None))
+    picked = jnp.take_along_axis(
+        x, label.reshape(x.shape[0], 1).astype(jnp.int32), axis=-1)
+    return (-jnp.log(jnp.clip(picked, 1e-8, None)) + logz
+            + alpha * logz * logz)
+
+
+@primitive("cross_entropy_over_beam", inputs=["Scores*", "Ids*", "Gold*"],
+           outputs=["Out"], stop_grad_slots=("Ids", "Gold"))
+def cross_entropy_over_beam(ctx, scores, ids, gold):
+    """Learning-to-search beam cost — reference
+    gserver/layers/CrossEntropyOverBeam.cpp (DSL
+    cross_entropy_over_beam:6386): a multi-step beam search produces E
+    expansions; every surviving path's score is the sum of its selected
+    candidate scores along the chain; the loss is -log softmax(gold path)
+    over ALL paths of the last VALID expansion (the first step where the
+    gold candidate falls off the beam ends the expansion; a fallen-off
+    gold joins as an extra path — CostForOneSequence::calValidExpandStep
+    / globallyNormalizedScore).
+
+    Per expansion i (batch-leading dense forms; step 0 has one row):
+      Scores[i]  [B, R_i, C_i]  candidate scores per surviving row
+      Ids[i]     [B, R_i, K_i]  top-k selected candidate ids, -1 padded
+      Gold[i]    [B]            gold candidate id within the gold row
+    Rows of expansion i+1 are expansion i's live selections in flat
+    row-major order, compacted — where the reference enumerates paths on
+    the host per sequence, here dead slots simply carry -inf into one
+    masked softmax (identical distribution, no compaction), and the
+    data-dependent valid-expansion cut selects between E statically
+    computed candidates.  Gradients reach Scores through the score
+    gathers — jax's take-vjp scatter-add is the reference's addToRows
+    backward."""
+    from ..core.lod import NestedSeqArray, SeqArray
+
+    E = len(scores)
+    assert E and len(ids) == E and len(gold) == E, \
+        "cross_entropy_over_beam: Scores/Ids/Gold must align per expansion"
+    sc, idl, gl = [], [], []
+    for i in range(E):
+        s = scores[i]
+        sd = s.data if isinstance(s, (SeqArray, NestedSeqArray)) else s
+        if sd.ndim > 2 and sd.shape[-1] == 1:
+            sd = sd[..., 0]                      # width-1 score columns
+        if sd.ndim == 2:
+            sd = sd[:, None, :]                  # step 0: one row
+        sc.append(sd.astype(jnp.float32))
+        d = ids[i]
+        dd = d.data if isinstance(d, (SeqArray, NestedSeqArray)) else d
+        if dd.ndim == 2:
+            dd = dd[:, None, :]
+        idl.append(dd)
+        g = gold[i]
+        gd = g.data if isinstance(g, (SeqArray, NestedSeqArray)) else g
+        gl.append(gd.reshape(gd.shape[0]))
+
+    NEG = jnp.float32(-1e30)
+
+    def one_seq(sc, idl, gl):
+        # --- gold tracking through the expansions (calValidExpandStep)
+        gr = jnp.int32(0)
+        found_l, grow_l, gcol_l = [], [], []
+        for i in range(E):
+            R, K = idl[i].shape
+            row_ids = jnp.take(idl[i], gr, axis=0)            # [K]
+            eq = row_ids == gl[i].astype(row_ids.dtype)
+            fnd = eq.any()
+            gc = jnp.where(fnd, jnp.argmax(eq), 0).astype(jnp.int32)
+            grow_l.append(gr)
+            found_l.append(fnd)
+            gcol_l.append(gc)
+            live = idl[i].reshape(-1) >= 0
+            flatpos = gr * K + gc
+            gr = jnp.where(
+                fnd,
+                (live & (jnp.arange(R * K) < flatpos)).sum().astype(
+                    jnp.int32),
+                gr)
+        found = jnp.stack(found_l)
+        miss = ~found
+        f = jnp.where(miss.any(), jnp.argmax(miss), E - 1).astype(jnp.int32)
+
+        # --- cost for each candidate final expansion, select by f
+        costs = []
+        for f0 in range(E):
+            R, K = idl[f0].shape
+            flat = idl[f0].reshape(-1)
+            alive = flat >= 0
+            c = jnp.clip(flat.astype(jnp.int32), 0, sc[f0].shape[1] - 1)
+            row = (jnp.arange(R * K) // K).astype(jnp.int32)
+            total = sc[f0][row, c]                            # [R*K]
+            for i in range(f0 - 1, -1, -1):
+                Ri, Ki = idl[i].shape
+                flat_i = idl[i].reshape(-1)
+                live_i = flat_i >= 0
+                nrows = idl[i + 1].shape[0]
+                compact = jnp.cumsum(live_i) - 1
+                tgt = jnp.where(live_i & (compact < nrows), compact, nrows)
+                pos_of = jnp.zeros((nrows + 1,), jnp.int32).at[tgt].set(
+                    jnp.arange(Ri * Ki, dtype=jnp.int32), mode="drop")
+                s_flat = pos_of[jnp.clip(row, 0, nrows)]
+                ci = jnp.clip(flat_i[s_flat].astype(jnp.int32), 0,
+                              sc[i].shape[1] - 1)
+                total = total + sc[i][s_flat // Ki, ci]
+                row = (s_flat // Ki).astype(jnp.int32)
+            gscore = jnp.float32(0.0)
+            for i in range(f0 + 1):
+                gscore = gscore + sc[i][
+                    grow_l[i],
+                    jnp.clip(gl[i].astype(jnp.int32), 0,
+                             sc[i].shape[1] - 1)]
+            goldflat = grow_l[f0] * K + gcol_l[f0]
+            extra = ~found_l[f0]
+            logits = jnp.concatenate(
+                [jnp.where(alive, total, NEG),
+                 jnp.where(extra, gscore, NEG).reshape(1)])
+            lse = jax.scipy.special.logsumexp(logits)
+            gold_logit = jnp.where(found_l[f0],
+                                   jnp.take(total, goldflat), gscore)
+            costs.append(lse - gold_logit)
+        return jnp.take(jnp.stack(costs), f)
+
+    cost = jax.vmap(one_seq)(tuple(sc), tuple(idl), tuple(gl))
+    return cost.reshape(-1, 1)
+
+
 @primitive("sigmoid_cross_entropy_with_logits", inputs=["X", "Label"],
            stop_grad_slots=("Label",), seq_transparent=True)
 def sigmoid_ce_logits(ctx, x, label):

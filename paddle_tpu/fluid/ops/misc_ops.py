@@ -40,6 +40,51 @@ def crop(ctx, x, y):
                          [o + s for o, s in zip(offsets, shape)])
 
 
+@primitive("scale_sub_region", inputs=["X", "Indices"],
+           stop_grad_slots=("Indices",))
+def scale_sub_region(ctx, x, indices):
+    """reference function/ScaleSubRegionOp.cpp (DSL
+    scale_sub_region_layer): multiply a per-sample continuous CHW
+    sub-region by ``value``.  Indices [b, 6] = 1-based INCLUSIVE
+    [c0, c1, h0, h1, w0, w1].  The hand-written backward scales region
+    grads by value — jax's where-gradient is identical."""
+    value = ctx.attr("value", 1.0)
+    ind = indices.reshape(x.shape[0], 6).astype(jnp.int32)
+    mask = None
+    for axis, (lo, hi) in enumerate([(0, 1), (2, 3), (4, 5)]):
+        n = x.shape[axis + 1]
+        pos = jnp.arange(n, dtype=jnp.int32).reshape(
+            (1,) + (1,) * axis + (n,) + (1,) * (2 - axis))
+        inside = (pos >= (ind[:, lo] - 1).reshape(-1, 1, 1, 1)) & \
+                 (pos <= (ind[:, hi] - 1).reshape(-1, 1, 1, 1))
+        mask = inside if mask is None else (mask & inside)
+    return jnp.where(mask, x * jnp.asarray(value, x.dtype), x)
+
+
+@primitive("selective_fc", inputs=["X", "W", "Select", "Bias?"],
+           stop_grad_slots=("Select",))
+def selective_fc(ctx, x, w, sel, bias):
+    """reference gserver/layers/SelectiveFullyConnectedLayer.cpp: an fc
+    whose output is computed only at per-row selected columns —
+    out[b, k] = x[b]·W[:, sel[b, k]] (+ bias[sel[b, k]]), -1 slots -> 0.
+    The reference materializes a sparse row matrix; here the selected
+    weight columns are gathered densely ([b, k, in]) and contracted on
+    the MXU — the grad's take-vjp scatter-adds onto W exactly like the
+    reference's sparse update."""
+    sel_i = (sel.data if isinstance(sel, SeqArray) else sel)
+    sel_i = jnp.asarray(sel_i).reshape(x.shape[0], -1).astype(jnp.int32)
+    valid = sel_i >= 0
+    idx = jnp.clip(sel_i, 0, w.shape[1] - 1)
+    wsel = jnp.take(w.T, idx, axis=0)                # [b, k, in]
+    out = jnp.einsum("bi,bki->bk", x, wsel,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        # f32 master bias + bf16 activation stays the activation dtype
+        # (the shared AMP rule, cf. math_ops.match_master_dtype)
+        out = (out + jnp.take(bias.reshape(-1), idx)).astype(x.dtype)
+    return jnp.where(valid, out, 0.0)
+
+
 @primitive("lod_reset", inputs=["X", "Y?"])
 def lod_reset(ctx, x, y):
     """reference lod_reset_op.cc: replace a sequence batch's lengths —

@@ -82,29 +82,98 @@ def conv2d_transpose(ctx, x, w):
         preferred_element_type=_conv_pet(x)).astype(x.dtype)
 
 
+@primitive("conv3d", inputs=["Input", "Filter"], outputs=["Output"])
+def conv3d(ctx, x, w):
+    """NCDHW 3-D conv — capability of the reference's Conv3DLayer.cpp /
+    DSL img_conv3d_layer (filter layout OIDHW).  One
+    lax.conv_general_dilated call; XLA tiles 3-D convs onto the MXU the
+    same way it does 2-D."""
+    w = _match_conv_dtype(x, w)
+    strides = tuple(ctx.attr("strides", [1, 1, 1]))
+    p = ctx.attr("paddings", [0, 0, 0])
+    dil = tuple(ctx.attr("dilations", [1, 1, 1]))
+    groups = ctx.attr("groups", 1)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pi, pi) for pi in p],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        preferred_element_type=_conv_pet(x)).astype(x.dtype)
+
+
+def _ceil_extra_pad(in_size, k, s, p, ceil_mode):
+    """End-padding beyond ``p`` so the last (partial) window is kept when
+    ceil_mode — reference pooling's ceil output-shape rule."""
+    if not ceil_mode:
+        return 0
+    out = -((in_size + 2 * p - k) // -s) + 1          # ceil div
+    return max((out - 1) * s + k - (in_size + 2 * p), 0)
+
+
+@primitive("pool3d")
+def pool3d(ctx, x):
+    """NCDHW 3-D pooling — reference Pool3DLayer.cpp / DSL
+    img_pool3d_layer.  Average pooling uses exclusive counts like
+    pool2d; ceil_mode keeps the trailing partial window (the
+    img_pool3d_layer default)."""
+    ptype = ctx.attr("pooling_type", "max")
+    ceil_mode = ctx.attr("ceil_mode", False)
+    if ctx.attr("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides, pads = ksize, [0, 0, 0]
+        ceil_mode = False
+    else:
+        ksize = ctx.attr("ksize", [2, 2, 2])
+        strides = ctx.attr("strides", [2, 2, 2])
+        pads = ctx.attr("paddings", [0, 0, 0])
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple(
+        (pi, pi + _ceil_extra_pad(x.shape[i + 2], ksize[i], strides[i],
+                                  pi, ceil_mode))
+        for i, pi in enumerate(pads))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                     strides5, padding)
+    total = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides5,
+                                  padding)
+    if not any(pads) and not ceil_mode:
+        return total / float(np.prod(ksize))
+    count = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                  window, strides5, padding)
+    return total / count
+
+
 @primitive("pool2d")
 def pool2d(ctx, x):
     """reference pool_op.cc (operators/math/pooling.cc).  Average pooling
     uses exclusive counts (padding excluded), matching the reference."""
     ptype = ctx.attr("pooling_type", "max")
+    ceil_mode = ctx.attr("ceil_mode", False)
     if ctx.attr("global_pooling", False):
         ksize = [x.shape[2], x.shape[3]]
         strides = ksize
         pads = [0, 0]
+        ceil_mode = False
     else:
         ksize = ctx.attr("ksize", [2, 2])
         strides = ctx.attr("strides", [2, 2])
         pads = ctx.attr("paddings", [0, 0])
     window = (1, 1, ksize[0], ksize[1])
     strides4 = (1, 1, strides[0], strides[1])
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    padding = ((0, 0), (0, 0)) + tuple(
+        (pi, pi + _ceil_extra_pad(x.shape[i + 2], ksize[i], strides[i],
+                                  pi, ceil_mode))
+        for i, pi in enumerate(pads))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
                                      padding)
     total = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4,
                                   padding)
-    if pads[0] == 0 and pads[1] == 0:
+    if pads[0] == 0 and pads[1] == 0 and not ceil_mode:
         return total / (ksize[0] * ksize[1])
     ones = jnp.ones_like(x)
     count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4,

@@ -277,6 +277,78 @@ def sequence_mask_op(ctx, lengths):
         ctx.attr("out_dtype", "float32"))
 
 
+def _topk_indices(scores, lengths, beam):
+    """Top-``beam`` positions by score along the last axis, masked by
+    ``lengths`` (broadcast over scores[..., :]), -1 beyond each row's
+    min(beam, length).  Float output, matching the reference's
+    real-matrix index convention (KmaxSeqScoreLayer.cpp:104-116)."""
+    t = scores.shape[-1]
+    pos = jnp.arange(t, dtype=jnp.int32)
+    live = pos < lengths[..., None].astype(jnp.int32)
+    masked = jnp.where(live, scores.astype(jnp.float32), -jnp.inf)
+    k = min(beam, t)
+    _, idx = jax.lax.top_k(masked, k)
+    k_eff = jnp.minimum(beam, lengths.astype(jnp.int32))
+    rank = jnp.arange(k, dtype=jnp.int32)
+    out = jnp.where(rank < k_eff[..., None], idx.astype(jnp.float32), -1.0)
+    if beam > k:                      # more slots than timesteps: pad -1
+        pad = jnp.full(out.shape[:-1] + (beam - k,), -1.0, out.dtype)
+        out = jnp.concatenate([out, pad], axis=-1)
+    return out
+
+
+@primitive("kmax_seq_score", inputs=["X"], no_grad=True)
+def kmax_seq_score(ctx, x):
+    """reference gserver/layers/KmaxSeqScoreLayer.cpp (DSL
+    kmax_sequence_score_layer): scores over a sequence (width 1) ->
+    indices of the top beam_size positions per sequence, -1 padded past
+    min(beam, len).  Nested input scores each SUB-sequence (the
+    reference emits numSubSequences rows; here the rows ride a SeqArray
+    over the outer axis).  No gradient, like the reference."""
+    from ..core.lod import NestedSeqArray
+
+    beam = int(ctx.attr("beam_size", 1))
+    if isinstance(x, NestedSeqArray):
+        scores = x.data.reshape(x.data.shape[:3])        # [b, n, m]
+        out = _topk_indices(scores, x.inner_lengths, beam)
+        dead = ~x.outer_mask()                            # vacant outer rows
+        out = jnp.where(dead[..., None], -1.0, out)
+        return SeqArray(out, x.outer_lengths)
+    assert isinstance(x, SeqArray), "kmax_seq_score expects a sequence"
+    scores = x.data.reshape(x.data.shape[:2])             # [b, t]
+    return _topk_indices(scores, x.lengths, beam)
+
+
+@primitive("sub_nested_seq", inputs=["X", "Selection"],
+           stop_grad_slots=("Selection",))
+def sub_nested_seq(ctx, x, sel):
+    """reference gserver/layers/SubNestedSequenceLayer.cpp: select whole
+    sub-sequences of a nested sequence by per-row indices ([b, k], -1
+    terminates the row's selection, matching calSelectedRows' break).
+    Output keeps the nested structure: row i holds its selected
+    sub-sequences in selection order.  The backward scatters output
+    grads onto the selected rows (addToRows) — jnp.take_along_axis's
+    vjp is exactly that scatter-add."""
+    from ..core.lod import NestedSeqArray
+
+    assert isinstance(x, NestedSeqArray), \
+        "sub_nested_seq: first input must be a nested (level-2) sequence"
+    sel = (sel.data if isinstance(sel, SeqArray) else sel)
+    b, n = x.data.shape[0], x.data.shape[1]
+    sel = jnp.asarray(sel).reshape(b, -1).astype(jnp.int32)
+    # -1 ends the selection (reference breaks at the first -1)
+    valid = jnp.cumprod((sel >= 0).astype(jnp.int32), axis=1).astype(bool)
+    idx = jnp.clip(sel, 0, n - 1)
+    gathered = jnp.take_along_axis(
+        x.data, idx.reshape(b, -1, *(1,) * (x.data.ndim - 2)), axis=1)
+    vmask = valid.reshape(b, -1, *(1,) * (x.data.ndim - 2))
+    inner = jnp.where(valid,
+                      jnp.take_along_axis(x.inner_lengths.astype(jnp.int32),
+                                          idx, axis=1), 0)
+    return NestedSeqArray(gathered * vmask.astype(gathered.dtype),
+                          valid.sum(axis=1).astype(jnp.int32), inner)
+
+
 @primitive("sequence_pad", inputs=["X"], outputs=["Out", "Mask"])
 def sequence_pad_op(ctx, x):
     """SeqArray -> (dense padded data [B, T, ...], float mask [B, T]).

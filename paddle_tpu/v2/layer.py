@@ -22,7 +22,8 @@ from .data_type import InputType
 __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "regression_cost", "cross_entropy_cost", "img_conv", "img_pool",
            "max_id", "concat", "dropout", "pool",
-           "recurrent_group", "memory", "StaticInput", "lstmemory",
+           "recurrent_group", "memory", "StaticInput", "SubsequenceInput",
+           "lstmemory",
            "grumemory", "last_seq", "first_seq",
            "beam_search", "GeneratedInput",
            "addto", "cos_sim", "seq_concat",
@@ -33,7 +34,11 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "sum_to_one_norm", "l2_distance", "scale_shift", "prelu",
            "factorization_machine", "huber_regression_cost",
            "huber_classification_cost", "repeat", "power", "out_prod",
-           "gated_unit", "lambda_cost", "multibox_loss"]
+           "gated_unit", "lambda_cost", "multibox_loss",
+           "kmax_seq_score", "sub_nested_seq", "selective_fc",
+           "cross_entropy_with_selfnorm", "scale_sub_region",
+           "img_conv3d", "img_pool3d", "BeamInput",
+           "cross_entropy_over_beam"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -155,6 +160,17 @@ class StaticInput:
         self.size = size
 
 
+class SubsequenceInput:
+    """Mark a recurrent_group input as nested (level-2): the group steps
+    the OUTER level and the step function receives each sub-sequence as a
+    level-1 sequence (reference layers.py SubsequenceInput /
+    RecurrentGradientMachine's recurrent-over-subsequences).  The step
+    typically pools or runs an inner RNN over the received sequence."""
+
+    def __init__(self, input):
+        self.input = input
+
+
 _rnn_ctx = []      # stack of {"rnn": builder, "memories": {name: mem}}
 
 
@@ -226,6 +242,10 @@ def recurrent_group(step, input, reverse=False, name=None):
         for x in inputs:
             if isinstance(x, StaticInput):
                 inner.append(rnn.static_input(x.input))
+            elif isinstance(x, SubsequenceInput):
+                assert (x.input.lod_level or 0) >= 2, \
+                    "SubsequenceInput needs a nested (lod_level-2) layer"
+                inner.append(rnn.step_input(x.input))
             else:
                 inner.append(rnn.step_input(x))
         _rnn_ctx.append({"rnn": rnn, "memories": {}, "updated": {}})
@@ -969,5 +989,116 @@ def multibox_loss(input_loc, input_conf, priorbox, gt_box, gt_label,
                             neg_pos_ratio=neg_pos_ratio,
                             background_label=background_id)
     out = flayers.mean(cost)
+    _register_named_output(name, out)
+    return out
+
+
+class BeamInput:
+    """One beam expansion for cross_entropy_over_beam (reference
+    layers.py BeamInput:6363): candidate scores, the top-k selected
+    candidate ids, and the gold candidate id."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None, **kw):
+    """Learning-to-search cost over multi-step beam expansions —
+    reference layers.py cross_entropy_over_beam:6386
+    (CrossEntropyOverBeam.cpp).  ``input`` is a BeamInput or list of
+    BeamInputs; pairs with kmax_seq_score + sub_nested_seq +
+    seq_slice to trim the search space.  Batch mean."""
+    beams = input if isinstance(input, (list, tuple)) else [input]
+    for b in beams:
+        assert isinstance(b, BeamInput), \
+            "cross_entropy_over_beam takes BeamInput objects"
+    cost = flayers.cross_entropy_over_beam(
+        [(b.candidate_scores, b.selected_candidates, b.gold)
+         for b in beams])
+    out = flayers.mean(cost)
+    _register_named_output(name, out)
+    return out
+
+
+def kmax_seq_score(input, beam_size=1, name=None, **kw):
+    """Top-``beam_size`` position ids per (sub-)sequence of scores —
+    reference layers.py kmax_seq_score_layer:7112
+    (KmaxSeqScoreLayer.cpp).  Pairs with sub_nested_seq for
+    beam-over-sequences selection."""
+    out = flayers.kmax_seq_score(input, beam_size=beam_size)
+    _register_named_output(name, out)
+    return out
+
+
+def sub_nested_seq(input, selected_indices, name=None, **kw):
+    """Select sub-sequences of a nested sequence by the index lists in
+    ``selected_indices`` — reference layers.py sub_nested_seq_layer:6966
+    (SubNestedSequenceLayer.cpp)."""
+    out = flayers.sub_nested_seq(input, selected_indices)
+    _register_named_output(name, out)
+    return out
+
+
+def selective_fc(input, size, select=None, act=None, param_attr=None,
+                 bias_attr=None, name=None, **kw):
+    """Selective fc — reference layers.py selective_fc_layer:5109: with
+    ``select`` only the chosen output columns are computed; without it,
+    exactly fc."""
+    out = flayers.selective_fc(
+        input, size, select=select, act=_act_name(act),
+        param_attr=param_attr,
+        bias_attr=True if bias_attr is None else bias_attr)
+    _register_named_output(name, out)
+    return out
+
+
+def cross_entropy_with_selfnorm(input, label, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1, name=None,
+                                **kw):
+    """Self-normalized CE cost — reference layers.py
+    cross_entropy_with_selfnorm:6120 (CostLayer.cpp:113).  ``input``
+    holds unnormalized positive scores (e.g. exp activations); batch
+    mean, scaled by ``coeff``."""
+    cost = flayers.cross_entropy_with_selfnorm(
+        input, label, softmax_selfnorm_alpha=softmax_selfnorm_alpha)
+    out = flayers.mean(cost)
+    if coeff != 1.0:
+        out = flayers.scale(out, scale=float(coeff))
+    _register_named_output(name, out)
+    return out
+
+
+def scale_sub_region(input, indices, value, name=None, **kw):
+    """Scale a per-sample CHW sub-region — reference layers.py
+    scale_sub_region_layer:7414 (function/ScaleSubRegionOp.cpp)."""
+    out = flayers.scale_sub_region(input, indices, float(value))
+    _register_named_output(name, out)
+    return out
+
+
+def img_conv3d(input, filter_size, num_filters, num_channels=None,
+               stride=1, padding=0, groups=1, act=None, param_attr=None,
+               bias_attr=None, name=None, **kw):
+    """NCDHW 3-D convolution — reference layers.py
+    img_conv3d_layer:7153 (Conv3DLayer.cpp)."""
+    out = flayers.conv3d(input=input, num_filters=num_filters,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, groups=groups,
+                         act=_act_name(act), param_attr=param_attr,
+                         bias_attr=bias_attr)
+    _register_named_output(name, out)
+    return out
+
+
+def img_pool3d(input, pool_size, stride=1, padding=0, pool_type=None,
+               ceil_mode=True, name=None, **kw):
+    """NCDHW 3-D pooling — reference layers.py img_pool3d_layer:2867
+    (Pool3DLayer.cpp).  ceil_mode defaults True like the reference."""
+    ptype = getattr(pool_type, "name", "max") if pool_type else "max"
+    out = flayers.pool3d(input=input, pool_size=pool_size,
+                         pool_stride=stride, pool_padding=padding,
+                         pool_type=ptype, ceil_mode=bool(ceil_mode))
     _register_named_output(name, out)
     return out

@@ -1,0 +1,615 @@
+"""OpTests for the round-5 COMPAT closers: kmax_seq_score,
+sub_nested_seq, selective_fc, scale_sub_region,
+cross_entropy_with_selfnorm, conv3d, pool3d — the layers the r4 COMPAT
+matrix still listed as absent (reference gserver KmaxSeqScoreLayer.cpp,
+SubNestedSequenceLayer.cpp, SelectiveFullyConnectedLayer.cpp,
+function/ScaleSubRegionOp.cpp, CostLayer.cpp:113, Conv3DLayer.cpp,
+Pool3DLayer.cpp).
+
+Numpy goldens + finite-difference grad checks for the differentiable
+ones, plus v2-surface smoke training — the reference OpTest contract.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.core.lod import (NestedSeqArray, SeqArray,
+                                       make_nested_seq, make_seq)
+from tests.op_test import OpTestCase
+
+
+def _r(*shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# kmax_seq_score
+# ---------------------------------------------------------------------------
+
+class TestKmaxSeqScore:
+    def test_level1(self):
+        scores = make_seq([[3.0, 1.0, 2.0], [5.0]], dtype=np.float32)
+        t = OpTestCase("kmax_seq_score", {"X": scores}, {"beam_size": 2})
+        t.check_output({"Out": np.asarray([[0.0, 2.0], [0.0, -1.0]])})
+
+    def test_beam_larger_than_maxlen(self):
+        scores = make_seq([[1.0, 4.0]], dtype=np.float32)
+        t = OpTestCase("kmax_seq_score", {"X": scores}, {"beam_size": 4})
+        t.check_output({"Out": np.asarray([[1.0, 0.0, -1.0, -1.0]])})
+
+    def test_nested(self, fresh_programs):
+        """Nested scores -> one row per sub-sequence, riding the outer
+        lengths (reference numSubSequences rows)."""
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [1], "float32", lod_level=2)
+        out = fluid.layers.kmax_seq_score(x, beam_size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = make_nested_seq(
+            [[[0.1, 0.9, 0.5], [0.7]], [[0.2, 0.1]]], dtype=np.float32)
+        got, = exe.run(main, feed={"x": feed}, fetch_list=[out],
+                       return_numpy=False)
+        assert isinstance(got, SeqArray)
+        np.testing.assert_array_equal(np.asarray(got.lengths), [2, 1])
+        np.testing.assert_allclose(
+            np.asarray(got.data)[0], [[1.0, 2.0], [0.0, -1.0]])
+        np.testing.assert_allclose(np.asarray(got.data)[1][0], [0.0, 1.0])
+        # vacant outer slot is all -1
+        np.testing.assert_allclose(np.asarray(got.data)[1][1], [-1.0, -1.0])
+
+
+# ---------------------------------------------------------------------------
+# sub_nested_seq
+# ---------------------------------------------------------------------------
+
+class TestSubNestedSeq:
+    def _feed(self):
+        return make_nested_seq(
+            [[[1.0, 1.5], [2.0, 2.5], [3.0, 3.5]], [[4.0, 4.5], [5.0, 5.5]]],
+            dtype=np.float32)
+
+    def test_select_and_reorder(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [1], "float32", lod_level=2)
+        sel = fluid.layers.data("sel", [2], "float32")
+        out = fluid.layers.sub_nested_seq(x, sel)
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={
+            "x": self._feed(),
+            "sel": np.asarray([[2.0, 0.0], [1.0, -1.0]], np.float32),
+        }, fetch_list=[out], return_numpy=False)
+        assert isinstance(got, NestedSeqArray)
+        np.testing.assert_array_equal(np.asarray(got.outer_lengths), [2, 1])
+        np.testing.assert_array_equal(
+            np.asarray(got.inner_lengths), [[2, 2], [2, 0]])
+        # row 0 selected subseq 2 then 0; row 1 selected subseq 1
+        np.testing.assert_allclose(np.asarray(got.data)[0, 0], [3.0, 3.5])
+        np.testing.assert_allclose(np.asarray(got.data)[0, 1], [1.0, 1.5])
+        np.testing.assert_allclose(np.asarray(got.data)[1, 0], [5.0, 5.5])
+        # -1 slot zeroed
+        np.testing.assert_allclose(np.asarray(got.data)[1, 1], 0.0)
+
+    def test_minus_one_terminates(self, fresh_programs):
+        """-1 ends the row's selection even if later entries are >= 0
+        (reference calSelectedRows breaks at the first -1)."""
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [1], "float32", lod_level=2)
+        sel = fluid.layers.data("sel", [3], "float32")
+        out = fluid.layers.sub_nested_seq(x, sel)
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={
+            "x": self._feed(),
+            "sel": np.asarray([[0.0, -1.0, 2.0], [0.0, 1.0, -1.0]],
+                              np.float32),
+        }, fetch_list=[out], return_numpy=False)
+        np.testing.assert_array_equal(np.asarray(got.outer_lengths), [1, 2])
+
+    def test_grad_scatters_to_selected_rows(self, fresh_programs):
+        """Training through the selection: grads land only on selected
+        sub-sequences (reference backward addToRows)."""
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [1], "float32", lod_level=2)
+        x.stop_gradient = False
+        sel = fluid.layers.data("sel", [1], "float32")
+        picked = fluid.layers.sub_nested_seq(x, sel)
+        pooled = fluid.layers.nested_sequence_pool(picked, "sum")
+        loss = fluid.layers.reduce_sum(fluid.layers.sequence_pool(
+            pooled, "sum"))
+        fluid.append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        g, = exe.run(main, feed={
+            "x": self._feed(),
+            "sel": np.asarray([[1.0], [0.0]], np.float32),
+        }, fetch_list=[x.grad_name], return_numpy=False)
+        gd = np.asarray(g.data if hasattr(g, "data") else g)
+        assert gd[0, 1].sum() == pytest.approx(2.0)   # selected: 2 steps
+        assert gd[0, 0].sum() == pytest.approx(0.0)   # unselected
+        assert gd[1, 0].sum() == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# selective_fc
+# ---------------------------------------------------------------------------
+
+class TestSelectiveFc:
+    def test_output_and_grads(self):
+        x = _r(3, 4)
+        w = _r(4, 6, seed=1)
+        b = _r(6, seed=2)
+        sel = np.asarray([[0, 5], [2, -1], [3, 1]], np.float32)
+        want = np.zeros((3, 2), np.float32)
+        for i in range(3):
+            for j in range(2):
+                c = int(sel[i, j])
+                if c >= 0:
+                    want[i, j] = x[i] @ w[:, c] + b[c]
+        t = OpTestCase("selective_fc",
+                       {"X": x, "W": w, "Select": sel, "Bias": b}, {})
+        t.check_output({"Out": want}, atol=1e-5)
+        t.check_grad(["X", "W", "Bias"], max_relative_error=1e-2)
+
+    def test_layer_without_select_is_fc(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [4], "float32")
+        out = fluid.layers.selective_fc(x, 6)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": _r(2, 4)}, fetch_list=[out])
+        assert np.asarray(got).shape == (2, 6)
+
+
+# ---------------------------------------------------------------------------
+# scale_sub_region
+# ---------------------------------------------------------------------------
+
+class TestScaleSubRegion:
+    def test_output(self):
+        x = _r(2, 3, 4, 5)
+        ind = np.asarray([[1, 2, 2, 3, 1, 2],
+                          [3, 3, 1, 4, 2, 5]], np.float32)
+        want = x.copy()
+        for i in range(2):
+            c0, c1, h0, h1, w0, w1 = (int(v) for v in ind[i])
+            want[i, c0 - 1:c1, h0 - 1:h1, w0 - 1:w1] *= 2.0
+        t = OpTestCase("scale_sub_region", {"X": x, "Indices": ind},
+                       {"value": 2.0})
+        t.check_output({"Out": want})
+        t.check_grad(["X"])
+
+
+# ---------------------------------------------------------------------------
+# cross_entropy_with_selfnorm
+# ---------------------------------------------------------------------------
+
+class TestSelfnormCE:
+    def test_output_and_grad(self):
+        x = (_r(4, 5) + 0.1).astype(np.float32)      # positive scores
+        label = np.asarray([[1], [0], [4], [2]], np.int64)
+        z = x.sum(1, keepdims=True)
+        alpha = 0.25
+        want = (-np.log(x[np.arange(4), label[:, 0]]).reshape(4, 1)
+                + np.log(z) + alpha * np.log(z) ** 2)
+        t = OpTestCase("cross_entropy_with_selfnorm",
+                       {"X": x, "Label": label},
+                       {"softmax_selfnorm_alpha": alpha})
+        t.check_output({"Out": want}, atol=1e-5)
+        t.check_grad(["X"], max_relative_error=1e-2)
+
+    def test_v2_cost_trains_z_toward_one(self, fresh_programs):
+        """The alpha term drives the partition sum toward 1 — the whole
+        point of self-normalization (serving skips the softmax)."""
+        import paddle_tpu.v2 as paddle
+
+        main, startup, scope = fresh_programs
+        x = paddle.layer.data(name="x",
+                              type=paddle.data_type.dense_vector(6))
+        lbl = paddle.layer.data(name="lbl",
+                                type=paddle.data_type.integer_value(4))
+        h = paddle.layer.fc(input=x, size=4,
+                            act=paddle.activation.Exp())
+        cost = paddle.layer.cross_entropy_with_selfnorm(
+            input=h, label=lbl, softmax_selfnorm_alpha=2.0)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        opt.minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(16, 6).astype(np.float32)
+        ys = rng.randint(0, 4, (16, 1)).astype(np.int64)
+        zsum = fluid.layers.reduce_mean(fluid.layers.reduce_sum(h, dim=1))
+        first = None
+        for _ in range(60):
+            c, zs = exe.run(main, feed={"x": xs, "lbl": ys},
+                            fetch_list=[cost, zsum])
+            if first is None:
+                first = abs(float(np.asarray(zs)) - 1.0)
+        assert abs(float(np.asarray(zs)) - 1.0) < first
+
+
+# ---------------------------------------------------------------------------
+# conv3d / pool3d
+# ---------------------------------------------------------------------------
+
+def _conv3d_ref(x, w, stride=1, pad=0):
+    b, cin, d, h, wd = x.shape
+    cout, _, kd, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad), (pad, pad)))
+    od = (xp.shape[2] - kd) // stride + 1
+    oh = (xp.shape[3] - kh) // stride + 1
+    ow = (xp.shape[4] - kw) // stride + 1
+    out = np.zeros((b, cout, od, oh, ow), np.float32)
+    for zi in range(od):
+        for yi in range(oh):
+            for xi in range(ow):
+                patch = xp[:, :, zi * stride:zi * stride + kd,
+                           yi * stride:yi * stride + kh,
+                           xi * stride:xi * stride + kw]
+                out[:, :, zi, yi, xi] = np.einsum(
+                    "bcdhw,ocdhw->bo", patch, w)
+    return out
+
+
+class TestConv3d:
+    def test_output_and_grad(self):
+        x = _r(2, 2, 3, 4, 4)
+        w = (_r(3, 2, 2, 2, 2, seed=1) - 0.5).astype(np.float32)
+        t = OpTestCase("conv3d", {"Input": x, "Filter": w},
+                       {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                        "dilations": [1, 1, 1], "groups": 1})
+        t.check_output({"Output": _conv3d_ref(x, w)}, atol=1e-4)
+        t.check_grad(["Input", "Filter"], max_relative_error=1e-2)
+
+    def test_stride_padding(self):
+        x = _r(1, 1, 4, 4, 4)
+        w = (_r(2, 1, 3, 3, 3, seed=3) - 0.5).astype(np.float32)
+        t = OpTestCase("conv3d", {"Input": x, "Filter": w},
+                       {"strides": [2, 2, 2], "paddings": [1, 1, 1],
+                        "dilations": [1, 1, 1], "groups": 1})
+        t.check_output({"Output": _conv3d_ref(x, w, stride=2, pad=1)},
+                       atol=1e-4)
+
+
+class TestPool3d:
+    def test_max(self):
+        # well-separated values: the finite-difference probe (delta 5e-3)
+        # must not flip any window's argmax
+        x = np.random.RandomState(0).permutation(
+            2 * 2 * 4 ** 3).reshape(2, 2, 4, 4, 4).astype(np.float32) * 0.1
+        want = x.reshape(2, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+        t = OpTestCase("pool3d", {"X": x},
+                       {"pooling_type": "max", "ksize": [2, 2, 2],
+                        "strides": [2, 2, 2], "paddings": [0, 0, 0]})
+        t.check_output({"Out": want})
+        t.check_grad(["X"])
+
+    def test_avg_global(self):
+        x = _r(2, 3, 2, 3, 4)
+        t = OpTestCase("pool3d", {"X": x},
+                       {"pooling_type": "avg", "global_pooling": True})
+        t.check_output({"Out": x.mean(axis=(2, 3, 4), keepdims=True)})
+
+    def test_ceil_mode_keeps_partial_window(self):
+        """ceil_mode (the img_pool3d_layer default) keeps the trailing
+        partial window — reference pooling ceil output-shape rule."""
+        x = _r(1, 1, 5, 5, 5)
+        t = OpTestCase("pool3d", {"X": x},
+                       {"pooling_type": "max", "ksize": [2, 2, 2],
+                        "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                        "ceil_mode": True})
+        want = np.full((1, 1, 3, 3, 3), -np.inf, np.float32)
+        for z in range(3):
+            for y in range(3):
+                for w in range(3):
+                    want[0, 0, z, y, w] = x[0, 0, 2 * z:2 * z + 2,
+                                            2 * y:2 * y + 2,
+                                            2 * w:2 * w + 2].max()
+        t.check_output({"Out": want})
+        # avg with exclusive counts: partial windows divide by their
+        # real element count, not k^3
+        t2 = OpTestCase("pool3d", {"X": x},
+                        {"pooling_type": "avg", "ksize": [2, 2, 2],
+                         "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                         "ceil_mode": True})
+        wa = np.zeros((1, 1, 3, 3, 3), np.float32)
+        for z in range(3):
+            for y in range(3):
+                for w in range(3):
+                    wa[0, 0, z, y, w] = x[0, 0, 2 * z:2 * z + 2,
+                                          2 * y:2 * y + 2,
+                                          2 * w:2 * w + 2].mean()
+        t2.check_output({"Out": wa})
+
+    def test_pool2d_ceil_mode(self):
+        x = _r(1, 1, 5, 5)
+        t = OpTestCase("pool2d", {"X": x},
+                       {"pooling_type": "max", "ksize": [2, 2],
+                        "strides": [2, 2], "paddings": [0, 0],
+                        "ceil_mode": True})
+        want = np.full((1, 1, 3, 3), -np.inf, np.float32)
+        for y in range(3):
+            for w in range(3):
+                want[0, 0, y, w] = x[0, 0, 2 * y:2 * y + 2,
+                                     2 * w:2 * w + 2].max()
+        t.check_output({"Out": want})
+
+
+def test_v2_conv3d_net_trains(fresh_programs):
+    """img_conv3d -> img_pool3d -> fc classification trains one step —
+    the 3-D family's end-to-end smoke (reference img_conv3d_layer
+    usage)."""
+    import paddle_tpu.v2 as paddle
+
+    main, startup, scope = fresh_programs
+    # v2 data layers are flat vectors; reshape to NCDHW like the
+    # reference's height/width/depth layer config
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(2 * 4 ** 3))
+    lbl = paddle.layer.data(name="lbl",
+                            type=paddle.data_type.integer_value(3))
+    vol = fluid.layers.reshape(x, [-1, 2, 4, 4, 4])
+    conv = paddle.layer.img_conv3d(vol, filter_size=2, num_filters=4,
+                                   act=paddle.activation.Relu())
+    pooled = paddle.layer.img_pool3d(conv, pool_size=3, stride=3)
+    flat = fluid.layers.reshape(pooled, [-1, 4])
+    pred = paddle.layer.fc(input=flat, size=3,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 2 * 4 ** 3).astype(np.float32)
+    ys = rng.randint(0, 3, (8, 1)).astype(np.int64)
+    losses = [float(np.asarray(exe.run(
+        main, feed={"x": xs, "lbl": ys}, fetch_list=[cost])[0]))
+        for _ in range(25)]
+    assert losses[-1] < losses[0]
+
+
+def _beam_ce_ref(scores, ids, gold):
+    """Independent numpy replica of CrossEntropyOverBeam.cpp's per-
+    sequence path enumeration: valid-expansion cut, gold-as-extra-path,
+    chain scores, global softmax."""
+    E = len(ids)
+    gr, found, grow, gcol = 0, [], [], []
+    for i in range(E):
+        grow.append(gr)
+        row = list(ids[i][gr])
+        if gold[i] in row:
+            j = row.index(gold[i])
+            found.append(True)
+            gcol.append(j)
+            flat = ids[i].reshape(-1)
+            pos = gr * ids[i].shape[1] + j
+            gr = int((flat[:pos] >= 0).sum())
+        else:
+            found.append(False)
+            gcol.append(-1)
+            break
+    f = len(found) - 1
+    extra = not found[f]
+
+    def chain(row_next, i):
+        flat = ids[i].reshape(-1)
+        live = [k for k, v in enumerate(flat) if v >= 0]
+        s = live[row_next]
+        r = s // ids[i].shape[1]
+        val = scores[i][r, int(flat[s])]
+        return val + (chain(r, i - 1) if i > 0 else 0.0)
+
+    flat_f = ids[f].reshape(-1)
+    slots, vals = [], []
+    for sp, v in enumerate(flat_f):
+        if v < 0:
+            continue
+        r = sp // ids[f].shape[1]
+        scr = scores[f][r, int(v)] + (chain(r, f - 1) if f > 0 else 0.0)
+        slots.append(sp)
+        vals.append(scr)
+    gscore = sum(scores[i][grow[i], gold[i]] for i in range(f + 1))
+    if extra:
+        vals.append(gscore)
+        gidx = len(vals) - 1
+    else:
+        goldslot = grow[f] * ids[f].shape[1] + gcol[f]
+        gidx = slots.index(goldslot)
+    vals = np.asarray(vals, np.float64)
+    m = vals.max()
+    lse = m + np.log(np.exp(vals - m).sum())
+    return lse - vals[gidx]
+
+
+class TestCrossEntropyOverBeam:
+    def _case(self, seed, gold_off_at=None):
+        """One sequence of a 3-expansion beam (beam 2): step0 1 row x 4
+        candidates, step1 2 rows x 5, step2 4 rows x 3.  gold_off_at
+        forces the gold candidate off the beam at that step."""
+        rng = np.random.RandomState(seed)
+        scores = [rng.rand(1, 4).astype(np.float32),
+                  rng.rand(2, 5).astype(np.float32),
+                  rng.rand(4, 3).astype(np.float32)]
+        ids = [np.asarray([[0, 2]], np.float32),
+               np.asarray([[1, 3], [0, 4]], np.float32),
+               np.asarray([[2, 0], [1, -1], [0, 2], [1, 0]], np.float32)]
+        # gold chain when on-beam throughout: 2 (row 0) -> row 1 -> 4 ->
+        # row 3 -> 1; gold_off_at swaps in a candidate absent from the
+        # gold row's selections at that step
+        gold = [2, 4, 1]
+        if gold_off_at is not None:
+            gold[gold_off_at] = {0: 1, 1: 2, 2: 2}[gold_off_at]
+        return scores, ids, gold
+
+    def _run_op(self, cases):
+        """cases: list of (scores, ids, gold) per sequence with the same
+        static structure; returns op costs [B]."""
+        B = len(cases)
+        E = len(cases[0][0])
+        sc = [np.stack([c[0][i] for c in cases]) for i in range(E)]
+        idl = [np.stack([c[1][i] for c in cases]) for i in range(E)]
+        gl = [np.asarray([c[2][i] for c in cases], np.float32)
+              for i in range(E)]
+        t = OpTestCase("cross_entropy_over_beam",
+                       {"Scores": sc, "Ids": idl, "Gold": gl}, {})
+        out = t.run_single()
+        return np.asarray(out).reshape(-1), t
+
+    def test_matches_reference_enumeration(self):
+        cases = [self._case(0), self._case(1, gold_off_at=1),
+                 self._case(2, gold_off_at=2), self._case(3)]
+        got, _ = self._run_op(cases)
+        want = [_beam_ce_ref(*c) for c in cases]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_gold_off_at_step0(self):
+        cases = [self._case(4, gold_off_at=0)]
+        got, _ = self._run_op(cases)
+        want = [_beam_ce_ref(*c) for c in cases]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_grad_on_scores(self):
+        cases = [self._case(5), self._case(6, gold_off_at=1)]
+        _, t = self._run_op(cases)
+        t.check_grad(["Scores"], max_relative_error=1e-2)
+
+    def test_v2_surface(self, fresh_programs):
+        """BeamInput triples through the v2 cost layer."""
+        import paddle_tpu.v2 as paddle
+
+        main, startup, scope = fresh_programs
+        s0 = fluid.layers.data("s0", [1, 4], "float32")
+        i0 = fluid.layers.data("i0", [1, 2], "float32")
+        g0 = fluid.layers.data("g0", [1], "float32")
+        s1 = fluid.layers.data("s1", [2, 5], "float32")
+        i1 = fluid.layers.data("i1", [2, 2], "float32")
+        g1 = fluid.layers.data("g1", [1], "float32")
+        cost = paddle.layer.cross_entropy_over_beam(input=[
+            paddle.layer.BeamInput(candidate_scores=s0,
+                                   selected_candidates=i0, gold=g0),
+            paddle.layer.BeamInput(candidate_scores=s1,
+                                   selected_candidates=i1, gold=g1),
+        ])
+        exe = fluid.Executor(fluid.CPUPlace())
+        c = self._case(7)
+        got, = exe.run(main, feed={
+            "s0": c[0][0][None], "i0": c[1][0][None],
+            "g0": np.asarray([[c[2][0]]], np.float32),
+            "s1": c[0][1][None], "i1": c[1][1][None],
+            "g1": np.asarray([[c[2][1]]], np.float32),
+        }, fetch_list=[cost])
+        want = _beam_ce_ref(c[0][:2], c[1][:2], c[2][:2])
+        np.testing.assert_allclose(float(np.asarray(got)), want, rtol=1e-5)
+
+
+class TestSubsequenceInput:
+    """recurrent_group over a nested sequence: the step sees each
+    sub-sequence as a level-1 sequence (reference SubsequenceInput /
+    RecurrentGradientMachine recurrent-over-subsequences)."""
+
+    def _nested(self):
+        return make_nested_seq(
+            [[[[1.0], [2.0]], [[3.0]]], [[[4.0], [5.0], [6.0]]]],
+            dtype=np.float32)
+
+    def test_fluid_dynamic_rnn_over_subsequences(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [1], "float32", lod_level=2)
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            sub = rnn.step_input(x)          # one sub-sequence per step
+            acc = rnn.memory(shape=[1])
+            pooled = fluid.layers.sequence_pool(sub, "sum")
+            new_acc = fluid.layers.elementwise_add(acc, pooled)
+            rnn.update_memory(acc, new_acc)
+            rnn.output(new_acc)
+        out = rnn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={"x": self._nested()},
+                       fetch_list=[out], return_numpy=False)
+        assert isinstance(got, SeqArray)
+        np.testing.assert_array_equal(np.asarray(got.lengths), [2, 1])
+        # row 0: running sums 3, 3+3=6 over its two subsequences
+        np.testing.assert_allclose(np.asarray(got.data)[0, :, 0],
+                                   [3.0, 6.0])
+        np.testing.assert_allclose(np.asarray(got.data)[1, 0, 0], 15.0)
+        # vacant outer step masked to zero
+        np.testing.assert_allclose(np.asarray(got.data)[1, 1, 0], 0.0)
+
+    def test_sequence_valued_step_output_stacks_nested(self,
+                                                       fresh_programs):
+        """A step that outputs the (scaled) sub-sequence itself yields a
+        nested output — the general recurrent-over-subsequence
+        contract."""
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [1], "float32", lod_level=2)
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            sub = rnn.step_input(x)
+            rnn.output(fluid.layers.scale(sub, scale=2.0))
+        out = rnn()
+        assert out.lod_level == 2
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={"x": self._nested()},
+                       fetch_list=[out], return_numpy=False)
+        assert isinstance(got, NestedSeqArray)
+        np.testing.assert_array_equal(np.asarray(got.outer_lengths), [2, 1])
+        np.testing.assert_array_equal(np.asarray(got.inner_lengths),
+                                      [[2, 1], [3, 0]])
+        np.testing.assert_allclose(np.asarray(got.data)[0, 0, :2, 0],
+                                   [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(got.data)[1, 0, :3, 0],
+                                   [8.0, 10.0, 12.0])
+
+    def test_v2_surface_trains(self, fresh_programs):
+        """v2 recurrent_group(SubsequenceInput(...)) with an fc on the
+        pooled sub-sequence trains end-to-end."""
+        import paddle_tpu.v2 as paddle
+
+        main, startup, scope = fresh_programs
+        x = fluid.layers.data("x", [2], "float32", lod_level=2)
+        lbl = fluid.layers.data("lbl", [1], "int64")
+
+        def step(sub):
+            pooled = fluid.layers.sequence_pool(sub, "sum")
+            return paddle.layer.fc(input=pooled, size=4,
+                                   act=paddle.activation.Tanh())
+
+        seq_feats = paddle.layer.recurrent_group(
+            step, paddle.layer.SubsequenceInput(x))
+        final = paddle.layer.last_seq(seq_feats)
+        pred = paddle.layer.fc(input=final, size=3,
+                               act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(input=pred, label=lbl)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.2).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed_x = make_nested_seq(
+            [[rng.rand(3, 2), rng.rand(2, 2)], [rng.rand(4, 2)]],
+            dtype=np.float32)
+        ys = np.asarray([[0], [2]], np.int64)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"x": feed_x, "lbl": ys}, fetch_list=[cost])[0]))
+            for _ in range(30)]
+        assert losses[-1] < losses[0]
+
+
+def test_v2_kmax_sub_nested_pipeline(fresh_programs):
+    """kmax_seq_score over per-sub-sequence scores selects the best
+    sub-sequences via sub_nested_seq — the beam-over-sequences pattern
+    the two reference layers were built for."""
+    import paddle_tpu.v2 as paddle  # noqa: F401 (v2 surface import)
+
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [1], "float32", lod_level=2)
+    scores = fluid.layers.data("scores", [1], "float32", lod_level=1)
+    top = fluid.layers.kmax_seq_score(scores, beam_size=1)
+    picked = fluid.layers.sub_nested_seq(x, top)
+    pooled = fluid.layers.nested_sequence_pool(picked, "sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed_x = make_nested_seq(
+        [[[1.0, 2.0], [10.0]], [[3.0], [4.0, 5.0]]], dtype=np.float32)
+    feed_s = make_seq([[0.1, 0.9], [0.8, 0.2]], dtype=np.float32)
+    got, = exe.run(main, feed={"x": feed_x, "scores": feed_s},
+                   fetch_list=[pooled], return_numpy=False)
+    # row 0: subseq 1 (score .9) sums to 10; row 1: subseq 0 sums to 3
+    np.testing.assert_allclose(np.asarray(got.data)[:, 0], [10.0, 3.0])
