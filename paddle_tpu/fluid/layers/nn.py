@@ -30,6 +30,7 @@ __all__ = [
     "hsigmoid", "sampling_id", "bilinear_interp", "prelu",
     "ssd_loss", "conv3d", "pool3d", "selective_fc", "scale_sub_region",
     "cross_entropy_with_selfnorm", "cross_entropy_over_beam",
+    "rotate", "detection_output",
 ]
 
 
@@ -717,6 +718,30 @@ def scale_sub_region(input, indices, value, name=None):
                      {"X": input, "Indices": indices}, {"Out": out},
                      {"value": float(value)})
     return out
+
+
+def rotate(x, name=None):
+    """Rotate each [H, W] feature map 90 degrees clockwise — reference
+    RotateLayer.cpp (see ops/misc_ops.py rotate)."""
+    return _single_out_layer("rotate", {"X": x}, {}, name=name)
+
+
+def detection_output(loc, conf, prior_box, prior_var,
+                     background_id=0, nms_threshold=0.45, nms_top_k=400,
+                     keep_top_k=200, confidence_threshold=0.01,
+                     name=None):
+    """SSD inference head — decode loc predictions against the priors,
+    softmax confidences, per-class NMS (reference
+    DetectionOutputLayer.cpp; see ops/detection_ops.py)."""
+    return _single_out_layer(
+        "detection_output",
+        {"Location": loc, "Confidence": conf, "PriorBox": prior_box,
+         "PriorVar": prior_var},
+        {"background_id": int(background_id),
+         "nms_threshold": float(nms_threshold),
+         "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+         "confidence_threshold": float(confidence_threshold)},
+        stop_gradient=True, name=name)
 
 
 def cross_entropy_over_beam(beams, name=None):

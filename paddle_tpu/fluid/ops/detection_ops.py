@@ -122,17 +122,11 @@ def _iou(boxes):
                                1e-10)
 
 
-@primitive("multiclass_nms", inputs=["BBoxes", "Scores"],
-           outputs=["Out"], no_grad=True)
-def multiclass_nms(ctx, bboxes, scores):
-    """detection_output capability (gserver DetectionOutputLayer /
-    later multiclass_nms_op): per class, greedy NMS over [n, 4] boxes
-    with [c, n] scores; emits [keep_top_k, 6] rows
-    (class, score, x1, y1, x2, y2), score -1 padding for vacant slots."""
-    score_thresh = ctx.attr("score_threshold", 0.01)
-    iou_thresh = ctx.attr("nms_threshold", 0.45)
-    per_class_k = ctx.attr("nms_top_k", 16)
-    keep_k = ctx.attr("keep_top_k", 16)
+def _nms_core(bboxes, scores, score_thresh, iou_thresh, per_class_k,
+              keep_k):
+    """Greedy per-class NMS core shared by multiclass_nms and
+    detection_output: [n,4] boxes + [c,n] scores -> [keep_k, 6] rows
+    (class, score, x1, y1, x2, y2), -1 padding for vacant slots."""
     n_cls, n_box = scores.shape
     iou = _iou(bboxes)
 
@@ -174,6 +168,61 @@ def multiclass_nms(ctx, bboxes, scores):
     out = jnp.where(top_scores[:, None] >= score_thresh, out,
                     jnp.full_like(out, -1.0))
     return out
+
+
+@primitive("multiclass_nms", inputs=["BBoxes", "Scores"],
+           outputs=["Out"], no_grad=True)
+def multiclass_nms(ctx, bboxes, scores):
+    """detection_output capability (gserver DetectionOutputLayer /
+    later multiclass_nms_op): per class, greedy NMS over [n, 4] boxes
+    with [c, n] scores; emits [keep_top_k, 6] rows."""
+    return _nms_core(bboxes, scores,
+                     ctx.attr("score_threshold", 0.01),
+                     ctx.attr("nms_threshold", 0.45),
+                     ctx.attr("nms_top_k", 16),
+                     ctx.attr("keep_top_k", 16))
+
+
+@primitive("detection_output",
+           inputs=["Location", "Confidence", "PriorBox", "PriorVar"],
+           outputs=["Out"], no_grad=True)
+def detection_output(ctx, loc, conf, prior, prior_var):
+    """reference gserver/layers/DetectionOutputLayer.cpp (DSL
+    detection_output_layer): decode the variance-encoded location
+    predictions against the priors (the exact inverse of ssd_loss's
+    encoding), softmax the confidences, and run per-class NMS with the
+    background class masked out.  Location [B, P, 4], Confidence
+    [B, P, C], PriorBox/PriorVar from prior_box -> [B, keep_top_k, 6]
+    rows (class, score, x1, y1, x2, y2), -1 padded."""
+    score_thresh = ctx.attr("confidence_threshold", 0.01)
+    iou_thresh = ctx.attr("nms_threshold", 0.45)
+    per_class_k = ctx.attr("nms_top_k", 400)
+    keep_k = ctx.attr("keep_top_k", 200)
+    bg = int(ctx.attr("background_id", 0))
+
+    prior = prior.reshape(-1, 4).astype(jnp.float32)
+    prior_var = prior_var.reshape(-1, 4).astype(jnp.float32)
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    pw = jnp.maximum(prior[:, 2] - prior[:, 0], 1e-8)
+    ph = jnp.maximum(prior[:, 3] - prior[:, 1], 1e-8)
+
+    def one(loc_i, conf_i):
+        l = loc_i.astype(jnp.float32)
+        cx = l[:, 0] * prior_var[:, 0] * pw + pcx
+        cy = l[:, 1] * prior_var[:, 1] * ph + pcy
+        w = pw * jnp.exp(l[:, 2] * prior_var[:, 2])
+        h = ph * jnp.exp(l[:, 3] * prior_var[:, 3])
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=-1)
+        probs = jax.nn.softmax(conf_i.astype(jnp.float32), axis=-1)
+        scores = probs.T                                  # [C, P]
+        cls_live = jnp.arange(scores.shape[0]) != bg
+        scores = jnp.where(cls_live[:, None], scores, -1.0)
+        return _nms_core(boxes, scores, score_thresh, iou_thresh,
+                         per_class_k, keep_k)
+
+    return jax.vmap(one)(loc, conf)
 
 
 @primitive("iou_similarity", inputs=["X", "Y"], outputs=["Out"],

@@ -36,8 +36,19 @@ def crop(ctx, x, y):
     target shape may come from the attr or a second input's shape."""
     offsets = ctx.attr("offsets", [0] * x.ndim)
     shape = list(y.shape) if y is not None else list(ctx.attr("shape"))
+    # -1 keeps the remaining extent on that axis (dynamic batch dims)
+    shape = [x.shape[i] - offsets[i] if s in (None, -1) else s
+             for i, s in enumerate(shape)]
     return jax.lax.slice(x, offsets,
                          [o + s for o, s in zip(offsets, shape)])
+
+
+@primitive("rotate")
+def rotate(ctx, x):
+    """reference gserver/layers/RotateLayer.cpp (DSL rotate_layer):
+    rotate each [H, W] feature map 90 degrees clockwise —
+    y[j, i] = x[H-1-i, j].  Output spatial dims swap to [W, H]."""
+    return jnp.swapaxes(jnp.flip(x, axis=-2), -2, -1)
 
 
 @primitive("scale_sub_region", inputs=["X", "Indices"],

@@ -38,7 +38,17 @@ __all__ = ["data", "fc", "embedding", "classification_cost", "mse_cost",
            "kmax_seq_score", "sub_nested_seq", "selective_fc",
            "cross_entropy_with_selfnorm", "scale_sub_region",
            "img_conv3d", "img_pool3d", "BeamInput",
-           "cross_entropy_over_beam"]
+           "cross_entropy_over_beam",
+           # fluid-row aliases (reference names minus `_layer`)
+           "printer", "expand", "seq_reshape", "scaling", "rotate",
+           "spp", "img_cmrnorm", "batch_norm", "row_l2_norm",
+           "cross_channel_norm", "conv_shift", "tensor", "linear_comb",
+           "block_expand", "nce", "rank_cost", "sum_cost",
+           "multi_binary_label_cross_entropy", "smooth_l1_cost",
+           "multiplex", "row_conv", "switch_order", "crop", "seq_slice",
+           "sub_seq", "resize", "priorbox", "detection_output",
+           "roi_pool", "identity_projection", "dotmul_projection",
+           "dotmul_operator", "slice_projection"]
 
 # name -> InputType for every data layer built in the current topology;
 # the v2 DataFeeder reads this to convert reader columns
@@ -991,6 +1001,405 @@ def multibox_loss(input_loc, input_conf, priorbox, gt_box, gt_label,
     out = flayers.mean(cost)
     _register_named_output(name, out)
     return out
+
+
+# ---------------------------------------------------------------------------
+# v2-surface aliases for the rows COMPAT.md previously listed as "fluid":
+# the capability shipped as a fluid layer; these wrappers give each one its
+# reference trainer_config_helpers name (minus `_layer`) with the reference
+# argument conventions, completing the import-swap surface.
+# ---------------------------------------------------------------------------
+
+def printer(input, format=None, name=None, **kw):
+    """reference layers.py printer_layer:1093 — debug-print a layer."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    for v in inputs:
+        flayers.Print(v, message=format or "")
+    return inputs[0]
+
+
+def expand(input, expand_as, expand_level=None, name=None, **kw):
+    """reference layers.py expand_layer:1858 — broadcast each row across
+    the timesteps of expand_as's sequences (ExpandLevel collapses under
+    the padded layout: level-2 targets expand per sub-sequence)."""
+    out = flayers.sequence_expand(input, expand_as)
+    _register_named_output(name, out)
+    return out
+
+
+def seq_reshape(input, reshape_size, act=None, name=None, **kw):
+    """reference layers.py seq_reshape_layer:1980."""
+    out = flayers.sequence_reshape(input, new_dim=reshape_size)
+    if act is not None and _act_name(act):
+        out = getattr(flayers, _act_name(act))(out)
+    _register_named_output(name, out)
+    return out
+
+
+def scaling(input, weight, name=None, **kw):
+    """reference layers.py scaling_layer:2185 — per-sample scalar weight
+    [B, 1] times each row of input."""
+    out = flayers.elementwise_mul(input, weight)
+    _register_named_output(name, out)
+    return out
+
+
+def rotate(input, height=None, width=None, name=None, **kw):
+    """reference layers.py rotate_layer:2266 (RotateLayer.cpp) — rotate
+    each [H, W] map 90 degrees clockwise.  Flat [B, C*H*W] inputs need
+    height/width like the reference; NCHW inputs rotate in place."""
+    x = input
+    if len(x.shape or []) == 2:
+        assert height and width, "rotate: flat input needs height/width"
+        d = int(x.shape[-1])
+        x = flayers.reshape(x, [-1, d // (height * width), height, width])
+    out = flayers.rotate(x)
+    _register_named_output(name, out)
+    return out
+
+
+def spp(input, pool_type=None, pyramid_height=3, name=None, **kw):
+    """reference layers.py spp_layer:3019 — spatial pyramid pooling over
+    an NCHW input."""
+    ptype = getattr(pool_type, "name", "max") if pool_type else "max"
+    out = flayers.spp(input, pyramid_height=pyramid_height,
+                      pool_type=ptype)
+    _register_named_output(name, out)
+    return out
+
+
+def img_cmrnorm(input, size=5, scale=0.0128, power=0.75, name=None, **kw):
+    """reference layers.py img_cmrnorm_layer:3120 — cross-map response
+    normalization (AlexNet LRN); scale is the reference's alpha/size."""
+    out = flayers.lrn(input, n=size, k=1.0, alpha=scale, beta=power)
+    _register_named_output(name, out)
+    return out
+
+
+def batch_norm(input, act=None, epsilon=1e-5,
+               moving_average_fraction=0.9, use_global_stats=None,
+               param_attr=None, bias_attr=None, name=None, **kw):
+    """reference layers.py batch_norm_layer:3169."""
+    out = flayers.batch_norm(input, act=_act_name(act), epsilon=epsilon,
+                             momentum=moving_average_fraction,
+                             is_test=bool(use_global_stats),
+                             param_attr=param_attr, bias_attr=bias_attr)
+    _register_named_output(name, out)
+    return out
+
+
+def row_l2_norm(input, name=None, **kw):
+    """reference layers.py row_l2_norm_layer:3333."""
+    out = flayers.l2_normalize(input, axis=-1)
+    _register_named_output(name, out)
+    return out
+
+
+def cross_channel_norm(input, param_attr=None, name=None, **kw):
+    """reference layers.py cross_channel_norm_layer:1375
+    (CrossChannelNormLayer.cpp): L2-normalize each pixel across
+    channels, then scale by a learned per-channel factor."""
+    from ..fluid.initializer import ConstantInitializer
+    from ..fluid.layer_helper import LayerHelper
+
+    normed = flayers.l2_normalize(input, axis=1)
+    helper = LayerHelper("cross_channel_norm", param_attr=param_attr,
+                         name=name)
+    c = input.shape[1]
+    scale = helper.create_parameter(
+        helper.param_attr, shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0))
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("elementwise_mul", {"X": normed, "Y": scale},
+                     {"Out": out}, {"axis": 1})
+    _register_named_output(name, out)
+    return out
+
+
+def conv_shift(a, b, name=None, **kw):
+    """reference layers.py conv_shift_layer:4987 — circular
+    convolution."""
+    out = flayers.conv_shift(a, b)
+    _register_named_output(name, out)
+    return out
+
+
+def tensor(a, b, size, act=None, param_attr=None, bias_attr=None,
+           name=None, **kw):
+    """reference layers.py tensor_layer:5039 — bilinear tensor product
+    y_k = a W_k b^T (bilinear_tensor_product_op)."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("tensor", param_attr=param_attr,
+                         bias_attr=bias_attr, act=_act_name(act),
+                         name=name)
+    da, db = int(a.shape[-1]), int(b.shape[-1])
+    w = helper.create_parameter(helper.param_attr, shape=[size, da, db],
+                                dtype=a.dtype)
+    inputs = {"X": a, "Y": b, "Weight": w}
+    if helper.bias_attr is not None:
+        inputs["Bias"] = helper.create_parameter(
+            helper.bias_attr, shape=[1, size], dtype=a.dtype,
+            is_bias=True)
+    out = helper.create_tmp_variable(a.dtype)
+    helper.append_op("bilinear_tensor_product", inputs, {"Out": out})
+    out = helper.append_activation(out)
+    _register_named_output(name, out)
+    return out
+
+
+def linear_comb(weights, vectors, size=None, name=None, **kw):
+    """reference layers.py linear_comb_layer:5288 — z_i = sum_j w_j *
+    v[j, i] with vectors flattened [B, M*N]."""
+    m = int(weights.shape[-1])
+    n = size or int(vectors.shape[-1]) // m   # reference: N = |v| / |w|
+    v = flayers.reshape(vectors, [-1, m, n])
+    w = flayers.reshape(weights, [-1, m, 1])
+    out = flayers.reduce_sum(flayers.elementwise_mul(v, w), dim=1)
+    _register_named_output(name, out)
+    return out
+
+
+def block_expand(input, block_x=1, block_y=1, stride_x=1, stride_y=1,
+                 padding_x=0, padding_y=0, name=None, **kw):
+    """reference layers.py block_expand_layer:5358 — image patches to a
+    patch sequence (im2sequence_op)."""
+    out = flayers.im2sequence(input, filter_size=[block_y, block_x],
+                              stride=[stride_y, stride_x],
+                              padding=[padding_y, padding_x])
+    _register_named_output(name, out)
+    return out
+
+
+def nce(input, label, num_classes=None, num_neg_samples=10,
+        param_attr=None, bias_attr=None, name=None, **kw):
+    """reference layers.py nce_layer:5817 — noise-contrastive
+    estimation cost."""
+    out = flayers.nce(input, label, num_total_classes=num_classes,
+                      num_neg_samples=num_neg_samples,
+                      param_attr=param_attr, bias_attr=bias_attr)
+    out = flayers.mean(out)
+    _register_named_output(name, out)
+    return out
+
+
+def rank_cost(left, right, label, weight=None, name=None, **kw):
+    """reference layers.py rank_cost:5936 — pairwise RankNet cost;
+    ``weight`` scales each pair's cost."""
+    cost = flayers.rank_loss(label, left, right)
+    if weight is not None:
+        cost = flayers.elementwise_mul(cost, weight)
+    out = flayers.mean(cost)
+    _register_named_output(name, out)
+    return out
+
+
+def sum_cost(input, name=None, **kw):
+    """reference layers.py sum_cost:6171 — sum of the input as cost
+    (batch mean of per-row sums)."""
+    out = flayers.mean(flayers.reduce_sum(input, dim=1))
+    _register_named_output(name, out)
+    return out
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **kw):
+    """reference layers.py multi_binary_label_cross_entropy:6311 —
+    element-wise binary CE on PROBABILITIES (post-sigmoid), labels a
+    dense 0/1 multi-hot matrix; batch mean of per-sample sums."""
+    eps = 1e-7
+    p = flayers.clip(input, min=eps, max=1.0 - eps)
+    lbl = flayers.cast(label, "float32")
+    pos = flayers.elementwise_mul(lbl, flayers.log(p))
+    one_m = flayers.scale(lbl, scale=-1.0, bias=1.0,
+                          bias_after_scale=True)
+    neg = flayers.elementwise_mul(
+        one_m, flayers.log(flayers.scale(p, scale=-1.0, bias=1.0,
+                                         bias_after_scale=True)))
+    ce = flayers.scale(flayers.elementwise_add(pos, neg), scale=-1.0)
+    out = flayers.mean(flayers.reduce_sum(ce, dim=1))
+    _register_named_output(name, out)
+    return out
+
+
+def smooth_l1_cost(input, label, name=None, **kw):
+    """reference layers.py smooth_l1_cost:6471."""
+    out = flayers.mean(flayers.smooth_l1(input, label))
+    _register_named_output(name, out)
+    return out
+
+
+def multiplex(input, name=None, **kw):
+    """reference layers.py multiplex_layer:6527 — first input is the
+    per-row selector index, the rest are candidate layers."""
+    assert isinstance(input, (list, tuple)) and len(input) >= 2
+    out = flayers.multiplex(list(input[1:]), input[0])
+    _register_named_output(name, out)
+    return out
+
+
+def row_conv(input, context_len, act=None, param_attr=None, name=None,
+             **kw):
+    """reference layers.py row_conv_layer:6611 — lookahead convolution
+    (context_len rows = current + future)."""
+    out = flayers.row_conv(input, future_context_size=context_len - 1,
+                           param_attr=param_attr, act=_act_name(act))
+    _register_named_output(name, out)
+    return out
+
+
+def switch_order(input, reshape_axis=None, act=None, name=None, **kw):
+    """reference layers.py switch_order_layer:6866 — NCHW -> NHWC.
+    The reference's reshape_axis groups [H, W] before the swap; for a
+    4-d input that is axis 3 (the only supported layout here)."""
+    if reshape_axis not in (None, 3):
+        raise ValueError(
+            f"switch_order: only the NCHW->NHWC form (reshape_axis=3) "
+            f"is supported, got {reshape_axis}")
+    out = flayers.transpose(input, perm=[0, 2, 3, 1])
+    if act is not None and _act_name(act):
+        out = getattr(flayers, _act_name(act))(out)
+    _register_named_output(name, out)
+    return out
+
+
+def crop(input, offset, axis=2, shape=None, name=None, **kw):
+    """reference layers.py crop_layer:6915 — crop ``shape`` starting at
+    ``offset`` from ``axis`` onward (leading dims untouched)."""
+    if shape is None:
+        raise ValueError(
+            "crop: shape= is required (the reference's second-input "
+            "shape-donor mode is not supported; pass the target shape)")
+    ndim = len(input.shape or [])
+    full_off = ([0] * axis + list(offset))[:ndim]
+    full_off += [0] * (ndim - len(full_off))
+    shape = list(shape)
+    if len(shape) < ndim:   # reference style: shape covers axis.. dims
+        shape = list(input.shape[:ndim - len(shape)]) + shape
+    full_shape = [-1 if s in (None, -1) or i == 0 else s
+                  for i, s in enumerate(shape)]
+    out = flayers.crop(input, shape=full_shape, offsets=full_off)
+    _register_named_output(name, out)
+    return out
+
+
+def seq_slice(input, starts, ends, name=None, **kw):
+    """reference layers.py seq_slice_layer:7046 — per-sequence
+    [starts, ends) windows; either side may be None."""
+    big = 1 << 30
+    if starts is None:
+        assert ends is not None
+        starts = flayers.scale(ends, scale=0.0)
+    if ends is not None:
+        length = flayers.elementwise_sub(ends, starts)
+    else:
+        length = flayers.scale(starts, scale=0.0, bias=float(big),
+                               bias_after_scale=True)
+    out = flayers.sequence_slice(input, starts, length)
+    _register_named_output(name, out)
+    return out
+
+
+def sub_seq(input, offsets, sizes, act=None, name=None, **kw):
+    """reference layers.py sub_seq_layer:7361 — slice each sequence at
+    its own offset/size."""
+    out = flayers.sequence_slice(input, offsets, sizes)
+    if act is not None and _act_name(act):
+        out = getattr(flayers, _act_name(act))(out)
+    _register_named_output(name, out)
+    return out
+
+
+def resize(input, size, name=None, **kw):
+    """reference layers.py resize_layer:7340 — reshape rows to width
+    ``size`` (batch extent adjusts)."""
+    out = flayers.reshape(input, [-1, size])
+    _register_named_output(name, out)
+    return out
+
+
+def priorbox(input, image, aspect_ratio, variance, min_size,
+             max_size=None, name=None, **kw):
+    """reference layers.py priorbox_layer:1127 — SSD anchors."""
+    boxes, variances = flayers.prior_box(
+        input, image, min_sizes=list(min_size),
+        max_sizes=list(max_size or []),
+        aspect_ratios=list(aspect_ratio), variances=list(variance))
+    return boxes, variances
+
+
+def detection_output(input_loc, input_conf, priorbox, num_classes=None,
+                     nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                     confidence_threshold=0.01, background_id=0,
+                     name=None, **kw):
+    """reference layers.py detection_output_layer:1249
+    (DetectionOutputLayer.cpp): decode the variance-encoded loc
+    predictions ([B, P, 4], the multibox_loss convention) against the
+    priors, softmax the confidences, per-class NMS with background
+    masked.  ``priorbox`` is the (boxes, variances) pair from
+    paddle.layer.priorbox / fluid prior_box."""
+    boxes, variances = priorbox
+    out = flayers.detection_output(
+        input_loc, input_conf, boxes, variances,
+        background_id=background_id,
+        nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k,
+        confidence_threshold=confidence_threshold)
+    _register_named_output(name, out)
+    return out
+
+
+def roi_pool(input, rois, pooled_width, pooled_height, spatial_scale,
+             name=None, **kw):
+    """reference layers.py roi_pool_layer:1330 — Fast R-CNN ROI
+    pooling."""
+    out = flayers.roi_pool(input, rois, pooled_height=pooled_height,
+                           pooled_width=pooled_width,
+                           spatial_scale=spatial_scale)
+    _register_named_output(name, out)
+    return out
+
+
+def identity_projection(input, offset=None, size=None, **kw):
+    """reference layers.py identity_projection — pass-through (offset
+    slices the feature axis)."""
+    if offset is None and size is None:
+        return input
+    d = size or (int(input.shape[-1]) - (offset or 0))
+    return flayers.crop(input, shape=[-1, d],
+                        offsets=[0, offset or 0])
+
+
+def dotmul_projection(input, param_attr=None, name=None, **kw):
+    """reference layers.py dotmul_projection — elementwise product with
+    a learned [1, D] weight."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("dotmul_projection", param_attr=param_attr,
+                         name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr, shape=[1, d],
+                                dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op("elementwise_mul", {"X": input, "Y": w},
+                     {"Out": out})
+    _register_named_output(name, out)
+    return out
+
+
+def dotmul_operator(a, b, scale=1.0, **kw):
+    """reference layers.py dotmul_operator — a .* b, scaled."""
+    out = flayers.elementwise_mul(a, b)
+    if scale != 1.0:
+        out = flayers.scale(out, scale=float(scale))
+    return out
+
+
+def slice_projection(input, slices, **kw):
+    """reference layers.py slice_projection — concat of [start, end)
+    feature slices."""
+    parts = [flayers.crop(input, shape=[-1, e - s], offsets=[0, s])
+             for s, e in slices]
+    return flayers.concat(parts, axis=1) if len(parts) > 1 else parts[0]
 
 
 class BeamInput:

@@ -1,0 +1,384 @@
+"""v2-surface aliases for the COMPAT rows that previously shipped only
+as fluid layers (reference trainer_config_helpers names minus `_layer`).
+Each test drives the alias through a real program with a numpy golden
+where the math is local; pure pass-throughs get shape/structure checks
+(their fluid ops have their own OpTests).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu import fluid
+from paddle_tpu.fluid.core.lod import SeqArray, make_seq
+
+
+def _run(main, feed, fetches, startup=None, scope=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    if startup is not None:
+        exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetches,
+                   return_numpy=False)
+
+
+def test_expand_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [3], "float32")
+    y = fluid.layers.data("y", [1], "float32", lod_level=1)
+    out = paddle.layer.expand(input=x, expand_as=y)
+    got, = _run(main, {"x": np.asarray([[1, 2, 3], [4, 5, 6]], np.float32),
+                       "y": make_seq([np.zeros((2, 1)), np.zeros((3, 1))],
+                                     dtype=np.float32)}, [out])
+    assert isinstance(got, SeqArray)
+    np.testing.assert_array_equal(np.asarray(got.lengths), [2, 3])
+    np.testing.assert_allclose(np.asarray(got.data)[1, 2], [4, 5, 6])
+
+
+def test_seq_reshape_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [4], "float32", lod_level=1)
+    out = paddle.layer.seq_reshape(input=x, reshape_size=2)
+    got, = _run(main, {"x": make_seq([np.arange(8).reshape(2, 4)],
+                                     dtype=np.float32)}, [out])
+    np.testing.assert_array_equal(np.asarray(got.lengths), [4])
+    np.testing.assert_allclose(np.asarray(got.data)[0, 1], [2, 3])
+
+
+def test_scaling_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [3], "float32")
+    w = fluid.layers.data("w", [1], "float32")
+    out = paddle.layer.scaling(input=x, weight=w)
+    got, = _run(main, {"x": np.ones((2, 3), np.float32),
+                       "w": np.asarray([[2.0], [3.0]], np.float32)}, [out])
+    np.testing.assert_allclose(np.asarray(got),
+                               [[2, 2, 2], [3, 3, 3]])
+
+
+def test_rotate_alias_flat_input(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [4], "float32")
+    out = paddle.layer.rotate(input=x, height=2, width=2)
+    got, = _run(main, {"x": np.asarray([[1, 2, 3, 4]], np.float32)}, [out])
+    # [[1,2],[3,4]] rotated 90 cw -> [[3,1],[4,2]]
+    np.testing.assert_allclose(np.asarray(got)[0, 0], [[3, 1], [4, 2]])
+
+
+def test_spp_and_cmrnorm_aliases(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [2, 4, 4], "float32")
+    s = paddle.layer.spp(input=x, pyramid_height=2)
+    n = paddle.layer.img_cmrnorm(input=x, size=5, scale=0.0128,
+                                 power=0.75)
+    xv = np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32)
+    sg, ng = _run(main, {"x": xv}, [s, n])
+    assert np.asarray(sg).shape == (1, 2 * (1 + 4))
+    # reference CrossMapNormal: out = x / (1 + scale*sum_window x^2)^beta
+    sq = xv ** 2
+    acc = sq.sum(axis=1, keepdims=True)    # window 5 >= 2 channels: all
+    want = xv / (1 + 0.0128 * acc) ** 0.75
+    np.testing.assert_allclose(np.asarray(ng), want, rtol=1e-5)
+
+
+def test_batch_norm_alias_trains(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [3, 2, 2], "float32")
+    out = paddle.layer.batch_norm(input=x,
+                                  act=paddle.activation.Relu())
+    loss = fluid.layers.reduce_mean(out)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    got, = _run(main, {"x": np.random.RandomState(0).rand(
+        4, 3, 2, 2).astype(np.float32)}, [loss], startup=startup)
+    assert np.isfinite(float(np.asarray(got)))
+
+
+def test_norm_aliases(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [4], "float32")
+    img = fluid.layers.data("img", [3, 2, 2], "float32")
+    r = paddle.layer.row_l2_norm(input=x)
+    c = paddle.layer.cross_channel_norm(input=img)
+    rg, cg = _run(main, {
+        "x": np.asarray([[3, 4, 0, 0]], np.float32),
+        "img": np.ones((1, 3, 2, 2), np.float32),
+    }, [r, c], startup=startup)
+    np.testing.assert_allclose(np.asarray(rg), [[0.6, 0.8, 0, 0]],
+                               atol=1e-6)
+    # unit channel norm * scale(init 1): each pixel 1/sqrt(3)
+    np.testing.assert_allclose(np.asarray(cg), 1 / np.sqrt(3), atol=1e-5)
+
+
+def test_tensor_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    a = fluid.layers.data("a", [3], "float32")
+    b = fluid.layers.data("b", [4], "float32")
+    out = paddle.layer.tensor(a=a, b=b, size=5)
+    got, = _run(main, {"a": np.ones((2, 3), np.float32),
+                       "b": np.ones((2, 4), np.float32)}, [out],
+                startup=startup)
+    assert np.asarray(got).shape == (2, 5)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_linear_comb_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    w = fluid.layers.data("w", [2], "float32")
+    v = fluid.layers.data("v", [6], "float32")
+    out = paddle.layer.linear_comb(weights=w, vectors=v, size=3)
+    got, = _run(main, {
+        "w": np.asarray([[2.0, 10.0]], np.float32),
+        "v": np.arange(6, dtype=np.float32).reshape(1, 6),
+    }, [out])
+    # rows [0,1,2] and [3,4,5]: 2*[0,1,2] + 10*[3,4,5]
+    np.testing.assert_allclose(np.asarray(got), [[30, 42, 54]])
+
+
+def test_linear_comb_infers_size(fresh_programs):
+    main, startup, scope = fresh_programs
+    w = fluid.layers.data("w", [2], "float32")
+    v = fluid.layers.data("v", [6], "float32")
+    out = paddle.layer.linear_comb(weights=w, vectors=v)   # size omitted
+    got, = _run(main, {
+        "w": np.asarray([[1.0, 1.0]], np.float32),
+        "v": np.arange(6, dtype=np.float32).reshape(1, 6),
+    }, [out])
+    np.testing.assert_allclose(np.asarray(got), [[3, 5, 7]])
+
+
+def test_crop_requires_shape(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [1, 4, 4], "float32")
+    with pytest.raises(ValueError, match="shape"):
+        paddle.layer.crop(input=x, offset=[1, 1])
+
+
+def test_switch_order_rejects_odd_axis(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [2, 3, 4], "float32")
+    with pytest.raises(ValueError, match="reshape_axis"):
+        paddle.layer.switch_order(input=x, reshape_axis=2)
+
+
+def test_rank_cost_weighted(fresh_programs):
+    main, startup, scope = fresh_programs
+    left = fluid.layers.data("l", [1], "float32")
+    right = fluid.layers.data("r", [1], "float32")
+    lbl = fluid.layers.data("y", [1], "float32")
+    wgt = fluid.layers.data("wg", [1], "float32")
+    rc = paddle.layer.rank_cost(left=left, right=right, label=lbl,
+                                weight=wgt)
+    lv = np.asarray([[1.0], [0.2]], np.float32)
+    rv = np.asarray([[0.5], [0.8]], np.float32)
+    yv = np.asarray([[1.0], [0.0]], np.float32)
+    wv = np.asarray([[2.0], [0.0]], np.float32)
+    got, = _run(main, {"l": lv, "r": rv, "y": yv, "wg": wv}, [rc])
+    o = lv - rv
+    want = ((np.log1p(np.exp(o)) - yv * o) * wv).mean()
+    np.testing.assert_allclose(float(np.asarray(got)), want, rtol=1e-5)
+
+
+def test_detection_output_decodes_and_nms(fresh_programs):
+    """Encode a known box with the multibox_loss variance convention,
+    then check detection_output decodes it back and NMS emits it with
+    the right class."""
+    main, startup, scope = fresh_programs
+    P, C = 2, 3
+    loc = fluid.layers.data("loc", [P, 4], "float32")
+    conf = fluid.layers.data("conf", [P, C], "float32")
+    pb = fluid.layers.data("pb", [P, 4], "float32")
+    pv = fluid.layers.data("pv", [P, 4], "float32")
+    out = paddle.layer.detection_output(
+        input_loc=loc, input_conf=conf, priorbox=(pb, pv),
+        num_classes=C, keep_top_k=4, confidence_threshold=0.1)
+    priors = np.asarray([[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9]], np.float32)
+    var = np.full((P, 4), 0.1, np.float32)
+    gt = np.asarray([0.1, 0.1, 0.3, 0.3], np.float32)   # true box
+    # encode gt against prior 0 (ssd_loss convention)
+    pcx, pcy = 0.2, 0.2
+    pw = ph = 0.4
+    gcx, gcy, gw, gh = 0.2, 0.2, 0.2, 0.2
+    enc = np.asarray([(gcx - pcx) / pw / 0.1, (gcy - pcy) / ph / 0.1,
+                      np.log(gw / pw) / 0.1, np.log(gh / ph) / 0.1],
+                     np.float32)
+    locv = np.stack([enc, np.zeros(4, np.float32)])[None]   # [1, P, 4]
+    confv = np.asarray([[[0.0, 5.0, 0.0],      # prior 0: class 1
+                         [5.0, 0.0, 0.0]]],    # prior 1: background
+                       np.float32)
+    got, = _run(main, {"loc": locv, "conf": confv,
+                       "pb": priors, "pv": var}, [out])
+    rows = np.asarray(got)[0]
+    live = rows[rows[:, 0] >= 0]
+    assert len(live) >= 1
+    assert live[0, 0] == 1.0                    # class 1, not background
+    np.testing.assert_allclose(live[0, 2:], gt, atol=1e-4)
+
+
+def test_block_expand_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [1, 4, 4], "float32")
+    out = paddle.layer.block_expand(input=x, block_x=2, block_y=2,
+                                    stride_x=2, stride_y=2)
+    got, = _run(main, {"x": np.arange(16, dtype=np.float32).reshape(
+        1, 1, 4, 4)}, [out])
+    assert np.asarray(got).shape == (1, 4, 4)   # 4 patches x (1*2*2)
+
+
+def test_nce_alias_trains(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [8], "float32")
+    lbl = fluid.layers.data("lbl", [1], "int64")
+    cost = paddle.layer.nce(input=x, label=lbl, num_classes=20,
+                            num_neg_samples=4)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+    rng = np.random.RandomState(0)
+    got, = _run(main, {"x": rng.rand(4, 8).astype(np.float32),
+                       "lbl": rng.randint(0, 20, (4, 1)).astype(np.int64)},
+                [cost], startup=startup)
+    assert np.isfinite(float(np.asarray(got)))
+
+
+def test_rank_and_sum_cost_aliases(fresh_programs):
+    main, startup, scope = fresh_programs
+    left = fluid.layers.data("l", [1], "float32")
+    right = fluid.layers.data("r", [1], "float32")
+    lbl = fluid.layers.data("y", [1], "float32")
+    rc = paddle.layer.rank_cost(left=left, right=right, label=lbl)
+    xs = fluid.layers.data("xs", [3], "float32")
+    sc = paddle.layer.sum_cost(input=xs)
+    lv = np.asarray([[1.0], [0.2]], np.float32)
+    rv = np.asarray([[0.5], [0.8]], np.float32)
+    yv = np.asarray([[1.0], [0.0]], np.float32)
+    xv = np.asarray([[1, 2, 3], [4, 5, 6]], np.float32)
+    rg, sg = _run(main, {"l": lv, "r": rv, "y": yv, "xs": xv}, [rc, sc])
+    o = lv - rv
+    want = (np.log1p(np.exp(o)) - yv * o).mean()
+    np.testing.assert_allclose(float(np.asarray(rg)), want, rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(sg)), (6 + 15) / 2.0,
+                               rtol=1e-6)
+
+
+def test_multi_binary_label_ce_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    p = fluid.layers.data("p", [3], "float32")
+    lbl = fluid.layers.data("lbl", [3], "float32")
+    cost = paddle.layer.multi_binary_label_cross_entropy(input=p,
+                                                         label=lbl)
+    pv = np.asarray([[0.9, 0.2, 0.6]], np.float32)
+    lv = np.asarray([[1.0, 0.0, 1.0]], np.float32)
+    got, = _run(main, {"p": pv, "lbl": lv}, [cost])
+    want = -(lv * np.log(pv) + (1 - lv) * np.log(1 - pv)).sum(1).mean()
+    np.testing.assert_allclose(float(np.asarray(got)), want, rtol=1e-4)
+
+
+def test_smooth_l1_cost_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [2], "float32")
+    y = fluid.layers.data("y", [2], "float32")
+    cost = paddle.layer.smooth_l1_cost(input=x, label=y)
+    got, = _run(main, {"x": np.zeros((1, 2), np.float32),
+                       "y": np.asarray([[0.1, 2.0]], np.float32)}, [cost])
+    assert np.isfinite(float(np.asarray(got)))
+
+
+def test_multiplex_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    idx = fluid.layers.data("i", [1], "int32")
+    a = fluid.layers.data("a", [2], "float32")
+    b = fluid.layers.data("b", [2], "float32")
+    out = paddle.layer.multiplex(input=[idx, a, b])
+    got, = _run(main, {
+        "i": np.asarray([[0], [1]], np.int32),
+        "a": np.asarray([[1, 1], [2, 2]], np.float32),
+        "b": np.asarray([[3, 3], [4, 4]], np.float32),
+    }, [out])
+    np.testing.assert_allclose(np.asarray(got), [[1, 1], [4, 4]])
+
+
+def test_row_conv_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [4], "float32", lod_level=1)
+    out = paddle.layer.row_conv(input=x, context_len=2)
+    got, = _run(main, {"x": make_seq([np.ones((3, 4))],
+                                     dtype=np.float32)}, [out],
+                startup=startup)
+    assert np.asarray(got.data).shape == (1, 3, 4)
+
+
+def test_switch_order_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [2, 3, 4], "float32")
+    out = paddle.layer.switch_order(input=x)
+    got, = _run(main, {"x": np.zeros((1, 2, 3, 4), np.float32)}, [out])
+    assert np.asarray(got).shape == (1, 3, 4, 2)
+
+
+def test_crop_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [1, 4, 4], "float32")
+    out = paddle.layer.crop(input=x, offset=[1, 1], shape=[2, 2])
+    xv = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got, = _run(main, {"x": xv}, [out])
+    np.testing.assert_allclose(np.asarray(got)[0, 0],
+                               xv[0, 0, 1:3, 1:3])
+
+
+def test_seq_slice_and_sub_seq_aliases(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [1], "float32", lod_level=1)
+    st = fluid.layers.data("st", [1], "float32")
+    en = fluid.layers.data("en", [1], "float32")
+    both = paddle.layer.seq_slice(input=x, starts=st, ends=en)
+    only_start = paddle.layer.seq_slice(input=x, starts=st, ends=None)
+    sub = paddle.layer.sub_seq(input=x, offsets=st, sizes=en)
+    feed = {"x": make_seq([[1, 2, 3, 4]], dtype=np.float32),
+            "st": np.asarray([[1]], np.float32),
+            "en": np.asarray([[3]], np.float32)}
+    bg, og, sg = _run(main, feed, [both, only_start, sub])
+    np.testing.assert_array_equal(np.asarray(bg.lengths), [2])
+    np.testing.assert_allclose(np.asarray(bg.data)[0, :2], [2, 3])
+    np.testing.assert_array_equal(np.asarray(og.lengths), [3])
+    np.testing.assert_array_equal(np.asarray(sg.lengths), [3])
+
+
+def test_resize_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [6], "float32")
+    out = paddle.layer.resize(input=x, size=3)
+    got, = _run(main, {"x": np.arange(12, dtype=np.float32).reshape(
+        2, 6)}, [out])
+    assert np.asarray(got).shape == (4, 3)
+
+
+def test_priorbox_alias(fresh_programs):
+    main, startup, scope = fresh_programs
+    feat = fluid.layers.data("f", [2, 2, 2], "float32")
+    img = fluid.layers.data("im", [3, 8, 8], "float32")
+    boxes, variances = paddle.layer.priorbox(
+        input=feat, image=img, aspect_ratio=[2.0],
+        variance=[0.1, 0.1, 0.2, 0.2], min_size=[4.0])
+    bg, vg = _run(main, {"f": np.zeros((1, 2, 2, 2), np.float32),
+                         "im": np.zeros((1, 3, 8, 8), np.float32)},
+                  [boxes, variances])
+    assert np.asarray(bg).shape == np.asarray(vg).shape
+    assert np.asarray(bg).shape[-1] == 4
+
+
+def test_projection_aliases(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [4], "float32")
+    y = fluid.layers.data("y", [4], "float32")
+    ident = paddle.layer.identity_projection(input=x)
+    sliced = paddle.layer.identity_projection(input=x, offset=1, size=2)
+    dm = paddle.layer.dotmul_operator(a=x, b=y, scale=2.0)
+    dp = paddle.layer.dotmul_projection(input=x)
+    sp = paddle.layer.slice_projection(input=x, slices=[(0, 1), (3, 4)])
+    assert ident is x
+    xv = np.asarray([[1, 2, 3, 4]], np.float32)
+    yv = np.asarray([[2, 2, 2, 2]], np.float32)
+    sg, dg, pg, spg = _run(main, {"x": xv, "y": yv},
+                           [sliced, dm, dp, sp], startup=startup)
+    np.testing.assert_allclose(np.asarray(sg), [[2, 3]])
+    np.testing.assert_allclose(np.asarray(dg), [[4, 8, 12, 16]])
+    assert np.asarray(pg).shape == (1, 4)
+    np.testing.assert_allclose(np.asarray(spg), [[1, 4]])
