@@ -1070,8 +1070,12 @@ def spp(input, pool_type=None, pyramid_height=3, name=None, **kw):
 
 def img_cmrnorm(input, size=5, scale=0.0128, power=0.75, name=None, **kw):
     """reference layers.py img_cmrnorm_layer:3120 — cross-map response
-    normalization (AlexNet LRN); scale is the reference's alpha/size."""
-    out = flayers.lrn(input, n=size, k=1.0, alpha=scale, beta=power)
+    normalization (AlexNet LRN).  The reference's config lowering
+    divides scale by the window size for cmrnorm-projection
+    (config_parser.py:1352 `norm_conf.scale /= norm.size`) before
+    CrossMapNormalOp computes (1 + scale*sum)^-power."""
+    out = flayers.lrn(input, n=size, k=1.0, alpha=scale / size,
+                      beta=power)
     _register_named_output(name, out)
     return out
 

@@ -16,7 +16,104 @@ __all__ = ["img_conv_bn_pool", "img_separable_conv", "small_vgg",
            "bidirectional_gru", "simple_img_conv_pool",
            "img_conv_group", "vgg_16_network", "text_conv_pool",
            "sequence_conv_pool", "dot_product_attention",
-           "multi_head_attention"]
+           "multi_head_attention", "lstmemory_unit", "gru_unit"]
+
+
+def _unique_unit_name(prefix):
+    """Unique default base name per unit call (the reference's
+    @wrap_name_default) — two unnamed units in one step must not share
+    state memories."""
+    from ..fluid.framework import unique_name
+
+    return unique_name.generate(prefix)
+
+
+def _sub_attr(param_attr, sub_name):
+    """Derive a per-weight ParamAttr: a shared user attr whose name is
+    set would make the unit's differently-shaped weights collide, so a
+    named attr gets a distinct sub-name per weight."""
+    from ..fluid.param_attr import ParamAttr
+
+    attr = ParamAttr.to_attr(param_attr)
+    if attr.name:
+        import copy
+
+        attr = copy.copy(attr)
+        attr.name = f"{attr.name}.{sub_name}"
+    return attr
+
+
+def lstmemory_unit(input, size=None, name=None, act=None, gate_act=None,
+                   param_attr=None, bias_attr=None, **kw):
+    """One LSTM step for use INSIDE a recurrent_group step function —
+    reference networks.py lstmemory_unit (mixed projection +
+    lstm_step_layer).  Declares its own h/c memories (zero-booted),
+    computes the four gates from [input, h_prev] with one fc, and
+    registers the state updates with the enclosing group.  Returns the
+    new hidden state."""
+    assert size, "lstmemory_unit needs size="
+    base = name or _unique_unit_name("lstmemory_unit")
+    h_prev = v2layer.memory(name=f"{base}__h", size=size)
+    c_prev = v2layer.memory(name=f"{base}__c", size=size)
+    mixed = flayers.elementwise_add(
+        flayers.fc(input=input, size=4 * size,
+                   param_attr=_sub_attr(param_attr, f"{base}.w_x"),
+                   bias_attr=True if bias_attr is None else bias_attr),
+        flayers.fc(input=h_prev, size=4 * size,
+                   param_attr=_sub_attr(param_attr, f"{base}.w_h"),
+                   bias_attr=False))
+    from .layer import _act_name, _register_named_output
+
+    ga = _act_name(gate_act) or "sigmoid"
+    aa = _act_name(act) or "tanh"
+    i, f, c_in, o = flayers.split(mixed, 4, dim=-1)
+    i = getattr(flayers, ga)(i)
+    f = getattr(flayers, ga)(f)
+    o = getattr(flayers, ga)(o)
+    c_new = flayers.elementwise_add(
+        flayers.elementwise_mul(f, c_prev),
+        flayers.elementwise_mul(i, getattr(flayers, aa)(c_in)))
+    h_new = flayers.elementwise_mul(o, getattr(flayers, aa)(c_new))
+    _register_named_output(f"{base}__c", c_new)
+    _register_named_output(f"{base}__h", h_new)
+    return h_new
+
+
+def gru_unit(input, size=None, name=None, act=None, gate_act=None,
+             param_attr=None, bias_attr=None, **kw):
+    """One GRU step for use INSIDE a recurrent_group step function —
+    reference networks.py gru_unit (gru_step_layer).  Declares its own
+    hidden memory, computes update/reset gates from [input, h_prev] and
+    the candidate from [input, r*h_prev], registers the state update.
+    Returns the new hidden state."""
+    assert size, "gru_unit needs size="
+    base = name or _unique_unit_name("gru_unit")
+    h_prev = v2layer.memory(name=f"{base}__h", size=size)
+    from .layer import _act_name, _register_named_output
+
+    ga = _act_name(gate_act) or "sigmoid"
+    aa = _act_name(act) or "tanh"
+    zr = getattr(flayers, ga)(flayers.elementwise_add(
+        flayers.fc(input=input, size=2 * size,
+                   param_attr=_sub_attr(param_attr, f"{base}.wg_x"),
+                   bias_attr=True if bias_attr is None else bias_attr),
+        flayers.fc(input=h_prev, size=2 * size,
+                   param_attr=_sub_attr(param_attr, f"{base}.wg_h"),
+                   bias_attr=False)))
+    z, r = flayers.split(zr, 2, dim=-1)
+    cand = getattr(flayers, aa)(flayers.elementwise_add(
+        flayers.fc(input=input, size=size,
+                   param_attr=_sub_attr(param_attr, f"{base}.wc_x"),
+                   bias_attr=True if bias_attr is None else bias_attr),
+        flayers.fc(input=flayers.elementwise_mul(r, h_prev), size=size,
+                   param_attr=_sub_attr(param_attr, f"{base}.wc_h"),
+                   bias_attr=False)))
+    # h = (1 - z) * h_prev + z * cand
+    h_new = flayers.elementwise_add(
+        flayers.elementwise_sub(h_prev, flayers.elementwise_mul(z, h_prev)),
+        flayers.elementwise_mul(z, cand))
+    _register_named_output(f"{base}__h", h_new)
+    return h_new
 
 
 def simple_lstm(input, size, reverse=False, act=None, gate_act=None,

@@ -73,15 +73,17 @@ def test_spp_and_cmrnorm_aliases(fresh_programs):
     xv = np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32)
     sg, ng = _run(main, {"x": xv}, [s, n])
     assert np.asarray(sg).shape == (1, 2 * (1 + 4))
-    # reference CrossMapNormal: out = x / (1 + scale*sum_window x^2)^beta
+    # reference CrossMapNormal with the config_parser scale/size rule:
+    # out = x / (1 + (scale/size)*sum_window x^2)^beta
     sq = xv ** 2
     acc = sq.sum(axis=1, keepdims=True)    # window 5 >= 2 channels: all
-    want = xv / (1 + 0.0128 * acc) ** 0.75
+    want = xv / (1 + (0.0128 / 5) * acc) ** 0.75
     np.testing.assert_allclose(np.asarray(ng), want, rtol=1e-5)
 
 
 def test_batch_norm_alias_trains(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for the convergence assert
     x = fluid.layers.data("x", [3, 2, 2], "float32")
     out = paddle.layer.batch_norm(input=x,
                                   act=paddle.activation.Relu())
@@ -226,6 +228,7 @@ def test_block_expand_alias(fresh_programs):
 
 def test_nce_alias_trains(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for the convergence assert
     x = fluid.layers.data("x", [8], "float32")
     lbl = fluid.layers.data("lbl", [1], "int64")
     cost = paddle.layer.nce(input=x, label=lbl, num_classes=20,
@@ -362,6 +365,114 @@ def test_priorbox_alias(fresh_programs):
                   [boxes, variances])
     assert np.asarray(bg).shape == np.asarray(vg).shape
     assert np.asarray(bg).shape[-1] == 4
+
+
+def test_lstmemory_unit_in_recurrent_group(fresh_programs):
+    """reference networks.py lstmemory_unit: a per-step LSTM cell usable
+    inside recurrent_group — trains a toy last-step classifier."""
+    main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for the convergence assert
+    x = fluid.layers.data("x", [4], "float32", lod_level=1)
+    lbl = fluid.layers.data("lbl", [1], "int64")
+
+    def step(xt):
+        return paddle.networks.lstmemory_unit(input=xt, size=8)
+
+    seq = paddle.layer.recurrent_group(step, x)
+    final = paddle.layer.last_seq(seq)
+    pred = paddle.layer.fc(input=final, size=3,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": make_seq([rng.rand(5, 4), rng.rand(3, 4)],
+                          dtype=np.float32),
+            "lbl": np.asarray([[0], [2]], np.int64)}
+    losses = [float(np.asarray(exe.run(main, feed=feed,
+                                       fetch_list=[cost])[0]))
+              for _ in range(40)]
+    assert losses[-1] < losses[0]
+
+
+def test_gru_unit_in_recurrent_group(fresh_programs):
+    """reference networks.py gru_unit inside recurrent_group."""
+    main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for the convergence assert
+    x = fluid.layers.data("x", [4], "float32", lod_level=1)
+    lbl = fluid.layers.data("lbl", [1], "int64")
+
+    def step(xt):
+        return paddle.networks.gru_unit(input=xt, size=6)
+
+    seq = paddle.layer.recurrent_group(step, x)
+    final = paddle.layer.last_seq(seq)
+    pred = paddle.layer.fc(input=final, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    feed = {"x": make_seq([rng.rand(4, 4), rng.rand(2, 4)],
+                          dtype=np.float32),
+            "lbl": np.asarray([[0], [1]], np.int64)}
+    losses = [float(np.asarray(exe.run(main, feed=feed,
+                                       fetch_list=[cost])[0]))
+              for _ in range(40)]
+    assert losses[-1] < losses[0]
+
+
+def test_ssd_train_to_detect_pipeline(fresh_programs):
+    """The full SSD story: prior_box -> loc/conf heads -> multibox_loss
+    training until the heads fit one ground-truth box, then
+    detection_output on the SAME heads decodes it back (reference
+    MultiBoxLossLayer + DetectionOutputLayer working as a pair)."""
+    main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for the convergence assert
+    feat = fluid.layers.data("feat", [2, 2, 2], "float32")
+    img = fluid.layers.data("img", [3, 8, 8], "float32")
+    gtb = fluid.layers.data("gtb", [4], "float32", lod_level=1)
+    gtl = fluid.layers.data("gtl", [1], "int64", lod_level=1)
+    pb, pv = fluid.layers.prior_box(feat, img, min_sizes=[2.0],
+                                    aspect_ratios=[1.0],
+                                    variances=[0.1, 0.1, 0.2, 0.2])
+    P = 4  # 2x2 feature map x 1 prior
+    loc = paddle.layer.fc(input=fluid.layers.reshape(feat, [-1, 8]),
+                          size=P * 4)
+    conf = paddle.layer.fc(input=fluid.layers.reshape(feat, [-1, 8]),
+                           size=P * 3)
+    loc3 = fluid.layers.reshape(loc, [-1, P, 4])
+    conf3 = fluid.layers.reshape(conf, [-1, P, 3])
+    cost = paddle.layer.multibox_loss(loc3, conf3, (pb, pv), gtb, gtl,
+                                      num_classes=3)
+    fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(cost)
+    det = fluid.layers.detection_output(loc3, conf3, pb, pv,
+                                        keep_top_k=4,
+                                        confidence_threshold=0.2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    featv = rng.rand(1, 2, 2, 2).astype(np.float32)
+    imgv = np.zeros((1, 3, 8, 8), np.float32)
+    gt = np.asarray([[0.1, 0.1, 0.4, 0.4]], np.float32)
+    feed = {"feat": featv, "img": imgv,
+            "gtb": make_seq([gt], dtype=np.float32),
+            "gtl": make_seq([[[1]]], dtype=np.int64)}
+    losses = []
+    for _ in range(150):
+        c, = exe.run(main, feed=feed, fetch_list=[cost])
+        losses.append(float(np.asarray(c)))
+    assert losses[-1] < losses[0] * 0.5
+    rows, = exe.run(main, feed=feed, fetch_list=[det],
+                    return_numpy=False)
+    rows = np.asarray(rows)[0]
+    live = rows[rows[:, 0] >= 0]
+    assert len(live) >= 1
+    assert live[0, 0] == 1.0                      # trained class
+    # decoded box close to the ground truth it was trained on
+    np.testing.assert_allclose(live[0, 2:], gt[0], atol=0.15)
 
 
 def test_projection_aliases(fresh_programs):
