@@ -40,10 +40,13 @@ def batch(reader, batch_size, drop_last: bool = False):
     return _reader_mod.batch(reader, batch_size, drop_last=drop_last)
 from .inference import infer  # noqa: F401
 from .. import datasets as dataset  # noqa: F401
+from ..datasets import image  # noqa: F401  (reference paddle.v2.image)
+from . import plot  # noqa: F401  (reference paddle.v2.plot)
 
 __all__ = ["init", "batch", "reader", "layer", "activation", "pooling",
            "data_type", "event", "optimizer", "parameters", "trainer",
-           "inference", "infer", "dataset", "networks", "attr"]
+           "inference", "infer", "dataset", "networks", "attr", "image",
+           "plot"]
 
 _initialized = False
 

@@ -43,6 +43,21 @@ def _sub_attr(param_attr, sub_name):
     return attr
 
 
+def _unit_act(act, default):
+    """Resolve a unit activation: None -> the unit's default; an
+    explicit activation object is honoured, including Linear() (name
+    "") which means identity.  Returns a callable."""
+    from .layer import _act_name
+
+    if act is None:
+        name = default
+    else:
+        name = _act_name(act)       # "" / None for Linear -> identity
+    if not name or name == "linear":
+        return lambda v: v
+    return getattr(flayers, name)
+
+
 def lstmemory_unit(input, size=None, name=None, act=None, gate_act=None,
                    param_attr=None, bias_attr=None, **kw):
     """One LSTM step for use INSIDE a recurrent_group step function —
@@ -58,22 +73,21 @@ def lstmemory_unit(input, size=None, name=None, act=None, gate_act=None,
     mixed = flayers.elementwise_add(
         flayers.fc(input=input, size=4 * size,
                    param_attr=_sub_attr(param_attr, f"{base}.w_x"),
-                   bias_attr=True if bias_attr is None else bias_attr),
+                   bias_attr=(True if bias_attr is None else False
+                              if bias_attr is False else
+                              _sub_attr(bias_attr, f"{base}.b"))),
         flayers.fc(input=h_prev, size=4 * size,
                    param_attr=_sub_attr(param_attr, f"{base}.w_h"),
                    bias_attr=False))
-    from .layer import _act_name, _register_named_output
+    from .layer import _register_named_output
 
-    ga = _act_name(gate_act) or "sigmoid"
-    aa = _act_name(act) or "tanh"
+    ga = _unit_act(gate_act, "sigmoid")
+    aa = _unit_act(act, "tanh")
     i, f, c_in, o = flayers.split(mixed, 4, dim=-1)
-    i = getattr(flayers, ga)(i)
-    f = getattr(flayers, ga)(f)
-    o = getattr(flayers, ga)(o)
     c_new = flayers.elementwise_add(
-        flayers.elementwise_mul(f, c_prev),
-        flayers.elementwise_mul(i, getattr(flayers, aa)(c_in)))
-    h_new = flayers.elementwise_mul(o, getattr(flayers, aa)(c_new))
+        flayers.elementwise_mul(ga(f), c_prev),
+        flayers.elementwise_mul(ga(i), aa(c_in)))
+    h_new = flayers.elementwise_mul(ga(o), aa(c_new))
     _register_named_output(f"{base}__c", c_new)
     _register_named_output(f"{base}__h", h_new)
     return h_new
@@ -89,22 +103,26 @@ def gru_unit(input, size=None, name=None, act=None, gate_act=None,
     assert size, "gru_unit needs size="
     base = name or _unique_unit_name("gru_unit")
     h_prev = v2layer.memory(name=f"{base}__h", size=size)
-    from .layer import _act_name, _register_named_output
+    from .layer import _register_named_output
 
-    ga = _act_name(gate_act) or "sigmoid"
-    aa = _act_name(act) or "tanh"
-    zr = getattr(flayers, ga)(flayers.elementwise_add(
+    ga = _unit_act(gate_act, "sigmoid")
+    aa = _unit_act(act, "tanh")
+    zr = ga(flayers.elementwise_add(
         flayers.fc(input=input, size=2 * size,
                    param_attr=_sub_attr(param_attr, f"{base}.wg_x"),
-                   bias_attr=True if bias_attr is None else bias_attr),
+                   bias_attr=(True if bias_attr is None else False
+                              if bias_attr is False else
+                              _sub_attr(bias_attr, f"{base}.bg"))),
         flayers.fc(input=h_prev, size=2 * size,
                    param_attr=_sub_attr(param_attr, f"{base}.wg_h"),
                    bias_attr=False)))
     z, r = flayers.split(zr, 2, dim=-1)
-    cand = getattr(flayers, aa)(flayers.elementwise_add(
+    cand = aa(flayers.elementwise_add(
         flayers.fc(input=input, size=size,
                    param_attr=_sub_attr(param_attr, f"{base}.wc_x"),
-                   bias_attr=True if bias_attr is None else bias_attr),
+                   bias_attr=(True if bias_attr is None else False
+                              if bias_attr is False else
+                              _sub_attr(bias_attr, f"{base}.bc"))),
         flayers.fc(input=flayers.elementwise_mul(r, h_prev), size=size,
                    param_attr=_sub_attr(param_attr, f"{base}.wc_h"),
                    bias_attr=False)))

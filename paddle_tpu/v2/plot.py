@@ -1,0 +1,73 @@
+"""paddle.v2.plot — training-curve plotting (reference
+python/paddle/v2/plot/plot.py).
+
+The reference's Ploter collects (step, value) series per title and
+renders them with matplotlib/IPython in notebooks, honouring
+``DISABLE_PLOT=True`` for headless test conversion.  Same contract
+here: data collection always works (and is inspectable — event
+handlers assert on it in tests); rendering activates only when
+matplotlib imports AND plotting isn't disabled, so training scripts
+never crash on a display-less TPU host.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["PlotData", "Ploter"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(float(value))
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    def __init__(self, *titles):
+        self._titles = titles
+        self._data = {t: PlotData() for t in titles}
+
+    @staticmethod
+    def _disabled():
+        return os.environ.get("DISABLE_PLOT") == "True"
+
+    def append(self, title, step, value):
+        assert title in self._data, f"unknown series {title!r}"
+        self._data[title].append(step, value)
+
+    def plot(self, path=None):
+        if self._disabled():
+            return
+        try:
+            # object-oriented API on a private Figure: no pyplot import,
+            # no process-global backend switch, no shared gcf state
+            from matplotlib.backends.backend_agg import FigureCanvasAgg
+            from matplotlib.figure import Figure
+        except Exception:
+            return                       # headless collection-only mode
+        fig = Figure()
+        FigureCanvasAgg(fig)
+        ax = fig.add_subplot(111)
+        titles = []
+        for title in self._titles:
+            data = self._data[title]
+            if data.step:
+                titles.append(title)
+                ax.plot(data.step, data.value)
+        if titles:
+            ax.legend(titles, loc="upper left")
+        if path is not None:
+            fig.savefig(path)
+
+    def reset(self):
+        for data in self._data.values():
+            data.reset()

@@ -493,3 +493,60 @@ def test_projection_aliases(fresh_programs):
     np.testing.assert_allclose(np.asarray(dg), [[4, 8, 12, 16]])
     assert np.asarray(pg).shape == (1, 4)
     np.testing.assert_allclose(np.asarray(spg), [[1, 4]])
+
+
+def test_v2_plot_and_image_surface(tmp_path, monkeypatch):
+    """paddle.v2.plot.Ploter (reference v2/plot/plot.py) collects
+    series headlessly and honours DISABLE_PLOT; paddle.v2.image exposes
+    the transform module."""
+    p = paddle.plot.Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    p.append("test", 0, 2.0)
+    assert p._data["train"].value == [1.0, 0.5]
+    out = tmp_path / "curve.png"
+    p.plot(str(out))                 # renders if matplotlib importable
+    p.reset()
+    assert p._data["train"].step == []
+    monkeypatch.setenv("DISABLE_PLOT", "True")
+    p2 = paddle.plot.Ploter("x")
+    p2.append("x", 0, 3.0)
+    p2.plot(str(tmp_path / "none.png"))   # no-op, must not raise
+    assert not (tmp_path / "none.png").exists()
+    with pytest.raises(AssertionError):
+        p.append("unknown", 0, 0.0)
+    # image transforms reachable under the reference name
+    img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+    chw = paddle.image.to_chw(img)
+    assert chw.shape == (3, 8, 8)
+
+
+def test_unit_helpers_named_attrs_and_linear_act(fresh_programs):
+    """Named param_attr/bias_attr get per-weight sub-names (no
+    shared-shape collision), and an explicit Linear() activation is
+    honoured as identity rather than coerced to tanh."""
+    main, startup, scope = fresh_programs
+    startup.random_seed = 7
+    x = fluid.layers.data("x", [3], "float32", lod_level=1)
+
+    def step(xt):
+        h1 = paddle.networks.gru_unit(
+            input=xt, size=4,
+            param_attr=fluid.ParamAttr(name="gw"),
+            bias_attr=fluid.ParamAttr(name="gb"))
+        # stacked unnamed unit: must get its own state memory
+        return paddle.networks.lstmemory_unit(
+            input=h1, size=4, act=paddle.activation.Linear())
+
+    seq = paddle.layer.recurrent_group(step, x)
+    out = paddle.layer.last_seq(seq)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, = _run(main, {"x": make_seq([np.ones((3, 3))],
+                                     dtype=np.float32)}, [out])
+    assert np.asarray(got).shape == (1, 4)
+    assert np.isfinite(np.asarray(got)).all()
+    # named weights exist with derived sub-names, one per shape
+    names = [p.name for p in main.global_block().all_parameters()]
+    assert any(n.startswith("gw.") for n in names)
+    assert any(n.startswith("gb.") for n in names)
