@@ -785,12 +785,17 @@ def _append_channel_bias(helper, pre_bias):
 
 
 def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
-                    seq_parallel=False, impl=None, dropout_rate=0.0,
-                    is_test=False, layout="bhld", name=None):
-    """Fused scaled-dot-product attention — flash attention on one chip,
-    ring attention over an 'sp' mesh axis when ``seq_parallel`` and the
-    active mesh shard the sequence.  O(L) memory, unlike the matmul+softmax
-    composition which materialises [lq, lk].
+                    seq_parallel=False, sp_impl="ring", impl=None,
+                    dropout_rate=0.0, is_test=False, layout="bhld",
+                    name=None):
+    """Fused scaled-dot-product attention — flash attention on one chip;
+    over an 'sp' mesh axis when ``seq_parallel`` and the active mesh
+    shard the sequence, either ring attention (``sp_impl='ring'``,
+    default — k/v shards rotate around the ICI, scales past the head
+    count) or Ulysses all-to-all (``sp_impl='ulysses'`` — two
+    all-to-alls re-shard seq<->heads; needs heads % sp == 0).  O(L)
+    memory either way, unlike the matmul+softmax composition which
+    materialises [lq, lk].
     ``layout='bhld'`` takes [b, h, l, d] tensors; ``'blhd'`` takes
     [b, l, h, d] head-interleaved tensors directly — the Pallas kernels
     index them in place, so callers skip the split-heads transposes (the
@@ -804,6 +809,7 @@ def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     if bias is not None:
         inputs["Bias"] = bias
     attrs = {"causal": bool(causal), "seq_parallel": bool(seq_parallel),
+             "sp_impl": str(sp_impl),
              "dropout_rate": float(dropout_rate), "is_test": bool(is_test),
              "layout": str(layout)}
     if sm_scale is not None:

@@ -317,12 +317,14 @@ def fused_attention(ctx, q, k, v, bias):
     attention composition (its Transformer config builds [lq, lk] score
     tensors) — O(L) memory via the Pallas flash kernel
     (paddle_tpu/kernels/flash_attention.py).  With an active mesh that has a
-    sequence axis, lowers to ring attention over the ICI instead
-    (kernels/ring_attention.py) — sequence parallelism the 2018 reference
-    had no analog for.
+    sequence axis, routes to a sequence-parallel strategy chosen by the
+    sp_impl attr: ring attention over the ICI (kernels/ring_attention.py,
+    default) or Ulysses all-to-all (kernels/ulysses_attention.py) —
+    sequence parallelism the 2018 reference had no analog for.
     """
     from ...kernels import flash_attention as _flash
     from ...kernels import ring_attention_sharded as _ring
+    from ...kernels import ulysses_attention_sharded as _ulysses
 
     causal = ctx.attr("causal", False)
     sm_scale = ctx.attr("sm_scale", None)
@@ -341,12 +343,21 @@ def fused_attention(ctx, q, k, v, bias):
     mesh = _pmesh.current_mesh()
     if ctx.attr("seq_parallel", False) and mesh is not None \
             and "sp" in mesh.axis_names:
-        if layout == "blhd":  # ring shards the seq axis of [b, h, l, d]
+        # strategy: "ring" rotates k/v shards (scales past the head
+        # count); "ulysses" re-shards seq<->heads with two all-to-alls
+        # (wins when ring-step latency dominates; needs heads % sp == 0)
+        sp_impl = ctx.attr("sp_impl", "ring")
+        if sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"fused_attention: sp_impl must be 'ring' or 'ulysses', "
+                f"got {sp_impl!r}")
+        shard_fn = _ring if sp_impl == "ring" else _ulysses
+        if layout == "blhd":  # sp shards the seq axis of [b, h, l, d]
             q, k, v = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
-        out = _ring(mesh, q, k, v, bias=bias, causal=causal,
-                    sm_scale=sm_scale,
-                    dp_axis="dp", mp_axis="mp", sp_axis="sp",
-                    dropout_rate=rate, dropout_seed=seed, impl=impl)
+        out = shard_fn(mesh, q, k, v, bias=bias, causal=causal,
+                       sm_scale=sm_scale,
+                       dp_axis="dp", mp_axis="mp", sp_axis="sp",
+                       dropout_rate=rate, dropout_seed=seed, impl=impl)
         if layout == "blhd":
             out = jnp.transpose(out, (0, 2, 1, 3))
         return out

@@ -319,24 +319,17 @@ def ring_attention(q, k, v, bias: Optional[jax.Array] = None,
                       dropout_rate, impl, bool(causal))
 
 
-def ring_attention_sharded(mesh: Mesh, q, k, v,
-                           bias: Optional[jax.Array] = None,
-                           causal: bool = False,
-                           sm_scale: Optional[float] = None,
-                           dp_axis: Optional[str] = "dp",
-                           mp_axis: Optional[str] = None,
-                           sp_axis: str = "sp",
-                           dropout_rate: float = 0.0,
-                           dropout_seed=None,
-                           impl: Optional[str] = None):
-    """Convenience wrapper: shard_map ring attention over a mesh.
-
-    q/k/v [B,H,L,D] global; batch sharded on dp_axis, heads on mp_axis
-    (tensor parallel), sequence on sp_axis.  Returns [B,H,L,D] with the same
-    sharding as q.  Dropout masks are decorrelated across dp/mp shards by
-    folding the device's axis indices into the seed (the hash already keys
-    on the global sequence position, so sp shards need no special care).
-    """
+def sp_sharded_call(inner_fn, mesh: Mesh, q, k, v, bias, causal,
+                    sm_scale, dp_axis, mp_axis, sp_axis, dropout_rate,
+                    dropout_seed, impl, bias_head_shardable: bool):
+    """Shared shard_map plumbing for the sequence-parallel strategies
+    (ring and Ulysses): resolves the dp/mp/sp axes, carries the dropout
+    seed through shard_map as an f32 scalar, decorrelates dp/mp shards
+    by folding their axis indices into the seed, and maps ``inner_fn``
+    (signature of ring_attention/ulysses_attention) over the mesh.
+    ``bias_head_shardable``: whether the strategy supports a bias whose
+    head axis is mp-sharded (the ring does; all-to-all needs broadcast
+    heads)."""
     names = mesh.axis_names
     dp = dp_axis if dp_axis in names else None
     mp = mp_axis if (mp_axis and mp_axis in names) else None
@@ -351,7 +344,7 @@ def ring_attention_sharded(mesh: Mesh, q, k, v,
     else:
         seed = jnp.zeros((), jnp.float32)
 
-    fn = functools.partial(ring_attention, causal=causal, sm_scale=sm_scale,
+    fn = functools.partial(inner_fn, causal=causal, sm_scale=sm_scale,
                            axis_name=sp_axis, dropout_rate=dropout_rate,
                            impl=impl)
 
@@ -375,7 +368,8 @@ def ring_attention_sharded(mesh: Mesh, q, k, v,
             out_specs=qkv_spec, check_vma=False)
         return mapped(q, k, v, seed)
     bias_spec = P(dp if bias.shape[0] > 1 else None,
-                  mp if bias.shape[1] > 1 else None,
+                  (mp if bias_head_shardable else None)
+                  if bias.shape[1] > 1 else None,
                   sp_axis, None)
     mapped = jax.shard_map(
         lambda q_, k_, v_, b_, s_: fn(q_, k_, v_, bias=b_,
@@ -383,3 +377,27 @@ def ring_attention_sharded(mesh: Mesh, q, k, v,
         mesh=mesh, in_specs=(qkv_spec,) * 3 + (bias_spec, P()),
         out_specs=qkv_spec, check_vma=False)
     return mapped(q, k, v, bias, seed)
+
+
+def ring_attention_sharded(mesh: Mesh, q, k, v,
+                           bias: Optional[jax.Array] = None,
+                           causal: bool = False,
+                           sm_scale: Optional[float] = None,
+                           dp_axis: Optional[str] = "dp",
+                           mp_axis: Optional[str] = None,
+                           sp_axis: str = "sp",
+                           dropout_rate: float = 0.0,
+                           dropout_seed=None,
+                           impl: Optional[str] = None):
+    """Convenience wrapper: shard_map ring attention over a mesh.
+
+    q/k/v [B,H,L,D] global; batch sharded on dp_axis, heads on mp_axis
+    (tensor parallel), sequence on sp_axis.  Returns [B,H,L,D] with the same
+    sharding as q.  Dropout masks are decorrelated across dp/mp shards by
+    folding the device's axis indices into the seed (the hash already keys
+    on the global sequence position, so sp shards need no special care).
+    """
+    return sp_sharded_call(ring_attention, mesh, q, k, v, bias, causal,
+                           sm_scale, dp_axis, mp_axis, sp_axis,
+                           dropout_rate, dropout_seed, impl,
+                           bias_head_shardable=True)

@@ -1,0 +1,118 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two sequence-parallel strategies the long-context
+literature offers (PAPERS.md; DeepSpeed-Ulysses): where ring attention
+keeps the sequence sharded and rotates k/v shards around the ICI ring
+(ring_attention.py), the all-to-all form RE-SHARDS for the attention
+itself — one all-to-all turns sequence shards into head shards
+([B, H, L/n, D] -> [B, H/n, L, D]), every device runs ordinary
+full-sequence flash attention over its head subset, and a second
+all-to-all restores the sequence sharding.  Two collectives per
+attention instead of n-1 ring steps; communication volume is the same
+O(B·H·L·D) but latency is two fused all-to-alls, which wins when the
+per-step ring latency dominates (short-ish shards, fast switchless
+interconnect).  The trade: parallelism is capped by the head count
+(H % n == 0), while the ring scales past it.
+
+Both strategies share the Pallas flash kernels: after the all-to-all
+the local problem IS plain full-sequence attention, so causal masking
+needs none of the ring's global-offset bookkeeping.
+
+No reference analog exists (the 2018 reference predates sequence
+parallelism; SURVEY §5 names long-context the signature deliverable) —
+this and ring attention are the TPU-native capability fulfilling it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from .flash_attention import flash_attention, seed_to_carrier
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, bias: Optional[jax.Array] = None,
+                      causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      axis_name: str = "sp",
+                      dropout_rate: float = 0.0, dropout_seed=None,
+                      impl: Optional[str] = None):
+    """All-to-all attention over a mapped ``axis_name``.
+
+    Must be called inside shard_map/pjit.  Local shards q/k/v
+    [B, H, L/n, D] with H % n == 0.  ``bias`` (additive
+    [B|1, H|1, Lq/n, Lk_global] — rows local, columns global, the same
+    convention ring_attention takes) is all-gathered over its row axis
+    to the full [.., Lq, Lk] block each head-shard needs; a
+    head-sharded bias (shape[1] > 1) is unsupported here — use the
+    ring.
+
+    dropout_rate > 0: the in-kernel hash keys on LOCAL head indices, so
+    the sequence-shard index is folded into the seed to decorrelate
+    head subsets; unlike the ring (whose mask is invariant to
+    sharding), the all-to-all mask differs from the unsharded one —
+    statistically equivalent, not bit-identical.
+    """
+    n = jax.lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses_attention: the sequence axis size ({n}) must "
+            f"divide the local head count ({h}) — use ring attention "
+            f"when it doesn't")
+    seed = None
+    if float(dropout_rate) > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        s = jax.lax.bitcast_convert_type(
+            seed_to_carrier(dropout_seed), jnp.uint32)
+        seed = s ^ (jax.lax.axis_index(axis_name).astype(jnp.uint32)
+                    * jnp.uint32(0x9E3779B9))
+
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    # seq shards -> head shards: [B, H, L/n, D] -> [B, H/n, L, D]
+    qg = a2a(q, split_axis=1, concat_axis=2)
+    kg = a2a(k, split_axis=1, concat_axis=2)
+    vg = a2a(v, split_axis=1, concat_axis=2)
+    bg = None
+    if bias is not None:
+        if bias.shape[1] != 1:
+            raise ValueError(
+                "ulysses_attention: head-sharded bias unsupported "
+                "(bias.shape[1] must be 1); use ring attention")
+        # rows are sequence-sharded: gather them to the full Lq axis
+        bg = jax.lax.all_gather(bias, axis_name, axis=2, tiled=True)
+    out = flash_attention(qg, kg, vg, bias=bg, causal=causal,
+                          sm_scale=sm_scale, impl=impl,
+                          dropout_rate=dropout_rate, dropout_seed=seed)
+    # head shards -> seq shards: [B, H/n, L, D] -> [B, H, L/n, D]
+    return a2a(out, split_axis=2, concat_axis=1)
+
+
+def ulysses_attention_sharded(mesh: Mesh, q, k, v,
+                              bias: Optional[jax.Array] = None,
+                              causal: bool = False,
+                              sm_scale: Optional[float] = None,
+                              dp_axis: Optional[str] = "dp",
+                              mp_axis: Optional[str] = None,
+                              sp_axis: str = "sp",
+                              dropout_rate: float = 0.0,
+                              dropout_seed=None,
+                              impl: Optional[str] = None):
+    """Convenience wrapper mirroring ring_attention_sharded: q/k/v
+    [B, H, L, D] global, batch on dp_axis, heads on mp_axis, sequence
+    on sp_axis; returns the same sharding.  The sp axis size must
+    divide the local head count (H / mp)."""
+    from .ring_attention import sp_sharded_call
+
+    return sp_sharded_call(ulysses_attention, mesh, q, k, v, bias,
+                           causal, sm_scale, dp_axis, mp_axis, sp_axis,
+                           dropout_rate, dropout_seed, impl,
+                           bias_head_shardable=False)

@@ -1,0 +1,115 @@
+"""COMPAT.md is a checked contract, not prose: every row whose status
+is `v2` must resolve to a real callable at the claimed surface
+(paddle.layer / paddle.networks), and the counts line must match the
+table.  A rename or removal that silently breaks the import-swap claim
+fails here.
+"""
+
+import re
+from pathlib import Path
+
+import paddle_tpu.v2 as paddle
+
+COMPAT = Path(__file__).resolve().parent.parent / "COMPAT.md"
+
+ROW = re.compile(r"\| (\d+) \| (\S+) \| (\w+) \| (.*) \|$")
+
+# table name -> attribute looked up (the v2 re-export strips `_layer`;
+# a handful of rows document their surface name in the Where column)
+SPECIAL = {
+    "kmax_seq_score_layer": "kmax_seq_score",
+    "square_error_cost": "mse_cost",
+    "cross_entropy": "cross_entropy_cost",
+    "conv_operator": "conv_projection",
+    "warp_ctc_layer": "ctc",
+    "lambda_cost": "lambda_cost",
+    "huber_regression_cost": "huber_regression_cost",
+    "huber_classification_cost": "huber_classification_cost",
+    "img_conv_layer": "img_conv",
+    "img_pool_layer": "img_pool",
+    "pooling_layer": "pool",
+    "maxid_layer": "max_id",
+}
+
+
+def _rows():
+    layers, networks, section = [], [], None
+    for line in COMPAT.read_text().splitlines():
+        if line.startswith("## layers.py"):
+            section = layers
+        elif line.startswith("## networks.py"):
+            section = networks
+        m = ROW.match(line)
+        if m and section is not None:
+            section.append((int(m.group(1)), m.group(2),
+                            m.group(3), m.group(4)))
+    return layers, networks
+
+
+def _surface_name(table_name, where):
+    if table_name in SPECIAL:
+        return SPECIAL[table_name]
+    # rows usually name the surface fn in backticks first
+    m = re.search(r"`(?:networks\.|layer\.)?([A-Za-z_][A-Za-z0-9_]*)`",
+                  where)
+    if m:
+        return m.group(1)
+    name = table_name
+    if name.endswith("_layer"):
+        name = name[: -len("_layer")]
+    return name
+
+
+def test_layers_rows_resolve():
+    layers, _ = _rows()
+    assert len(layers) == 106, f"expected 106 layer rows, got {len(layers)}"
+    missing = []
+    for num, name, status, where in layers:
+        if status != "v2":
+            continue
+        attr = _surface_name(name, where)
+        if not (hasattr(paddle.layer, attr)
+                or hasattr(paddle.networks, attr)):
+            missing.append((num, name, attr))
+    assert not missing, f"COMPAT v2 rows without a real surface: {missing}"
+
+
+def test_networks_rows_resolve():
+    _, networks = _rows()
+    assert len(networks) == 21, \
+        f"expected 21 network rows, got {len(networks)}"
+    missing = []
+    for num, name, status, where in networks:
+        if status != "v2":
+            continue
+        attr = _surface_name(name, where)
+        if not (hasattr(paddle.networks, attr)
+                or hasattr(paddle.layer, attr)):
+            missing.append((num, name, attr))
+    assert not missing, f"COMPAT v2 rows without a real surface: {missing}"
+
+
+def test_counts_line_matches_table():
+    layers, networks = _rows()
+    text = COMPAT.read_text()
+    m = re.search(r"Counts: (\d+) v2 \+ (\d+) fluid \+ (\d+) superseded "
+                  r"\+ (\d+) absent", text)
+    assert m, "counts line missing"
+    from collections import Counter
+
+    c = Counter(status for _, _, status, _ in layers)
+    assert (int(m.group(1)), int(m.group(2)), int(m.group(3)),
+            int(m.group(4))) == (c["v2"], c["fluid"], c["superseded"],
+                                 c["absent"])
+    mn = re.search(r"networks\.py: (\d+) v2 \+ (\d+) superseded", text)
+    assert mn, "networks counts missing"
+    cn = Counter(status for _, _, status, _ in networks)
+    assert (int(mn.group(1)), int(mn.group(2))) == (cn["v2"],
+                                                    cn["superseded"])
+
+
+def test_no_absent_rows_remain():
+    layers, networks = _rows()
+    absent = [(n, name) for n, name, status, _ in layers + networks
+              if status == "absent"]
+    assert absent == [], f"absent rows resurfaced: {absent}"
