@@ -803,6 +803,10 @@ def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     ``dropout_rate`` applies attention-probability dropout inside the kernel
     (counter-based hash mask, train mode only) — same semantics as the
     softmax→dropout→matmul composition."""
+    if sp_impl not in ("ring", "ulysses"):
+        raise ValueError(
+            f"fused_attention: sp_impl must be 'ring' or 'ulysses', "
+            f"got {sp_impl!r}")
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_tmp_variable(q.dtype)
     inputs = {"Q": q, "K": k, "V": v}

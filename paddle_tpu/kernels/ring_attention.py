@@ -321,15 +321,12 @@ def ring_attention(q, k, v, bias: Optional[jax.Array] = None,
 
 def sp_sharded_call(inner_fn, mesh: Mesh, q, k, v, bias, causal,
                     sm_scale, dp_axis, mp_axis, sp_axis, dropout_rate,
-                    dropout_seed, impl, bias_head_shardable: bool):
+                    dropout_seed, impl):
     """Shared shard_map plumbing for the sequence-parallel strategies
     (ring and Ulysses): resolves the dp/mp/sp axes, carries the dropout
     seed through shard_map as an f32 scalar, decorrelates dp/mp shards
     by folding their axis indices into the seed, and maps ``inner_fn``
-    (signature of ring_attention/ulysses_attention) over the mesh.
-    ``bias_head_shardable``: whether the strategy supports a bias whose
-    head axis is mp-sharded (the ring does; all-to-all needs broadcast
-    heads)."""
+    (signature of ring_attention/ulysses_attention) over the mesh."""
     names = mesh.axis_names
     dp = dp_axis if dp_axis in names else None
     mp = mp_axis if (mp_axis and mp_axis in names) else None
@@ -368,8 +365,7 @@ def sp_sharded_call(inner_fn, mesh: Mesh, q, k, v, bias, causal,
             out_specs=qkv_spec, check_vma=False)
         return mapped(q, k, v, seed)
     bias_spec = P(dp if bias.shape[0] > 1 else None,
-                  (mp if bias_head_shardable else None)
-                  if bias.shape[1] > 1 else None,
+                  mp if bias.shape[1] > 1 else None,
                   sp_axis, None)
     mapped = jax.shard_map(
         lambda q_, k_, v_, b_, s_: fn(q_, k_, v_, bias=b_,
@@ -399,5 +395,4 @@ def ring_attention_sharded(mesh: Mesh, q, k, v,
     """
     return sp_sharded_call(ring_attention, mesh, q, k, v, bias, causal,
                            sm_scale, dp_axis, mp_axis, sp_axis,
-                           dropout_rate, dropout_seed, impl,
-                           bias_head_shardable=True)
+                           dropout_rate, dropout_seed, impl)

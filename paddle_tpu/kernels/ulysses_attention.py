@@ -48,10 +48,10 @@ def ulysses_attention(q, k, v, bias: Optional[jax.Array] = None,
     Must be called inside shard_map/pjit.  Local shards q/k/v
     [B, H, L/n, D] with H % n == 0.  ``bias`` (additive
     [B|1, H|1, Lq/n, Lk_global] — rows local, columns global, the same
-    convention ring_attention takes) is all-gathered over its row axis
-    to the full [.., Lq, Lk] block each head-shard needs; a
-    head-sharded bias (shape[1] > 1) is unsupported here — use the
-    ring.
+    convention ring_attention takes): a head-ful bias is sliced to this
+    device's post-all-to-all head tile, then the rows are all-gathered
+    to the full [.., Lq, Lk] block the local full-sequence attention
+    needs.
 
     dropout_rate > 0: the in-kernel hash keys on LOCAL head indices, so
     the sequence-shard index is folded into the seed to decorrelate
@@ -83,12 +83,21 @@ def ulysses_attention(q, k, v, bias: Optional[jax.Array] = None,
     vg = a2a(v, split_axis=1, concat_axis=2)
     bg = None
     if bias is not None:
-        if bias.shape[1] != 1:
-            raise ValueError(
-                "ulysses_attention: head-sharded bias unsupported "
-                "(bias.shape[1] must be 1); use ring attention")
-        # rows are sequence-sharded: gather them to the full Lq axis
-        bg = jax.lax.all_gather(bias, axis_name, axis=2, tiled=True)
+        bg = bias
+        if bg.shape[1] == 1:
+            # broadcast heads: just gather the row shards to full Lq
+            bg = jax.lax.all_gather(bg, axis_name, axis=2, tiled=True)
+        else:
+            # head-ful bias rides the SAME all-to-all as q: head tiles
+            # scatter, row shards gather — each device ends with its
+            # own head tile over the full rows (slicing heads before a
+            # row-gather would instead mix every source's own tile)
+            if bg.shape[1] % n != 0:
+                raise ValueError(
+                    f"ulysses_attention: bias head dim ({bg.shape[1]}) "
+                    f"must be 1 or divisible by the sequence axis size "
+                    f"({n})")
+            bg = a2a(bg, split_axis=1, concat_axis=2)
     out = flash_attention(qg, kg, vg, bias=bg, causal=causal,
                           sm_scale=sm_scale, impl=impl,
                           dropout_rate=dropout_rate, dropout_seed=seed)
@@ -114,5 +123,4 @@ def ulysses_attention_sharded(mesh: Mesh, q, k, v,
 
     return sp_sharded_call(ulysses_attention, mesh, q, k, v, bias,
                            causal, sm_scale, dp_axis, mp_axis, sp_axis,
-                           dropout_rate, dropout_seed, impl,
-                           bias_head_shardable=False)
+                           dropout_rate, dropout_seed, impl)

@@ -66,11 +66,15 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         q = interleave_heads(q, d_key)      # [b, lq, h, dk]
         k = interleave_heads(k, d_key)
         v = interleave_heads(v, d_value)
+        # seq_parallel may be a bool (ring, the default strategy) or the
+        # strategy name itself ("ring" / "ulysses")
         ctx = layers.fused_attention(q, k, v, bias=attn_bias,
                                      causal=causal,
                                      sm_scale=float(d_key) ** -0.5,
                                      dropout_rate=dropout_rate,
-                                     seq_parallel=seq_parallel,
+                                     seq_parallel=bool(seq_parallel),
+                                     sp_impl=(seq_parallel if isinstance(
+                                         seq_parallel, str) else "ring"),
                                      layout="blhd")
         b, l = ctx.shape[0], ctx.shape[1]
         return layers.fc(

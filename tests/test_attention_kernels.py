@@ -644,3 +644,52 @@ def test_fused_attention_op_ulysses_matches_single(fresh_programs):
         sharded, = exe.run(main, feed=feed, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_headful_bias():
+    """A bias with a full head axis is sliced to each device's
+    post-all-to-all head tile (the transformer's materialised attn-bias
+    path)."""
+    from paddle_tpu.kernels import ulysses_attention_sharded
+
+    mesh = make_mesh({"sp": 4}, jax.devices()[:4])
+    q, k, v = make_qkv(b=2, h=4, lq=32, lk=32, d=8)
+    bias = np.random.RandomState(3).randn(2, 4, 32, 32).astype(
+        np.float32) * 0.5
+    bias = jnp.asarray(bias)
+    out = ulysses_attention_sharded(mesh, q, k, v, bias=bias,
+                                    dp_axis=None)
+    ref = naive_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_dropout_runs_and_differs():
+    """Ulysses attention-prob dropout: deterministic per seed,
+    differentiable, and the head-tile masks are decorrelated — no two
+    sequence shards (= head tiles after the all-to-all) produce
+    identical keep patterns."""
+    from paddle_tpu.kernels import ulysses_attention_sharded
+
+    mesh = make_mesh({"sp": 4}, jax.devices()[:4])
+    q, k, v = make_qkv(b=2, h=4, lq=32, lk=32, d=8)
+    clean = ulysses_attention_sharded(mesh, q, k, v, dp_axis=None)
+    drop = ulysses_attention_sharded(mesh, q, k, v, dp_axis=None,
+                                     dropout_rate=0.4, dropout_seed=5)
+    assert not np.allclose(np.asarray(clean), np.asarray(drop))
+    drop2 = ulysses_attention_sharded(mesh, q, k, v, dp_axis=None,
+                                      dropout_rate=0.4, dropout_seed=5)
+    np.testing.assert_array_equal(np.asarray(drop), np.asarray(drop2))
+    # decorrelation across head tiles: with IDENTICAL q/k/v per head,
+    # identical masks would give identical per-head outputs
+    q1 = jnp.broadcast_to(q[:, :1], q.shape)
+    k1 = jnp.broadcast_to(k[:, :1], k.shape)
+    v1 = jnp.broadcast_to(v[:, :1], v.shape)
+    d1 = np.asarray(ulysses_attention_sharded(
+        mesh, q1, k1, v1, dp_axis=None, dropout_rate=0.4,
+        dropout_seed=5))
+    heads_equal = [np.allclose(d1[:, 0], d1[:, hh]) for hh in range(1, 4)]
+    assert not all(heads_equal), "head-tile dropout masks are correlated"
+    g = jax.grad(lambda q: ulysses_attention_sharded(
+        mesh, q, k, v, dp_axis=None, dropout_rate=0.4,
+        dropout_seed=5).sum())(q)
+    assert np.isfinite(np.asarray(g)).all()
