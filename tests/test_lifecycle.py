@@ -1,0 +1,101 @@
+"""One user journey end-to-end: the path a reference user walks when
+they switch frameworks.  Train a conv classifier -> checkpoint ->
+"crash" (throw the scope away) -> restore and verify bit-identical
+state -> keep training -> eval via clone(for_test) -> package with
+save_inference_model -> reload and match -> serve the same directory
+from the no-Python C engine and match again.
+
+Every piece has its own tests (test_checkpoint, test_book, test_capi);
+this locks the seams between them.
+"""
+
+import numpy as np
+
+from paddle_tpu import fluid
+
+
+def _build(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.layers.data("img", [1, 12, 12], "float32")
+        lbl = fluid.layers.data("lbl", [1], "int64")
+        conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                   act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+        pred = fluid.layers.fc(fluid.layers.reshape(pool, [-1, 100]),
+                               10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(pred, lbl))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    return main, startup, test_prog, img, lbl, pred, loss
+
+
+def test_train_checkpoint_crash_resume_export_serve(tmp_path):
+    from paddle_tpu.fluid.checkpoint import CheckpointManager
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(32, 1, 12, 12).astype(np.float32)
+    ys = rng.randint(0, 10, (32, 1)).astype(np.int64)
+    feed = {"img": xs, "lbl": ys}
+
+    # -- phase 1: train + periodic checkpoints -----------------------------
+    main, startup, test_prog, img, lbl, pred, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    ckpt = CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2,
+                             save_interval_steps=5)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(1, 11):
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+            ckpt.save(step, program=main, scope=scope)
+    assert losses[-1] < losses[0]
+    with fluid.scope_guard(scope):
+        ref_pred, = exe.run(test_prog,
+                            feed={"img": xs[:4], "lbl": ys[:4]},
+                            fetch_list=[pred], mode="infer")
+
+    # -- phase 2: crash (fresh scope) + restore ----------------------------
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup)                       # re-init, then overwrite
+        step = ckpt.restore(program=main, scope=scope2)
+    assert step == 10
+    with fluid.scope_guard(scope2):
+        resumed_pred, = exe.run(test_prog,
+                                feed={"img": xs[:4], "lbl": ys[:4]},
+                                fetch_list=[pred], mode="infer")
+    np.testing.assert_array_equal(np.asarray(ref_pred),
+                                  np.asarray(resumed_pred))
+
+    # -- phase 3: resume training where we left off ------------------------
+    with fluid.scope_guard(scope2):
+        for _ in range(5):
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(l)) < losses[0]
+
+    # -- phase 4: package for inference and reload -------------------------
+    model_dir = str(tmp_path / "model")
+    with fluid.scope_guard(scope2):
+        fluid.io.save_inference_model(model_dir, ["img"], [pred], exe,
+                                      main_program=main)
+        want, = exe.run(test_prog,
+                        feed={"img": xs[:4], "lbl": ys[:4]},
+                        fetch_list=[pred], mode="infer")
+        prog2, feeds2, fetches2 = fluid.io.load_inference_model(
+            model_dir, exe)
+        got, = exe.run(prog2, feed={feeds2[0]: xs[:4]},
+                       fetch_list=fetches2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+    # -- phase 5: serve the same directory from the C engine ---------------
+    from tests.test_capi import native_forward
+
+    out, = native_forward(model_dir, {"img": xs[:4]})
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
