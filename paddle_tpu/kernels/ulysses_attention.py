@@ -1,7 +1,8 @@
 """Ulysses-style all-to-all sequence parallelism.
 
 The second of the two sequence-parallel strategies the long-context
-literature offers (PAPERS.md; DeepSpeed-Ulysses): where ring attention
+literature offers (the public DeepSpeed-Ulysses recipe — arXiv
+2309.14509): where ring attention
 keeps the sequence sharded and rotates k/v shards around the ICI ring
 (ring_attention.py), the all-to-all form RE-SHARDS for the attention
 itself — one all-to-all turns sequence shards into head shards
