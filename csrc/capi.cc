@@ -15,6 +15,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <functional>
 #include <limits>
 #include <cstring>
 #include <map>
@@ -741,12 +742,82 @@ void Engine::forward() {
   }
 }
 
-Engine* load_engine(const std::string& dir) {
+// file provider: (name) -> bytes, plus an existence probe — one
+// implementation reads a save_inference_model directory, the other a
+// single merged file (the reference's MergeModel.cpp packaging:
+// config + params concatenated for one-file deployment)
+struct FileProvider {
+  std::function<bool(const std::string&)> has;
+  std::function<std::string(const std::string&)> get;
+};
+
+FileProvider dir_provider(const std::string& dir) {
+  return {[dir](const std::string& name) {
+            std::ifstream probe(dir + "/" + name);
+            return (bool)probe;
+          },
+          [dir](const std::string& name) {
+            return read_file(dir + "/" + name);
+          }};
+}
+
+// merged container: "PTPUMRG1" u64 n, then per entry
+// [u32 name_len][name][u64 data_len][data] — entry bytes are the exact
+// on-disk file bytes (tensor entries keep their CRC framing)
+FileProvider merged_provider(const std::string& path) {
+  // the blob is held once; the index stores (offset, length) views into
+  // it, so peak memory matches the directory path (one transient copy
+  // per entry at parse time, nothing else)
+  auto blob = std::make_shared<const std::string>(read_file(path));
+  auto index = std::make_shared<
+      std::map<std::string, std::pair<size_t, size_t>>>();
+  static const char kMagic[] = "PTPUMRG1";
+  if (blob->size() < 16 || std::memcmp(blob->data(), kMagic, 8) != 0)
+    throw std::runtime_error(path + ": not a merged ptpu model");
+  size_t off = 8;
+  // overflow-safe: off <= size always holds, so compare against the
+  // REMAINING bytes (off + n could wrap for a crafted 64-bit length)
+  auto need = [&](uint64_t n) {
+    if (n > blob->size() - off)
+      throw std::runtime_error(path + ": truncated merged model");
+  };
+  need(8);
+  uint64_t n_entries;
+  std::memcpy(&n_entries, blob->data() + off, 8);
+  off += 8;
+  for (uint64_t i = 0; i < n_entries; ++i) {
+    need(4);
+    uint32_t nlen;
+    std::memcpy(&nlen, blob->data() + off, 4);
+    off += 4;
+    need(nlen);
+    std::string name = blob->substr(off, nlen);
+    off += nlen;
+    need(8);
+    uint64_t dlen;
+    std::memcpy(&dlen, blob->data() + off, 8);
+    off += 8;
+    need(dlen);
+    (*index)[name] = {off, (size_t)dlen};
+    off += dlen;
+  }
+  return {[index](const std::string& name) {
+            return index->count(name) > 0;
+          },
+          [blob, index](const std::string& name) {
+            auto it = index->find(name);
+            if (it == index->end())
+              throw std::runtime_error("merged model: no entry " + name);
+            return blob->substr(it->second.first, it->second.second);
+          }};
+}
+
+Engine* load_engine_from(const FileProvider& files) {
   auto eng = std::make_unique<Engine>();
   // __model__ is the raw canonical-JSON desc (desc.py serialize_to_string);
   // only the tensor files carry the CRC framing
   eng->prog = std::make_shared<const ProgramDesc>(
-      parse_program(read_file(dir + "/__model__")));
+      parse_program(files.get("__model__")));
   const BlockDesc& b = eng->prog->blocks.at(0);
   // order by the ops' 'col' attr, NOT block order: save_inference_model
   // prepends feed ops one at a time, so block order is the REVERSE of
@@ -767,14 +838,16 @@ Engine* load_engine(const std::string& dir) {
   auto params = std::make_shared<std::map<std::string, Tensor>>();
   for (auto& kv : b.vars) {
     if (!kv.second.persistable) continue;
-    std::string path = dir + "/" + kv.first;
-    std::ifstream probe(path);
-    if (!probe) continue;  // e.g. feed/fetch holder vars
+    if (!files.has(kv.first)) continue;  // e.g. feed/fetch holder vars
     (*params)[kv.first] =
-        parse_tensor(unframe(read_file(path), kv.first), kv.first);
+        parse_tensor(unframe(files.get(kv.first), kv.first), kv.first);
   }
   eng->params = std::move(params);
   return eng.release();
+}
+
+Engine* load_engine(const std::string& dir) {
+  return load_engine_from(dir_provider(dir));
 }
 
 thread_local std::string g_err;
@@ -793,6 +866,17 @@ const char* ptpu_last_error() { return ptpu::g_err.c_str(); }
 void* ptpu_create_for_inference(const char* model_dir) {
   try {
     return ptpu::load_engine(model_dir);
+  } catch (const std::exception& e) {
+    ptpu::g_err = e.what();
+    return nullptr;
+  }
+}
+
+// single-file deployment — the analog of the reference's merged model
+// (trainer/MergeModel.cpp packs ModelConfig + params for capi)
+void* ptpu_create_for_inference_merged(const char* model_file) {
+  try {
+    return ptpu::load_engine_from(ptpu::merged_provider(model_file));
   } catch (const std::exception& e) {
     ptpu::g_err = e.what();
     return nullptr;

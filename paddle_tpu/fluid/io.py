@@ -34,8 +34,8 @@ from .framework import (Parameter, Program, Variable, default_main_program,
 __all__ = ["save_tensor", "load_tensor", "save_tensors", "load_tensors",
            "save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
-           "load_inference_model", "get_inference_program",
-           "CheckpointCorrupt"]
+           "load_inference_model", "merge_inference_model",
+           "get_inference_program", "CheckpointCorrupt"]
 
 _MAGIC = b"PDTPU\x01"      # legacy: no checksum
 _MAGIC2 = b"PDTPU\x02"     # payload followed by crc32 trailer
@@ -345,6 +345,35 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
                          [v.name for v in target_vars], scope=scope,
                          batch_size=stablehlo_batch_size,
                          seq_len=stablehlo_seq_len)
+
+
+_MERGED_MAGIC = b"PTPUMRG1"
+
+
+def merge_inference_model(dirname: str, out_path: str) -> None:
+    """Pack a save_inference_model directory into ONE deployable file —
+    the analog of the reference's merged-model tool
+    (trainer/MergeModel.cpp: ModelConfig + parameters in one blob for
+    capi embedding).  Container: magic, u64 entry count, then per entry
+    [u32 name_len][name][u64 data_len][data]; entry bytes are the exact
+    on-disk file bytes (tensor entries keep their CRC framing).  Served
+    by the C engine via ``ptpu_create_for_inference_merged``."""
+    import struct
+
+    names = sorted(n for n in os.listdir(dirname)
+                   if os.path.isfile(os.path.join(dirname, n))
+                   and not n.startswith("model.stablehlo"))
+    if "__model__" not in names:
+        raise ValueError(f"{dirname} is not a save_inference_model "
+                         f"directory (no __model__)")
+    payload = [_MERGED_MAGIC, struct.pack("<Q", len(names))]
+    for name in names:
+        with open(os.path.join(dirname, name), "rb") as f:
+            data = f.read()
+        nb = name.encode()
+        payload += [struct.pack("<I", len(nb)), nb,
+                    struct.pack("<Q", len(data)), data]
+    _atomic_write(out_path, b"".join(payload))
 
 
 def load_inference_model(dirname: str, executor: Executor,

@@ -32,6 +32,8 @@ DRIVER = """
     lib = ctypes.CDLL(so)
     lib.ptpu_create_for_inference.restype = ctypes.c_void_p
     lib.ptpu_create_for_inference.argtypes = [ctypes.c_char_p]
+    lib.ptpu_create_for_inference_merged.restype = ctypes.c_void_p
+    lib.ptpu_create_for_inference_merged.argtypes = [ctypes.c_char_p]
     lib.ptpu_last_error.restype = ctypes.c_char_p
     lib.ptpu_input_name.restype = ctypes.c_char_p
     lib.ptpu_input_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -52,7 +54,10 @@ DRIVER = """
         ctypes.POINTER(ctypes.c_int), ctypes.c_int]
     lib.ptpu_destroy.argtypes = [ctypes.c_void_p]
 
-    h = lib.ptpu_create_for_inference(model_dir.encode())
+    create = (lib.ptpu_create_for_inference_merged
+              if model_dir.endswith(".ptpu")
+              else lib.ptpu_create_for_inference)
+    h = create(model_dir.encode())
     if not h:
         raise SystemExit("create failed: "
                          + lib.ptpu_last_error().decode())
@@ -792,3 +797,40 @@ def test_native_multithread_shared_clone(tmp_path):
         assert "MT_OK" in out.stdout
     finally:
         os.unlink(path)
+
+
+def test_merged_single_file_model(tmp_path):
+    """merge_inference_model packs the directory into one .ptpu file
+    (reference trainer/MergeModel.cpp: config + params in one blob);
+    ptpu_create_for_inference_merged serves it identically to the
+    directory form."""
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 5
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [6], "float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        y = fluid.layers.fc(h, 3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want, = exe.run(main, feed={"x": xs}, fetch_list=[y],
+                        mode="infer")
+        model_dir = str(tmp_path / "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [y], exe,
+                                      main_program=main)
+    merged = str(tmp_path / "model.ptpu")
+    fluid.io.merge_inference_model(model_dir, merged)
+    from_dir, = native_forward(model_dir, {"x": xs})
+    from_merged, = native_forward(merged, {"x": xs})
+    np.testing.assert_allclose(from_merged, np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_array_equal(from_dir, from_merged)
+    # corrupt container is rejected with a clear error, not a crash
+    bad = str(tmp_path / "bad.ptpu")
+    with open(bad, "wb") as f:
+        f.write(b"NOTMERGED" + b"\0" * 32)
+    import pytest as _pytest
+    with _pytest.raises(AssertionError, match="not a merged"):
+        native_forward(bad, {"x": xs})
