@@ -1,0 +1,94 @@
+"""GPipe-style pipeline parallelism over a mesh axis.
+
+The reference's nearest concept is layer-to-device placement
+(ParallelNeuralNetwork, gserver/gradientmachines/ParallelNeuralNetwork.h
+via the per-layer `device` attr) — stages of the net living on
+different devices with activations flowing between them.  The TPU-native
+form is the public GPipe schedule (arXiv 1811.06965): parameters shard
+by STAGE over a 'pp' mesh axis, the batch splits into microbatches, and
+each device applies its stage to the stream while `lax.ppermute` passes
+activations to the next stage over the ICI — the pipeline fills, runs
+steady-state with all stages busy, and drains.  Bubble fraction is
+(n_stages - 1) / (n_microbatches + n_stages - 1), the standard GPipe
+trade.
+
+This is the building block (mirroring how ring/ulysses attention are
+the sequence-parallel building blocks): ``gpipe_call`` runs a
+homogeneous stage function over stage-stacked parameters inside one
+``shard_map``, reverse-mode differentiable end-to-end (the backward
+ppermutes run the ring in reverse under jax AD, GPipe's backward
+schedule).  Heterogeneous stages fit by dispatching on the stage index
+inside ``stage_fn``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["gpipe_call"]
+
+
+def gpipe_call(stage_fn, stage_params, x_micro, mesh: Mesh,
+               pp_axis: str = "pp"):
+    """Run ``n_stages`` chained applications of ``stage_fn`` over
+    microbatches, pipelined across the ``pp_axis`` devices.
+
+    stage_fn(params, x) -> y: one stage's computation; activations and
+    outputs must share x's shape/dtype (project inside the stage if
+    widths differ).  ``stage_params``: a pytree whose leaves lead with
+    the stage axis [n_stages, ...] (sharded over pp_axis).  ``x_micro``:
+    [n_micro, b, ...] microbatches (replicated).  Returns [n_micro,
+    b, ...] — microbatch m holds stage_{n-1}(...stage_0(x[m])).
+    """
+    n_stages = mesh.shape[pp_axis]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"gpipe_call: stage_params leaves must lead with the "
+                f"stage axis ({n_stages} = mesh.shape[{pp_axis!r}]); "
+                f"got leading dim {leaf.shape[0]}")
+    n_micro = x_micro.shape[0]
+    total = n_micro + n_stages - 1          # fill + steady + drain
+
+    def local(params, xs):
+        # params: this stage's slice, leading axis 1 — collapse it
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(pp_axis)
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        buf0 = jnp.zeros_like(xs[0])
+        outs0 = jnp.zeros_like(xs)
+
+        def step(carry, t):
+            buf, outs = carry
+            # pass the previous step's activation to the next stage;
+            # stage 0 injects microbatch t instead (clipped while
+            # draining — the masked writes below ignore the overrun)
+            recv = jax.lax.ppermute(buf, pp_axis, fwd)
+            mine = jnp.where(stage == 0,
+                             xs[jnp.clip(t, 0, n_micro - 1)], recv)
+            out = stage_fn(params, mine)
+            # the LAST stage finishes microbatch t - (n_stages - 1)
+            m = t - (n_stages - 1)
+            write = (stage == n_stages - 1) & (m >= 0)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(write, out, outs[jnp.clip(m, 0,
+                                                          n_micro - 1)]),
+                jnp.clip(m, 0, n_micro - 1), axis=0)
+            return (out, outs), None
+
+        (_, outs), _ = jax.lax.scan(step, (buf0, outs0),
+                                    jnp.arange(total))
+        # only the last stage holds real outputs; psum replicates them
+        return jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
+            pp_axis)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(pp_axis), stage_params)
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(param_specs, P()),
+                         out_specs=P(), check_vma=False)(
+        stage_params, x_micro)
