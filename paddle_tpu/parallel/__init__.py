@@ -23,6 +23,7 @@ This package replaces ALL FOUR of the reference's distribution backends
 from .mesh import (Mesh, current_mesh, make_mesh, mesh_guard, set_mesh,
                    feed_sharding, state_sharding)
 from .distributed import init_distributed
+from .moe import switch_moe_call
 from .pipeline import gpipe_call
 from .transpiler import DistributeTranspiler
 from .master import Task, TaskQueue, master_reader
@@ -31,4 +32,5 @@ from .master_service import MasterClient, MasterServer
 __all__ = ["Mesh", "make_mesh", "mesh_guard", "set_mesh", "current_mesh",
            "feed_sharding", "state_sharding", "init_distributed",
            "DistributeTranspiler", "Task", "TaskQueue", "master_reader",
-           "MasterClient", "MasterServer", "gpipe_call"]
+           "MasterClient", "MasterServer", "gpipe_call",
+           "switch_moe_call"]

@@ -1,0 +1,29 @@
+"""Shared helpers for the axis-sharded building blocks (gpipe_call,
+switch_moe_call): per-leaf leading-axis validation and the per-device
+slice collapse inside shard_map."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["validate_leading_axis", "collapse_leading"]
+
+
+def validate_leading_axis(params, n: int, axis_name: str, what: str,
+                          caller: str) -> None:
+    """Every leaf must lead with the sharded axis of size ``n`` —
+    a multiple would silently shard-and-drop (each device keeps only
+    the first slice of its shard)."""
+    for leaf in jax.tree_util.tree_leaves(params):
+        if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != n:
+            raise ValueError(
+                f"{caller}: {what} leaves must lead with the "
+                f"{what.split('_')[0]} axis ({n} = "
+                f"mesh.shape[{axis_name!r}]); got "
+                f"{getattr(leaf, 'shape', ())}")
+
+
+def collapse_leading(params):
+    """Inside shard_map each device's slice leads with extent 1 —
+    collapse it to the per-device pytree."""
+    return jax.tree_util.tree_map(lambda p: p[0], params)

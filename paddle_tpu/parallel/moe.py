@@ -1,0 +1,86 @@
+"""Switch-style mixture-of-experts with expert parallelism over a mesh
+axis.
+
+No reference analog exists (the 2018 reference predates MoE); this is
+the fifth parallelism axis next to dp/tp/sp/pp, built the same way as
+the gpipe and ring/ulysses blocks: one expert per device on the 'ep'
+axis, top-1 switch routing (the public Switch-Transformer recipe —
+arXiv 2101.03961) with a capacity limit, each device computing only its
+own expert's tokens and the combine riding one psum over the ICI.
+
+Routing is computed identically on every device from the replicated
+gate logits, so dispatch is a local capacity-bounded gather (no
+collective); tokens over capacity are dropped (output zero), the
+standard switch behaviour, and the router gradient flows through the
+gate probability scaling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["switch_moe_call"]
+
+
+def switch_moe_call(expert_fn, expert_params, x, gate_logits,
+                    mesh: Mesh, ep_axis: str = "ep",
+                    capacity_factor: float = 1.25):
+    """Top-1 switch MoE over ``ep_axis`` (one expert per device).
+
+    expert_fn(params, tokens) -> tokens: one expert applied to a
+    [C, d] token buffer.  ``expert_params``: pytree, leaves lead with
+    the expert axis [n_experts, ...] (sharded over ep_axis; n_experts
+    must equal the axis size).  ``x`` [T, d] tokens and ``gate_logits``
+    [T, n_experts] (both replicated over ep_axis).  Returns [T, d]:
+    y[t] = p[t] * expert_{argmax gate[t]}(x[t]), zero for tokens past
+    the per-expert capacity ceil(T / E * capacity_factor).
+    """
+    from ._shard_utils import collapse_leading, validate_leading_axis
+
+    n_exp = mesh.shape[ep_axis]
+    validate_leading_axis(expert_params, n_exp, ep_axis,
+                          "expert_params", "switch_moe_call")
+    if gate_logits.shape[-1] != n_exp:
+        raise ValueError(
+            f"switch_moe_call: gate_logits last dim "
+            f"({gate_logits.shape[-1]}) must equal the expert count "
+            f"({n_exp})")
+    t_tokens = x.shape[0]
+    cap = int(-(-t_tokens * float(capacity_factor) // n_exp))
+
+    def local(params, x_, gate_):
+        params = collapse_leading(params)
+        me = jax.lax.axis_index(ep_axis)
+        probs = jax.nn.softmax(gate_.astype(jnp.float32), axis=-1)
+        choice = jnp.argmax(gate_, axis=-1)              # [T]
+        p_top = jnp.take_along_axis(probs, choice[:, None],
+                                    axis=-1)[:, 0]       # [T]
+        mine = choice == me                               # [T]
+        # rank of each of my tokens among my tokens (deterministic,
+        # first-come priority like the reference switch routing)
+        rank = jnp.cumsum(mine.astype(jnp.int32)) - 1     # [T]
+        keep = mine & (rank < cap)
+        slot = jnp.where(keep, rank, cap)                 # overflow slot
+        # dispatch: capacity buffer [cap+1, d]; dropped tokens pile
+        # into the dump row which is never read back
+        buf = jnp.zeros((cap + 1,) + x_.shape[1:], x_.dtype)
+        buf = buf.at[slot].set(jnp.where(keep[:, None], x_, 0.0),
+                               mode="drop")
+        out = expert_fn(params, buf[:cap])                # [cap, d]
+        out = jnp.concatenate(
+            [out, jnp.zeros((1,) + out.shape[1:], out.dtype)], axis=0)
+        y = out[slot]                                     # [T, d]
+        y = jnp.where(keep[:, None], y, 0.0)
+        y = y * p_top[:, None].astype(y.dtype)            # router grad
+        # combine: every token was computed on exactly one device
+        return jax.lax.psum(y, ep_axis)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(ep_axis), expert_params)
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(param_specs, P(), P()),
+                         out_specs=P(), check_vma=False)(
+        expert_params, x, gate_logits)

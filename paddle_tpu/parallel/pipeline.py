@@ -43,19 +43,16 @@ def gpipe_call(stage_fn, stage_params, x_micro, mesh: Mesh,
     [n_micro, b, ...] microbatches (replicated).  Returns [n_micro,
     b, ...] — microbatch m holds stage_{n-1}(...stage_0(x[m])).
     """
+    from ._shard_utils import collapse_leading, validate_leading_axis
+
     n_stages = mesh.shape[pp_axis]
-    for leaf in jax.tree_util.tree_leaves(stage_params):
-        if leaf.shape[0] != n_stages:
-            raise ValueError(
-                f"gpipe_call: stage_params leaves must lead with the "
-                f"stage axis ({n_stages} = mesh.shape[{pp_axis!r}]); "
-                f"got leading dim {leaf.shape[0]}")
+    validate_leading_axis(stage_params, n_stages, pp_axis,
+                          "stage_params", "gpipe_call")
     n_micro = x_micro.shape[0]
     total = n_micro + n_stages - 1          # fill + steady + drain
 
     def local(params, xs):
-        # params: this stage's slice, leading axis 1 — collapse it
-        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        params = collapse_leading(params)
         stage = jax.lax.axis_index(pp_axis)
         fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         buf0 = jnp.zeros_like(xs[0])
