@@ -30,7 +30,7 @@ __all__ = [
     "hsigmoid", "sampling_id", "bilinear_interp", "prelu",
     "ssd_loss", "conv3d", "pool3d", "selective_fc", "scale_sub_region",
     "cross_entropy_with_selfnorm", "cross_entropy_over_beam",
-    "rotate", "detection_output",
+    "rotate", "detection_output", "switch_moe",
 ]
 
 
@@ -742,6 +742,42 @@ def detection_output(loc, conf, prior_box, prior_var,
          "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
          "confidence_threshold": float(confidence_threshold)},
         stop_gradient=True, name=name)
+
+
+def switch_moe(input, num_experts, d_hidden, capacity_factor=1.25,
+               act="relu", param_attr=None, name=None):
+    """Switch-Transformer MoE FFN layer — top-1 capacity-bounded
+    routing over ``num_experts`` two-matmul experts (ops/moe_ops.py).
+    Under a mesh with an 'ep' axis of size num_experts the experts
+    shard one-per-device (parallel.switch_moe_call); otherwise the same
+    routing runs densely.  ``input`` [B, T, d] or [T, d]."""
+    from ..initializer import XavierInitializer
+
+    helper = LayerHelper("switch_moe", param_attr=param_attr, name=name)
+    dtype = input.dtype
+    d = int(input.shape[-1])
+    gate_w = helper.create_parameter(helper.param_attr,
+                                     shape=[d, num_experts], dtype=dtype,
+                                     suffix="gate")
+    # per-expert Glorot over (d, d_hidden): the default fan rule would
+    # read the 3-d shapes as conv filters and shrink init ~d_hidden-fold
+    w1 = helper.create_parameter(
+        helper.param_attr, shape=[num_experts, d, d_hidden], dtype=dtype,
+        suffix="w1",
+        default_initializer=XavierInitializer(fan_in=d,
+                                              fan_out=d_hidden))
+    w2 = helper.create_parameter(
+        helper.param_attr, shape=[num_experts, d_hidden, d], dtype=dtype,
+        suffix="w2",
+        default_initializer=XavierInitializer(fan_in=d_hidden,
+                                              fan_out=d))
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op("switch_moe",
+                     {"X": input, "GateW": gate_w, "W1": w1, "W2": w2},
+                     {"Out": out},
+                     {"capacity_factor": float(capacity_factor),
+                      "act": str(act)})
+    return out
 
 
 def cross_entropy_over_beam(beams, name=None):

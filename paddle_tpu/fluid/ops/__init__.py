@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     loss_ops,
     math_ops,
     misc_ops,
+    moe_ops,
     nn_ops,
     optimizer_ops,
     rnn_ops,

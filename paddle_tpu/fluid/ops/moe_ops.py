@@ -1,0 +1,91 @@
+"""Switch-MoE as a fluid op: the framework surface over
+parallel/moe.py (the way fused_attention is the surface over the
+flash/ring/ulysses kernels).
+
+The expert is the Switch-Transformer FFN (two matmuls around an
+activation); routing is capacity-bounded top-1.  With an active mesh
+that has an 'ep' axis the experts shard one-per-device
+(parallel.switch_moe_call); otherwise the SAME routing math runs
+densely on one device, so meshless and ep-sharded runs agree
+token-for-token (tested).  No reference analog — the 2018 reference
+predates MoE.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import primitive
+
+
+def _ffn(w1, w2, act, x):
+    h = x @ w1
+    h = jax.nn.relu(h) if act == "relu" else jnp.tanh(h)
+    return h @ w2
+
+
+def _route(gate_logits, n_exp, cap):
+    """Shared top-1 routing: returns (choice [T], p_top [T],
+    keep [T], slot [T]) with per-expert first-come capacity — the same
+    math parallel/moe.py applies per device."""
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    choice = jnp.argmax(gate_logits, axis=-1)
+    p_top = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(choice, n_exp, dtype=jnp.int32)   # [T, E]
+    rank = jnp.cumsum(onehot, axis=0) - 1                     # [T, E]
+    my_rank = jnp.take_along_axis(rank, choice[:, None],
+                                  axis=-1)[:, 0]              # [T]
+    keep = my_rank < cap
+    return choice, p_top, keep, my_rank
+
+
+@primitive("switch_moe", inputs=["X", "GateW", "W1", "W2"],
+           outputs=["Out"])
+def switch_moe(ctx, x, gate_w, w1, w2):
+    """X [B, T, d] or [T, d] tokens; GateW [d, E]; W1 [E, d, h];
+    W2 [E, h, d].  attrs: capacity_factor (1.25), act ('relu')."""
+    cap_f = float(ctx.attr("capacity_factor", 1.25))
+    act = ctx.attr("act", "relu")
+    n_exp = w1.shape[0]
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    toks = x.reshape(-1, d)
+    t_tokens = toks.shape[0]
+    cap = int(-(-t_tokens * cap_f // n_exp))
+    gate_logits = (toks @ gate_w).astype(jnp.float32)          # [T, E]
+
+    from ...parallel import mesh as _pmesh
+
+    mesh = _pmesh.current_mesh()
+    if mesh is not None and "ep" in mesh.axis_names:
+        if mesh.shape["ep"] != n_exp:
+            raise ValueError(
+                f"switch_moe: the active mesh's 'ep' axis has size "
+                f"{mesh.shape['ep']} but the layer has {n_exp} experts "
+                f"— they must match (one expert per device)")
+        from ...parallel.moe import switch_moe_call
+
+        out = switch_moe_call(
+            lambda p, tk: _ffn(p["w1"], p["w2"], act, tk),
+            {"w1": w1, "w2": w2}, toks, gate_logits, mesh,
+            capacity_factor=cap_f)
+        return out.reshape(lead + (d,)).astype(x.dtype)
+
+    # dense single-device path: identical routing; each expert computes
+    # only its capacity buffer (the same gather-dispatch the ep path
+    # uses), not all T tokens
+    choice, p_top, keep, my_rank = _route(gate_logits, n_exp, cap)
+    toks32 = toks.astype(jnp.float32)
+    out = jnp.zeros_like(toks32)
+    for e in range(n_exp):
+        sel = keep & (choice == e)
+        slot = jnp.where(sel, my_rank, cap)
+        buf = jnp.zeros((cap + 1, d), jnp.float32)
+        buf = buf.at[slot].set(jnp.where(sel[:, None], toks32, 0.0),
+                               mode="drop")
+        y = _ffn(w1[e], w2[e], act, buf[:cap])
+        y = jnp.concatenate([y, jnp.zeros((1, d), jnp.float32)], axis=0)
+        out = out + jnp.where(sel[:, None], y[slot], 0.0)
+    out = out * p_top[:, None]
+    return out.reshape(lead + (d,)).astype(x.dtype)

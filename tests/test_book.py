@@ -28,6 +28,7 @@ def _train(main, startup, scope, feeder, loss_var, steps=25, acc_var=None):
 
 def test_recognize_digits_conv(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     _, avg_cost, acc = recognize_digits.conv_net(img, label)
@@ -48,6 +49,7 @@ def test_recognize_digits_conv(fresh_programs):
 
 def test_word2vec_ngram(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     dict_size = 30
     words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
              for i in range(5)]
@@ -70,6 +72,7 @@ def test_word2vec_ngram(fresh_programs):
 
 def test_image_classification_resnet_small(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     # depth 8 = smallest valid CIFAR resnet ((8-2)%6==0); 32px input is
@@ -95,6 +98,7 @@ def test_image_classification_resnet_small(fresh_programs):
 
 def test_vgg_builds_and_steps(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     predict = image_classification.vgg16_bn_drop(img, class_num=10)
@@ -113,6 +117,7 @@ def test_vgg_builds_and_steps(fresh_programs):
 
 def test_sentiment_conv_net(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     data = fluid.layers.data(name="words", shape=[1], dtype="int64",
                              lod_level=1)
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
@@ -139,6 +144,7 @@ def test_sentiment_conv_net(fresh_programs):
 
 def test_sentiment_stacked_lstm(fresh_programs):
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     data = fluid.layers.data(name="words", shape=[1], dtype="int64",
                              lod_level=1)
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
@@ -169,6 +175,7 @@ def test_recommender_system(fresh_programs):
     from paddle_tpu.models import recommender as R
 
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     dims = R.MovieLensDims(max_user_id=40, max_job_id=10, n_age_buckets=7,
                            max_movie_id=60, n_categories=10,
                            title_dict_size=80)
@@ -210,6 +217,7 @@ def test_label_semantic_roles(fresh_programs):
     from paddle_tpu.models import label_semantic_roles as L
 
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     dims = L.SRLDims(word_dict_len=30, label_dict_len=5, pred_len=8,
                      hidden_dim=16, depth=2)
     avg_cost, feature_out, crf_decode, target, _ = L.srl_model(dims)
@@ -285,6 +293,7 @@ def test_bf16_activation_training(fresh_programs):
     recipe; r2 conv PET fix) — a conv net trains without dtype errors
     and the loss decreases."""
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     img = fluid.layers.data(name="img", shape=[3, 16, 16],
                             dtype="bfloat16")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
@@ -328,6 +337,7 @@ def test_benchmark_nets_build_and_smallnet_trains(fresh_programs):
         assert tuple(pred.shape)[-1] == ncls
 
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for convergence asserts
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
         img = fluid.layers.data("img", [3, 32, 32], "float32")
         label = fluid.layers.data("label", [1], "int64")
