@@ -200,6 +200,7 @@ def test_ctc_speech_model_trains():
     batch = 8
 
     main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 7  # deterministic init for the convergence assert
     scope = fluid.Scope()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
         feats = fluid.layers.data("feats", [feat_dim], "float32",

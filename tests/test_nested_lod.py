@@ -95,6 +95,7 @@ def test_paragraph_sentence_pooling_pipeline(fresh_programs):
     (inner) of word embeddings -> nested inner pool -> level-1 outer
     pool -> classifier; trains end-to-end through the nested grads."""
     main, startup, scope = fresh_programs
+    startup.random_seed = 7  # deterministic init for the convergence assert
     vocab, dim = 20, 6
     words = fluid.layers.data(name="words", shape=[1], dtype="int64",
                               lod_level=2)

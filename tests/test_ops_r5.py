@@ -566,6 +566,7 @@ class TestSubsequenceInput:
         import paddle_tpu.v2 as paddle
 
         main, startup, scope = fresh_programs
+        startup.random_seed = 7  # deterministic init for the convergence assert
         x = fluid.layers.data("x", [2], "float32", lod_level=2)
         lbl = fluid.layers.data("lbl", [1], "int64")
 
