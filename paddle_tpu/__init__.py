@@ -5,6 +5,8 @@ Structure:
   paddle_tpu.fluid     program IR + layers + lowering executor (the core)
   paddle_tpu.v2        legacy v2 user API (init/layer/trainer/events) on fluid
   paddle_tpu.parallel  device meshes, SPMD sharding, distributed init
+  paddle_tpu.resilience  fault tolerance: retries, chaos injection,
+                       crash-safe training driver
   paddle_tpu.models    the "book" model zoo (fit_a_line ... transformer)
   paddle_tpu.native    ctypes bridge to the C++ IR library (csrc/)
   paddle_tpu.ops       Pallas TPU kernels for ops XLA fusion can't cover
@@ -13,6 +15,7 @@ Structure:
 
 from . import fluid  # noqa: F401
 from . import parallel  # noqa: F401
+from . import resilience  # noqa: F401
 from . import utils  # noqa: F401
 from . import native  # noqa: F401
 

@@ -95,6 +95,13 @@ class CheckpointManager:
             shutil.rmtree(final)
         os.rename(tmp, final)              # atomic publish
         fio._fsync_dir(self.dirname)
+        if names:
+            # chaos harness: an injected torn write right after publish
+            # (inert unless configured) — restore() must fall back to
+            # the previous CRC-valid checkpoint
+            from ..resilience.chaos import injector
+
+            injector().maybe_truncate(os.path.join(final, names[0]))
         # marker makes restore O(1) in the common case
         fio._atomic_write(os.path.join(self.dirname, "latest"),
                           str(int(step)).encode())

@@ -9,6 +9,21 @@ copies of the script with PADDLE_TPU_COORDINATOR / PADDLE_TPU_NPROCS /
 PADDLE_TPU_PROC_ID set, and the script's ``init_distributed()`` call joins
 them into one JAX coordination-service job (parallel/distributed.py).
 
+Elastic supervision (``--max-restarts N``): a rank that dies with a
+non-zero exit (including SIGKILL) is respawned with the same rank and
+environment instead of tearing the job down — the reference's
+trainers-are-expected-to-die contract, where a restarted worker rejoins
+the master's task queue and resumes from its checkpoint (see
+paddle_tpu/resilience).  Restart supervision is for master/data-dispatch
+workloads (ResilientTrainer + MasterClient); collective SPMD jobs keep
+the default fail-fast teardown (``--max-restarts 0``) because a restarted
+rank cannot rejoin a live jax.distributed coordination-service job.
+
+Teardown always escalates: survivors get SIGTERM, then SIGKILL after
+``--kill-grace`` seconds, so one wedged rank can never hang the launcher
+(or CI).  ``--log-dir`` gives each rank an append-mode
+``rank-<i>.log`` that persists across restarts.
+
 On a real multi-host TPU pod each host runs its own launcher-less process
 (the TPU runtime supplies the topology); this launcher is for CPU/GPU
 simulation, CI, and single-host many-process runs — the role the
@@ -23,6 +38,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def find_free_port() -> int:
@@ -47,43 +63,112 @@ def _hold_port() -> tuple:
     return s.getsockname()[1], s
 
 
+class _RankSpec:
+    """Everything needed to (re)spawn one rank: same cmd, same env, same
+    rank id, append-mode log across incarnations."""
+
+    __slots__ = ("rank", "cmd", "env", "log_path")
+
+    def __init__(self, rank, cmd, env, log_path=None):
+        self.rank = rank
+        self.cmd = list(cmd)
+        self.env = dict(env)
+        self.log_path = log_path
+
+    def spawn(self) -> subprocess.Popen:
+        if self.log_path is None:
+            return subprocess.Popen(self.cmd, env=self.env)
+        log = open(self.log_path, "ab", buffering=0)
+        try:
+            return subprocess.Popen(self.cmd, env=self.env,
+                                    stdout=log, stderr=log)
+        finally:
+            log.close()   # the child holds its own fd
+
+
+def _terminate(procs, kill_grace: float = 10.0) -> None:
+    """SIGTERM every live rank, then SIGKILL whatever ignored it after
+    `kill_grace` seconds — a wedged rank cannot hang the launcher."""
+    for q in procs:
+        if q.poll() is None:
+            try:
+                q.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = time.monotonic() + kill_grace
+    for q in procs:
+        if q.poll() is None:
+            try:
+                q.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                q.kill()
+    for q in procs:
+        if q.poll() is None:
+            try:
+                q.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+
+
 def launch(nprocs: int, argv, coordinator: str | None = None,
-           env_extra: dict | None = None) -> int:
-    """Spawn ``nprocs`` copies of ``argv``; returns the first non-zero
-    exit code (terminating the rest), else 0."""
+           env_extra: dict | None = None, max_restarts: int = 0,
+           kill_grace: float = 10.0, log_dir: str | None = None) -> int:
+    """Spawn ``nprocs`` copies of ``argv``; returns the first fatal
+    non-zero exit code (terminating the rest), else 0.
+
+    ``max_restarts`` > 0 enables elastic supervision: a rank exiting
+    non-zero is respawned (same rank/env) while the shared restart
+    budget lasts; only exhaustion of the budget tears the job down.
+    Meant for master/data-dispatch workloads — collective (SPMD) jobs
+    should keep the fail-fast default (see module docstring)."""
     held = None
     if coordinator is None:
         port, held = _hold_port()
         coordinator = f"127.0.0.1:{port}"
-    procs = []
+    if log_dir is not None:
+        os.makedirs(log_dir, exist_ok=True)
+    specs = []
     for rank in range(nprocs):
         env = dict(os.environ)
         env.update(env_extra or {})
         env["PADDLE_TPU_COORDINATOR"] = coordinator
         env["PADDLE_TPU_NPROCS"] = str(nprocs)
         env["PADDLE_TPU_PROC_ID"] = str(rank)
-        procs.append(subprocess.Popen([sys.executable] + list(argv),
-                                      env=env))
+        log = (os.path.join(log_dir, f"rank-{rank}.log")
+               if log_dir is not None else None)
+        specs.append(_RankSpec(rank, [sys.executable] + list(argv), env,
+                               log))
+    procs = []
     try:
+        # spawn INSIDE the try: a spawn failure at rank k (fd/disk
+        # exhaustion opening its log) must tear down ranks 0..k-1, not
+        # orphan them in collective init
+        for spec in specs:
+            procs.append(spec.spawn())
         # poll ALL ranks: a crash in any rank must terminate the rest
         # immediately (a sequential wait on rank 0 would hang forever on
         # a collective stuck waiting for the dead rank)
-        return _monitor(procs)
-    except KeyboardInterrupt:
-        for q in procs:
-            if q.poll() is None:
-                q.send_signal(signal.SIGTERM)
+        return _monitor(procs, specs=specs, max_restarts=max_restarts,
+                        kill_grace=kill_grace)
+    except BaseException:
+        # Ctrl-C, but also a failed (re)spawn: nothing may orphan live
+        # ranks
+        _terminate(procs, kill_grace)
         raise
     finally:
         if held is not None:
             held.close()
 
 
-def _monitor(procs):
-    """Poll all ranks; first non-zero exit terminates the rest."""
-    import time
-
+def _monitor(procs, specs=None, max_restarts: int = 0,
+             kill_grace: float = 10.0) -> int:
+    """Poll all ranks.  A rank exiting non-zero is restarted in place
+    (same rank, same env) while ``specs`` is given and the shared
+    ``max_restarts`` budget lasts; otherwise — and when the budget runs
+    out — the first non-zero exit terminates the remaining ranks with
+    SIGTERM->SIGKILL escalation."""
     rc = 0
+    restarts_left = max_restarts if specs is not None else 0
     live = set(range(len(procs)))
     while live:
         progressed = False
@@ -91,13 +176,16 @@ def _monitor(procs):
             code = procs[i].poll()
             if code is None:
                 continue
-            live.discard(i)
             progressed = True
+            if code != 0 and restarts_left > 0:
+                restarts_left -= 1
+                procs[i] = specs[i].spawn()   # same rank, same env
+                continue
+            live.discard(i)
             if code != 0 and rc == 0:
                 rc = code
-                for q in procs:
-                    if q.poll() is None:
-                        q.send_signal(signal.SIGTERM)
+                _terminate([q for j, q in enumerate(procs) if j in live],
+                           kill_grace)
         if live and not progressed:
             time.sleep(0.05)
     return rc
@@ -108,7 +196,8 @@ _LOCAL_HOSTS = ("localhost", "127.0.0.1")
 
 def launch_hosts(hosts, nprocs_per_host: int, argv,
                  coordinator: str | None = None, ssh_cmd: str = "ssh",
-                 env_extra: dict | None = None) -> int:
+                 env_extra: dict | None = None,
+                 kill_grace: float = 10.0) -> int:
     """Multi-host launch — the analog of the reference's ssh cluster
     launcher (paddle/scripts/cluster_train/paddle.py: fabric-over-ssh,
     one trainer per node with role env vars).  ``hosts`` is a list of
@@ -118,6 +207,10 @@ def launch_hosts(hosts, nprocs_per_host: int, argv,
     host (shared filesystem, the reference's assumption too).  Local
     hosts (localhost/127.0.0.1) spawn directly, so CI exercises the full
     rank/coordinator wiring without sshd.
+
+    No restart supervision here: an ssh child's exit code conflates the
+    remote rank with the transport, so multi-host jobs keep fail-fast
+    teardown (with the same kill-grace escalation).
     """
     import shlex
 
@@ -158,13 +251,11 @@ def launch_hosts(hosts, nprocs_per_host: int, argv,
                               for a in [sys.executable] + list(argv)]
                     procs.append(subprocess.Popen(
                         [ssh_cmd, host, "env"] + kv + remote))
-        return _monitor(procs)
+        return _monitor(procs, kill_grace=kill_grace)
     except BaseException:
         # a failed spawn (bad host, missing ssh) or Ctrl-C must not
         # orphan already-started ranks blocked in collective init
-        for q in procs:
-            if q.poll() is None:
-                q.send_signal(signal.SIGTERM)
+        _terminate(procs, kill_grace)
         raise
     finally:
         if held is not None:
@@ -194,6 +285,16 @@ def main() -> None:
     ap.add_argument("--coordinator", default=None,
                     help="host:port (default: a free local port, or "
                          "first-host:29571 for remote hosts)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="elastic mode: respawn a rank that dies non-zero "
+                         "(same rank/env), up to N restarts total — for "
+                         "master/data-dispatch workloads; collective SPMD "
+                         "jobs should keep 0 (fail-fast)")
+    ap.add_argument("--kill-grace", type=float, default=10.0,
+                    help="seconds between SIGTERM and SIGKILL at teardown")
+    ap.add_argument("--log-dir", default=None,
+                    help="write each rank's stdout/stderr to "
+                         "DIR/rank-<i>.log (appended across restarts)")
     ap.add_argument("script", help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args()
@@ -202,8 +303,10 @@ def main() -> None:
     if ns.hosts is not None:
         sys.exit(launch_hosts(_parse_hosts(ns.hosts), ns.nprocs_per_host,
                               [ns.script] + ns.args, ns.coordinator,
-                              ssh_cmd=ns.ssh))
-    sys.exit(launch(ns.nprocs, [ns.script] + ns.args, ns.coordinator))
+                              ssh_cmd=ns.ssh, kill_grace=ns.kill_grace))
+    sys.exit(launch(ns.nprocs, [ns.script] + ns.args, ns.coordinator,
+                    max_restarts=ns.max_restarts,
+                    kill_grace=ns.kill_grace, log_dir=ns.log_dir))
 
 
 if __name__ == "__main__":

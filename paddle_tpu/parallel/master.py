@@ -58,6 +58,12 @@ class TaskQueue:
         self._done: List[Task] = []
         self._failed: List[Task] = []
         self._epoch = 0
+        # bumped on every change to the DURABLE image (what snapshot()
+        # writes): finishes, failures/timeouts, dataset/epoch changes —
+        # NOT bare leases, which snapshot as todo anyway.  Lets the
+        # auto-snapshotting MasterServer skip snapshots when nothing
+        # durable moved (idle polls stay fsync-free).
+        self._version = 0
 
     # -- dataset -------------------------------------------------------------
     def set_dataset(self, chunks: Sequence) -> None:
@@ -101,6 +107,7 @@ class TaskQueue:
             self._pending.clear()
             self._done.clear()
             self._failed.clear()
+            self._version += 1
 
     # -- worker protocol -----------------------------------------------------
     def get_task(self, worker: str = "") -> Optional[Task]:
@@ -125,6 +132,7 @@ class TaskQueue:
                 return False
             t.deadline = t.owner = None
             self._done.append(t)
+            self._version += 1
             return True
 
     def task_failed(self, task_id: int) -> bool:
@@ -137,9 +145,29 @@ class TaskQueue:
             self._fail_locked(t)
             return True
 
+    def task_returned(self, task_id: int, worker: str = "") -> bool:
+        """Graceful lease hand-back (a worker shutting down cleanly
+        mid-chunk, e.g. a bounded ResilientTrainer run): the chunk goes
+        to the FRONT of todo with NO failure charge — the worker didn't
+        fail, it stopped.  False for unknown/expired leases, and for a
+        lease that has since been re-dispatched to a DIFFERENT worker
+        (the ``worker`` check stops a late hand-back from revoking
+        someone else's live lease)."""
+        with self._lock:
+            t = self._pending.get(task_id)
+            if t is None or (worker and t.owner != worker):
+                return False
+            del self._pending[task_id]
+            t.deadline = t.owner = None
+            self._todo.insert(0, t)
+            # no version bump: pending already snapshots as todo, so the
+            # durable image is unchanged
+            return True
+
     def _fail_locked(self, t: Task) -> None:
         t.num_failures += 1
         t.deadline = t.owner = None
+        self._version += 1
         if t.num_failures >= self._failure_max:
             self._failed.append(t)
         else:
@@ -171,18 +199,33 @@ class TaskQueue:
                     "done": len(self._done), "failed": len(self._failed),
                     "epoch": self._epoch}
 
+    @property
+    def version(self) -> int:
+        """Durable-image version (see __init__); compare across calls to
+        detect whether a snapshot would differ from the last one."""
+        with self._lock:
+            return self._version
+
     def new_epoch(self) -> None:
         """All tasks processed → recycle done tasks for the next pass
         (the reference's epoch rollover when todo+pending drain)."""
         with self._lock:
-            assert not self._todo and not self._pending, \
-                "epoch rollover with undispatched work"
+            if self._todo or self._pending:
+                # a real exception, not an assert: under python -O an
+                # assert would vanish and the rollover below would
+                # silently DISCARD the undispatched chunks — and the
+                # master client's no-retry /new_epoch contract leans on
+                # this tripping for a re-sent rollover
+                raise RuntimeError("epoch rollover with undispatched "
+                                   "work (todo=%d pending=%d)"
+                                   % (len(self._todo), len(self._pending)))
             self._epoch += 1
             for t in self._done:
                 t.epoch = self._epoch
                 t.num_failures = 0
             self._todo = self._done
             self._done = []
+            self._version += 1
 
     # -- snapshot / recover (reference: master state in etcd :166-207) -------
     def snapshot(self, path: str) -> None:
@@ -242,7 +285,13 @@ def master_reader(queue: TaskQueue, read_chunk: Callable[[object], Iterable],
     Only read_chunk's own iteration is guarded: an exception the
     *consumer* throws into the generator (gen.throw / gen.close)
     propagates instead of being miscounted as a chunk failure.
+
+    Chaos harness hook: each acquired lease is reported to the process
+    fault injector (resilience/chaos.py), whose kill-after-N-tasks mode
+    SIGKILLs the worker mid-chunk — exactly the death this reader's
+    lease-timeout contract exists to survive.  Inert unless configured.
     """
+    from ..resilience.chaos import injector
 
     def reader():
         polls = 0
@@ -257,6 +306,7 @@ def master_reader(queue: TaskQueue, read_chunk: Callable[[object], Iterable],
                 time.sleep(poll_interval)   # leases outstanding elsewhere
                 continue
             polls = 0
+            injector().note_lease()
             try:
                 it = iter(read_chunk(task.chunk))
             except Exception:

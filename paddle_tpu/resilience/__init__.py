@@ -1,0 +1,37 @@
+"""paddle_tpu.resilience — the fault-tolerance layer.
+
+The reference's distributed story is that components die and the job
+survives: the Go master re-dispatches timed-out leases and snapshots its
+queue to etcd (go/master/service.go:166-341), the pserver checkpoints
+parameters with CRC + atomic rename (go/pserver/service.go:119-175), and
+the client redials through restarts (go/master/client.go).  This package
+is the behavior half of that story over the repo's existing state half:
+
+  retry.py    RetryPolicy — backoff + decorrelated jitter + deadline;
+              wired into MasterClient so a master restart is a pause,
+              not a crash.
+  chaos.py    FaultInjector — seeded, deterministic fault injection
+              threaded through the master client/server, the reader,
+              and CheckpointManager; off by default, env-configured.
+  trainer.py  ResilientTrainer — CheckpointManager.restore() composed
+              with master_reader: a SIGKILLed run resumes from the
+              newest valid checkpoint and re-leases expired chunks.
+
+`ResilientTrainer` imports the fluid/parallel layers, which themselves
+use chaos hooks from here — it loads lazily to keep this package
+importable from anywhere in the stack.
+"""
+
+from .retry import RetryPolicy
+from .chaos import ChaosError, FaultInjector, injector, install
+
+__all__ = ["RetryPolicy", "ChaosError", "FaultInjector", "injector",
+           "install", "ResilientTrainer"]
+
+
+def __getattr__(name):
+    if name == "ResilientTrainer":
+        from .trainer import ResilientTrainer
+
+        return ResilientTrainer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

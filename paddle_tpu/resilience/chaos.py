@@ -1,0 +1,171 @@
+"""Deterministic fault injection — the chaos half of the test story.
+
+The reference proved its fault tolerance by hand (kill a trainer, watch
+the master re-dispatch); this module makes those experiments *seeded and
+reproducible*.  A `FaultInjector` owns named injection points threaded
+through the distributed stack (all no-ops unless configured):
+
+  * ``master.http``   — client-side: raise a transient ChaosError instead
+                        of sending the RPC (exercises MasterClient retry);
+  * ``master.drop``   — server-side: hang up BEFORE dispatching (a lost
+                        request; the retry is the first application);
+  * ``master.drop_reply`` — server-side: hang up AFTER the route ran and
+                        snapshotted (a lost reply; the retry re-runs the
+                        mutation — exercises the at-least-once
+                        idempotency of re-sent mutations);
+  * ``ckpt.truncate`` — truncate a tensor file of the just-published
+                        checkpoint (exercises CRC fallback in restore());
+  * kill-after-N      — SIGKILL the process upon leasing its Nth task
+                        (mid-chunk: the lease must expire and re-dispatch).
+
+Every probabilistic decision is a pure function of (seed, point, draw
+index) — `FaultInjector.decision` — so the same seed yields the same
+injection schedule on every run, across processes, regardless of wall
+time.  An optional journal logs each draw for post-hoc replay checks.
+
+Configuration (environment, all off by default):
+
+  PADDLE_TPU_CHAOS="master.http=0.2,master.drop=0.1,ckpt.truncate=0.05"
+  PADDLE_TPU_CHAOS_SEED=7
+  PADDLE_TPU_CHAOS_KILL_AFTER=3     # SIGKILL self on leasing task #3
+  PADDLE_TPU_CHAOS_LOG=/path/chaos.journal
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import zlib
+from typing import Dict, Optional
+
+__all__ = ["ChaosError", "FaultInjector", "injector", "install"]
+
+
+class ChaosError(ConnectionError):
+    """Injected transient fault.  Subclasses ConnectionError so the
+    retry layer treats an injected network fault like a real one."""
+
+
+def _parse_spec(spec: str) -> Dict[str, float]:
+    probs = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"chaos spec entry {part!r}: want point=prob")
+        point, prob = part.split("=", 1)
+        probs[point.strip()] = float(prob)
+    return probs
+
+
+class FaultInjector:
+    """Seeded injection points; a default-constructed one is inert."""
+
+    def __init__(self, spec: str = "", seed: int = 0,
+                 kill_after: int = 0, log_path: Optional[str] = None):
+        self.probs = _parse_spec(spec)
+        self.seed = int(seed)
+        self.kill_after = int(kill_after)
+        self.log_path = log_path
+        self._lock = threading.Lock()
+        self._draws: Dict[str, int] = {}
+        self._leases = 0
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FaultInjector":
+        env = os.environ if environ is None else environ
+        return cls(spec=env.get("PADDLE_TPU_CHAOS", ""),
+                   seed=int(env.get("PADDLE_TPU_CHAOS_SEED", "0")),
+                   kill_after=int(env.get("PADDLE_TPU_CHAOS_KILL_AFTER",
+                                          "0")),
+                   log_path=env.get("PADDLE_TPU_CHAOS_LOG"))
+
+    def enabled(self) -> bool:
+        return bool(self.probs) or self.kill_after > 0
+
+    # -- deterministic draws -------------------------------------------------
+    @staticmethod
+    def decision(seed: int, point: str, index: int) -> float:
+        """Uniform [0,1) value for draw `index` at `point` — a pure
+        function of its arguments (crc32-based, stable across processes
+        and platforms, unlike Python's salted hash())."""
+        key = f"{seed}|{point}|{index}".encode()
+        return (zlib.crc32(key) & 0xFFFFFFFF) / 2**32
+
+    def should(self, point: str) -> bool:
+        """Deterministically decide whether draw #k at `point` fires;
+        points with no configured probability consume no draws (adding a
+        new point never perturbs another point's schedule)."""
+        prob = self.probs.get(point, 0.0)
+        if prob <= 0.0:
+            return False
+        with self._lock:
+            index = self._draws.get(point, 0)
+            self._draws[point] = index + 1
+        value = self.decision(self.seed, point, index)
+        fired = value < prob
+        self._log(f"{point} {index} {value:.9f} {int(fired)}")
+        return fired
+
+    def _log(self, line: str) -> None:
+        if not self.log_path:
+            return
+        with self._lock, open(self.log_path, "a") as f:
+            f.write(line + "\n")
+
+    # -- injection actions ---------------------------------------------------
+    def maybe_fail(self, point: str) -> None:
+        """Raise a transient ChaosError when `point` fires."""
+        if self.should(point):
+            raise ChaosError(f"chaos[{point}]: injected fault")
+
+    def maybe_truncate(self, path: str, point: str = "ckpt.truncate") -> bool:
+        """Truncate `path` to half its size when `point` fires — a torn
+        write the CRC layer must catch; returns True if truncated."""
+        if not self.should(point):
+            return False
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        self._log(f"# truncated {path} {size}->{size // 2}")
+        return True
+
+    def note_lease(self) -> None:
+        """Count task leases; SIGKILL self upon acquiring lease number
+        `kill_after` (the process dies MID-CHUNK, holding the lease, so
+        re-dispatch after timeout is what keeps the job correct)."""
+        if self.kill_after <= 0:
+            return
+        with self._lock:
+            self._leases += 1
+            fatal = self._leases >= self.kill_after
+        if fatal:
+            self._log(f"# kill-self at lease {self.kill_after} "
+                      f"pid={os.getpid()}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+_global: Optional[FaultInjector] = None
+_global_lock = threading.Lock()
+
+
+def injector() -> FaultInjector:
+    """Process-global injector, built from the environment on first use
+    (inert unless PADDLE_TPU_CHAOS* is set)."""
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = FaultInjector.from_env()
+    return _global
+
+
+def install(inj: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Swap the process-global injector (tests); returns the previous
+    one.  Pass None to fall back to env-based construction on next use."""
+    global _global
+    with _global_lock:
+        prev, _global = _global, inj
+    return prev

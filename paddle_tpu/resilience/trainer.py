@@ -1,0 +1,183 @@
+"""ResilientTrainer — the crash-safe training driver.
+
+Composes the two state halves the repo already had into the *behavior*
+the reference got from its Go master + pserver loop: `CheckpointManager`
+(CRC'd parameter checkpoints, fluid/checkpoint.py) for model state and
+the TaskQueue worker protocol (parallel/master.py, served cross-process
+by MasterServer/MasterClient) for data position.  A SIGKILLed run,
+restarted with the same checkpoint dir and master address, resumes from
+the newest *valid* checkpoint while the master re-dispatches its expired
+leases — no coordination beyond the two artifacts that already exist.
+
+run() drives the lease loop itself (rather than through master_reader)
+because lease settlement must distinguish three exits with different
+accounting:
+
+  * chunk exhausted           -> force-checkpoint, then task_finished
+                                 (once the master records a chunk done
+                                 its records never re-deliver, so the
+                                 steps they trained must be durable
+                                 FIRST or a crash in the gap loses them)
+  * read_chunk or train_step  -> task_failed + re-raise (failure charged,
+    raised                       so a poison chunk hits failure_max and
+                                 is eventually discarded instead of
+                                 crash-looping the worker forever)
+  * max_steps reached         -> task_returned          (uncharged: a
+    mid-chunk                    deliberate stop is not a failure and
+                                 must not erode the budget)
+
+Delivery is at-least-once (see master_reader): records of a chunk whose
+lease expired mid-read are re-delivered on restart, and optimizer steps
+since the last checkpoint re-run.  Keep `save_interval_steps` small
+relative to chunk size if duplicated steps matter.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..fluid.checkpoint import CheckpointManager
+from ..parallel.master import master_reader
+
+__all__ = ["ResilientTrainer"]
+
+
+class ResilientTrainer:
+    """Drive `train_step` over an elastic task queue with periodic
+    checkpoints and restart-time recovery.
+
+    Parameters
+    ----------
+    checkpoint_dir: CheckpointManager directory (shared across restarts).
+    queue: a TaskQueue or MasterClient — anything speaking the worker
+        protocol (get_task/task_finished/task_failed/task_returned/
+        all_done).
+    read_chunk: chunk -> iterable of records (same contract as
+        master_reader).
+    program / scope: what to checkpoint; default main program and global
+        scope when None (resolved at save/restore time).
+    """
+
+    def __init__(self, checkpoint_dir: str, queue, read_chunk,
+                 *, program=None, scope=None, worker: str = "worker-0",
+                 save_interval_steps: int = 1, max_to_keep: int = 3,
+                 poll_interval: float = 0.05):
+        self.manager = CheckpointManager(
+            checkpoint_dir, max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps)
+        self.queue = queue
+        self.read_chunk = read_chunk
+        self.program = program
+        self.scope = scope
+        self.worker = worker
+        self.poll_interval = poll_interval
+
+    def resume(self) -> Optional[int]:
+        """Restore the newest CRC-valid checkpoint into the scope;
+        returns its step, or None when starting fresh (corrupt/missing
+        checkpoints are skipped, like pserver's LoadCheckpoint)."""
+        return self.manager.restore(self.program, self.scope)
+
+    def records(self):
+        """The elastic record stream (a fresh generator per call) — for
+        callers that want the raw reader; run() uses its own loop for
+        exact lease settlement (see module docstring)."""
+        return master_reader(self.queue, self.read_chunk,
+                             worker=self.worker,
+                             poll_interval=self.poll_interval)()
+
+    def _save(self, step: int, force: bool = False) -> bool:
+        return self.manager.save(step, self.program, self.scope,
+                                 force=force)
+
+    def run(self, train_step: Callable, init_fn: Optional[Callable] = None,
+            max_steps: Optional[int] = None) -> int:
+        """resume() -> lease chunks -> train_step(record, step) ->
+        checkpoint every save_interval_steps.  `init_fn` runs only when
+        no checkpoint exists (startup-program initialization); a crash
+        anywhere re-enters through resume() on the next run().  Returns
+        the final step (the queue drained, or `max_steps` reached)."""
+        from .chaos import injector
+
+        restored = self.resume()
+        if restored is None:
+            if init_fn is not None:
+                init_fn()
+            step = 0
+        else:
+            step = restored
+        last_saved = restored
+        stopping = False
+        while not stopping:
+            if max_steps is not None and step >= max_steps:
+                # a resume at/past the bound must not lease and train an
+                # overshoot step per incarnation
+                break
+            task = self.queue.get_task(self.worker)
+            if task is None:
+                if self.queue.all_done():
+                    break
+                time.sleep(self.poll_interval)  # leases pending elsewhere
+                continue
+            injector().note_lease()     # chaos kill-after-N hook
+            try:
+                it = iter(self.read_chunk(task.chunk))
+            except Exception:
+                self.queue.task_failed(task.task_id)
+                continue
+            while True:
+                try:
+                    record = next(it)
+                except StopIteration:
+                    # checkpoint BEFORE reporting the chunk done: once
+                    # the master durably records it finished, its
+                    # records are never re-delivered — so the steps they
+                    # trained must already be durable too, or a crash in
+                    # this gap silently loses them (at-most-once)
+                    if step > 0 and last_saved != step:
+                        self._save(step, force=True)
+                        last_saved = step
+                    self.queue.task_finished(task.task_id)
+                    break
+                except Exception:
+                    self.queue.task_failed(task.task_id)
+                    break
+                step += 1
+                try:
+                    train_step(record, step)
+                except Exception:
+                    # charge the failure BEFORE propagating: a poison
+                    # record must burn failure budget on every crash so
+                    # failure_max eventually discards its chunk instead
+                    # of the worker crash-looping forever
+                    self.queue.task_failed(task.task_id)
+                    raise
+                except BaseException:
+                    # KeyboardInterrupt / SystemExit: a deliberate stop
+                    # is not a failure — hand the lease back uncharged
+                    # (best-effort, as in the max_steps stop below)
+                    try:
+                        self.queue.task_returned(task.task_id,
+                                                 self.worker)
+                    except Exception:
+                        pass
+                    raise
+                if self._save(step):
+                    last_saved = step
+                if max_steps is not None and step >= max_steps:
+                    # deliberate stop mid-chunk: hand the lease back
+                    # uncharged (best-effort — if the master is away,
+                    # the lease simply expires as a crash would)
+                    try:
+                        self.queue.task_returned(task.task_id,
+                                                 self.worker)
+                    except Exception:
+                        pass
+                    stopping = True
+                    break
+        # the final step always persists, whatever the interval (but
+        # never rewrite a checkpoint the loop just finished writing)
+        if step > 0 and last_saved != step:
+            self._save(step, force=True)
+        return step

@@ -27,6 +27,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process chaos/restart tests excluded from the "
+        "tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture
 def fresh_programs():
     """Give a test its own main/startup programs and scope (the reference's

@@ -236,6 +236,36 @@ def test_checkpoint_manager_restore_skips_corrupt(tmp_path):
                                   w_at[1])
 
 
+def test_checkpoint_manager_restore_falls_back_on_truncation(tmp_path):
+    """A TRUNCATED newest checkpoint (torn write: the file ends
+    mid-payload, CRC trailer gone) must not stop restore() — it falls
+    back to the previous CRC-valid checkpoint, like pserver's
+    LoadCheckpoint scan."""
+    main, startup, scope, loss, _ = _linear_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    rng = np.random.RandomState(8)
+    w_at = {}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in (1, 2):
+            exe.run(main, feed=_feed(rng), fetch_list=[loss])
+            mgr.save(step, main, scope)
+            w_at[step] = np.asarray(scope.find_var("w")).copy()
+    f = os.path.join(tmp_path, "ckpt-2", "w")
+    size = os.path.getsize(f)
+    with open(f, "r+b") as fh:
+        fh.truncate(size // 2)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup)
+        step = mgr.restore(main, scope2)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(scope2.find_var("w")),
+                                  w_at[1])
+
+
 def test_kill_and_restore_on_mesh():
     """Train under the dp mesh, checkpoint, 'kill' (fresh scope), restore,
     resume — final params bit-match the uninterrupted run."""

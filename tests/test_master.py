@@ -106,6 +106,72 @@ def test_snapshot_recover(tmp_path):
     assert q2.all_done()
 
 
+def test_task_returned_requeues_without_failure_charge():
+    """Graceful hand-back (bounded-run stop, clean worker shutdown):
+    the chunk goes back to the FRONT of todo, num_failures untouched —
+    stopping must not erode the failure budget the way a crash does."""
+    q = TaskQueue(timeout_secs=30, failure_max=2)
+    q.set_dataset(["a", "b"])
+    t = q.get_task("w0")
+    assert t.chunk == "a"
+    assert q.task_returned(t.task_id)
+    c = q.counts()
+    assert c["pending"] == 0 and c["todo"] == 2
+    t2 = q.get_task("w1")                      # returned chunk comes first
+    assert t2.chunk == "a" and t2.num_failures == 0
+    # a hand-back naming the WRONG worker is rejected: a late/duplicate
+    # return must not revoke another worker's live lease
+    assert not q.task_returned(t2.task_id, "w0")
+    assert q.counts()["pending"] == 1          # w1's lease untouched
+    # stale hand-back of a settled lease is rejected
+    q.task_finished(t2.task_id)
+    assert not q.task_returned(t2.task_id, "w1")
+
+
+def test_snapshot_midepoch_recovery_redispatch_and_failure_budget(tmp_path):
+    """Mid-epoch master crash with leases outstanding AND failure
+    history: after recover(), every unfinished chunk re-dispatches
+    exactly once, and failure_max accounting picks up where it left off
+    (a chunk one failure from its budget pre-crash has ONE failure left
+    post-crash, not a fresh budget)."""
+    q = TaskQueue(timeout_secs=30, failure_max=3)
+    q.set_dataset(["a", "b", "c"])
+    t = q.get_task("w0")                       # "a" (FIFO)
+    assert t.chunk == "a"
+    q.task_failed(t.task_id)                   # a: num_failures=1
+    t = q.get_task("w0")                       # "b"
+    q.task_finished(t.task_id)
+    t = q.get_task("w1")                       # "c": left pending (crash)
+    assert t.chunk == "c"
+    path = str(tmp_path / "master.snap")
+    q.snapshot(path)
+
+    q2 = TaskQueue.recover(path)
+    c = q2.counts()
+    assert c["done"] == 1 and c["pending"] == 0 and c["todo"] == 2
+    # drain: each unfinished chunk dispatches exactly once
+    leased = {}
+    while True:
+        t2 = q2.get_task("w2")
+        if t2 is None:
+            break
+        assert t2.chunk not in leased
+        leased[t2.chunk] = t2
+    assert set(leased) == {"a", "c"}
+    assert leased["a"].num_failures == 1       # budget survived recovery
+    # a master-restart lost lease re-runs without a failure charge (the
+    # worker didn't fail — the master's lease record did)
+    assert leased["c"].num_failures == 0
+    # spend a's remaining budget: 2 more failures discard it (3 total)
+    q2.task_failed(leased["a"].task_id)
+    t3 = q2.get_task("w2")
+    assert t3.chunk == "a" and t3.num_failures == 2
+    q2.task_failed(t3.task_id)
+    assert q2.counts()["failed"] == 1          # discarded, NOT re-queued
+    q2.task_finished(leased["c"].task_id)
+    assert q2.all_done()
+
+
 def test_master_reader_dying_consumer():
     """End-to-end exactly-once-or-retried: one consumer dies mid-chunk
     (records partially consumed, lease never finished); the surviving

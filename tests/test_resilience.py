@@ -1,0 +1,664 @@
+"""Fault-tolerance layer (paddle_tpu/resilience): retry policy,
+deterministic chaos injection, master durability (auto-snapshot +
+recovery + /ping), the 400-vs-500 request contract, the one-RPC poll
+loop, launcher kill-grace, and the ResilientTrainer resume driver.
+
+The multi-process chaos/restart scenarios live in
+test_resilience_e2e.py (marked slow); everything here is fast and
+deterministic, in the default tier-1 suite.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel import (MasterClient, MasterServer, TaskQueue,
+                                 master_reader)
+from paddle_tpu.resilience import (ChaosError, FaultInjector, RetryPolicy,
+                                   install)
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=5, deadline=None, base_delay=0.001,
+                      max_delay=0.002, seed=0)
+    assert pol.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_non_retryable_raises_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("caller bug")
+
+    pol = RetryPolicy(max_attempts=5, deadline=None, base_delay=0.001)
+    with pytest.raises(ValueError):
+        pol.call(bad)
+    assert len(calls) == 1
+
+
+def test_retry_exhausts_attempts_and_reraises_last():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TimeoutError("still down")
+
+    pol = RetryPolicy(max_attempts=4, deadline=None, base_delay=0.001,
+                      max_delay=0.002, seed=0)
+    with pytest.raises(TimeoutError):
+        pol.call(always)
+    assert len(calls) == 4
+
+
+def test_retry_deadline_bounds_total_time():
+    """Fake clock: each attempt consumes 1s of 'wall' time; a 3.5s
+    deadline allows at most 4 attempts regardless of max_attempts."""
+    state = {"t": 0.0, "calls": 0}
+
+    def clock():
+        return state["t"]
+
+    def sleep(d):
+        state["t"] += d
+
+    def always():
+        state["calls"] += 1
+        state["t"] += 1.0
+        raise ConnectionError("down")
+
+    pol = RetryPolicy(max_attempts=None, deadline=3.5, base_delay=0.01,
+                      max_delay=0.01, seed=0, sleep=sleep, clock=clock)
+    with pytest.raises(ConnectionError):
+        pol.call(always)
+    assert state["calls"] == 4
+
+
+def test_retry_predicate_refines_retryable():
+    """retry_if vetoes: an exception of a retryable class that the
+    predicate rejects (the HTTP-4xx case) raises immediately."""
+    calls = []
+
+    class Fault(ConnectionError):
+        def __init__(self, code):
+            self.code = code
+
+    def fail_400():
+        calls.append(1)
+        raise Fault(400)
+
+    pol = RetryPolicy(max_attempts=5, deadline=None, base_delay=0.001,
+                      retryable=(ConnectionError,),
+                      retry_if=lambda e: getattr(e, "code", 0) >= 500)
+    with pytest.raises(Fault):
+        pol.call(fail_400)
+    assert len(calls) == 1
+
+
+def test_retry_backoff_schedule_is_seeded_and_bounded():
+    import itertools
+
+    d1 = list(itertools.islice(
+        RetryPolicy(base_delay=0.05, max_delay=2.0, seed=11).delays(), 20))
+    d2 = list(itertools.islice(
+        RetryPolicy(base_delay=0.05, max_delay=2.0, seed=11).delays(), 20))
+    assert d1 == d2                              # same seed, same schedule
+    assert all(0.05 <= d <= 2.0 for d in d1)
+    assert len(set(d1)) > 1                      # jitter actually jitters
+
+
+def test_retry_rejects_unbounded_configuration():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=None, deadline=None)
+
+
+# -- FaultInjector -----------------------------------------------------------
+
+def test_chaos_seeded_injections_reproduce_exactly():
+    """The satellite contract: the same seed yields the same injection
+    schedule — across instances, draw by draw."""
+    mk = lambda: FaultInjector(spec="master.http=0.3,ckpt.truncate=0.5",
+                               seed=42)
+    a, b = mk(), mk()
+    sched_a = [(p, a.should(p)) for p in ["master.http", "ckpt.truncate"]
+               for _ in range(40)]
+    sched_b = [(p, b.should(p)) for p in ["master.http", "ckpt.truncate"]
+               for _ in range(40)]
+    assert sched_a == sched_b
+    fired = [s for _, s in sched_a]
+    assert any(fired) and not all(fired)         # non-trivial schedule
+
+
+def test_chaos_decision_is_pure_and_point_independent():
+    # pure function of (seed, point, index)
+    assert (FaultInjector.decision(7, "a", 3)
+            == FaultInjector.decision(7, "a", 3))
+    # interleaving draws of another point must not perturb a's schedule
+    solo = FaultInjector(spec="a=0.5,b=0.5", seed=1)
+    inter = FaultInjector(spec="a=0.5,b=0.5", seed=1)
+    solo_sched = [solo.should("a") for _ in range(30)]
+    inter_sched = []
+    for _ in range(30):
+        inter.should("b")
+        inter_sched.append(inter.should("a"))
+    assert solo_sched == inter_sched
+
+
+def test_chaos_default_injector_is_inert(tmp_path):
+    inj = FaultInjector()
+    assert not inj.enabled()
+    assert not inj.should("master.http")
+    inj.maybe_fail("master.http")                # no raise
+    inj.note_lease()                             # no kill
+    p = str(tmp_path / "f")
+    open(p, "wb").write(b"x" * 100)
+    assert not inj.maybe_truncate(p)
+    assert os.path.getsize(p) == 100
+
+
+def test_chaos_maybe_fail_raises_transient_error():
+    inj = FaultInjector(spec="pt=1.0", seed=0)
+    with pytest.raises(ChaosError):
+        inj.maybe_fail("pt")
+    # ChaosError is a ConnectionError: the retry layer treats it as a
+    # real transient network fault
+    assert issubclass(ChaosError, ConnectionError)
+
+
+def test_chaos_from_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS", "master.http=0.25, x=1.0")
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SEED", "9")
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_KILL_AFTER", "5")
+    log = str(tmp_path / "journal")
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_LOG", log)
+    inj = FaultInjector.from_env()
+    assert inj.enabled()
+    assert inj.probs == {"master.http": 0.25, "x": 1.0}
+    assert inj.seed == 9 and inj.kill_after == 5 and inj.log_path == log
+
+
+def test_chaos_journal_replays_deterministically(tmp_path):
+    """Every journaled draw recomputes identically from (seed, point,
+    index) — the post-hoc determinism check the e2e test also runs."""
+    log = str(tmp_path / "journal")
+    inj = FaultInjector(spec="a=0.4,b=0.2", seed=13, log_path=log)
+    for _ in range(25):
+        inj.should("a")
+        inj.should("b")
+    lines = [ln.split() for ln in open(log)
+             if ln.strip() and not ln.startswith("#")]
+    assert len(lines) == 50
+    for point, index, value, fired in lines:
+        want = FaultInjector.decision(13, point, int(index))
+        assert abs(float(value) - want) < 1e-9
+        assert int(fired) == int(want < inj.probs[point])
+
+
+def test_chaos_truncate_halves_file(tmp_path):
+    p = str(tmp_path / "ckpt")
+    open(p, "wb").write(b"z" * 100)
+    inj = FaultInjector(spec="ckpt.truncate=1.0", seed=0)
+    assert inj.maybe_truncate(p)
+    assert os.path.getsize(p) == 50
+
+
+def test_chaos_truncated_checkpoint_falls_back(tmp_path):
+    """The ckpt.truncate hook in CheckpointManager.save: an injected
+    torn write on the newest checkpoint sends restore() to the previous
+    CRC-valid one."""
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid.checkpoint import CheckpointManager
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        fluid.layers.fc(input=x, size=1, param_attr="w")
+    exe = fluid.Executor(fluid.CPUPlace())
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        mgr.save(1, main, scope)
+        w1 = np.asarray(scope.find_var("w")).copy()
+        scope.set_var("w", w1 + 1.0)
+        prev = install(FaultInjector(spec="ckpt.truncate=1.0", seed=0))
+        try:
+            mgr.save(2, main, scope)             # published, then torn
+        finally:
+            install(prev)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup)
+        assert mgr.restore(main, scope2) == 1
+    np.testing.assert_array_equal(np.asarray(scope2.find_var("w")), w1)
+
+
+# -- master service: durability, liveness, request contract ------------------
+
+def _post(addr, route, payload=None):
+    """Raw POST returning (code, body-dict) — status-code assertions the
+    client's RuntimeError mapping would hide."""
+    req = urllib.request.Request(
+        f"http://{addr}{route}", data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_ping_route_and_client_probe():
+    server = MasterServer(TaskQueue())
+    addr = server.start()
+    try:
+        with urllib.request.urlopen(f"http://{addr}/ping",
+                                    timeout=10) as resp:
+            assert json.loads(resp.read()) == {"ok": True}
+        client = MasterClient(addr, retry=False)
+        assert client.ping()
+    finally:
+        server.stop()
+    assert not MasterClient(addr, retry=False).ping(timeout=0.5)
+
+
+def test_malformed_requests_get_400_not_500():
+    q = TaskQueue()
+    q.set_dataset(["a"])
+    server = MasterServer(q)
+    addr = server.start()
+    try:
+        code, body = _post(addr, "/task_finished", {})          # missing
+        assert code == 400 and "task_id" in body["error"]
+        code, _ = _post(addr, "/task_finished", {"task_id": "xyz"})
+        assert code == 400
+        code, _ = _post(addr, "/task_failed", {"task_id": None})
+        assert code == 400
+        code, _ = _post(addr, "/set_dataset", {})               # no chunks
+        assert code == 400
+        code, _ = _post(addr, "/get_task", [1, 2])  # JSON, not an object
+        assert code == 400
+        code, _ = _post(addr, "/nope")
+        assert code == 404
+        # genuine server-side fault stays 500: epoch rollover with
+        # undispatched work violates the queue's invariant
+        code, _ = _post(addr, "/new_epoch")
+        assert code == 500
+        # and the happy path still works after all that
+        code, body = _post(addr, "/get_task", {"worker": "w"})
+        assert code == 200 and body["task"]["chunk"] == "a"
+        code, body = _post(addr, "/task_finished",
+                           {"task_id": body["task"]["task_id"]})
+        assert code == 200 and body["ok"]
+    finally:
+        server.stop()
+
+
+def test_get_task_piggybacks_all_done_one_rpc_per_poll():
+    """The poll loop (empty get_task -> all_done) spends ONE RPC: the
+    server returns all_done alongside the empty task and the client
+    hands it to the next all_done() call."""
+    q = TaskQueue(timeout_secs=5)
+    q.set_dataset([[1], [2]])
+    server = MasterServer(q)
+    addr = server.start()
+    try:
+        client = MasterClient(addr, worker="w", retry=False)
+        routes = []
+        orig = client._call_once
+        client._call_once = lambda r, p=None: routes.append(r) or orig(r, p)
+        got = sorted(master_reader(client, lambda c: list(c))())
+        assert got == [1, 2]
+        assert "/all_done" not in routes         # hint covered every poll
+        # the hint is one-shot: a standalone all_done() goes to the wire
+        routes.clear()
+        assert client.all_done()
+        assert routes == ["/all_done"]
+    finally:
+        server.stop()
+
+
+def test_server_auto_snapshot_and_recover(tmp_path):
+    """Master durability: mutations auto-snapshot; a restarted master
+    recovers the queue — done stays done, the outstanding lease comes
+    back as todo and re-dispatches (at-least-once)."""
+    snap = str(tmp_path / "master.snap")
+    q = TaskQueue(timeout_secs=30)
+    q.set_dataset(["a", "b", "c"])
+    server = MasterServer(q, snapshot_path=snap, snapshot_every=1)
+    addr = server.start()
+    client = MasterClient(addr, worker="w", retry=False)
+    t = client.get_task()
+    client.task_finished(t.task_id)
+    leased = client.get_task()                   # never finished: crash
+    assert os.path.exists(snap)                  # auto-snapshot happened
+    server._httpd.shutdown()                     # hard stop: no final snap
+    server._httpd.server_close()
+
+    server2 = MasterServer(None, snapshot_path=snap)
+    try:
+        c = server2.queue.counts()
+        assert c["done"] == 1 and c["pending"] == 0 and c["todo"] == 2
+        addr2 = server2.start()
+        client2 = MasterClient(addr2, worker="w2", retry=False)
+        got = sorted(master_reader(client2, lambda ch: [ch])())
+        assert leased.chunk in got               # the lost lease re-ran
+        assert len(got) == 2
+        assert client2.counts()["done"] == 3
+    finally:
+        server2.stop()
+
+
+def test_server_rejects_queue_plus_existing_snapshot(tmp_path):
+    """Two conflicting sources of truth must not be resolved silently:
+    a caller-supplied queue AND an existing snapshot is an error."""
+    snap = str(tmp_path / "master.snap")
+    q = TaskQueue()
+    q.set_dataset(["a"])
+    q.snapshot(snap)
+    with pytest.raises(ValueError, match="snapshot"):
+        MasterServer(TaskQueue(), snapshot_path=snap)
+    # queue=None recovers cleanly
+    server = MasterServer(None, snapshot_path=snap)
+    try:
+        assert server.queue.counts()["todo"] == 1
+    finally:
+        server._httpd.server_close()
+
+
+def test_client_retries_through_master_restart(tmp_path):
+    """The go/master/client.go contract: a master restart mid-poll is a
+    pause, not a worker crash — the client's next RPC lands on the
+    recovered master."""
+    snap = str(tmp_path / "master.snap")
+    q = TaskQueue(timeout_secs=30)
+    q.set_dataset(["a", "b"])
+    server = MasterServer(q, snapshot_path=snap, snapshot_every=1)
+    addr = server.start()
+    host, port = addr.split(":")
+    client = MasterClient(
+        addr, worker="w",
+        retry=RetryPolicy(max_attempts=None, deadline=20.0,
+                          base_delay=0.02, max_delay=0.2,
+                          retryable=(urllib.error.URLError,
+                                     ConnectionError, TimeoutError),
+                          seed=3))
+    t = client.get_task()
+    client.task_finished(t.task_id)
+    server.stop()                                # master goes away
+
+    boot = []
+
+    def restart():
+        time.sleep(0.5)                          # client retries meanwhile
+        s2 = MasterServer(None, host=host, port=int(port),
+                          snapshot_path=snap)
+        s2.start()
+        boot.append(s2)
+
+    th = threading.Thread(target=restart)
+    th.start()
+    try:
+        t2 = client.get_task()                   # spans the outage
+        assert t2 is not None and t2.chunk == "b"
+        client.task_finished(t2.task_id)
+        assert client.counts()["done"] == 2
+    finally:
+        th.join()
+        for s in boot:
+            s.stop()
+
+
+def test_reader_drains_queue_under_injected_chaos():
+    """Client-side injected faults (master.http), dropped requests
+    (master.drop) and dropped replies AFTER the mutation ran
+    (master.drop_reply — the retry re-runs a settled task_finished,
+    which must return ok=False, never double-count) all retry
+    transparently; every chunk is still processed exactly once by the
+    queue's accounting.  A get_task whose reply is dropped leaves an
+    orphan lease, so the timeout is short and the failure budget wide:
+    orphans must expire, re-dispatch, and not exhaust the budget."""
+    prev = install(FaultInjector(
+        spec="master.http=0.25,master.drop=0.2,master.drop_reply=0.2",
+        seed=5))
+    try:
+        q = TaskQueue(timeout_secs=0.5, failure_max=20)
+        q.set_dataset([[i] for i in range(6)])
+        server = MasterServer(q)
+        addr = server.start()
+        try:
+            client = MasterClient(
+                addr, worker="w", timeout=5.0,
+                retry=RetryPolicy(max_attempts=None, deadline=30.0,
+                                  base_delay=0.01, max_delay=0.1,
+                                  retryable=(urllib.error.URLError,
+                                             ConnectionError, TimeoutError),
+                                  seed=6))
+            got = sorted(master_reader(client, lambda c: list(c))())
+            assert got == list(range(6))
+            counts = q.counts()
+            assert counts["done"] == 6 and counts["failed"] == 0
+        finally:
+            server.stop()
+    finally:
+        install(prev)
+
+
+# -- launcher kill-grace -----------------------------------------------------
+
+def test_launcher_kill_grace_escalates_to_sigkill(tmp_path):
+    """A rank that ignores SIGTERM cannot hang the launcher: teardown
+    escalates to SIGKILL after the grace period."""
+    import textwrap
+
+    from paddle_tpu.launch import launch
+
+    script = str(tmp_path / "wedge.py")
+    flag = str(tmp_path / "rank0-ready")
+    open(script, "w").write(textwrap.dedent("""
+        import os, signal, sys, time
+        flag = sys.argv[1]
+        if os.environ["PADDLE_TPU_PROC_ID"] == "0":
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)   # wedged rank
+            open(flag, "w").write("ready")
+            time.sleep(600)
+        else:
+            while not os.path.exists(flag):
+                time.sleep(0.01)
+            sys.exit(3)
+    """))
+    start = time.monotonic()
+    rc = launch(2, [script, flag], kill_grace=1.0)
+    elapsed = time.monotonic() - start
+    assert rc == 3
+    assert elapsed < 30, elapsed                 # no 600s hang
+
+
+# -- ResilientTrainer --------------------------------------------------------
+
+def test_resilient_trainer_driver_resumes_without_reinit(tmp_path):
+    """Driver logic without a model: an interrupted run leaves a
+    checkpointed step; the next run() resumes from it (init_fn NOT
+    re-run), re-leases the abandoned chunk after its timeout, and drains
+    the queue with zero lost tasks."""
+    from paddle_tpu import fluid
+    from paddle_tpu.resilience import ResilientTrainer
+
+    q = TaskQueue(timeout_secs=0.3)
+    q.set_dataset([[0, 1], [2, 3], [4, 5]])
+    seen1, inits = [], []
+    t1 = ResilientTrainer(str(tmp_path), q, lambda c: list(c),
+                          program=fluid.Program(), scope=fluid.Scope(),
+                          poll_interval=0.02)
+    end = t1.run(lambda rec, step: seen1.append(rec),
+                 init_fn=lambda: inits.append(1), max_steps=3)
+    assert end == 3 and inits == [1]
+    assert not q.all_done()                      # interrupted mid-dataset
+    # the bounded stop handed its mid-chunk lease back immediately and
+    # uncharged: no pending lease to wait out, no failure-budget erosion
+    c = q.counts()
+    assert c["pending"] == 0 and c["failed"] == 0
+    # a crash-respawn at the bound must NOT lease and overshoot: a fresh
+    # trainer resuming at step 3 with max_steps=3 returns immediately
+    t_again = ResilientTrainer(str(tmp_path), q, lambda c: list(c),
+                               program=fluid.Program(),
+                               scope=fluid.Scope(), poll_interval=0.02)
+    assert t_again.run(lambda rec, step: 1 / 0, max_steps=3) == 3
+    assert q.counts() == c                       # nothing leased/changed
+
+    seen2 = []
+    t2 = ResilientTrainer(str(tmp_path), q, lambda c: list(c),
+                          program=fluid.Program(), scope=fluid.Scope(),
+                          poll_interval=0.02)
+    final = t2.run(lambda rec, step: seen2.append(rec),
+                   init_fn=lambda: inits.append(2))
+    assert inits == [1]                          # resumed, not re-inited
+    assert final > 3                             # step counter continued
+    assert q.all_done()
+    counts = q.counts()
+    assert counts["done"] == 3 and counts["failed"] == 0
+    # at-least-once: together both runs covered every record
+    assert set(seen1) | set(seen2) == set(range(6))
+
+
+def test_resilient_trainer_poison_record_charges_failure(tmp_path):
+    """A train_step exception must charge the chunk's failure budget
+    BEFORE propagating: across worker crash-restarts the poison chunk
+    hits failure_max and is discarded, instead of crash-looping the job
+    forever."""
+    from paddle_tpu import fluid
+    from paddle_tpu.resilience import ResilientTrainer
+
+    q = TaskQueue(timeout_secs=30, failure_max=2)
+    q.set_dataset(["good", "poison"])
+
+    def train_step(rec, step):
+        if rec == "poison":
+            raise RuntimeError("bad record")
+
+    runs = 0
+    while not q.all_done():
+        runs += 1
+        assert runs < 10, "poison chunk never discarded"
+        trainer = ResilientTrainer(str(tmp_path), q, lambda c: [c],
+                                   program=fluid.Program(),
+                                   scope=fluid.Scope(), poll_interval=0.02)
+        try:
+            trainer.run(train_step)
+        except RuntimeError:
+            continue                             # "crash"; supervisor retries
+    counts = q.counts()
+    assert counts["failed"] == 1                 # poison discarded at budget
+    assert counts["done"] == 1                   # good chunk trained
+    # exactly failure_max crashes: the 2nd crash spends the budget and
+    # discards the chunk, draining the queue — no third run needed
+    assert runs == 2
+
+
+def test_resilient_trainer_checkpoints_before_finishing_chunk(tmp_path):
+    """A chunk's trained steps must be durable BEFORE the master hears
+    task_finished: with a sparse save interval, a crash right after a
+    chunk completes must still find those steps in a checkpoint (the
+    master won't re-deliver a done chunk's records)."""
+    from paddle_tpu import fluid
+    from paddle_tpu.resilience import ResilientTrainer
+
+    q = TaskQueue(timeout_secs=30)
+    q.set_dataset([["a1", "a2", "a3"], ["BOOM"]])
+
+    def train_step(rec, step):
+        if rec == "BOOM":
+            raise RuntimeError("crash on chunk B")
+
+    trainer = ResilientTrainer(str(tmp_path), q, lambda c: list(c),
+                               program=fluid.Program(),
+                               scope=fluid.Scope(),
+                               save_interval_steps=10,  # never by interval
+                               poll_interval=0.02)
+    with pytest.raises(RuntimeError):
+        trainer.run(train_step)
+    # chunk A is durably done on the master AND its 3 steps are durably
+    # checkpointed, despite the crash before any interval/exit save
+    assert q.counts()["done"] == 1
+    assert trainer.manager.latest_step() == 3
+
+
+def test_resilient_trainer_trains_through_interruption(tmp_path):
+    """End-to-end single-process: train a linear model through an
+    interrupt + fresh-scope resume; optimizer state round-trips through
+    the checkpoint and the loss keeps decreasing."""
+    from paddle_tpu import fluid
+    from paddle_tpu.resilience import ResilientTrainer
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4], "float32")
+            y = fluid.layers.data("y", [1], "float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+        return main, startup, scope, loss
+
+    W = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+
+    def read_chunk(seed):
+        r = np.random.RandomState(seed)
+        out = []
+        for _ in range(8):                       # 8 batches per chunk
+            xs = r.randn(8, 4).astype(np.float32)
+            out.append((xs, xs @ W[:, None]))
+        return out
+
+    def make_queue():
+        q = TaskQueue(timeout_secs=0.3)
+        q.set_dataset(list(range(8)))
+        return q
+
+    losses = []
+
+    def run_one(q, ckpt, max_steps=None):
+        main, startup, scope, loss = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        trainer = ResilientTrainer(str(ckpt), q, read_chunk,
+                                   program=main, scope=scope,
+                                   save_interval_steps=4,
+                                   poll_interval=0.02)
+
+        def train_step(rec, step):
+            xs = np.asarray(rec[0], np.float32)
+            ys = np.asarray(rec[1], np.float32)
+            l, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+
+        with fluid.scope_guard(scope):
+            return trainer.run(train_step,
+                               init_fn=lambda: exe.run(startup),
+                               max_steps=max_steps)
+
+    q = make_queue()
+    run_one(q, tmp_path / "ck", max_steps=3)     # "crash" after 3 steps
+    run_one(q, tmp_path / "ck")                  # fresh scope, resume
+    assert q.all_done() and q.counts()["failed"] == 0
+    assert q.counts()["done"] == 8
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
