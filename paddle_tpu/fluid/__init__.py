@@ -18,6 +18,7 @@ from .core.registry import registered_ops
 from .data_feeder import DataFeeder
 from .executor import (CPUPlace, Executor, Scope, TPUPlace, global_scope,
                        scope_guard)
+from .pipeline_io import DataLoader
 from .framework import (Block, Operator, Parameter, Program, Variable,
                         default_main_program, default_startup_program,
                         program_guard, switch_main_program,
@@ -34,5 +35,5 @@ __all__ = [
     "Program", "Block", "Operator", "Variable", "Parameter", "ParamAttr",
     "default_main_program", "default_startup_program", "program_guard",
     "switch_main_program", "switch_startup_program",
-    "SeqArray", "make_seq", "registered_ops", "DataFeeder",
+    "SeqArray", "make_seq", "registered_ops", "DataFeeder", "DataLoader",
 ]

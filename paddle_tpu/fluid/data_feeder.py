@@ -40,3 +40,15 @@ class DataFeeder:
                     arr = arr.reshape(arr.shape[0], *shape)
                 out[var.name] = arr
         return out
+
+    def decorate_reader(self, reader, capacity: int = 2,
+                        device_prefetch: bool = True):
+        """Reference ``DataFeeder.decorate_reader``: wrap a batch reader
+        so this feeder's row->feed-dict conversion AND the H2D transfer
+        happen on a background thread, ``capacity`` batches ahead of the
+        consuming step (returns a ``DataLoader`` — iterate it and pass
+        each yielded dict to ``Executor.run``/``run_pipeline``)."""
+        from .pipeline_io import DataLoader
+
+        return DataLoader(reader, feeder=self, capacity=capacity,
+                          device_prefetch=device_prefetch)

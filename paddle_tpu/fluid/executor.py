@@ -180,6 +180,25 @@ class Executor:
         # backward) — measurable per-step Python overhead in the hot loop
         # (the reference re-walks the block per step; we don't have to)
         self._cls_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        # hit/miss/eviction counters for both caches — the observability
+        # half of log_recompiles (cache_stats() accessor below)
+        self._stats = {
+            "executable": {"hits": 0, "misses": 0, "evictions": 0},
+            "structure": {"hits": 0, "misses": 0, "evictions": 0},
+        }
+
+    def cache_stats(self) -> Dict[str, Dict[str, int]]:
+        """Counters for the executable cache (compiled step signatures)
+        and the structure cache (feed/state/fetch classification):
+        {'executable': {hits, misses, evictions, size}, 'structure':
+        {...}}.  A hot training loop should converge to pure hits; a
+        climbing miss count is the recompile churn `log_recompiles`
+        prints about (unbucketed sequence lengths, drifting feed
+        signatures, cache capacity thrash)."""
+        out = {k: dict(v) for k, v in self._stats.items()}
+        out["executable"]["size"] = len(self._cache)
+        out["structure"]["size"] = len(self._cls_cache)
+        return out
 
     @staticmethod
     def _program_key(program: Program) -> str:
@@ -261,21 +280,107 @@ class Executor:
             state_vals[n] = v
         return state_vals
 
-    def _classify_state(self, traced_ops, feed, fetch_names, block, scope):
-        """Classification + scope pull in one call (cost_analysis uses
-        this so the analyzed step IS the executed step)."""
+    @staticmethod
+    def _check_nan_inf(named_values) -> None:
+        """Post-step scan of every produced value — the analog of
+        CheckTensorNANOrInf per op output (executor.cc:64,129); shared
+        by run() and run_steps()."""
+        for name, v in named_values:
+            arr = np.asarray(v.data if isinstance(v, SeqArray) else v)
+            if np.issubdtype(arr.dtype, np.floating) and \
+                    not np.isfinite(arr).all():
+                raise FloatingPointError(
+                    f"Tensor {name!r} contains NaN/Inf "
+                    f"(FLAGS check_nan_inf)")
+
+    def _lookup_executable(self, key, what: str = "step"):
+        """Executable-cache probe with hit/miss accounting and the
+        log_recompiles miss narration; returns the cached entry tuple
+        or None."""
+        entry = self._cache.get(key)
+        if entry is not None:
+            self._cache.move_to_end(key)
+            self._stats["executable"]["hits"] += 1
+            return entry
+        self._stats["executable"]["misses"] += 1
+        from ..utils.flags import FLAGS
+
+        if FLAGS["log_recompiles"] and self._cache:
+            import sys
+
+            st = self._stats["executable"]
+            print(f"[paddle_tpu] compiling new {what} signature "
+                  f"(cache size {len(self._cache)}, "
+                  f"hits {st['hits']} misses {st['misses']} "
+                  f"evictions {st['evictions']})", file=sys.stderr)
+        return None
+
+    def _store_executable(self, key, entry) -> None:
+        """Insert + LRU-evict with eviction accounting/narration."""
+        from ..utils.flags import FLAGS
+
+        self._cache[key] = entry
+        while len(self._cache) > self.CACHE_CAPACITY:
+            self._cache.popitem(last=False)
+            self._stats["executable"]["evictions"] += 1
+            if FLAGS["log_recompiles"]:
+                import sys
+
+                print("[paddle_tpu] evicted a compiled step (cache over "
+                      f"capacity {self.CACHE_CAPACITY})", file=sys.stderr)
+
+    def _classified(self, prog_fp, feed, fetch_names, block):
+        """Structure-cache lookup (or derivation) of the block's
+        host-op split + feed/state/fetch classification — the per-step
+        Python cost run()/run_steps() must NOT re-pay in the hot loop:
+        -> (traced_ops, pre_host, post_host, state_in, state_out)."""
+        cls_key = (prog_fp, tuple(sorted(feed)), tuple(fetch_names))
+        cls = self._cls_cache.get(cls_key)
+        if cls is not None:
+            self._cls_cache.move_to_end(cls_key)
+            self._stats["structure"]["hits"] += 1
+            return cls
+        self._stats["structure"]["misses"] += 1
+        # host IO ops (save/load) execute in block order relative to
+        # the compiled segment: a `load` prologue before, a `save`
+        # epilogue after (the reference executor runs them inline; an
+        # IO op sandwiched between compute ops would need segment
+        # splitting — reject it).
+        traced_ops = [op for op in block.ops if op.type not in HOST_OPS]
+        pre_host, post_host = [], []
+        seen_traced = False
+        for op in block.ops:
+            if op.type in HOST_OPS:
+                (post_host if seen_traced else pre_host).append(op)
+            else:
+                seen_traced = True
+        for op in post_host:
+            idx = block.ops.index(op)
+            if any(o.type not in HOST_OPS for o in block.ops[idx:]):
+                raise NotImplementedError(
+                    "save/load ops interleaved between compute ops are "
+                    "not supported; put IO ops at the block boundary or "
+                    "in their own program")
+        # classify vars: feeds come from the feed dict; every other var
+        # read before written (or fetched but never written) must come
+        # from the scope as state.
         state_in, state_out = self._classify_structure(
             traced_ops, set(feed), fetch_names, block)
-        state_vals = self._fetch_state(state_in, traced_ops, fetch_names,
-                                       scope)
-        return state_in, state_out, state_vals
+        cls = (traced_ops, pre_host, post_host, state_in, state_out)
+        self._cls_cache[cls_key] = cls
+        while len(self._cls_cache) > self.CACHE_CAPACITY:
+            self._cls_cache.popitem(last=False)
+            self._stats["structure"]["evictions"] += 1
+        return cls
 
     def _prepare_step(self, program, feed, fetch_list, scope, mode):
         """Shared prologue for the out-of-band step consumers
         (cost_analysis / device_time_per_step): normalize the call,
         classify state against the scope, and build the pure step fn —
-        the same classification run() performs, so the analyzed/timed
-        step IS the executed step."""
+        the same (cached) classification run() performs, so the
+        analyzed/timed step IS the executed step.  Like run(), this
+        rejects programs with host IO ops interleaved between compute
+        ops."""
         program = program or default_main_program()
         feed = {k: _as_feed_value(v) for k, v in (feed or {}).items()}
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
@@ -283,9 +388,10 @@ class Executor:
         scope = scope or global_scope()
         desc = program.desc
         block = desc.global_block()
-        traced_ops = [op for op in block.ops if op.type not in HOST_OPS]
-        state_in, state_out, state_vals = self._classify_state(
-            traced_ops, feed, fetch_names, block, scope)
+        traced_ops, _, _, state_in, state_out = self._classified(
+            self._program_key(program), feed, fetch_names, block)
+        state_vals = self._fetch_state(state_in, traced_ops, fetch_names,
+                                       scope)
         step = build_step_fn(desc, 0, list(feed), state_in, state_out,
                              fetch_names, mode)
         return feed, state_vals, step
@@ -384,41 +490,8 @@ class Executor:
         block = desc.global_block()
 
         prog_fp = self._program_key(program)
-        cls_key = (prog_fp, tuple(sorted(feed)), tuple(fetch_names))
-        cls = self._cls_cache.get(cls_key)
-        if cls is not None:
-            self._cls_cache.move_to_end(cls_key)
-            traced_ops, pre_host, post_host, state_in, state_out = cls
-        else:
-            # host IO ops (save/load) execute in block order relative to
-            # the compiled segment: a `load` prologue before, a `save`
-            # epilogue after (the reference executor runs them inline; an
-            # IO op sandwiched between compute ops would need segment
-            # splitting — reject it).
-            traced_ops = [op for op in block.ops if op.type not in HOST_OPS]
-            pre_host, post_host = [], []
-            seen_traced = False
-            for op in block.ops:
-                if op.type in HOST_OPS:
-                    (post_host if seen_traced else pre_host).append(op)
-                else:
-                    seen_traced = True
-            for op in post_host:
-                idx = block.ops.index(op)
-                if any(o.type not in HOST_OPS for o in block.ops[idx:]):
-                    raise NotImplementedError(
-                        "save/load ops interleaved between compute ops are "
-                        "not supported; put IO ops at the block boundary or "
-                        "in their own program")
-            # classify vars: feeds come from the feed dict; every other var
-            # read before written (or fetched but never written) must come
-            # from the scope as state.
-            state_in, state_out = self._classify_structure(
-                traced_ops, set(feed), fetch_names, block)
-            self._cls_cache[cls_key] = (traced_ops, pre_host, post_host,
-                                        state_in, state_out)
-            while len(self._cls_cache) > self.CACHE_CAPACITY:
-                self._cls_cache.popitem(last=False)
+        traced_ops, pre_host, post_host, state_in, state_out = \
+            self._classified(prog_fp, feed, fetch_names, block)
 
         for op in pre_host:
             self._run_host_op(op, scope)
@@ -445,16 +518,9 @@ class Executor:
                tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))
         from ..utils.flags import FLAGS
 
-        compiled, state_sh, feed_sh = self._cache.get(key,
-                                                      (None, None, None))
-        if compiled is not None:
-            self._cache.move_to_end(key)
+        compiled, state_sh, feed_sh = self._lookup_executable(key) \
+            or (None, None, None)
         if compiled is None:
-            if FLAGS["log_recompiles"] and self._cache:
-                import sys
-
-                print(f"[paddle_tpu] compiling new step signature "
-                      f"(cache size {len(self._cache)})", file=sys.stderr)
             step = build_step_fn(desc, 0, list(feed), state_in, state_out,
                                  fetch_names, mode)
             if mesh is not None:
@@ -476,10 +542,9 @@ class Executor:
             else:
                 compiled = jax.jit(step, donate_argnums=(1,))
                 feed_sh = None
-            self._cache[key] = (compiled, state_sh if mesh is not None
-                                else None, feed_sh)
-            while len(self._cache) > self.CACHE_CAPACITY:
-                self._cache.popitem(last=False)
+            self._store_executable(key, (compiled, state_sh
+                                         if mesh is not None else None,
+                                         feed_sh))
 
         if state_sh is not None:
             # re-lay out state whose current placement disagrees with its
@@ -536,16 +601,8 @@ class Executor:
             if FLAGS["benchmark"]:
                 jax.block_until_ready(fetches)
         if FLAGS["check_nan_inf"]:
-            # post-step scan of every produced value — the analog of
-            # CheckTensorNANOrInf per op output (executor.cc:64,129)
-            for name, v in list(new_state.items()) + list(
-                    zip(fetch_names, fetches)):
-                arr = np.asarray(v.data if isinstance(v, SeqArray) else v)
-                if np.issubdtype(arr.dtype, np.floating) and \
-                        not np.isfinite(arr).all():
-                    raise FloatingPointError(
-                        f"Tensor {name!r} contains NaN/Inf "
-                        f"(FLAGS check_nan_inf)")
+            self._check_nan_inf(list(new_state.items()) +
+                                list(zip(fetch_names, fetches)))
         for n, v in new_state.items():
             scope.set_var(n, v)
         for op in post_host:
@@ -554,6 +611,236 @@ class Executor:
         if return_numpy:
             return [_to_numpy(f) for f in fetches]
         return list(fetches)
+
+    # -- pipelined dispatch --------------------------------------------------
+    def run_pipeline(self, program: Optional[Program] = None,
+                     loader=None,
+                     fetch_list: Optional[Sequence] = None,
+                     scope: Optional[Scope] = None,
+                     fetch_every: int = 8, return_numpy: bool = True,
+                     mode: str = "train", on_fetch=None) -> List[Any]:
+        """Drive a DataLoader (or any iterable of feed dicts) through
+        compiled steps WITHOUT blocking on fetch each iteration.
+
+        Each step is the exact same dispatch ``run()`` performs (same
+        executable cache, same rng advancement, donated state buffers
+        reused in place), so the results are bitwise identical to the
+        synchronous loop — the difference is purely scheduling: fetches
+        stay device-resident futures and only materialise every
+        ``fetch_every`` steps, so the host races ahead dispatching and
+        the loader's device-prefetch overlaps H2D with compute.  Up to
+        ``fetch_every`` steps are in flight at once (the periodic drain
+        is the backpressure that stops the host queueing unbounded
+        work).
+
+        Returns the per-step fetch lists, or — when ``on_fetch(outs)``
+        is given — streams them to the callback and returns the step
+        count (long epochs should stream; accumulating a million fetch
+        lists is its own host stall).
+
+        Caveat: fetching a STATE value (a persistable such as a
+        parameter, or any var the program does not itself compute)
+        forces per-step host materialisation — such a fetch aliases a
+        buffer the next step donates, so deferring it is unsafe.  The
+        loop then performs like the synchronous one; keep fetch lists
+        to freshly computed values (losses, metrics) for overlap.
+        """
+        if loader is None:
+            raise ValueError("run_pipeline needs a loader (DataLoader or "
+                             "iterable of feed dicts)")
+        if callable(loader) and not hasattr(loader, "__iter__"):
+            loader = loader()    # zero-arg reader convention
+        fetch_every = max(1, int(fetch_every))
+        # a fetched STATE value shares its buffer with the scope entry
+        # the NEXT step donates — holding such a fetch device-side
+        # across steps would read a reused/deleted buffer on hardware
+        # where donation is real.  State here means anything that is
+        # not freshly WRITTEN by the program this step (persistables,
+        # @STATE@ names, and scope-only fetch targets the program never
+        # produces).  Those fetches materialise to host numpy
+        # IMMEDIATELY (overriding return_numpy=False — a live device
+        # alias is never safe to hand back); deferred fetch is only for
+        # freshly computed values (losses, metrics).
+        blk = (program or default_main_program()).desc.global_block()
+        # written by the COMPILED step only: a var a host load op
+        # produces is served from scope state (donated) like any other
+        written = {n for op in blk.ops if op.type not in HOST_OPS
+                   for n in op.output_names() if n}
+        force_numpy = False
+        for f in (fetch_list or []):
+            n = f.name if isinstance(f, Variable) else str(f)
+            if n.startswith("@STATE@") or n not in written or (
+                    n in blk.vars and blk.vars[n].persistable):
+                fetch_every = 1
+                force_numpy = True
+                break
+        pending: List[Any] = []
+        results: List[Any] = []
+        n_steps = 0
+
+        def _drain():
+            for outs in pending:
+                if return_numpy or force_numpy:
+                    outs = [_to_numpy(f) for f in outs]
+                else:
+                    # still a sync point: without it the device-fetch
+                    # path would let the host dispatch arbitrarily far
+                    # ahead, voiding the documented in-flight bound
+                    outs = list(outs)
+                    jax.block_until_ready(outs)
+                if on_fetch is not None:
+                    on_fetch(outs)
+                else:
+                    results.append(outs)
+            pending.clear()
+
+        try:
+            for feed in loader:
+                outs = self.run(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope, return_numpy=False, mode=mode)
+                n_steps += 1
+                pending.append(outs)
+                if len(pending) >= fetch_every:
+                    _drain()
+        except BaseException:
+            # deliver fetches of steps that DID execute even when the
+            # loader raises mid-epoch (the scope already advanced
+            # through them) — but never let that best-effort drain
+            # mask the root-cause error
+            try:
+                _drain()
+            except Exception:
+                pass
+            raise
+        _drain()
+        return n_steps if on_fetch is not None else results
+
+    def run_steps(self, program: Optional[Program] = None,
+                  feeds: Optional[Sequence[Dict[str, Any]]] = None,
+                  fetch_list: Optional[Sequence] = None,
+                  scope: Optional[Scope] = None,
+                  return_numpy: bool = True,
+                  mode: str = "train") -> List[List[Any]]:
+        """Execute ``len(feeds)`` steps in ONE device dispatch.
+
+        The real version of ``device_time_per_step``'s chained-steps
+        trick: the per-step function is wrapped in a ``lax.scan`` over
+        the stacked feed batches (carrying the state dict), so k
+        optimizer steps cost one host dispatch instead of k — on a
+        tunneled/remote device that's the difference between paying the
+        RTT per step and per k steps.  Unlike the timing helper this is
+        a first-class execution mode: the scope's rng advances exactly
+        as k ``run()`` calls would, the final state is written back, and
+        every step's fetches are returned (list over steps of fetch
+        lists, matching ``run``'s shape).
+
+        All feeds must share one signature (bucket padded sequences).
+        Under an SPMD mesh or multi-host the scan would need
+        axis-shifted shardings; those fall back to per-step dispatch —
+        same results, no fusion.
+        """
+        feeds = list(feeds or [])
+        if not feeds:
+            return []
+        from ..parallel import mesh as _pmesh
+
+        if _pmesh.current_mesh() is not None or jax.process_count() > 1:
+            return [self.run(program, feed=f, fetch_list=fetch_list,
+                             scope=scope, return_numpy=return_numpy,
+                             mode=mode) for f in feeds]
+
+        program = program or default_main_program()
+        feeds = [{k: _as_feed_value(v) for k, v in f.items()}
+                 for f in feeds]
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        scope = scope or global_scope()
+        desc = program.desc
+        block = desc.global_block()
+        k = len(feeds)
+
+        prog_fp = self._program_key(program)
+        traced_ops, pre_host, post_host, state_in, state_out = \
+            self._classified(prog_fp, feeds[0], fetch_names, block)
+        if pre_host or post_host:
+            raise NotImplementedError(
+                "run_steps cannot scan over host IO ops (save/load); "
+                "run them in their own program")
+
+        sig0 = tuple((n, _sig_of(v)) for n, v in sorted(feeds[0].items()))
+        for i, f in enumerate(feeds[1:], 1):
+            sig = tuple((n, _sig_of(v)) for n, v in sorted(f.items()))
+            if sig != sig0:
+                raise ValueError(
+                    f"run_steps feed #{i} signature differs from feed #0 "
+                    f"— every step in one dispatch must share a compiled "
+                    f"shape (bucket sequence lengths / fix the batch "
+                    f"size): {sig} != {sig0}")
+
+        state_vals = self._fetch_state(state_in, traced_ops, fetch_names,
+                                       scope)
+        from ..utils.flags import FLAGS
+
+        key = (prog_fp, mode, ("scan", k), sig0, tuple(fetch_names),
+               tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))
+        compiled, _, _ = self._lookup_executable(key, f"{k}-step scan") \
+            or (None, None, None)
+        if compiled is None:
+            step = build_step_fn(desc, 0, list(feeds[0]), state_in,
+                                 state_out, fetch_names, mode)
+
+            def multi(stacked_feeds, state, rng_stack):
+                def body(st, xs):
+                    fd, bits = xs
+                    fetches, ns = step(fd, st, bits)
+                    # carry keys stay type-stable (state_in); outputs the
+                    # next step never reads ride along in ys so the
+                    # epilogue can still persist them
+                    carry = {n: ns.get(n, st[n]) for n in st}
+                    extra = {n: v for n, v in ns.items() if n not in st}
+                    return carry, (fetches, extra)
+
+                return jax.lax.scan(body, state, (stacked_feeds, rng_stack))
+
+            compiled = jax.jit(multi, donate_argnums=(1,))
+            self._store_executable(key, (compiled, None, None))
+
+        import jax.numpy as jnp
+        from jax import tree_util as jtu
+
+        stacked_feeds = jtu.tree_map(lambda *xs: jnp.stack(xs), *feeds)
+        # the SAME rng stream k sequential run() calls would consume
+        rng_stack = np.stack([scope.next_rng_bits(program.random_seed)
+                              for _ in range(k)])
+
+        from .profiler import record_event
+
+        with record_event(f"executor_scan{k}/{mode}"):
+            final_state, (fetch_stack, extra_stack) = compiled(
+                stacked_feeds, state_vals, rng_stack)
+            if FLAGS["benchmark"]:
+                jax.block_until_ready(fetch_stack)
+
+        # write back EVERY carried entry, not just the classified
+        # state_out: the whole state dict was donated, so any var not
+        # re-stored (read-only LR, all params under mode='infer') would
+        # be a deleted buffer in the scope on hardware where donation is
+        # real (build_step_fn returns every entry for the same reason)
+        new_state = dict(final_state)
+        new_state.update({n: jtu.tree_map(lambda a: a[-1], v)
+                          for n, v in extra_stack.items()})
+        if FLAGS["check_nan_inf"]:
+            self._check_nan_inf(list(new_state.items()) +
+                                list(zip(fetch_names, fetch_stack)))
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+
+        out: List[List[Any]] = []
+        for i in range(k):
+            row = [jtu.tree_map(lambda a: a[i], f) for f in fetch_stack]
+            out.append([_to_numpy(f) for f in row] if return_numpy
+                       else row)
+        return out
 
     def close(self):
         self._cache.clear()

@@ -1,11 +1,15 @@
-"""Data layers — analog of python/paddle/v2/fluid/layers/io.py (``data``)."""
+"""Data layers — analog of python/paddle/v2/fluid/layers/io.py (``data``),
+plus the input-pipeline surface replacing the reference's reader op stack
+(``py_reader`` / ``double_buffer`` / prefetch): here those become a
+``DataLoader`` (fluid/pipeline_io.py) whose background thread batches,
+converts, and device-prefetches feeds ahead of the executor."""
 
 from __future__ import annotations
 
 from ..framework import default_main_program, default_startup_program
 from ..layer_helper import LayerHelper
 
-__all__ = ["data"]
+__all__ = ["data", "data_loader", "py_reader", "double_buffer"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -25,3 +29,48 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     return helper.block.create_var(name=name, shape=shape, dtype=dtype,
                                    lod_level=lod_level,
                                    stop_gradient=stop_gradient)
+
+
+def data_loader(reader, feed_list=None, feeder=None, capacity: int = 2,
+                device_prefetch: bool = True):
+    """Build a device-prefetch ``DataLoader`` over ``reader``.
+
+    ``reader`` follows the reference convention (zero-arg callable
+    yielding batches).  Pass ``feed_list`` (data Variables) to convert
+    raw row batches with a ``DataFeeder`` on the producer thread, or
+    ``feeder`` to supply your own converter; with neither, the reader
+    must yield ready feed dicts.  The loader keeps ``capacity`` batches
+    transferred ahead of the consuming step (see fluid/pipeline_io.py).
+    """
+    from ..data_feeder import DataFeeder
+    from ..pipeline_io import DataLoader
+
+    if feed_list is not None:
+        if feeder is not None:
+            raise ValueError("pass feed_list or feeder, not both")
+        feeder = DataFeeder(feed_list)
+    return DataLoader(reader, feeder=feeder, capacity=capacity,
+                      device_prefetch=device_prefetch)
+
+
+def py_reader(capacity, feed_list=None, reader=None,
+              use_double_buffer: bool = True, name=None):
+    """Compat shim for the reference ``py_reader`` (layers/io.py /
+    create_py_reader_op.cc): a background python thread feeding a
+    bounded queue.  Our executor is feed-dict based, so instead of
+    binding queue-fed Variables this returns the equivalent
+    ``DataLoader``; ``use_double_buffer`` maps to device prefetch."""
+    return data_loader(reader, feed_list=feed_list, capacity=capacity,
+                       device_prefetch=use_double_buffer)
+
+
+def double_buffer(reader, place=None, capacity: int = 2):
+    """Compat shim for the reference ``double_buffer`` reader op: keep
+    the next ``capacity`` batches device-resident while the current one
+    computes.  ``reader`` must yield feed dicts (or be a DataLoader
+    already — returned unchanged, it prefetches natively)."""
+    from ..pipeline_io import DataLoader
+
+    if isinstance(reader, DataLoader):
+        return reader
+    return data_loader(reader, capacity=capacity)

@@ -1,0 +1,155 @@
+"""Async input pipeline — the device-prefetch DataLoader.
+
+Analog of the reference's reader-op stack: ``py_reader``
+(operators/reader/create_py_reader_op.cc) pulled python batches through
+a blocking queue on a background thread, ``double_buffer``
+(create_double_buffer_reader_op.cc) kept the next batch resident on the
+device, and ``decorator.buffered`` overlapped host-side data prep with
+compute.  Here all three collapse into one object: a ``DataLoader``
+whose producer thread runs the reader, applies the ``DataFeeder``
+conversion, and issues ``jax.device_put`` (sharding-aware under an SPMD
+mesh) up to ``capacity`` batches ahead — so H2D transfer and host
+batching overlap device execution instead of serialising with it.
+
+Consumption is a plain iterator of executor feed dicts whose values are
+already device-resident, which ``Executor.run`` passes straight through
+(`_as_feed_value` keeps jax.Arrays untouched), so the synchronous and
+pipelined paths are numerically identical by construction.
+
+Producer-thread exceptions re-raise at the consuming ``next()`` (via
+``utils.reader.PrefetchIterator``) — a failing reader is an error, not
+a short epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..utils.reader import PrefetchIterator
+
+__all__ = ["DataLoader", "device_put_feed"]
+
+
+def _put_leaf(a, mesh):
+    import jax
+
+    if isinstance(a, jax.Array):
+        return a
+    if mesh is not None:
+        from ..parallel import mesh as _pmesh
+
+        return jax.device_put(a, _pmesh.feed_sharding(mesh, a))
+    return jax.device_put(a)
+
+
+def _put_value(v, mesh):
+    """One feed value -> device-resident value.  Normalisation (dtype
+    narrowing, Seq containers) is the executor's `_as_feed_value` —
+    the ONE source of truth, so pipelined feeds can never drift from
+    what the synchronous path would have transferred."""
+    from .core.lod import NestedSeqArray, SeqArray
+    from .executor import _as_feed_value
+
+    v = _as_feed_value(v)
+    if isinstance(v, SeqArray):
+        # lengths stay host-side int32: they are tiny, and the executor
+        # normalises them with np.asarray (a device-resident lengths
+        # array would force a D2H pull per step)
+        return SeqArray(_put_leaf(v.data, mesh), v.lengths)
+    if isinstance(v, NestedSeqArray):
+        return NestedSeqArray(_put_leaf(v.data, mesh),
+                              v.outer_lengths, v.inner_lengths)
+    return _put_leaf(v, mesh)
+
+
+def device_put_feed(feed: dict, mesh=None) -> dict:
+    """Transfer a whole feed dict to the device ahead of the step that
+    consumes it (sharded over the mesh's 'dp' axis when one is given).
+    Multi-host SPMD keeps host numpy: every process must see the GLOBAL
+    batch, and the executor's `_globalize` path owns that conversion."""
+    import jax
+
+    if jax.process_count() > 1:
+        return dict(feed)
+    return {n: _put_value(v, mesh) for n, v in feed.items()}
+
+
+class DataLoader:
+    """Bounded device-prefetch input pipeline.
+
+    Parameters
+    ----------
+    reader: the data source — a zero-arg callable returning an iterator
+        (the reference reader convention; re-invoked on every epoch) or
+        a plain iterable.  Yields either ready feed dicts, or raw
+        batches when ``feeder`` is given.
+    feeder: optional converter applied to each reader item on the
+        producer thread — a ``fluid.DataFeeder`` (its ``.feed``), a v2
+        ``DataFeeder`` (callable), or any ``batch -> feed dict``
+        callable.
+    capacity: how many converted, device-resident batches the producer
+        runs ahead (the reference py_reader queue capacity / the N of
+        N-batch double buffering).
+    device_prefetch: issue ``jax.device_put`` on the producer thread so
+        the H2D transfer itself overlaps compute; when False the loader
+        only overlaps reading + host conversion and leaves the transfer
+        to the executor's jitted-arg path.
+    """
+
+    def __init__(self, reader, feeder=None, capacity: int = 2,
+                 device_prefetch: bool = True):
+        if capacity < 1:
+            raise ValueError(f"DataLoader capacity must be >= 1, "
+                             f"got {capacity}")
+        if reader is None or not (callable(reader)
+                                  or hasattr(reader, "__iter__")):
+            # fail at construction, not first iteration: the reference
+            # py_reader attached its generator later, but this loader
+            # has no decorate-afterwards phase
+            raise ValueError(
+                "DataLoader needs a reader (zero-arg callable or "
+                f"iterable), got {reader!r}")
+        self._reader = reader
+        # a bare iterator/generator (iter(x) is x) is one-shot: fine
+        # for a single epoch, but a second epoch over it would be
+        # silently empty — the exact failure mode the buffered() fix
+        # eliminated.  Track it and raise instead.
+        self._one_shot = (not callable(reader)
+                          and iter(reader) is reader)
+        self._exhausted = False
+        self._feed_fn: Optional[Callable] = None
+        if feeder is not None:
+            self._feed_fn = (feeder.feed if hasattr(feeder, "feed")
+                             else feeder)
+        self.capacity = capacity
+        self.device_prefetch = device_prefetch
+
+    def _prepare(self, item):
+        """Producer-thread transform: convert + transfer one batch."""
+        if self._feed_fn is not None:
+            item = self._feed_fn(item)
+        if not isinstance(item, dict):
+            raise TypeError(
+                "DataLoader expects the reader (after the feeder, if "
+                f"any) to yield feed dicts, got {type(item).__name__}; "
+                "pass feeder= to convert raw batches")
+        if self.device_prefetch:
+            from ..parallel import mesh as _pmesh
+
+            return device_put_feed(item, _pmesh.current_mesh())
+        return dict(item)
+
+    def __iter__(self):
+        if self._one_shot:
+            if self._exhausted:
+                raise RuntimeError(
+                    "DataLoader reader was a one-shot iterator and is "
+                    "already exhausted; pass a zero-arg callable (or a "
+                    "re-iterable) for multi-epoch use")
+            self._exhausted = True
+        src = self._reader() if callable(self._reader) else iter(self._reader)
+        it = PrefetchIterator(src, self.capacity, transform=self._prepare)
+        try:
+            yield from it
+        finally:
+            it.close()

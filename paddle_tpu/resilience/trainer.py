@@ -57,12 +57,16 @@ class ResilientTrainer:
         master_reader).
     program / scope: what to checkpoint; default main program and global
         scope when None (resolved at save/restore time).
+    prefetch: when > 0, read each chunk's records on a background
+        thread that many records ahead of train_step (the input half of
+        the async pipeline; read errors still surface at the consuming
+        next() and settle the lease as task_failed).
     """
 
     def __init__(self, checkpoint_dir: str, queue, read_chunk,
                  *, program=None, scope=None, worker: str = "worker-0",
                  save_interval_steps: int = 1, max_to_keep: int = 3,
-                 poll_interval: float = 0.05):
+                 poll_interval: float = 0.05, prefetch: int = 0):
         self.manager = CheckpointManager(
             checkpoint_dir, max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps)
@@ -72,6 +76,11 @@ class ResilientTrainer:
         self.scope = scope
         self.worker = worker
         self.poll_interval = poll_interval
+        # records-ahead depth for the background chunk reader (0 = read
+        # inline).  Prefetch keeps lease settlement exact: a read error
+        # surfaces at the consuming next() (utils.reader propagation)
+        # and still charges task_failed, never a short chunk.
+        self.prefetch = prefetch
 
     def resume(self) -> Optional[int]:
         """Restore the newest CRC-valid checkpoint into the scope;
@@ -122,62 +131,81 @@ class ResilientTrainer:
                 continue
             injector().note_lease()     # chaos kill-after-N hook
             try:
-                it = iter(self.read_chunk(task.chunk))
+                src = self.read_chunk(task.chunk)
+                if self.prefetch:
+                    from ..utils.reader import PrefetchIterator
+
+                    src = PrefetchIterator(src, self.prefetch)
+                it = iter(src)
             except Exception:
                 self.queue.task_failed(task.task_id)
                 continue
-            while True:
-                try:
-                    record = next(it)
-                except StopIteration:
-                    # checkpoint BEFORE reporting the chunk done: once
-                    # the master durably records it finished, its
-                    # records are never re-delivered — so the steps they
-                    # trained must already be durable too, or a crash in
-                    # this gap silently loses them (at-most-once)
-                    if step > 0 and last_saved != step:
-                        self._save(step, force=True)
-                        last_saved = step
-                    self.queue.task_finished(task.task_id)
-                    break
-                except Exception:
-                    self.queue.task_failed(task.task_id)
-                    break
-                step += 1
-                try:
-                    train_step(record, step)
-                except Exception:
-                    # charge the failure BEFORE propagating: a poison
-                    # record must burn failure budget on every crash so
-                    # failure_max eventually discards its chunk instead
-                    # of the worker crash-looping forever
-                    self.queue.task_failed(task.task_id)
-                    raise
-                except BaseException:
-                    # KeyboardInterrupt / SystemExit: a deliberate stop
-                    # is not a failure — hand the lease back uncharged
-                    # (best-effort, as in the max_steps stop below)
-                    try:
-                        self.queue.task_returned(task.task_id,
-                                                 self.worker)
-                    except Exception:
-                        pass
-                    raise
-                if self._save(step):
-                    last_saved = step
-                if max_steps is not None and step >= max_steps:
-                    # deliberate stop mid-chunk: hand the lease back
-                    # uncharged (best-effort — if the master is away,
-                    # the lease simply expires as a crash would)
-                    try:
-                        self.queue.task_returned(task.task_id,
-                                                 self.worker)
-                    except Exception:
-                        pass
-                    stopping = True
-                    break
+            try:
+                step, last_saved, stopping = self._drive_chunk(
+                    task, it, train_step, max_steps, step, last_saved)
+            finally:
+                # unblock a prefetching producer on EVERY exit path
+                # (chunk done, failure break, train_step raise)
+                close = getattr(src, "close", None)
+                if close is not None:
+                    close()
         # the final step always persists, whatever the interval (but
         # never rewrite a checkpoint the loop just finished writing)
         if step > 0 and last_saved != step:
             self._save(step, force=True)
         return step
+
+    def _drive_chunk(self, task, it, train_step, max_steps, step,
+                     last_saved):
+        """Consume one leased chunk's records; returns (step, last_saved,
+        stopping).  train_step exceptions propagate after the lease is
+        settled (see run's accounting table in the module docstring)."""
+        while True:
+            try:
+                record = next(it)
+            except StopIteration:
+                # checkpoint BEFORE reporting the chunk done: once
+                # the master durably records it finished, its
+                # records are never re-delivered — so the steps they
+                # trained must already be durable too, or a crash in
+                # this gap silently loses them (at-most-once)
+                if step > 0 and last_saved != step:
+                    self._save(step, force=True)
+                    last_saved = step
+                self.queue.task_finished(task.task_id)
+                return step, last_saved, False
+            except Exception:
+                self.queue.task_failed(task.task_id)
+                return step, last_saved, False
+            step += 1
+            try:
+                train_step(record, step)
+            except Exception:
+                # charge the failure BEFORE propagating: a poison
+                # record must burn failure budget on every crash so
+                # failure_max eventually discards its chunk instead
+                # of the worker crash-looping forever
+                self.queue.task_failed(task.task_id)
+                raise
+            except BaseException:
+                # KeyboardInterrupt / SystemExit: a deliberate stop
+                # is not a failure — hand the lease back uncharged
+                # (best-effort, as in the max_steps stop below)
+                try:
+                    self.queue.task_returned(task.task_id,
+                                             self.worker)
+                except Exception:
+                    pass
+                raise
+            if self._save(step):
+                last_saved = step
+            if max_steps is not None and step >= max_steps:
+                # deliberate stop mid-chunk: hand the lease back
+                # uncharged (best-effort — if the master is away,
+                # the lease simply expires as a crash would)
+                try:
+                    self.queue.task_returned(task.task_id,
+                                             self.worker)
+                except Exception:
+                    pass
+                return step, last_saved, True

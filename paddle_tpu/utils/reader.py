@@ -12,11 +12,96 @@ from __future__ import annotations
 
 import itertools
 import random
-from queue import Queue
-from threading import Thread
+from queue import Empty, Full, Queue
+from threading import Event, Thread
 
 __all__ = ["batch", "shuffle", "map_readers", "buffered", "compose",
-           "chain", "firstn", "shard", "cache"]
+           "chain", "firstn", "shard", "cache", "PrefetchIterator"]
+
+
+class _EndOfStream:
+    """Queue sentinel carrying the producer's terminal status: ``error``
+    is None on clean exhaustion, else the exception to re-raise in the
+    consumer (a failing reader must NOT look like a short epoch)."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error=None):
+        self.error = error
+
+
+class PrefetchIterator:
+    """Pull ``it`` from a background thread through a bounded queue.
+
+    The building block behind ``buffered`` and the pipeline DataLoader
+    (fluid/pipeline_io.py): the producer thread stays ``size`` items
+    ahead of the consumer, producer exceptions are captured and
+    re-raised at the consuming ``next()`` (not swallowed), and closing
+    the iterator (or abandoning it) unblocks a producer stuck on a full
+    queue via the stop event instead of leaking it on a ``put``.
+    """
+
+    def __init__(self, it, size, transform=None):
+        self._q: Queue = Queue(maxsize=max(1, int(size)))
+        self._stop = Event()
+        self._done = False
+
+        def fill():
+            try:
+                for item in it:
+                    if transform is not None:
+                        item = transform(item)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except Full:
+                            continue
+                    else:
+                        return          # consumer went away — drop the tail
+            except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+                end = _EndOfStream(e)
+            else:
+                end = _EndOfStream()
+            while not self._stop.is_set():
+                try:
+                    self._q.put(end, timeout=0.1)
+                    break
+                except Full:
+                    continue
+
+        self._thread = Thread(target=fill, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    # producer died without posting a sentinel (should not
+                    # happen; belt-and-braces against a hung epoch)
+                    self._done = True
+                    raise StopIteration from None
+        if isinstance(item, _EndOfStream):
+            self._done = True
+            if item.error is not None:
+                raise item.error
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        self._done = True
+
+    def __del__(self):
+        self._stop.set()
 
 
 def batch(reader, batch_size, drop_last=True):
@@ -58,26 +143,16 @@ def map_readers(func, *readers):
 
 def buffered(reader, size):
     """Background-thread prefetch (reference decorator.py buffered) — the
-    host-side overlap that hides data prep behind device steps."""
-    END = object()
-
+    host-side overlap that hides data prep behind device steps.  A
+    producer-thread exception re-raises at the consuming ``next()``
+    (historically it was swallowed by the end-of-queue sentinel, turning
+    a failing reader into a silently short epoch)."""
     def _r():
-        q: Queue = Queue(maxsize=size)
-
-        def fill():
-            try:
-                for sample in reader():
-                    q.put(sample)
-            finally:
-                q.put(END)
-
-        t = Thread(target=fill, daemon=True)
-        t.start()
-        while True:
-            s = q.get()
-            if s is END:
-                break
-            yield s
+        it = PrefetchIterator(reader(), size)
+        try:
+            yield from it
+        finally:
+            it.close()
     return _r
 
 

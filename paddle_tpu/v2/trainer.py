@@ -72,20 +72,36 @@ class SGD:
 
     # -- API -----------------------------------------------------------------
     def train(self, reader: Callable, num_passes: int = 1,
-              event_handler: Optional[Callable] = None, feeding=None):
+              event_handler: Optional[Callable] = None, feeding=None,
+              prefetch: int = 2):
+        """Drive passes over ``reader``.  ``prefetch`` > 0 routes the
+        batches through a device-prefetch DataLoader (fluid/pipeline_io):
+        feeding-map conversion and H2D transfer run on a background
+        thread that many batches ahead, overlapping the device step —
+        numerically identical to the synchronous path (prefetch=0), the
+        feeds are merely transferred early."""
         event_handler = event_handler or default_event_handler
         feeder = self._feeder(feeding)
         self._ensure_init()
         fetch = [self.__cost__] + list(self.__extra_layers__)
+        if prefetch and prefetch > 0:
+            loader = fluid.DataLoader(reader, feeder=feeder,
+                                      capacity=prefetch)
+
+            def batches():
+                return iter(loader)
+        else:
+            def batches():
+                return (feeder(b) for b in reader())
         with fluid.scope_guard(self.__parameters__.scope):
             for pass_id in range(num_passes):
                 event_handler(v2_event.BeginPass(pass_id))
                 pass_costs = []
-                for batch_id, data_batch in enumerate(reader()):
+                for batch_id, feed in enumerate(batches()):
                     event_handler(v2_event.BeginIteration(pass_id,
                                                           batch_id))
                     outs = self.__exe__.run(self.__topology__,
-                                            feed=feeder(data_batch),
+                                            feed=feed,
                                             fetch_list=fetch)
                     cost = float(np.asarray(outs[0]))
                     metrics = {getattr(v, "name", f"extra_{i}"):

@@ -174,3 +174,74 @@ def test_device_time_per_step_chained(fresh_programs):
         l0 = float(np.asarray(exe.run(main, feed=feed,
                                       fetch_list=[loss])[0]))
         assert np.isfinite(l0)
+
+
+def test_cache_stats_and_log_recompiles(fresh_programs, capsys):
+    """Executor.cache_stats(): executable + structure hits/misses/
+    evictions, and the log_recompiles flag prints on a fresh signature
+    (ISSUE 2 satellite)."""
+    from paddle_tpu.utils.flags import set_flag
+
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    stats0 = exe.cache_stats()     # the startup run was one compile
+    assert stats0["executable"] == {"hits": 0, "misses": 1,
+                                    "evictions": 0, "size": 1}
+
+    feed8 = {"x": np.ones((8, 4), np.float32)}
+    exe.run(main, feed=feed8, fetch_list=[h])      # miss (compile)
+    exe.run(main, feed=feed8, fetch_list=[h])      # hit (replay)
+    s = exe.cache_stats()
+    # the startup run compiled too: 2 misses total, 1 hit
+    assert s["executable"]["misses"] == 2
+    assert s["executable"]["hits"] == 1
+    assert s["structure"]["misses"] == 2
+    assert s["structure"]["hits"] == 1
+    assert s["executable"]["size"] == 2
+
+    # a new batch size is a new executable signature but the SAME
+    # structure classification (keyed on names, not shapes)
+    set_flag("log_recompiles", True)
+    try:
+        exe.run(main, feed={"x": np.ones((16, 4), np.float32)},
+                fetch_list=[h])
+    finally:
+        set_flag("log_recompiles", False)
+    s2 = exe.cache_stats()
+    assert s2["executable"]["misses"] == 3
+    assert s2["structure"]["hits"] == 2
+    assert s2["structure"]["misses"] == 2
+    err = capsys.readouterr().err
+    assert "compiling new step signature" in err
+    assert "hits" in err and "misses" in err
+
+    # close() empties the caches but keeps the counters' history
+    exe.close()
+    s3 = exe.cache_stats()
+    assert s3["executable"]["size"] == 0
+    assert s3["executable"]["misses"] == 3
+
+
+def test_cache_eviction_counts(fresh_programs):
+    """Overflowing CACHE_CAPACITY distinct signatures records
+    evictions (LRU) in cache_stats."""
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    old_cap = fluid.Executor.CACHE_CAPACITY
+    fluid.Executor.CACHE_CAPACITY = 3
+    try:
+        for bs in (1, 2, 3, 4, 5):
+            exe.run(main, feed={"x": np.ones((bs, 4), np.float32)},
+                    fetch_list=[h])
+    finally:
+        fluid.Executor.CACHE_CAPACITY = old_cap
+    s = exe.cache_stats()
+    assert s["executable"]["evictions"] >= 2
+    assert s["executable"]["size"] <= 3

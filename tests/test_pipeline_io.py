@@ -1,0 +1,383 @@
+"""Async input/execution pipeline (ISSUE 2): device-prefetch DataLoader
+(fluid/pipeline_io.py) + pipelined executor dispatch (run_pipeline /
+run_steps).  The contract under test is the acceptance criterion: the
+pipelined paths are NUMERICALLY IDENTICAL to the synchronous
+feed->step->fetch loop — prefetch and deferred fetch change scheduling,
+never values."""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import framework
+
+
+def _build_tiny(seed=5):
+    """Tiny fixed-seed regression net: fc -> square_error -> SGD.  The
+    rng-salt counter resets so two builds of this model produce the
+    SAME init stream (what makes bitwise comparison meaningful)."""
+    framework._rng_salt_counter[0] = 0
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        y = fluid.layers.data("y", [1], "float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return main, startup, scope, cost
+
+
+def _batches(n=6, bs=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.rand(bs, 4).astype(np.float32),
+             "y": rng.rand(bs, 1).astype(np.float32)} for _ in range(n)]
+
+
+def _sync_losses(batches):
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return [exe.run(main, feed=f, fetch_list=[cost])[0]
+                for f in batches]
+
+
+# -- DataLoader ------------------------------------------------------------
+
+def test_dataloader_yields_device_feeds_in_order():
+    import jax
+
+    batches = _batches()
+    loader = fluid.DataLoader(lambda: iter(batches), capacity=2)
+    got = list(loader)
+    assert len(got) == len(batches)
+    for feed, ref in zip(got, batches):
+        assert set(feed) == {"x", "y"}
+        assert isinstance(feed["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(feed["x"]), ref["x"])
+        np.testing.assert_array_equal(np.asarray(feed["y"]), ref["y"])
+
+
+def test_dataloader_feeder_conversion_matches_direct():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        y = fluid.layers.data("y", [1], "float32")
+    feeder = fluid.DataFeeder([x, y])
+    rows = [([0.1, 0.2, 0.3, 0.4], [1.0]), ([0.5, 0.6, 0.7, 0.8], [0.0])]
+    direct = feeder.feed(rows)
+    loader = feeder.decorate_reader(lambda: iter([rows]))
+    (piped,) = list(loader)
+    for name in direct:
+        np.testing.assert_array_equal(np.asarray(piped[name]),
+                                      np.asarray(direct[name]))
+
+
+def test_dataloader_propagates_reader_error():
+    batches = _batches(2)
+
+    def bad_reader():
+        yield batches[0]
+        raise ValueError("poison batch")
+
+    loader = fluid.DataLoader(bad_reader, capacity=2)
+    it = iter(loader)
+    next(it)                       # the good batch arrives first
+    with pytest.raises(ValueError, match="poison batch"):
+        next(it)
+
+
+def test_dataloader_restarts_reader_per_epoch():
+    batches = _batches(3)
+    calls = []
+
+    def reader():
+        calls.append(1)
+        return iter(batches)
+
+    loader = fluid.DataLoader(reader, capacity=2)
+    assert len(list(loader)) == 3
+    assert len(list(loader)) == 3
+    assert len(calls) == 2
+
+
+def test_dataloader_rejects_non_dict():
+    loader = fluid.DataLoader(lambda: iter([[1, 2, 3]]), capacity=1)
+    with pytest.raises(TypeError, match="feed dicts"):
+        list(loader)
+
+
+def test_layers_io_shims():
+    from paddle_tpu.fluid.layers.io import double_buffer, py_reader
+
+    batches = _batches(2)
+    dl = py_reader(capacity=3, reader=lambda: iter(batches))
+    assert isinstance(dl, fluid.DataLoader)
+    assert dl.capacity == 3
+    assert len(list(dl)) == 2
+    assert double_buffer(dl) is dl        # already a loader: no rewrap
+    dl2 = double_buffer(lambda: iter(batches))
+    assert len(list(dl2)) == 2
+
+
+# -- pipelined execution ---------------------------------------------------
+
+def test_run_pipeline_bitwise_identical_to_sync():
+    """The ISSUE-2 smoke criterion: pipelined loop == synchronous run()
+    loop, bit for bit, on a fixed-seed tiny model."""
+    batches = _batches()
+    sync = _sync_losses(batches)
+
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    loader = fluid.DataLoader(lambda: iter(batches), capacity=3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        piped = exe.run_pipeline(main, loader, fetch_list=[cost],
+                                 fetch_every=4)
+    assert len(piped) == len(sync)
+    for s, p in zip(sync, piped):
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(p[0]))
+
+
+def test_run_pipeline_on_fetch_streams():
+    batches = _batches(5)
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    seen = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        n = exe.run_pipeline(main, fluid.DataLoader(lambda: iter(batches)),
+                             fetch_list=[cost], fetch_every=2,
+                             on_fetch=seen.append)
+    assert n == 5
+    assert len(seen) == 5
+    np.testing.assert_array_equal(np.asarray(seen[0][0]),
+                                  np.asarray(_sync_losses(batches)[0]))
+
+
+def test_run_pipeline_accepts_plain_iterables():
+    batches = _batches(3)
+    sync = _sync_losses(batches)
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        piped = exe.run_pipeline(main, iter(batches), fetch_list=[cost])
+    for s, p in zip(sync, piped):
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(p[0]))
+
+
+def test_run_steps_matches_sequential():
+    """Multi-step-per-dispatch (lax.scan over stacked feeds): same
+    losses AND same final parameters as k sequential run() calls."""
+    batches = _batches()
+    sync = _sync_losses(batches)
+
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        stepped = exe.run_steps(main, feeds=batches, fetch_list=[cost])
+    assert len(stepped) == len(sync)
+    for s, p in zip(sync, stepped):
+        np.testing.assert_allclose(np.asarray(s), np.asarray(p[0]),
+                                   rtol=1e-6, atol=1e-7)
+
+    # final parameter state matches the sequential loop's
+    main2, startup2, scope2, cost2 = _build_tiny()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        for f in batches:
+            exe2.run(main2, feed=f, fetch_list=[cost2])
+    for p in main.global_block().all_parameters():
+        np.testing.assert_allclose(np.asarray(scope.find_var(p.name)),
+                                   np.asarray(scope2.find_var(p.name)),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_run_steps_advances_scope_rng_like_sequential():
+    batches = _batches(4)
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_steps(main, feeds=batches, fetch_list=[cost])
+    rng_scan = scope._rng_step
+
+    main2, startup2, scope2, cost2 = _build_tiny()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        for f in batches:
+            exe2.run(main2, feed=f, fetch_list=[cost2])
+    assert rng_scan == scope2._rng_step
+
+
+def test_run_steps_rejects_mismatched_signatures():
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeds = _batches(2) + _batches(1, bs=16)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="signature differs"):
+            exe.run_steps(main, feeds=feeds, fetch_list=[cost])
+
+
+def test_run_steps_empty_feeds():
+    main, startup, scope, cost = _build_tiny()
+    exe = fluid.Executor(fluid.CPUPlace())
+    assert exe.run_steps(main, feeds=[], fetch_list=[cost]) == []
+
+
+# -- trainer wiring --------------------------------------------------------
+
+def test_v2_trainer_prefetch_matches_sync():
+    """Both v2 front-end paths (prefetch DataLoader vs inline feeder)
+    must produce identical per-iteration costs."""
+    import paddle_tpu.v2 as paddle
+
+    def run_v2(prefetch):
+        paddle.init(use_gpu=False, trainer_count=1, seed=7)
+        images = paddle.layer.data(
+            name="x", type=paddle.data_type.dense_vector(4))
+        label = paddle.layer.data(
+            name="y", type=paddle.data_type.integer_value(2))
+        fc = paddle.layer.fc(input=images, size=2,
+                             act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(input=fc, label=label)
+        params = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+        rows = [(list(np.linspace(0, 1, 4) + i * 0.01), i % 2)
+                for i in range(32)]
+
+        def reader():
+            for i in range(0, 32, 8):
+                yield rows[i:i + 8]
+
+        costs = []
+
+        def handler(evt):
+            if isinstance(evt, paddle.event.EndIteration):
+                costs.append(evt.cost)
+
+        trainer.train(reader, num_passes=2, event_handler=handler,
+                      prefetch=prefetch)
+        return costs
+
+    sync = run_v2(prefetch=0)
+    piped = run_v2(prefetch=2)
+    assert len(sync) == len(piped) == 8
+    np.testing.assert_array_equal(np.asarray(sync), np.asarray(piped))
+
+
+def test_resilient_trainer_prefetch_trains_identically(tmp_path):
+    """ResilientTrainer(prefetch=N) consumes the same records in the
+    same order and settles leases the same way as the inline reader."""
+    from paddle_tpu.parallel.master import TaskQueue
+    from paddle_tpu.resilience import ResilientTrainer
+
+    def run(prefetch, subdir):
+        q = TaskQueue(timeout_secs=30)
+        q.set_dataset(["c0", "c1", "c2"])
+        seen = []
+        trainer = ResilientTrainer(
+            str(tmp_path / subdir), q,
+            read_chunk=lambda c: [f"{c}:{i}" for i in range(4)],
+            prefetch=prefetch)
+        trainer.run(lambda rec, step: seen.append(rec))
+        return seen
+
+    assert run(0, "sync") == run(3, "piped")
+
+
+def test_resilient_trainer_prefetch_read_error_charges_failure(tmp_path):
+    from paddle_tpu.parallel.master import TaskQueue
+    from paddle_tpu.resilience import ResilientTrainer
+
+    q = TaskQueue(timeout_secs=30, failure_max=1)
+    q.set_dataset(["c0"])
+
+    def read_chunk(chunk):
+        yield "ok"
+        raise IOError("mid-chunk read failure")
+
+    seen = []
+    trainer = ResilientTrainer(str(tmp_path / "ckpt"), q,
+                               read_chunk=read_chunk, prefetch=2)
+    trainer.run(lambda rec, step: seen.append(rec))
+    # the good record trained; the failure burned the chunk's budget
+    # (failure_max=1 discards it) instead of looking like a short chunk
+    assert seen == ["ok", "ok"] or seen == ["ok"]
+    assert q.all_done()
+
+
+# -- throughput (slow) -----------------------------------------------------
+
+@pytest.mark.slow
+def test_pipelined_feed_no_slower_than_sync():
+    """Throughput guard: with real host-side data prep in the reader
+    (the thing prefetch exists to hide), the pipelined loop must not
+    lose to the synchronous feed->step->fetch loop.  Generous 1.5x
+    slack: CI boxes jitter (observed 3x wall swings between trials),
+    the CPU backend has no true async H2D to overlap, and the win
+    grows with transfer cost on hardware.  (A
+    microsecond-scale model with zero data prep is deliberately NOT
+    tested — there per-batch thread handoff dominates and pipelining
+    has nothing to hide.)"""
+    framework._rng_salt_counter[0] = 0
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [256], "float32")
+        y = fluid.layers.data("y", [1], "float32")
+        h = fluid.layers.fc(input=x, size=256, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+
+    n, bs = 30, 256
+
+    def make_batch(i):
+        rng = np.random.RandomState(i)
+        xv = rng.rand(bs, 256).astype(np.float32)
+        xv = (xv - xv.mean(axis=1, keepdims=True)) \
+            / (xv.std(axis=1, keepdims=True) + 1e-6)
+        return {"x": xv, "y": rng.rand(bs, 1).astype(np.float32)}
+
+    def reader():
+        for i in range(n):
+            yield make_batch(i)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    sync_dt = piped_dt = float("inf")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=make_batch(0), fetch_list=[cost])  # compile
+        loader = fluid.DataLoader(reader, capacity=4)
+        # best-of-5 each: a loaded CI box stalls either loop for whole
+        # scheduler quanta (observed 3x wall-time swings between
+        # back-to-back trials); the comparison needs the unstalled times
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for f in reader():
+                out, = exe.run(main, feed=f, fetch_list=[cost],
+                               return_numpy=False)
+                float(np.asarray(out))
+            sync_dt = min(sync_dt, time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            exe.run_pipeline(main, loader, fetch_list=[cost],
+                             fetch_every=8)
+            piped_dt = min(piped_dt, time.perf_counter() - t0)
+    assert piped_dt <= sync_dt * 1.5, (piped_dt, sync_dt)

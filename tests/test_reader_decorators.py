@@ -1,0 +1,151 @@
+"""utils/reader decorator coverage (ISSUE 2 satellites): shard
+determinism, compose tuple flattening, and the buffered/Prefetch
+exception contract — a failing producer must raise at the consumer,
+never masquerade as a short epoch."""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils import reader as reader_mod
+
+
+def _range_reader(n):
+    return lambda: iter(range(n))
+
+
+# -- shard -----------------------------------------------------------------
+
+def test_shard_partitions_exactly_and_deterministically():
+    n, num_shards = 23, 4
+    parts = [list(reader_mod.shard(_range_reader(n), num_shards=num_shards,
+                                   shard_id=s)())
+             for s in range(num_shards)]
+    # disjoint cover of the whole stream
+    flat = sorted(x for p in parts for x in p)
+    assert flat == list(range(n))
+    # deterministic striding: shard s sees i with i % num_shards == s
+    for s, p in enumerate(parts):
+        assert p == [i for i in range(n) if i % num_shards == s]
+    # re-iteration yields the identical slice (no hidden state)
+    for s in range(num_shards):
+        again = list(reader_mod.shard(_range_reader(n),
+                                      num_shards=num_shards,
+                                      shard_id=s)())
+        assert again == parts[s]
+
+
+def test_shard_varying_num_shards():
+    n = 12
+    for num_shards in (1, 2, 3, 6):
+        parts = [list(reader_mod.shard(_range_reader(n), num_shards,
+                                       shard_id=s)())
+                 for s in range(num_shards)]
+        assert sorted(x for p in parts for x in p) == list(range(n))
+        sizes = {len(p) for p in parts}
+        assert len(sizes) == 1          # n divisible: equal shards
+
+
+def test_shard_defaults_to_process_topology():
+    # single-process jax: process_count=1/index=0 -> identity stream
+    assert list(reader_mod.shard(_range_reader(5))()) == list(range(5))
+
+
+# -- compose ---------------------------------------------------------------
+
+def test_compose_flattens_tuple_and_scalar_parts():
+    scalars = lambda: iter([1, 2, 3])
+    pairs = lambda: iter([("a", "b"), ("c", "d"), ("e", "f")])
+    out = list(reader_mod.compose(scalars, pairs, scalars)())
+    assert out == [(1, "a", "b", 1), (2, "c", "d", 2), (3, "e", "f", 3)]
+
+
+def test_compose_single_reader_wraps_scalars():
+    out = list(reader_mod.compose(_range_reader(3))())
+    assert out == [(0,), (1,), (2,)]
+
+
+def test_compose_stops_at_shortest():
+    out = list(reader_mod.compose(_range_reader(2), _range_reader(5))())
+    assert out == [(0, 0), (1, 1)]
+
+
+# -- buffered / prefetch exception contract --------------------------------
+
+def test_buffered_preserves_order_and_completes():
+    out = list(reader_mod.buffered(_range_reader(100), 7)())
+    assert out == list(range(100))
+
+
+def test_buffered_reraises_producer_exception():
+    def failing():
+        yield from range(3)
+        raise IOError("disk vanished")
+
+    got = []
+    with pytest.raises(IOError, match="disk vanished"):
+        for x in reader_mod.buffered(lambda: failing(), 2)():
+            got.append(x)
+    # everything produced BEFORE the failure was delivered in order
+    assert got == [0, 1, 2]
+
+
+def test_buffered_immediate_failure_is_not_an_empty_epoch():
+    def broken():
+        raise ValueError("bad header")
+        yield  # pragma: no cover
+
+    with pytest.raises(ValueError, match="bad header"):
+        list(reader_mod.buffered(lambda: broken(), 4)())
+
+
+def test_buffered_failure_with_full_queue():
+    """The historical bug's worst case: producer fails while the queue
+    is saturated — the error must still arrive after the buffered items
+    drain, not deadlock and not truncate."""
+    def failing():
+        yield from range(10)
+        raise RuntimeError("late failure")
+
+    it = reader_mod.buffered(lambda: failing(), 2)()
+    got = []
+    with pytest.raises(RuntimeError, match="late failure"):
+        for x in it:
+            got.append(x)
+            time.sleep(0.001)       # let the producer saturate the queue
+    assert got == list(range(10))
+
+
+def test_prefetch_iterator_close_unblocks_producer():
+    produced = []
+
+    def slow_source():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    it = reader_mod.PrefetchIterator(slow_source(), 2)
+    assert next(it) == 0
+    it.close()
+    time.sleep(0.3)                 # producer must notice the stop event
+    n_after_close = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n_after_close   # producer exited, not spinning
+    assert n_after_close < 1000
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_iterator_keyboard_interrupt_propagates():
+    """BaseException subclasses (KeyboardInterrupt) cross the thread
+    boundary too — a ^C in a reader must stop training, not end the
+    epoch quietly."""
+    def interrupted():
+        yield 1
+        raise KeyboardInterrupt
+
+    it = reader_mod.PrefetchIterator(interrupted(), 2)
+    assert next(it) == 1
+    with pytest.raises(KeyboardInterrupt):
+        next(it)
