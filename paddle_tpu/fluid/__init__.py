@@ -7,9 +7,11 @@ dispatching per-op kernels), with save/load, initializers, regularizers,
 clipping, and profiler."""
 
 from . import ops as _ops  # registers all op emitters  # noqa: F401
-from . import (checkpoint, clip, debugger, evaluator, initializer, io,
-               layers, learning_rate_decay, memory_optimization_transpiler,
-               nets, optimizer, profiler, regularizer, unique_name)
+from . import (analysis, checkpoint, clip, debugger, evaluator, initializer,
+               io, layers, learning_rate_decay,
+               memory_optimization_transpiler, nets, optimizer, profiler,
+               regularizer, unique_name)
+from .analysis import analyze_program
 from .memory_optimization_transpiler import memory_optimize
 from .backward import append_backward, calc_gradient
 from .core.lod import (NestedSeqArray, SeqArray, make_nested_seq,
@@ -28,7 +30,7 @@ from .param_attr import ParamAttr
 __all__ = [
     "layers", "optimizer", "initializer", "regularizer", "clip", "io",
     "nets", "unique_name", "evaluator", "profiler", "learning_rate_decay",
-    "memory_optimize", "debugger",
+    "memory_optimize", "debugger", "analysis", "analyze_program",
     "append_backward", "calc_gradient",
     "Executor", "Scope", "global_scope", "scope_guard",
     "TPUPlace", "CPUPlace",

@@ -1,0 +1,99 @@
+"""paddle_tpu.fluid.analysis — pass-based static analyzer over ProgramDesc.
+
+Because the program is *data* (core/desc.py — the same bet as the
+reference's framework.proto), whole-program verification is a walk over
+plain Python objects.  The reference only ever shipped per-op checks
+(``InferShape``, ``OpDesc::CheckAttrs``) plus the executor's var-existence
+loop (executor.cc:36-75); this package runs compiler-style passes over the
+whole desc and reports every finding at once with exact coordinates.
+
+Entry points:
+
+* ``analyze_program(program, level=..., fetch=...)`` → ``Diagnostics``
+  (also surfaced as ``Program.analyze``);
+* ``Executor.run(..., validate="off|structural|full")`` pre-flight (or
+  ``PADDLE_TPU_VALIDATE=<level>``), fingerprint-cached per program;
+* ``python -m paddle_tpu.tools.plint program.json`` for serialized
+  programs (the ones most likely to be malformed).
+
+Levels: ``"structural"`` runs the desc-only passes (structural, dataflow,
+grad_link, sharding); ``"full"`` adds the abstract shape/dtype re-check,
+which traces every registered emitter with ``jax.eval_shape``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .dataflow import ProgramView, block_liveness, live_ops
+from .diagnostics import ERROR, INFO, WARNING, Diagnostics, Finding
+from .passes import PASSES, AnalysisContext
+
+__all__ = ["Diagnostics", "Finding", "ERROR", "WARNING", "INFO",
+           "ProgramView", "block_liveness", "live_ops",
+           "LEVELS", "analyze_program", "structural_errors",
+           "ProgramValidationError"]
+
+LEVELS = {
+    "structural": ("structural", "dataflow", "grad_link", "sharding"),
+    "full": ("structural", "dataflow", "grad_link", "sharding",
+             "shape_check"),
+}
+
+
+class ProgramValidationError(RuntimeError):
+    """Raised by the executor pre-flight when a program has error-severity
+    findings; carries the full Diagnostics for programmatic access."""
+
+    def __init__(self, diagnostics: Diagnostics, context: str = ""):
+        self.diagnostics = diagnostics
+        head = (f"program failed static analysis"
+                f"{' (' + context + ')' if context else ''}:")
+        super().__init__(head + "\n" + diagnostics.render(max_findings=20))
+
+
+def _desc_of(program):
+    return getattr(program, "desc", program)
+
+
+def _fetch_names(fetch) -> List[str]:
+    out = []
+    for f in fetch or ():
+        name = getattr(f, "name", None)
+        out.append(name if isinstance(name, str) else str(f))
+    return out
+
+
+def analyze_program(program, level: str = "full",
+                    fetch: Optional[Sequence] = None,
+                    passes: Optional[Sequence[str]] = None) -> Diagnostics:
+    """Run the pass suite over ``program`` (a Program, ProgramDesc, or
+    anything with a ``.desc``).
+
+    ``fetch`` (var names or Variables) seeds the liveness roots — pass the
+    values you intend to read so dead-code findings reflect real intent.
+    ``passes`` overrides the level's pass selection by name.
+    """
+    if level not in LEVELS:
+        raise ValueError(f"analyze_program: level must be one of "
+                         f"{sorted(LEVELS)}, got {level!r}")
+    selected = tuple(passes) if passes is not None else LEVELS[level]
+    unknown = set(selected) - {name for name, _ in PASSES}
+    if unknown:
+        raise ValueError(f"analyze_program: unknown passes {sorted(unknown)}")
+    ctx = AnalysisContext(_desc_of(program), fetch=_fetch_names(fetch),
+                          fetch_given=fetch is not None)
+    diag = Diagnostics()
+    for name, fn in PASSES:
+        if name in selected:
+            fn(ctx, diag)
+    return diag
+
+
+def structural_errors(program) -> List[str]:
+    """Legacy flat-string form of the structural pass — byte-compatible
+    with the native validator (csrc/ir.cc), consumed by
+    ``debugger.validate_program``'s Python fallback."""
+    diag = analyze_program(program, passes=("structural",),
+                           level="structural")
+    return [f.legacy() for f in diag.errors()]

@@ -1,5 +1,12 @@
 """Program inspection — analog of python/paddle/v2/fluid/debuger.py +
-graphviz.py: pretty-print programs and render them to dot."""
+graphviz.py: pretty-print programs and render them to dot.
+
+``validate_program`` is now a thin consumer of the shared analysis
+infrastructure (fluid/analysis): native (csrc/ir.cc) when built, the
+analyzer's structural pass otherwise — both produce the same error
+strings.  For the full pass suite (dataflow, shape re-check, sharding,
+grad lint) use ``Program.analyze`` / ``fluid.analysis.analyze_program``.
+"""
 
 from __future__ import annotations
 
@@ -28,15 +35,44 @@ def pprint_program_codes(program: Program) -> str:
     return text
 
 
+def _dot_id(name: str) -> str:
+    """A dot-safe quoted node id: var/op names here routinely contain
+    ``@`` (``X@GRAD``), ``%``-suffixed unique names, quotes, and unicode —
+    all of which must be escaped inside a double-quoted dot ID."""
+    return '"' + name.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
 def draw_block_graphviz(block, path: str = "block.dot") -> str:
-    """Emit a graphviz dot file of one block (graphviz.py analog)."""
+    """Emit a graphviz dot file of one block (graphviz.py analog).
+
+    Var nodes are declared once each (deduped) with escaped labels; op
+    nodes get positional ids so two instances of the same op type stay
+    distinct."""
     lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids: dict = {}
+    edges = []
+    seen_edges = set()
+
+    def var_node(name: str) -> str:
+        if name not in var_ids:
+            var_ids[name] = f"var_{len(var_ids)}"
+        return var_ids[name]
+
     for i, op in enumerate(block.ops):
-        lines.append(f'  op{i} [shape=box, label="{op.type}"];')
+        lines.append(f"  op{i} [shape=box, label={_dot_id(op.type)}];")
         for name in op.input_names:
-            lines.append(f'  "{name}" -> op{i};')
+            edge = (var_node(name), f"op{i}")
+            if edge not in seen_edges:
+                seen_edges.add(edge)
+                edges.append(f"  {edge[0]} -> {edge[1]};")
         for name in op.output_names:
-            lines.append(f'  op{i} -> "{name}";')
+            edge = (f"op{i}", var_node(name))
+            if edge not in seen_edges:
+                seen_edges.add(edge)
+                edges.append(f"  {edge[0]} -> {edge[1]};")
+    for name, node in var_ids.items():
+        lines.append(f"  {node} [shape=ellipse, label={_dot_id(name)}];")
+    lines.extend(edges)
     lines.append("}")
     with open(path, "w") as f:
         f.write("\n".join(lines))
@@ -47,7 +83,8 @@ def validate_program(program: Program):
     """Structural pre-flight check — the analog of the reference's
     OpDesc::CheckAttrs / executor var-existence enforcement
     (executor.cc:36-75), run in the native IR library (csrc/ir.cc
-    validate_program) when built, else a Python walk.  Returns a list of
+    validate_program) when built, else the analyzer's structural pass
+    (fluid/analysis) — same error strings either way.  Returns a list of
     error strings ([] = valid)."""
     from .. import native
 
@@ -58,40 +95,6 @@ def validate_program(program: Program):
             errs = None
         if errs is not None:
             return errs
-    errors = []
-    nblocks = len(program.blocks)
-    for block in program.blocks:
-        bd = block.desc
-        if bd.parent_idx >= nblocks or not (bd.parent_idx < bd.idx):
-            errors.append(f"block {bd.idx}: parent_idx out of range or "
-                          f"not an ancestor")
-        declared = set()
-        b = bd
-        hops = 0
-        while b is not None and hops <= nblocks:
-            hops += 1
-            declared |= set(b.vars)
-            b = (program.blocks[b.parent_idx].desc
-                 if 0 <= b.parent_idx < min(b.idx, nblocks) else None)
-        # walk the DESC (source of truth — same view the native lib parses)
-        for i, od in enumerate(bd.ops):
-            where = f"block {bd.idx} op#{i} ({od.type})"
-            if not od.type:
-                errors.append(f"{where}: empty op type")
-            for names in od.inputs.values():
-                for n in names:
-                    if n and n not in declared:
-                        errors.append(
-                            f"{where}: input var '{n}' not declared")
-            for names in od.outputs.values():
-                for n in names:
-                    if n and n not in declared:
-                        errors.append(
-                            f"{where}: output var '{n}' not declared")
-            for a in od.attrs.values():
-                if isinstance(a, dict) and "__block__" in a:
-                    bi = a["__block__"]
-                    if not (isinstance(bi, int) and 0 <= bi < nblocks):
-                        errors.append(f"{where}: sub-block index {bi} "
-                                      f"out of range")
-    return errors
+    from .analysis import structural_errors
+
+    return structural_errors(program)

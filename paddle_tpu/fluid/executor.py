@@ -29,7 +29,6 @@ import jax
 import numpy as np
 
 from .core.lod import SeqArray
-from .core.types import np_dtype
 from .framework import Program, Variable, default_main_program
 from .lowering import HOST_OPS, build_step_fn
 
@@ -185,7 +184,14 @@ class Executor:
         self._stats = {
             "executable": {"hits": 0, "misses": 0, "evictions": 0},
             "structure": {"hits": 0, "misses": 0, "evictions": 0},
+            # pre-flight analysis (validate=...): "runs" = full analyses
+            # performed, "cached" = dispatches that skipped re-analysis
+            # because the (fingerprint, level) was already validated
+            "validate": {"runs": 0, "cached": 0},
         }
+        # (program fingerprint, level) pairs already analyzed clean —
+        # the analyzer runs once per program STRUCTURE, not per step
+        self._validated: set = set()
 
     def cache_stats(self) -> Dict[str, Dict[str, int]]:
         """Counters for the executable cache (compiled step signatures)
@@ -198,7 +204,40 @@ class Executor:
         out = {k: dict(v) for k, v in self._stats.items()}
         out["executable"]["size"] = len(self._cache)
         out["structure"]["size"] = len(self._cls_cache)
+        out["validate"]["size"] = len(self._validated)
         return out
+
+    # -- static-analysis pre-flight -----------------------------------------
+    @staticmethod
+    def _validate_level(validate: Optional[str]) -> str:
+        """Resolve the effective pre-flight level: explicit arg wins, else
+        the PADDLE_TPU_VALIDATE env flag, else off."""
+        level = (validate if validate is not None
+                 else os.environ.get("PADDLE_TPU_VALIDATE", "off"))
+        if level not in ("off", "structural", "full"):
+            raise ValueError(
+                f"validate must be 'off', 'structural' or 'full', "
+                f"got {level!r}")
+        return level
+
+    def _preflight(self, program: Program, prog_fp: str, level: str,
+                   fetch_names: Sequence[str]) -> None:
+        """Run the static analyzer once per (program fingerprint, level);
+        raise ProgramValidationError on error-severity findings.  The
+        fingerprint cache makes validate="full" effectively free on the
+        steps after the first (the <5% overhead contract)."""
+        key = (prog_fp, level)
+        if key in self._validated:
+            self._stats["validate"]["cached"] += 1
+            return
+        self._stats["validate"]["runs"] += 1
+        from .analysis import ProgramValidationError, analyze_program
+
+        diag = analyze_program(program, level=level, fetch=fetch_names)
+        if diag.has_errors:
+            raise ProgramValidationError(diag,
+                                         context=f"validate={level!r}")
+        self._validated.add(key)
 
     @staticmethod
     def _program_key(program: Program) -> str:
@@ -480,7 +519,13 @@ class Executor:
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
             scope: Optional[Scope] = None, return_numpy: bool = True,
-            mode: str = "train") -> List[Any]:
+            mode: str = "train",
+            validate: Optional[str] = None) -> List[Any]:
+        """``validate``: opt-in static-analysis pre-flight — "off" (default),
+        "structural" (desc-only passes) or "full" (adds the abstract
+        shape/dtype re-check).  Defaults to the PADDLE_TPU_VALIDATE env
+        flag; analysis is cached by program fingerprint, so a hot loop
+        pays it once."""
         program = program or default_main_program()
         feed = {k: _as_feed_value(v) for k, v in (feed or {}).items()}
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
@@ -490,6 +535,9 @@ class Executor:
         block = desc.global_block()
 
         prog_fp = self._program_key(program)
+        level = self._validate_level(validate)
+        if level != "off":
+            self._preflight(program, prog_fp, level, fetch_names)
         traced_ops, pre_host, post_host, state_in, state_out = \
             self._classified(prog_fp, feed, fetch_names, block)
 
@@ -760,6 +808,9 @@ class Executor:
         k = len(feeds)
 
         prog_fp = self._program_key(program)
+        level = self._validate_level(None)
+        if level != "off":       # PADDLE_TPU_VALIDATE covers scans too
+            self._preflight(program, prog_fp, level, fetch_names)
         traced_ops, pre_host, post_host, state_in, state_out = \
             self._classified(prog_fp, feeds[0], fetch_names, block)
         if pre_host or post_host:
@@ -845,6 +896,7 @@ class Executor:
     def close(self):
         self._cache.clear()
         self._cls_cache.clear()
+        self._validated.clear()
 
 
 def _is_cpu(place) -> bool:

@@ -18,7 +18,7 @@ by the TPU/XLA design:
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -119,25 +119,8 @@ class Variable:
     def abstract_value(self):
         """ShapeDtypeStruct (or SeqArray thereof) standing in for this var
         during eval_shape-based inference."""
-        import jax
-
-        if self.shape is None:
-            raise ValueError(f"variable {self.name} has no shape")
-        shape = [(_DUMMY_BATCH if d == -1 else d) for d in self.shape]
-        np_dt = np.int32 if self.dtype == "int64" else self.dtype
-        if self.lod_level >= 2:
-            from .core.lod import NestedSeqArray
-
-            data = jax.ShapeDtypeStruct(
-                (shape[0], _DUMMY_TIME, _DUMMY_TIME, *shape[1:]), np_dt)
-            outer = jax.ShapeDtypeStruct((shape[0],), np.int32)
-            inner = jax.ShapeDtypeStruct((shape[0], _DUMMY_TIME), np.int32)
-            return NestedSeqArray(data, outer, inner)
-        if self.lod_level > 0:
-            data = jax.ShapeDtypeStruct((shape[0], _DUMMY_TIME, *shape[1:]), np_dt)
-            lens = jax.ShapeDtypeStruct((shape[0],), np.int32)
-            return SeqArray(data, lens)
-        return jax.ShapeDtypeStruct(tuple(shape), np_dt)
+        return abstract_from_meta(self.shape, self.dtype, self.lod_level,
+                                  name=self.name)
 
     def set_sharding(self, sharding: Optional[Sequence[Optional[str]]]):
         """Mutate the desc-level sharding annotation.  Goes through the
@@ -340,30 +323,67 @@ class Block:
                 raise RuntimeError(
                     f"shape inference failed for op {desc.type}: {e}") from e
             return
-        from .core.lod import NestedSeqArray
-
         for slot, vals in out_abs.items():
             for var, av in zip(out_vars.get(slot, []), vals):
-                if not isinstance(av, (SeqArray, NestedSeqArray)) \
-                        and not hasattr(av, "shape"):
+                red = reduce_abstract(av)
+                if red is None:
                     continue  # opaque value (RankTable, TensorArray, ...)
-                if isinstance(av, NestedSeqArray):
-                    dshape = list(av.data.shape)
-                    shape = [dshape[0]] + dshape[3:]   # drop outer+inner
-                    var.desc.lod_level = max(var.desc.lod_level, 2)
-                elif isinstance(av, SeqArray):
-                    dshape = list(av.data.shape)
-                    shape = [dshape[0]] + dshape[2:]
-                    var.desc.lod_level = max(var.desc.lod_level, 1)
-                else:
-                    shape = list(av.shape)
-                    var.desc.lod_level = 0
+                shape, dt, lod = red
+                var.desc.lod_level = (max(var.desc.lod_level, lod)
+                                      if lod else 0)
                 if batch_dyn and shape and shape[0] == _DUMMY_BATCH:
                     shape[0] = -1
                 var.desc.shape = shape
-                dt = np.dtype(av.dtype if not isinstance(av, SeqArray)
-                              else av.data.dtype).name
                 var.desc.dtype = canonical_dtype(dt)
+
+
+def abstract_from_meta(shape, dtype: str, lod_level: int = 0,
+                       name: str = "<var>"):
+    """ShapeDtypeStruct (or SeqArray/NestedSeqArray) from recorded var
+    metadata — dummy extents for dynamic dims, int64 narrowed to the
+    runtime's int32.  The ONE encoding shared by build-time inference
+    (Variable.abstract_value) and the analyzer's shape re-check
+    (analysis/passes.py); keeping a single copy is what guarantees the
+    re-check re-runs exactly the recorded procedure."""
+    import jax
+
+    if shape is None:
+        raise ValueError(f"variable {name} has no shape")
+    shape = [(_DUMMY_BATCH if d == -1 else d) for d in shape]
+    np_dt = np.int32 if dtype == "int64" else dtype
+    if lod_level >= 2:
+        from .core.lod import NestedSeqArray
+
+        data = jax.ShapeDtypeStruct(
+            (shape[0], _DUMMY_TIME, _DUMMY_TIME, *shape[1:]), np_dt)
+        outer = jax.ShapeDtypeStruct((shape[0],), np.int32)
+        inner = jax.ShapeDtypeStruct((shape[0], _DUMMY_TIME), np.int32)
+        return NestedSeqArray(data, outer, inner)
+    if lod_level > 0:
+        data = jax.ShapeDtypeStruct((shape[0], _DUMMY_TIME, *shape[1:]),
+                                    np_dt)
+        lens = jax.ShapeDtypeStruct((shape[0],), np.int32)
+        return SeqArray(data, lens)
+    return jax.ShapeDtypeStruct(tuple(shape), np_dt)
+
+
+def reduce_abstract(av):
+    """Collapse an abstract output value to its recorded-desc form:
+    ``(shape, dtype_name, lod_level)`` — dropping the dummy time axes a
+    SeqArray/NestedSeqArray carries — or None for opaque values
+    (RankTable, TensorArray, ...).  The inverse-direction twin of
+    ``abstract_from_meta``, shared by _infer_op and the analyzer."""
+    from .core.lod import NestedSeqArray
+
+    if isinstance(av, NestedSeqArray):
+        dshape = list(av.data.shape)
+        return [dshape[0]] + dshape[3:], np.dtype(av.data.dtype).name, 2
+    if isinstance(av, SeqArray):
+        dshape = list(av.data.shape)
+        return [dshape[0]] + dshape[2:], np.dtype(av.data.dtype).name, 1
+    if hasattr(av, "shape") and hasattr(av, "dtype"):
+        return list(av.shape), np.dtype(av.dtype).name, 0
+    return None
 
 
 _STRICT_INFER = False
@@ -505,6 +525,19 @@ class Program:
     @random_seed.setter
     def random_seed(self, seed):
         self._seed = seed
+
+    def analyze(self, level: str = "full", fetch_list=None,
+                passes=None):
+        """Run the static analyzer (fluid/analysis) over this program —
+        dataflow verification, grad-graph lint, sharding/donation safety,
+        and (at ``level="full"``) abstract shape/dtype re-checking against
+        the recorded descs.  Returns a ``Diagnostics`` report; pass
+        ``fetch_list`` (vars or names you intend to read) so dead-code
+        findings reflect real intent."""
+        from .analysis import analyze_program
+
+        return analyze_program(self, level=level, fetch=fetch_list,
+                               passes=passes)
 
     def list_vars(self):
         for b in self.blocks:

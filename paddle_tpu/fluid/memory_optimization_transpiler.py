@@ -19,41 +19,15 @@ __all__ = ["memory_optimize", "liveness_stats"]
 
 
 def _python_stats(program: Program, block_idx: int = 0) -> dict:
-    """Fallback liveness: program order = schedule; live range
-    [first def, last use]; greedy interval coloring for slot count.
-    Walks the DESC ops — the same view the native lib parses — so a
-    desc-only op cannot make the two backends disagree."""
-    block = program.blocks[block_idx]
-    descs = block.desc.ops
-    first_def, last_pos = {}, {}
-    for i, od in enumerate(descs):
-        for names in od.outputs.values():
-            for name in names:
-                if name:
-                    first_def.setdefault(name, i)
-                    last_pos[name] = i
-        for names in od.inputs.values():
-            for name in names:
-                if name:
-                    last_pos[name] = i
-    persistable = {n for n, v in block.desc.vars.items()
-                   if getattr(v, "persistable", False)}
-    live_range = {n: (d, last_pos[n]) for n, d in first_def.items()
-                  if n not in persistable}
-    ivs = sorted((rng, n) for n, rng in live_range.items())
-    free_at, reuse_slot = [], {}
-    for (start, end), name in ivs:
-        slot = next((s for s, f in enumerate(free_at) if f < start), None)
-        if slot is None:
-            slot = len(free_at)
-            free_at.append(-1)
-        free_at[slot] = end
-        reuse_slot[name] = slot
-    return {"topo_order": list(range(len(descs))),
-            "level": list(range(len(descs))),
-            "live_range": {n: list(r) for n, r in live_range.items()},
-            "reuse_slot": reuse_slot,
-            "num_slots": len(free_at)}
+    """Fallback liveness — now a thin consumer of the analyzer's shared
+    liveness infrastructure (fluid/analysis/dataflow.block_liveness):
+    program order = schedule; live range [first def, last use]; greedy
+    interval coloring for slot count.  Walks the DESC ops — the same
+    view the native lib parses — so a desc-only op cannot make the two
+    backends disagree."""
+    from .analysis.dataflow import block_liveness
+
+    return block_liveness(program.blocks[block_idx].desc)
 
 
 def liveness_stats(program: Program = None, block_idx: int = 0) -> dict:

@@ -1,0 +1,1 @@
+"""Command-line tooling for paddle_tpu (``python -m paddle_tpu.tools.<tool>``)."""

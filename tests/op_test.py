@@ -75,6 +75,16 @@ class OpTestCase:
                             infer_shape=False)
         return main, startup, scope, feed, in_vars, out_vars
 
+    @staticmethod
+    def _analyze(main, fetch):
+        """Run the static analyzer (structural + shape re-check) over the
+        program the test just built — every op test doubles as analyzer
+        coverage; any error-severity finding is a real defect in either
+        the op's registration or the analyzer."""
+        diag = main.analyze(level="full", fetch_list=fetch)
+        assert not diag.has_errors, (
+            "op_test program failed static analysis:\n" + diag.render())
+
     def _discover_outputs(self) -> Dict[str, int]:
         if self.n_outputs is not None:
             return self.n_outputs
@@ -104,6 +114,7 @@ class OpTestCase:
         exe = fluid.Executor(fluid.CPUPlace())
         order = [(slot, i) for slot in out_slots
                  for i in range(len(out_vars[slot]))]
+        self._analyze(main, [out_vars[s][i] for s, i in order])
         with fluid.scope_guard(scope):
             results = exe.run(main, feed=feed,
                               fetch_list=[out_vars[s][i] for s, i in order],
@@ -125,6 +136,7 @@ class OpTestCase:
         out_slots = self._discover_outputs()
         main, startup, scope, feed, _, out_vars = self._build(out_slots)
         exe = fluid.Executor(fluid.CPUPlace())
+        self._analyze(main, [v for slot in expect for v in out_vars[slot]])
         with fluid.scope_guard(scope):
             fetch = [v for slot in expect for v in out_vars[slot]]
             results = exe.run(main, feed=feed, fetch_list=fetch,
@@ -165,6 +177,10 @@ class OpTestCase:
                     v.stop_gradient = False
                     grad_targets.append(v)
             fluid.append_backward(loss)
+        # the analyzer sees the FULL program here — forward + the
+        # infer_shape=False *_grad ops backward.py appends — so the whole
+        # grad suite exercises the grad-shape positional rule for free
+        self._analyze(main, [loss] + [v.grad_name for v in grad_targets])
 
         exe = fluid.Executor(fluid.CPUPlace())
 

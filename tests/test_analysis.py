@@ -1,0 +1,494 @@
+"""The static analyzer (paddle_tpu/fluid/analysis): seeded-defect
+detection with exact coordinates, zero errors on real (book/bench-style)
+programs, fingerprint-cached executor pre-flight, the plint CLI, and the
+graphviz escaping fix.
+
+The analog of the reference's framework tests for InferShape /
+CheckAttrs / prune — except our checks run whole-program over the desc.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.analysis import (ProgramValidationError,
+                                       analyze_program, structural_errors)
+from paddle_tpu.fluid.core.desc import OpDesc, VarDesc
+
+
+def _net():
+    """Small forward + backward + optimizer program."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        y = fluid.layers.data("y", [1], "float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# clean programs: zero findings at error severity
+# ---------------------------------------------------------------------------
+
+def test_clean_trained_net_has_no_errors_or_warnings():
+    main, startup, loss = _net()
+    diag = main.analyze(level="full", fetch_list=[loss])
+    assert not diag.has_errors, diag.render()
+    assert not diag.warnings(), diag.render()
+    sd = startup.analyze(level="full")
+    assert not sd.has_errors, sd.render()
+
+
+def test_book_programs_analyze_clean_after_deserialization():
+    """The acceptance bar: book-style programs (forward + append_backward
+    + optimizer), round-tripped through the wire format — the programs
+    no build-time check ever saw — must re-check clean."""
+    from paddle_tpu.models import recognize_digits, word2vec
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        _, avg_cost, acc = recognize_digits.conv_net(img, label)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    reloaded = fluid.Program.parse_from_string(
+        main.desc.serialize_to_string())
+    diag = reloaded.analyze(level="full",
+                            fetch_list=[avg_cost.name, acc.name])
+    assert not diag.has_errors, diag.render()
+    assert not diag.warnings(), diag.render()
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
+        words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                 for i in range(5)]
+        avg_cost2, _ = word2vec.ngram_model(words, 30, embed_size=8,
+                                            hidden_size=32)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost2)
+    reloaded2 = fluid.Program.parse_from_string(
+        main2.desc.serialize_to_string())
+    d2 = reloaded2.analyze(level="full", fetch_list=[avg_cost2.name])
+    assert not d2.has_errors, d2.render()
+    assert not d2.warnings(), d2.render()
+
+
+def test_bench_program_analyzes_clean():
+    """bench.py's image nets go through the same analyzer bar."""
+    from paddle_tpu.models import benchmark_nets
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        pred = benchmark_nets.smallnet_cifar(img, class_num=10)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(loss)
+    diag = main.analyze(level="full", fetch_list=[loss])
+    assert not diag.has_errors, diag.render()
+    assert not diag.warnings(), diag.render()
+
+
+def test_control_flow_program_analyzes_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=5)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=0.0)
+        cond = fluid.layers.less_than(x=i, y=n)
+        loop = fluid.layers.While(cond=cond, max_iters=8)
+        with loop.block():
+            fluid.layers.increment(x=acc, value=2.0, in_place=True)
+            fluid.layers.increment(x=i, in_place=True)
+            fluid.layers.less_than(x=i, y=n, cond=cond)
+    diag = main.analyze(level="full", fetch_list=[acc.name])
+    assert not diag.has_errors, diag.render()
+    assert not diag.warnings(), diag.render()
+
+
+# ---------------------------------------------------------------------------
+# seeded defects: each detected with exact block/op coordinates
+# ---------------------------------------------------------------------------
+
+def test_use_before_write_exact_coordinates():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    b.add_var(VarDesc("late", shape=[-1, 4], dtype="float32"))
+    b.add_var(VarDesc("late_out", shape=[-1, 4], dtype="float32"))
+    # op#1 reads 'late'; its only writer is appended at the block's end
+    b.ops.insert(1, OpDesc("relu", {"X": ["late"]}, {"Out": ["late_out"]},
+                           {}))
+    b.append_op(OpDesc("relu", {"X": ["x"]}, {"Out": ["late"]}, {}))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    found = diag.by_code("use-before-write")
+    assert len(found) == 1
+    f = found[0]
+    assert (f.block, f.op, f.var) == (0, 1, "late")
+    assert f.severity == "error"
+    assert f"op#{len(b.ops) - 1}" in f.message    # names the late writer
+
+
+def test_write_after_write_within_one_op():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    b.add_var(VarDesc("dup", shape=[-1, 2], dtype="float32"))
+    b.append_op(OpDesc("split", {"X": ["x"]}, {"Out": ["dup", "dup"]},
+                       {"num": 2}))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    found = diag.by_code("write-after-write")
+    assert len(found) == 1
+    assert found[0].var == "dup"
+    assert found[0].op == len(b.ops) - 1
+    assert found[0].severity == "error"
+
+
+def test_dead_op_detected_and_severity_tracks_fetch_intent():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    b.add_var(VarDesc("deadv", shape=[-1, 4], dtype="float32"))
+    b.append_op(OpDesc("relu", {"X": ["x"]}, {"Out": ["deadv"]}, {}))
+    dead_idx = len(b.ops) - 1
+    # with fetch roots the finding is a warning with exact coordinates
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    found = diag.by_code("dead-op")
+    assert [(f.block, f.op) for f in found] == [(0, dead_idx)]
+    assert found[0].severity == "warning"
+    # without fetch roots intent is unknowable -> info
+    diag2 = main.analyze(level="structural")
+    assert all(f.severity == "info" for f in diag2.by_code("dead-op"))
+    # fetching the var makes it live
+    diag3 = main.analyze(level="structural", fetch_list=[loss, "deadv"])
+    assert not diag3.by_code("dead-op")
+
+
+def test_shape_and_dtype_mismatch_after_deserialization():
+    main, _, loss = _net()
+    reloaded = fluid.Program.parse_from_string(
+        main.desc.serialize_to_string())
+    gb = reloaded.global_block().desc
+    victim = "fc_0.tmp_1"                    # fc pre-activation, op#1's out
+    assert victim in gb.vars
+    gb.vars[victim].shape = [7, 99]
+    diag = reloaded.analyze(level="full", fetch_list=[loss.name])
+    found = diag.by_code("shape-mismatch")
+    assert found and found[0].severity == "error"
+    hit = [f for f in found if f.var == victim]
+    assert hit and hit[0].block == 0 and hit[0].op == 1
+    assert "[7, 99]" in hit[0].message
+
+    gb.vars[victim].shape = [-1, 8]          # heal the shape...
+    gb.vars[victim].dtype = "int32"          # ...corrupt the dtype
+    diag2 = reloaded.analyze(level="full", fetch_list=[loss.name])
+    dd = [f for f in diag2.by_code("dtype-mismatch") if f.var == victim]
+    assert dd and dd[0].severity == "error" and dd[0].op == 1
+
+
+def test_grad_shape_positional_rule():
+    """*_grad ops are appended with infer_shape=False; the analyzer's
+    positional vjp rule still catches a grad var whose recorded shape
+    disagrees with its forward var."""
+    main, _, loss = _net()
+    b = main.global_block().desc
+    # the @RENAME@ vars are the *direct* outputs of the infer_shape=False
+    # *_grad ops (canonical @GRAD names are assigned afterwards)
+    grads = [n for n in b.vars if "@GRAD@RENAME@" in n
+             and b.vars[n].shape is not None]
+    victim = sorted(grads)[0]
+    b.vars[victim].shape = [3, 3, 3]
+    diag = main.analyze(level="full", fetch_list=[loss])
+    found = [f for f in diag.by_code("grad-shape-mismatch")
+             if f.var == victim]
+    assert found and found[0].severity == "error"
+    assert found[0].op is not None
+
+
+def test_sharding_rank_axis_and_consistency():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    params = sorted(n for n, v in b.vars.items()
+                    if v.persistable and v.shape and len(v.shape) == 2)
+    p = params[0]
+    # rank mismatch
+    b.vars[p].sharding = ["mp"]
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    assert any(f.var == p for f in diag.by_code("rank-mismatch"))
+    # same axis on two dims
+    b.vars[p].sharding = ["mp", "mp"]
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    assert any(f.var == p for f in diag.by_code("axis-reuse"))
+    # param/grad layout disagreement (the grad all-reduce would be laid
+    # out differently from the param it updates)
+    b.vars[p].sharding = ["mp", None]
+    b.vars[p + "@GRAD"].sharding = [None, "mp"]
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    found = diag.by_code("producer-consumer-conflict")
+    assert found and found[0].severity == "error"
+    assert found[0].op is not None           # names the optimizer op
+    # consistent annotations -> clean
+    b.vars[p + "@GRAD"].sharding = ["mp", None]
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    assert not diag.by_pass("sharding"), diag.render()
+
+
+def test_orphan_grad_var():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    b.add_var(VarDesc("ghost@GRAD", shape=[4], dtype="float32"))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    found = diag.by_code("orphan-grad")
+    assert [(f.block, f.var) for f in found] == [(0, "ghost@GRAD")]
+    assert found[0].severity == "error"
+    assert "'ghost'" in found[0].message
+
+
+def test_grad_op_base_lint():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    b.add_var(VarDesc("zz", shape=[4], dtype="float32"))
+    b.add_var(VarDesc("zz2", shape=[4], dtype="float32"))
+    b.append_op(OpDesc("no_such_thing_grad", {"X": ["zz"]},
+                       {"Out": ["zz2"]}, {}))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    assert diag.by_code("grad-base-unregistered")
+
+
+def test_donation_read_and_interleaved_host_io():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    # a save op at the block boundary reading a TRANSIENT the compiled
+    # segment computes: that value does not survive buffer donation
+    transient = "fc_0.tmp_2"
+    assert transient in b.vars and not b.vars[transient].persistable
+    b.append_op(OpDesc("save", {"X": [transient]}, {},
+                       {"file_path": "/tmp/x.pt"}))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    found = diag.by_code("donation-read")
+    assert found and found[0].var == transient
+    assert found[0].severity == "error"
+    b.ops.pop()
+    # saving a persistable is fine
+    pname = sorted(n for n, v in b.vars.items() if v.persistable)[0]
+    b.append_op(OpDesc("save", {"X": [pname]}, {},
+                       {"file_path": "/tmp/x.pt"}))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    assert not diag.by_code("donation-read")
+    b.ops.pop()
+    # host IO wedged between compute ops: the executor rejects it, the
+    # analyzer flags it statically
+    b.ops.insert(2, OpDesc("save", {"X": [pname]}, {},
+                           {"file_path": "/tmp/x.pt"}))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    assert diag.by_code("host-io-interleaved")
+
+
+def test_structural_errors_legacy_strings():
+    main, _, _ = _net()
+    main.global_block().desc.append_op(
+        OpDesc("relu", {"X": ["does_not_exist"]}, {"Out": ["nope"]}, {}))
+    errs = structural_errors(main)
+    assert any("input var 'does_not_exist' not declared" in e for e in errs)
+    assert any("output var 'nope' not declared" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# executor pre-flight: fingerprint-cached, counter-observable
+# ---------------------------------------------------------------------------
+
+def test_executor_preflight_caches_by_fingerprint():
+    main, startup, loss = _net()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+
+    def feed():
+        return {"x": rng.randn(4, 4).astype(np.float32),
+                "y": rng.randn(4, 1).astype(np.float32)}
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):
+            exe.run(main, feed=feed(), fetch_list=[loss], validate="full")
+    st = exe.cache_stats()["validate"]
+    # one analysis for the program structure, every later step a cache hit
+    # (startup ran with validate off, so it does not count)
+    assert st["runs"] == 1, st
+    assert st["cached"] == 4, st
+    # mutating the program changes the fingerprint -> re-analysis
+    b = main.global_block().desc
+    b.add_var(VarDesc("extra", shape=[-1, 4], dtype="float32"))
+    b.append_op(OpDesc("relu", {"X": ["x"]}, {"Out": ["extra"]}, {}))
+    main._bump_version()
+    with fluid.scope_guard(scope):
+        exe.run(main, feed=feed(), fetch_list=[loss], validate="full")
+    assert exe.cache_stats()["validate"]["runs"] == 2
+
+
+def test_executor_preflight_rejects_bad_program():
+    main, startup, loss = _net()
+    main.global_block().desc.append_op(
+        OpDesc("relu", {"X": ["missing_input"]}, {"Out": ["nowhere"]}, {}))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ProgramValidationError) as ei:
+            exe.run(main, feed={"x": np.zeros((2, 4), np.float32),
+                                "y": np.zeros((2, 1), np.float32)},
+                    fetch_list=[loss], validate="structural")
+    assert "missing_input" in str(ei.value)
+    assert ei.value.diagnostics.has_errors
+
+
+def test_executor_preflight_env_flag(monkeypatch):
+    main, startup, loss = _net()
+    main.global_block().desc.append_op(
+        OpDesc("relu", {"X": ["missing_input"]}, {"Out": ["nowhere"]}, {}))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "structural")
+    with fluid.scope_guard(scope):
+        exe.run(startup)      # startup program itself is clean
+        with pytest.raises(ProgramValidationError):
+            exe.run(main, feed={"x": np.zeros((2, 4), np.float32),
+                                "y": np.zeros((2, 1), np.float32)},
+                    fetch_list=[loss])
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "bogus")
+    with pytest.raises(ValueError):
+        exe.run(startup)
+
+
+def test_executor_run_results_unchanged_by_validation():
+    main, startup, loss = _net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    fv = {"x": rng.randn(4, 4).astype(np.float32),
+          "y": rng.randn(4, 1).astype(np.float32)}
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    startup.random_seed = 11    # identical init across the two scopes
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        want, = exe.run(main, feed=fv, fetch_list=[loss])
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(s2):
+        exe2.run(startup, validate="full")
+        got, = exe2.run(main, feed=fv, fetch_list=[loss], validate="full")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# plint CLI
+# ---------------------------------------------------------------------------
+
+def test_plint_cli_clean_and_bad(tmp_path, capsys):
+    from paddle_tpu.tools import plint
+
+    main, _, loss = _net()
+    clean = tmp_path / "clean.json"
+    clean.write_bytes(main.desc.serialize_to_string())
+    rc = plint.main([str(clean), "--fetch", loss.name])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0 error(s)" in out
+
+    bad = fluid.Program.parse_from_string(main.desc.serialize_to_string())
+    bad.global_block().desc.append_op(
+        OpDesc("relu", {"X": ["does_not_exist"]}, {"Out": ["nope"]}, {}))
+    badf = tmp_path / "bad.json"
+    badf.write_bytes(bad.desc.serialize_to_string())
+    rc = plint.main([str(badf), "--level", "structural"])
+    assert rc == 1
+    assert "does_not_exist" in capsys.readouterr().out
+
+    rc = plint.main([str(badf), "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["error"] >= 1
+    assert any(f["code"] == "undeclared-input"
+               for f in payload["findings"])
+
+    rc = plint.main([str(tmp_path / "missing.json")])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# graphviz escaping + dedup (satellite)
+# ---------------------------------------------------------------------------
+
+def test_graphviz_escapes_and_dedupes(tmp_path):
+    main = fluid.Program()
+    b = main.global_block()
+    weird = 'w"quote'
+    for name in ("x@GRAD", "pct%0", weird, "out1", "out2"):
+        b.create_var(name=name, shape=[2], dtype="float32")
+    bd = b.desc
+    bd.append_op(OpDesc("relu", {"X": ["x@GRAD"]}, {"Out": ["out1"]}, {}))
+    bd.append_op(OpDesc("scale", {"X": ["x@GRAD", "pct%0"]},
+                        {"Out": ["out2"]}, {"scale": 2.0}))
+    bd.append_op(OpDesc("tanh", {"X": [weird]}, {"Out": ["out1"]}, {}))
+    # rebuild wrappers so block.ops sees the desc ops
+    main2 = fluid.Program.parse_from_string(main.desc.serialize_to_string())
+    path = str(tmp_path / "g.dot")
+    fluid.debugger.draw_block_graphviz(main2.global_block(), path)
+    text = open(path).read()
+    # the quote inside a var name is escaped, never a bare terminator
+    assert '\\"' in text
+    assert 'label="w\\"quote"' in text
+    # each var declared exactly ONCE even when used by several ops
+    assert text.count('label="x@GRAD"') == 1
+    # balanced UNESCAPED quotes -> parseable dot (structural sanity)
+    assert text.replace('\\"', '').count('"') % 2 == 0
+
+
+# ---------------------------------------------------------------------------
+# analyzer API details
+# ---------------------------------------------------------------------------
+
+def test_analyze_program_level_and_pass_validation():
+    main, _, _ = _net()
+    with pytest.raises(ValueError):
+        analyze_program(main, level="everything")
+    with pytest.raises(ValueError):
+        analyze_program(main, passes=("nope",))
+    # pass selection works
+    diag = analyze_program(main, passes=("structural",))
+    assert not diag.findings
+
+
+def test_diagnostics_render_and_json_roundtrip():
+    main, _, loss = _net()
+    b = main.global_block().desc
+    b.add_var(VarDesc("ghost@GRAD", shape=[4], dtype="float32"))
+    diag = main.analyze(level="structural", fetch_list=[loss])
+    text = diag.render()
+    assert "orphan-grad" in text and "error(s)" in text
+    payload = json.loads(json.dumps(diag.to_dict()))
+    assert payload["counts"]["error"] == len(diag.errors())
+
+
+def test_analyzer_survives_malformed_block_graph():
+    """Lying idx/parent_idx and bogus sub-block refs must produce findings,
+    not hangs or crashes (the property the native validator guards)."""
+    main, _, _ = _net()
+    d = json.loads(main.desc.serialize_to_string())
+    d["blocks"].append({"idx": 5, "parent_idx": 3, "vars": {},
+                        "ops": [{"type": "relu",
+                                 "inputs": {"X": ["ghost_in"]},
+                                 "outputs": {"Out": ["ghost_out"]},
+                                 "attrs": {"b": {"__block__": 77}}}]})
+    raw = json.dumps(d, sort_keys=True, separators=(",", ":")).encode()
+    prog = fluid.Program.parse_from_string(raw)
+    diag = prog.analyze(level="structural")
+    msgs = [f.legacy() for f in diag.errors()]
+    assert any("parent_idx" in m for m in msgs)
+    assert any("ghost_in" in m for m in msgs)
+    assert any("sub-block index 77" in m for m in msgs)

@@ -14,6 +14,11 @@ from paddle_tpu.models import (image_classification, recognize_digits,
 
 def _train(main, startup, scope, feeder, loss_var, steps=25, acc_var=None):
     """startup=None skips the init run (scope already initialized)."""
+    # every book program doubles as static-analyzer acceptance coverage:
+    # forward + append_backward + optimizer must re-check clean
+    fetch = [loss_var] + ([acc_var] if acc_var is not None else [])
+    diag = main.analyze(level="full", fetch_list=fetch)
+    assert not diag.has_errors, diag.render()
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(scope):
         if startup is not None:

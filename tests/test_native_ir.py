@@ -257,3 +257,47 @@ def test_python_fallback_parity_extras():
     assert len(py["topo_order"]) == len(nat["topo_order"])
     assert set(py["live_range"]) == set(nat["live_range"])
     assert any("parent_idx" in e for e in py_errs)
+
+
+def test_structural_pass_native_differential_equality():
+    """PR 3 satellite: the Python structural pass (fluid/analysis) and the
+    native validate_program must agree — error SET equality — on clean
+    AND seeded-bad programs."""
+    from paddle_tpu.fluid.analysis import structural_errors
+
+    # clean program: both empty
+    main, _, _ = _net()
+    assert native.validate(main) == []
+    assert structural_errors(main) == []
+
+    # seed every structural defect class the native validator knows
+    bd = main.global_block().desc
+    bd.append_op(OpDesc("relu", {"X": ["does_not_exist"]},
+                        {"Out": ["nope"]}, {}))
+    bd.append_op(OpDesc("", {}, {}, {}))                 # empty op type
+    bd.append_op(OpDesc("while", {}, {},
+                        {"sub_block": {"__block__": 42}}))  # bad sub-block
+    nat = native.validate(main)
+    py = structural_errors(main)
+    assert set(nat) == set(py)
+    assert len(py) >= 4          # undeclared in+out, empty type, sub-block
+
+    # malformed block graph (lying idx/parent): parse the raw JSON so both
+    # sides see the identical desc
+    d = json.loads(main.desc.serialize_to_string())
+    d["blocks"].append({"idx": 5, "parent_idx": 3, "vars": {},
+                        "ops": [{"type": "relu",
+                                 "inputs": {"X": ["ghost"]},
+                                 "outputs": {"Out": ["ghost2"]},
+                                 "attrs": {}}]})
+    raw = json.dumps(d, sort_keys=True, separators=(",", ":")).encode()
+
+    class FakeProg:
+        def serialize_to_string(self):
+            return raw
+
+    from paddle_tpu.fluid.core.desc import ProgramDesc
+    nat2 = native.validate(FakeProg())
+    py2 = structural_errors(ProgramDesc.parse_from_string(raw))
+    assert set(nat2) == set(py2)
+    assert any("parent_idx" in e for e in py2)

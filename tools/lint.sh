@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Lint gate (PR 3 satellite): ruff over paddle_tpu/ (config in
+# pyproject.toml) + a plint sweep over freshly built book programs.
+#
+#   tools/lint.sh            # run everything available
+#   tools/lint.sh --ruff     # ruff only
+#   tools/lint.sh --plint    # program lint only
+#
+# ruff is optional in the hermetic CI container (no network installs);
+# when absent we warn and still run the program linter, which needs
+# nothing beyond the repo's own Python deps.
+
+set -u
+cd "$(dirname "$0")/.."
+
+want_ruff=1
+want_plint=1
+case "${1:-}" in
+  --ruff)  want_plint=0 ;;
+  --plint) want_ruff=0 ;;
+  "") ;;
+  *) echo "usage: tools/lint.sh [--ruff|--plint]" >&2; exit 64 ;;
+esac
+
+rc=0
+
+if [ "$want_ruff" = 1 ]; then
+  if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check paddle_tpu/"
+    ruff check paddle_tpu/ || rc=1
+  elif python -c "import ruff" >/dev/null 2>&1; then
+    echo "== python -m ruff check paddle_tpu/"
+    python -m ruff check paddle_tpu/ || rc=1
+  else
+    echo "== ruff not installed; skipping style lint (pyproject.toml holds the config)"
+  fi
+fi
+
+if [ "$want_plint" = 1 ]; then
+  echo "== plint over the book programs (forward + backward + optimizer)"
+  tmpdir="$(mktemp -d)"
+  trap 'rm -rf "$tmpdir"' EXIT
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$tmpdir" <<'EOF' || rc=1
+# Build each book model, serialize it, and emit <name>.json + <name>.fetch
+# for the CLI sweep below — the same programs tests/test_book.py trains.
+import sys, os
+
+tmpdir = sys.argv[1]
+from paddle_tpu import fluid
+from paddle_tpu.models import recognize_digits, word2vec, image_classification
+
+
+def build(name, fn):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        fetch = fn()
+    with open(os.path.join(tmpdir, name + ".json"), "wb") as f:
+        f.write(main.desc.serialize_to_string())
+    with open(os.path.join(tmpdir, name + ".fetch"), "w") as f:
+        f.write("".join(v.name + "\n" for v in fetch))
+
+
+def digits_conv():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    _, avg_cost, acc = recognize_digits.conv_net(img, label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    return [avg_cost, acc]
+
+
+def w2v():
+    words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+             for i in range(5)]
+    avg_cost, _ = word2vec.ngram_model(words, 30, embed_size=8,
+                                       hidden_size=32)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    return [avg_cost]
+
+
+def resnet():
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    predict = image_classification.resnet_cifar10(img, depth=8, class_num=4)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Momentum(learning_rate=0.02, momentum=0.9).minimize(
+        avg_cost)
+    return [avg_cost]
+
+
+build("digits_conv", digits_conv)
+build("word2vec", w2v)
+build("resnet_cifar", resnet)
+EOF
+  for prog in "$tmpdir"/*.json; do
+    name="$(basename "$prog" .json)"
+    fetch_args=""
+    while read -r v; do
+      [ -n "$v" ] && fetch_args="$fetch_args --fetch $v"
+    done < "$tmpdir/$name.fetch"
+    echo "-- plint $name"
+    # shellcheck disable=SC2086
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m paddle_tpu.tools.plint "$prog" --quiet $fetch_args || rc=1
+  done
+fi
+
+exit $rc
