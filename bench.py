@@ -308,6 +308,60 @@ def bench_pipeline_feed(model: str, batch: int, steps: int, trials: int,
             "pipelined_speedup": round(sync_ms / piped_ms, 3)}
 
 
+def bench_guardrails(model: str, batch: int, steps: int, trials: int):
+    """Guarded vs unguarded ms/batch (ISSUE 4 satellite): the same
+    host-feed training loop run plain and under
+    GuardPolicy(on_nonfinite="skip") with the full loss/grads/params
+    sentinel.  The guarded loop pays (a) the fused isfinite reductions
+    + select-gated state publish inside the dispatch and (b) a per-step
+    host sync on the health flag — the reported overhead_pct is the
+    honest price of divergence protection, measured, not guessed."""
+    from paddle_tpu import fluid
+    from paddle_tpu.resilience import GuardPolicy
+
+    main_prog, startup, scope, cost, px, ncls = _build_image_net(
+        model, in_dtype="float32")
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(batch, 3, px, px).astype(np.float32),
+            "label": rng.randint(0, ncls, (batch, 1)).astype(np.int32)}
+    policy = GuardPolicy(on_nonfinite="skip")
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # warm BOTH executables (plain + guarded signatures) out of band
+        exe.run(main_prog, feed=feed, fetch_list=[cost])
+        exe.run(main_prog, feed=feed, fetch_list=[cost], guard=policy)
+        warm = exe.health_stats()   # counters are cumulative; report deltas
+
+        best_plain = best_guarded = float("inf")
+        for _ in range(trials):
+            t0 = time.time()
+            for _ in range(steps):
+                out, = exe.run(main_prog, feed=feed, fetch_list=[cost],
+                               return_numpy=False)
+            final = float(np.asarray(out))          # blocking fetch
+            best_plain = min(best_plain, time.time() - t0)
+            assert np.isfinite(final), f"diverged: {final}"
+        for _ in range(trials):
+            t0 = time.time()
+            for _ in range(steps):
+                out, = exe.run(main_prog, feed=feed, fetch_list=[cost],
+                               return_numpy=False, guard=policy)
+            best_guarded = min(best_guarded, time.time() - t0)
+
+    stats = {k: v - warm[k] for k, v in exe.health_stats().items()}
+    assert stats["nonfinite_steps"] == 0, stats     # clean data stays clean
+    plain_ms = best_plain / steps * 1e3
+    guarded_ms = best_guarded / steps * 1e3
+    return {"model": model, "batch": batch,
+            "ms_per_batch": round(plain_ms, 2),
+            "guarded_ms_per_batch": round(guarded_ms, 2),
+            "sentinel_overhead_pct": round(
+                (guarded_ms - plain_ms) / plain_ms * 100, 1),
+            "guarded_steps": stats["guarded_steps"]}
+
+
 def bench_transformer(batch: int, steps: int, trials: int,
                       seq_len: int = 256):
     import jax
@@ -708,6 +762,17 @@ def main() -> None:
             image_suite[model] = {"error": str(e)[:120]}
             print(f"image bench {model} failed: {e}", file=sys.stderr)
 
+    guardrails_cmp = None
+    if os.environ.get("BENCH_SKIP_GUARDRAILS", "") != "1":
+        try:
+            guardrails_cmp = retry_transient(
+                bench_guardrails,
+                os.environ.get("BENCH_GUARD_MODEL", "smallnet"),
+                int(os.environ.get("BENCH_IMAGE_BATCH", "128")),
+                steps, trials)
+        except Exception as e:
+            print(f"guardrails bench failed: {e}", file=sys.stderr)
+
     pipeline_cmp = None
     if os.environ.get("BENCH_SKIP_PIPELINE", "") != "1":
         try:
@@ -765,6 +830,9 @@ def main() -> None:
         # feed->step->fetch vs DataLoader prefetch + run_pipeline, both
         # against the chained device ms/batch
         "pipeline": pipeline_cmp,
+        # guarded-vs-unguarded step cost (ISSUE 4): the measured price
+        # of the fused NaN/divergence sentinel + health-flag sync
+        "guardrails": guardrails_cmp,
         "transformer_long_context": long_ctx,
         # real-data trained quality — 'real' tier with egress, else the
         # committed real-data fixture tier (never synthetic, never None
@@ -787,6 +855,9 @@ def main() -> None:
     if os.environ.get("BENCH_SKIP_PIPELINE", "") != "1" \
             and pipeline_cmp is None:
         missing.append("pipeline")
+    if os.environ.get("BENCH_SKIP_GUARDRAILS", "") != "1" \
+            and guardrails_cmp is None:
+        missing.append("guardrails")
     if os.environ.get("BENCH_SKIP_QUALITY", "") != "1":
         if quality is None:
             missing.append("mnist_quality")

@@ -63,19 +63,32 @@ def _make_grad_var(block: Block, fwd_name: str, grad_name: str):
 def append_backward(loss: Variable,
                     parameter_list: Optional[Sequence[str]] = None,
                     no_grad_set: Optional[Set[str]] = None,
+                    callbacks: Optional[Sequence] = None,
                     ) -> List[Tuple[Parameter, Variable]]:
     """Append grad ops for every op contributing to ``loss``; returns
-    (param, grad) pairs — mirror of reference backward.py:338."""
+    (param, grad) pairs — mirror of reference backward.py:338.
+
+    ``callbacks``: callables ``cb(block, op)`` invoked after each op
+    this pass appends (the reference's _append_backward_ops_ callback
+    hook) — how ``Optimizer.minimize`` applies per-var ``error_clip``
+    (clip.error_clip_callback) to gradients as they materialize."""
     block = loss.block
     program = block.program
     no_grad = set(no_grad_set or ())
+    callbacks = list(callbacks or ())
+
+    def emit(type, inputs=None, outputs=None, attrs=None, **kw):
+        op = block.append_op(type, inputs, outputs, attrs, **kw)
+        for cb in callbacks:
+            cb(block, op)
+        return op
 
     fwd_ops = list(block.ops)
 
     # seed d(loss)/d(loss) = 1 (reference fill_constant at backward.py:365)
     loss_grad = grad_var_name(loss.name)
     _make_grad_var(block, loss.name, loss_grad)
-    block.append_op(
+    emit(
         "fill_constant", outputs={"Out": block.vars[loss_grad]},
         attrs={"shape": list(loss.shape or []), "value": 1.0,
                "dtype": loss.dtype})
@@ -102,13 +115,13 @@ def append_backward(loss: Variable,
         else:
             _make_grad_var(block, name, canon)
             if len(contribs) == 1:
-                block.append_op("assign",
-                                inputs={"X": block.vars[contribs[0]]},
-                                outputs={"Out": block.vars[canon]})
+                emit("assign",
+                     inputs={"X": block.vars[contribs[0]]},
+                     outputs={"Out": block.vars[canon]})
             else:
-                block.append_op(
-                    "sum", inputs={"X": [block.vars[c] for c in contribs]},
-                    outputs={"Out": block.vars[canon]})
+                emit("sum",
+                     inputs={"X": [block.vars[c] for c in contribs]},
+                     outputs={"Out": block.vars[canon]})
         finalized[name] = canon
         return canon
 
@@ -135,9 +148,9 @@ def append_backward(loss: Variable,
                     if g is None:
                         z = grad_var_name(n) + "@ZERO"
                         _make_grad_var(block, n, z)
-                        block.append_op("fill_zeros_like",
-                                        inputs={"X": block.var(n)},
-                                        outputs={"Out": block.vars[z]})
+                        emit("fill_zeros_like",
+                             inputs={"X": block.var(n)},
+                             outputs={"Out": block.vars[z]})
                         g = z
                     fixed.append(g)
                 grad_inputs[slot + GRAD_SUFFIX] = [block.vars[g] for g in fixed]
@@ -174,9 +187,9 @@ def append_backward(loss: Variable,
         for slot in list(g_outputs):
             while g_outputs[slot] and g_outputs[slot][-1] == "":
                 g_outputs[slot].pop()
-        block.append_op(op.type + "_grad", inputs=g_inputs,
-                        outputs=dict(g_outputs), attrs=dict(op.desc.attrs),
-                        infer_shape=False)
+        emit(op.type + "_grad", inputs=g_inputs,
+             outputs=dict(g_outputs), attrs=dict(op.desc.attrs),
+             infer_shape=False)
 
     # finalize leaves (vars with no producer op in this block: parameters,
     # data vars) so grad_var_name(v) always resolves

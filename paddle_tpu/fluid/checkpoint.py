@@ -78,6 +78,21 @@ class CheckpointManager:
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
+
+        def write_durable(path: str, payload: bytes) -> None:
+            # plain write + explicit fsync: inside the unpublished tmp
+            # dir the per-file tmp+rename dance of _atomic_write buys
+            # nothing (nobody reads tmp), but the fsync is load-bearing
+            # — the publish rename below must never land before the
+            # tensor bytes it names are on the platter, or a crash
+            # right after publish leaves a "complete" checkpoint whose
+            # files are torn (the CRC catches it, but the previous
+            # checkpoint may already be pruned)
+            with open(path, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+
         names = []
         for v in program.list_vars():
             if not v.persistable:
@@ -85,12 +100,16 @@ class CheckpointManager:
             val = scope.find_var(v.name)
             if val is None:
                 continue
-            fio.save_tensor(val, os.path.join(tmp, v.name))
+            write_durable(os.path.join(tmp, v.name),
+                          fio.tensor_to_bytes(val))
             names.append(v.name)
         meta = {"step": int(step), "names": names,
                 "time": time.time()}
-        fio._atomic_write(os.path.join(tmp, "META.json"),
-                          json.dumps(meta).encode())
+        write_durable(os.path.join(tmp, "META.json"),
+                      json.dumps(meta).encode())
+        # every file is fsynced; now persist their directory ENTRIES
+        # before the rename makes them reachable under the final name
+        fio._fsync_dir(tmp)
         if os.path.exists(final):          # re-checkpoint of same step
             shutil.rmtree(final)
         os.rename(tmp, final)              # atomic publish

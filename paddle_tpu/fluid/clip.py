@@ -16,10 +16,22 @@ class BaseErrorClipAttr:
 
 
 class ErrorClipByValue(BaseErrorClipAttr):
+    """Per-var ERROR clipping — reference clip.py:40.  Attached to a
+    forward Variable (``var.error_clip = ErrorClipByValue(max=...)``),
+    it clips that var's GRADIENT as it is produced during
+    ``append_backward`` — bounding the error signal flowing upstream
+    from that point, where GradientClip* only bounds what reaches the
+    optimizer.  The clip op joins the step's single XLA computation
+    like every other backward op."""
+
     def __init__(self, max, min=None):
+        max = float(max)
         if min is None:
             min = -max
-        self.max, self.min = float(max), float(min)
+        self.max, self.min = max, float(min)
+        if self.min >= self.max:
+            raise ValueError(f"ErrorClipByValue: min must be < max "
+                             f"(got min={self.min}, max={self.max})")
 
     def append_clip_op(self, block, grad_name):
         gv = block.vars[grad_name]
@@ -28,14 +40,30 @@ class ErrorClipByValue(BaseErrorClipAttr):
 
 
 def error_clip_callback(block, op):
+    """append_backward callback (reference clip.py:66, wired by
+    Optimizer.minimize): for each canonical ``@GRAD`` output the newly
+    appended op produces, apply the FORWARD var's ``error_clip``.
+    Intermediate ``@RENAME@``/``@ZERO`` contribution pieces are skipped —
+    the clip lands once, on the summed gradient the rest of the
+    backward pass consumes."""
+    from .core.registry import GRAD_SUFFIX
+
     for name in op.output_names:
+        if not name or not name.endswith(GRAD_SUFFIX):
+            continue
+        fwd_name = name[: -len(GRAD_SUFFIX)]
         try:
-            var = block.var(name)
+            fwd_var = block.var(fwd_name)
         except KeyError:
             continue
-        clip = getattr(var, "error_clip", None)
-        if clip is not None:
-            clip.append_clip_op(block, name)
+        clip = getattr(fwd_var, "error_clip", None)
+        if clip is None:
+            continue
+        if not isinstance(clip, BaseErrorClipAttr):
+            raise TypeError(
+                f"Variable {fwd_name!r}.error_clip must be a "
+                f"BaseErrorClipAttr, got {type(clip).__name__}")
+        clip.append_clip_op(block, name)
 
 
 class BaseGradientClipAttr:

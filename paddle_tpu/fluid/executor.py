@@ -192,6 +192,28 @@ class Executor:
         # (program fingerprint, level) pairs already analyzed clean —
         # the analyzer runs once per program STRUCTURE, not per step
         self._validated: set = set()
+        # guardrail counters (health_stats()) + per-(program, scope)
+        # guard contexts: the device-side last-good snapshot and the
+        # consecutive-bad-step escalation counter.  Keyed by program
+        # fingerprint with the owning scope held weakly — a snapshot of
+        # program A's params must never be republished into program B's
+        # scope (or A's vars into a fresh scope).
+        self._health = {"guarded_steps": 0, "nonfinite_steps": 0,
+                        "skips": 0, "rollbacks": 0, "escalations": 0,
+                        "watchdog_fires": 0, "retries": 0}
+        self._guard_ctxs: "OrderedDict[tuple, dict]" = OrderedDict()
+        # (prog fp, fetch names, policy.check) -> sentinel check names
+        self._guard_names: Dict[tuple, tuple] = {}
+
+    def health_stats(self) -> Dict[str, int]:
+        """Guardrail counters (see resilience/guardrails.py):
+        guarded_steps (dispatches run under a GuardPolicy),
+        nonfinite_steps (health flag came back False), skips /
+        rollbacks / escalations (recovery actions taken),
+        watchdog_fires (dispatch deadline expiries), retries
+        (transient-fault re-dispatches).  Deltas over a training window
+        are the divergence telemetry the reference never had."""
+        return dict(self._health)
 
     def cache_stats(self) -> Dict[str, Dict[str, int]]:
         """Counters for the executable cache (compiled step signatures)
@@ -412,6 +434,178 @@ class Executor:
             self._stats["structure"]["evictions"] += 1
         return cls
 
+    # -- guardrails ----------------------------------------------------------
+    def _guard_check_names(self, prog_fp: str, policy, program, traced_ops,
+                           state_out, fetch_names) -> tuple:
+        """Resolve the sentinel's check set for this (program, fetch,
+        policy.check) — cached, since re-walking every parameter per
+        step is exactly the hot-loop Python cost the classifier caches
+        exist to avoid.  'loss' = the fetches (non-floats are skipped
+        at trace time), 'grads' = each parameter's @GRAD the program
+        writes, 'params' = the post-update parameters themselves.
+        Parameters are identified on the FRAMEWORK block (the desc
+        block's VarDescs don't record parameter-ness)."""
+        key = (prog_fp, tuple(fetch_names), policy.check)
+        cached = self._guard_names.get(key)
+        if cached is not None:
+            return cached
+        from .core.registry import grad_var_name
+        from .framework import Parameter
+
+        names: List[str] = []
+        want = set(policy.check)
+        if "loss" in want:
+            names.extend(fetch_names)
+        params = [n for n, v in program.global_block().vars.items()
+                  if isinstance(v, Parameter)]
+        if "grads" in want:
+            written = {n for op in traced_ops
+                       for n in op.output_names() if n}
+            names.extend(g for g in (grad_var_name(p) for p in params)
+                         if g in written)
+        if "params" in want:
+            pset = set(params)
+            names.extend(n for n in state_out if n in pset)
+        out = tuple(dict.fromkeys(names))
+        self._guard_names[key] = out
+        return out
+
+    def _guard_ctx_for(self, prog_fp: str, scope) -> dict:
+        """The guard context (snapshot + escalation counter) for this
+        (program, scope) pairing — rollback must republish values that
+        came from THIS scope's run of THIS program, and alternating
+        scopes (an ensemble sharing one executor) must each keep their
+        own escalation counter.  The scope is held weakly and verified
+        by identity (an id() reused after GC must not inherit a stale
+        snapshot).  LRU-bounded like the executable caches: an evicted
+        context drops its device-resident snapshot instead of pinning
+        HBM for programs that will never run again."""
+        import weakref
+
+        key = (prog_fp, id(scope))
+        ctx = self._guard_ctxs.get(key)
+        if ctx is None or ctx["scope"]() is not scope:
+            ctx = {"scope": weakref.ref(scope), "snapshot": None,
+                   "since_snapshot": 0, "consecutive_bad": 0}
+            self._guard_ctxs[key] = ctx
+        else:
+            self._guard_ctxs.move_to_end(key)
+        while len(self._guard_ctxs) > self.CACHE_CAPACITY:
+            self._guard_ctxs.popitem(last=False)
+        while len(self._guard_names) > self.CACHE_CAPACITY:
+            self._guard_names.pop(next(iter(self._guard_names)))
+        return ctx
+
+    def _run_guarded(self, compiled, feed, state_vals, rng_bits, policy,
+                     scope, prog_fp):
+        """One guarded dispatch: chaos points -> rollback snapshot
+        upkeep -> watchdog/retry dispatch -> recovery accounting.
+        Returns (fetches, new_state, healthy); raises NonFiniteError /
+        NonFiniteEscalation with the (pre-step) state already written
+        back to the scope.  A StepFault/StepTimeout escape republishes
+        the last-good snapshot into the scope when one exists (rollback
+        policy) — without a snapshot the scope keeps its pre-dispatch
+        entries, which a real-hardware mid-execution hang may have
+        consumed (pair step_timeout with on_nonfinite="rollback" when
+        the scope must survive a wedged device)."""
+        from ..resilience import guardrails as gr
+        from ..resilience.chaos import injector
+
+        inj = injector()
+        if inj.enabled():
+            feed = gr.poison_feed(feed, inj)
+        gctx = self._guard_ctx_for(prog_fp, scope)
+        if policy.on_nonfinite == "rollback" and (
+                gctx["snapshot"] is None
+                or gctx["since_snapshot"] >= policy.snapshot_every):
+            # pre-step state is always last-good (bad steps publish the
+            # gated pre-step values), so snapshotting before dispatch
+            # is safe at any cadence
+            gctx["snapshot"] = gr.device_snapshot(state_vals)
+            gctx["since_snapshot"] = 0
+
+        def dispatch(ctl):
+            if inj.enabled():
+                inj.maybe_fail("guard.fault")
+                inj.maybe_hang("guard.hang")
+            if not ctl.begin_consume():
+                # the watchdog abandoned this attempt while it stalled
+                # host-side; a retry may already be re-dispatching the
+                # same donated buffers — do not touch the device (the
+                # claim is atomic with the monitor's cancel)
+                raise gr.StepFault("dispatch abandoned after watchdog "
+                                   "timeout")
+            try:
+                fetches, new_state, flag = compiled(feed, state_vals,
+                                                    rng_bits)
+                # the health flag materialises here, INSIDE the watchdog
+                # deadline — a hung dispatch blocks on this sync
+                return fetches, new_state, bool(np.asarray(flag))
+            except Exception:
+                # a transient PJRT fault (preemption, transport drop) is
+                # only re-dispatchable if the donated inputs survived —
+                # is_deleted() is ground truth, so a failure that left
+                # every state buffer live releases the consumption claim
+                # and stays retryable
+                if gr.state_buffers_live(state_vals):
+                    ctl.unconsume()
+                raise
+
+        try:
+            fetches, new_state, healthy = gr.dispatch_guarded(
+                dispatch, policy, self._health)
+        except gr.StepFault:
+            # the failed/hung dispatch may have consumed the scope's
+            # donated buffers (real hardware); with a rollback policy
+            # we hold a never-donated last-good snapshot — republish it
+            # so the scope keeps live arrays for whoever catches this
+            if gctx["snapshot"] is not None:
+                for n, v in gr.device_snapshot(gctx["snapshot"]).items():
+                    scope.set_var(n, v)
+                gctx["since_snapshot"] = 0
+            raise
+        self._health["guarded_steps"] += 1
+        gctx["since_snapshot"] += 1
+        if healthy:
+            gctx["consecutive_bad"] = 0
+            return fetches, new_state, True
+        self._health["nonfinite_steps"] += 1
+        gctx["consecutive_bad"] += 1
+        # a write-only persistable (a metric the program writes but never
+        # reads) has no pre-step twin for the gate to select, so its
+        # non-finite value came through ungated — drop it: a bad step
+        # must not publish ANYTHING to the scope (or the next checkpoint
+        # would durably record the poison)
+        new_state = {n: v for n, v in new_state.items() if n in state_vals}
+        escalate = (policy.escalate_after > 0
+                    and gctx["consecutive_bad"] >= policy.escalate_after)
+        if escalate:
+            self._health["escalations"] += 1
+            gctx["consecutive_bad"] = 0
+            gctx["snapshot"] = None     # the restorer will change the scope
+            for n, v in new_state.items():
+                scope.set_var(n, v)     # gated = pre-step, still live
+            raise gr.NonFiniteEscalation(
+                f"{policy.escalate_after} consecutive non-finite steps "
+                f"under on_nonfinite={policy.on_nonfinite!r}; escalate to "
+                f"checkpoint restore")
+        if policy.on_nonfinite == "raise":
+            for n, v in new_state.items():
+                scope.set_var(n, v)
+            raise gr.NonFiniteError(
+                "guarded step produced non-finite values (loss/grad/param "
+                "sentinel); scope holds the pre-step state")
+        if policy.on_nonfinite == "rollback":
+            self._health["rollbacks"] += 1
+            # publish COPIES: the snapshot itself must survive the next
+            # dispatch donating whatever sits in the scope
+            new_state = dict(new_state)
+            new_state.update(gr.device_snapshot(gctx["snapshot"]))
+            gctx["since_snapshot"] = 0  # scope now equals the snapshot
+        else:                           # "skip": gated state IS pre-step
+            self._health["skips"] += 1
+        return fetches, new_state, False
+
     def _prepare_step(self, program, feed, fetch_list, scope, mode):
         """Shared prologue for the out-of-band step consumers
         (cost_analysis / device_time_per_step): normalize the call,
@@ -520,12 +714,29 @@ class Executor:
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
             scope: Optional[Scope] = None, return_numpy: bool = True,
             mode: str = "train",
-            validate: Optional[str] = None) -> List[Any]:
+            validate: Optional[str] = None,
+            guard=None) -> List[Any]:
         """``validate``: opt-in static-analysis pre-flight — "off" (default),
         "structural" (desc-only passes) or "full" (adds the abstract
         shape/dtype re-check).  Defaults to the PADDLE_TPU_VALIDATE env
         flag; analysis is cached by program fingerprint, so a hot loop
-        pays it once."""
+        pays it once.
+
+        ``guard``: a ``resilience.GuardPolicy`` (or an ``on_nonfinite``
+        string shorthand) enabling the training guardrails: the step is
+        compiled with a fused finiteness sentinel over loss/grads/params
+        (same dispatch — no extra device round-trip), non-finite steps
+        are raised/skipped/rolled back per the policy with the scope
+        never holding a corrupted update, and the dispatch runs under
+        the policy's watchdog deadline + transient-fault retry.
+        Counters: ``health_stats()``.  Guarded steps are
+        bitwise-identical to unguarded ones on healthy batches."""
+        policy = None
+        if guard is not None:
+            from ..resilience.guardrails import GuardPolicy
+
+            policy = (guard if isinstance(guard, GuardPolicy)
+                      else GuardPolicy(on_nonfinite=str(guard)))
         program = program or default_main_program()
         feed = {k: _as_feed_value(v) for k, v in (feed or {}).items()}
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
@@ -560,17 +771,29 @@ class Executor:
         mesh_key = None if mesh is None else (
             tuple(mesh.shape.items()),
             tuple(d.id for d in mesh.devices.flat))
+        guard_names = None
+        if policy is not None:
+            guard_names = self._guard_check_names(
+                prog_fp, policy, program, traced_ops, state_out, fetch_names)
         key = (self._program_key(program), mode, mesh_key,
                tuple((n, _sig_of(v)) for n, v in sorted(feed.items())),
                tuple(fetch_names),
-               tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())))
+               tuple((n, _sig_of(v)) for n, v in sorted(state_vals.items())),
+               None if guard_names is None else ("guard",) + guard_names)
         from ..utils.flags import FLAGS
 
         compiled, state_sh, feed_sh = self._lookup_executable(key) \
             or (None, None, None)
         if compiled is None:
-            step = build_step_fn(desc, 0, list(feed), state_in, state_out,
-                                 fetch_names, mode)
+            if policy is not None:
+                from ..resilience.guardrails import build_guarded_step_fn
+
+                step = build_guarded_step_fn(desc, 0, list(feed), state_in,
+                                             state_out, fetch_names, mode,
+                                             guard_names)
+            else:
+                step = build_step_fn(desc, 0, list(feed), state_in,
+                                     state_out, fetch_names, mode)
             if mesh is not None:
                 # SPMD: feeds batch-sharded over 'dp', persistables per
                 # their desc annotations; the partitioner emits the grad
@@ -645,10 +868,23 @@ class Executor:
         from .profiler import record_event
 
         with record_event(f"executor_step/{mode}"):
-            fetches, new_state = compiled(feed, state_vals, rng_bits)
-            if FLAGS["benchmark"]:
-                jax.block_until_ready(fetches)
-        if FLAGS["check_nan_inf"]:
+            if policy is not None:
+                fetches, new_state, _healthy = self._run_guarded(
+                    compiled, feed, state_vals, rng_bits, policy, scope,
+                    prog_fp)
+            else:
+                fetches, new_state = compiled(feed, state_vals, rng_bits)
+                if FLAGS["benchmark"]:
+                    jax.block_until_ready(fetches)
+        if FLAGS["check_nan_inf"] and (
+                policy is None
+                or set(policy.check) != {"loss", "grads", "params"}):
+            # the full-check sentinel supersedes the host-side post-hoc
+            # scan; a guard watching a NARROWER set must not silently
+            # disable the explicitly-requested global scan (note the
+            # scan raises on the non-finite fetches of a skipped step —
+            # the flag's promise is "raise on any non-finite", and it
+            # outranks a partial guard's recovery)
             self._check_nan_inf(list(new_state.items()) +
                                 list(zip(fetch_names, fetches)))
         for n, v in new_state.items():
@@ -666,7 +902,8 @@ class Executor:
                      fetch_list: Optional[Sequence] = None,
                      scope: Optional[Scope] = None,
                      fetch_every: int = 8, return_numpy: bool = True,
-                     mode: str = "train", on_fetch=None) -> List[Any]:
+                     mode: str = "train", on_fetch=None,
+                     guard=None) -> List[Any]:
         """Drive a DataLoader (or any iterable of feed dicts) through
         compiled steps WITHOUT blocking on fetch each iteration.
 
@@ -692,6 +929,10 @@ class Executor:
         buffer the next step donates, so deferring it is unsafe.  The
         loop then performs like the synchronous one; keep fetch lists
         to freshly computed values (losses, metrics) for overlap.
+
+        ``guard`` (a resilience.GuardPolicy) threads through to each
+        step's run(); note the health flag syncs per step, so a guarded
+        pipeline trades the deferred-fetch overlap for the sentinel.
         """
         if loader is None:
             raise ValueError("run_pipeline needs a loader (DataLoader or "
@@ -745,7 +986,8 @@ class Executor:
         try:
             for feed in loader:
                 outs = self.run(program, feed=feed, fetch_list=fetch_list,
-                                scope=scope, return_numpy=False, mode=mode)
+                                scope=scope, return_numpy=False, mode=mode,
+                                guard=guard)
                 n_steps += 1
                 pending.append(outs)
                 if len(pending) >= fetch_every:
@@ -897,6 +1139,8 @@ class Executor:
         self._cache.clear()
         self._cls_cache.clear()
         self._validated.clear()
+        self._guard_ctxs.clear()
+        self._guard_names.clear()
 
 
 def _is_cpu(place) -> bool:

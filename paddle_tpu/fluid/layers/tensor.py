@@ -7,7 +7,7 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["create_tensor", "create_global_var", "fill_constant",
            "fill_constant_batch_size_like", "zeros", "ones", "concat",
-           "sums", "assign", "cast", "argmax"]
+           "sums", "assign", "cast", "argmax", "isfinite"]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -89,4 +89,18 @@ def argmax(x, axis=-1):
     helper = LayerHelper("argmax")
     out = helper.create_tmp_variable("int32", stop_gradient=True)
     helper.append_op("argmax", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def isfinite(x):
+    """Scalar bool: true iff every element of ``x`` (one var or a list
+    of vars) is finite — reference ``fluid.layers.isfinite``
+    (isfinite_op.cc).  Fuses into the same XLA step as the math it
+    checks; `Executor.run(..., guard=...)` appends the equivalent
+    reduction automatically over loss/grads/params."""
+    helper = LayerHelper("isfinite", input=x)
+    out = helper.create_tmp_variable("bool", stop_gradient=True)
+    helper.append_op("isfinite",
+                     {"X": x if isinstance(x, (list, tuple)) else [x]},
+                     {"Out": out})
     return out

@@ -426,6 +426,22 @@ def assign_value(ctx, ):
     return jnp.asarray(int32 or [], jnp.int32).reshape(shape)
 
 
+@primitive("isfinite", inputs=["X*"], no_grad=True)
+def isfinite(ctx, xs):
+    """reference isfinite_op.cc (fluid ``layers.isfinite`` / the
+    FLAGS_check_nan_inf scan in executor.cc:64): Out = scalar bool,
+    true iff EVERY element of every input tensor is finite.  Non-float
+    inputs are vacuously finite (the reference scans float tensors
+    only).  This is the op the guardrail sentinel fuses into the
+    training dispatch (resilience/guardrails.py)."""
+    flag = jnp.bool_(True)
+    for x in xs:
+        data = x.data if isinstance(x, SeqArray) else x
+        if jnp.issubdtype(jnp.asarray(data).dtype, jnp.floating):
+            flag = jnp.logical_and(flag, jnp.all(jnp.isfinite(data)))
+    return flag
+
+
 @primitive("bilinear_tensor_product",
            inputs=["X", "Y", "Weight", "Bias?"])
 def bilinear_tensor_product(ctx, x, y, w, bias):

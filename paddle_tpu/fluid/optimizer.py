@@ -164,9 +164,13 @@ class Optimizer:
     def minimize(self, loss: Variable, startup_program: Optional[Program] = None,
                  parameter_list=None, no_grad_set=None
                  ) -> Tuple[list, List[Tuple[Parameter, Variable]]]:
-        """reference optimizer.py:220 — backward + optimization pass."""
+        """reference optimizer.py:220 — backward + optimization pass.
+        error_clip_callback rides the backward walk (reference
+        optimizer.py:225 passes the same callback), so per-var
+        ``error_clip`` attrs clip gradients the moment they finalize."""
         program = loss.block.program
-        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        params_grads = append_backward(loss, parameter_list, no_grad_set,
+                                       callbacks=[error_clip_callback])
         params_grads = append_gradient_clip_ops(params_grads, program)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization, program)

@@ -16,6 +16,9 @@ is the behavior half of that story over the repo's existing state half:
   trainer.py  ResilientTrainer — CheckpointManager.restore() composed
               with master_reader: a SIGKILLed run resumes from the
               newest valid checkpoint and re-leases expired chunks.
+  guardrails.py  GuardPolicy + the fused finiteness sentinel, the
+              device-side rollback-and-skip recovery, and the hung-step
+              watchdog behind ``Executor.run(..., guard=...)``.
 
 `ResilientTrainer` imports the fluid/parallel layers, which themselves
 use chaos hooks from here — it loads lazily to keep this package
@@ -24,9 +27,12 @@ importable from anywhere in the stack.
 
 from .retry import RetryPolicy
 from .chaos import ChaosError, FaultInjector, injector, install
+from .guardrails import (GuardPolicy, NonFiniteError, NonFiniteEscalation,
+                         StepFault, StepTimeout)
 
 __all__ = ["RetryPolicy", "ChaosError", "FaultInjector", "injector",
-           "install", "ResilientTrainer"]
+           "install", "ResilientTrainer", "GuardPolicy", "NonFiniteError",
+           "NonFiniteEscalation", "StepFault", "StepTimeout"]
 
 
 def __getattr__(name):

@@ -16,7 +16,14 @@ through the distributed stack (all no-ops unless configured):
   * ``ckpt.truncate`` — truncate a tensor file of the just-published
                         checkpoint (exercises CRC fallback in restore());
   * kill-after-N      — SIGKILL the process upon leasing its Nth task
-                        (mid-chunk: the lease must expire and re-dispatch).
+                        (mid-chunk: the lease must expire and re-dispatch);
+  * ``guard.nan`` /   — poison the first float feed of a GUARDED step
+    ``guard.inf_grad``  with NaN/Inf (exercises the finiteness sentinel's
+                        skip/rollback recovery, resilience/guardrails.py);
+  * ``guard.hang``    — sleep ``hang_seconds`` inside the step dispatch
+                        (exercises the watchdog deadline -> StepTimeout);
+  * ``guard.fault``   — raise a transient ChaosError at dispatch entry
+                        (exercises the guarded step's RetryPolicy).
 
 Every probabilistic decision is a pure function of (seed, point, draw
 index) — `FaultInjector.decision` — so the same seed yields the same
@@ -29,6 +36,7 @@ Configuration (environment, all off by default):
   PADDLE_TPU_CHAOS_SEED=7
   PADDLE_TPU_CHAOS_KILL_AFTER=3     # SIGKILL self on leasing task #3
   PADDLE_TPU_CHAOS_LOG=/path/chaos.journal
+  PADDLE_TPU_CHAOS_HANG_SECONDS=5   # guard.hang stall length
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ from __future__ import annotations
 import os
 import signal
 import threading
+import time
 import zlib
 from typing import Dict, Optional
 
@@ -64,11 +73,13 @@ class FaultInjector:
     """Seeded injection points; a default-constructed one is inert."""
 
     def __init__(self, spec: str = "", seed: int = 0,
-                 kill_after: int = 0, log_path: Optional[str] = None):
+                 kill_after: int = 0, log_path: Optional[str] = None,
+                 hang_seconds: float = 5.0):
         self.probs = _parse_spec(spec)
         self.seed = int(seed)
         self.kill_after = int(kill_after)
         self.log_path = log_path
+        self.hang_seconds = float(hang_seconds)
         self._lock = threading.Lock()
         self._draws: Dict[str, int] = {}
         self._leases = 0
@@ -80,7 +91,9 @@ class FaultInjector:
                    seed=int(env.get("PADDLE_TPU_CHAOS_SEED", "0")),
                    kill_after=int(env.get("PADDLE_TPU_CHAOS_KILL_AFTER",
                                           "0")),
-                   log_path=env.get("PADDLE_TPU_CHAOS_LOG"))
+                   log_path=env.get("PADDLE_TPU_CHAOS_LOG"),
+                   hang_seconds=float(
+                       env.get("PADDLE_TPU_CHAOS_HANG_SECONDS", "5")))
 
     def enabled(self) -> bool:
         return bool(self.probs) or self.kill_after > 0
@@ -130,6 +143,18 @@ class FaultInjector:
         with open(path, "r+b") as f:
             f.truncate(size // 2)
         self._log(f"# truncated {path} {size}->{size // 2}")
+        return True
+
+    def maybe_hang(self, point: str = "guard.hang") -> bool:
+        """Stall the calling thread ``hang_seconds`` when `point` fires —
+        a wedged device dispatch the step watchdog must detect (the
+        sleep runs on the guarded dispatch's worker thread, so a fired
+        watchdog abandons it exactly like a real PJRT hang); returns
+        True if it hung."""
+        if not self.should(point):
+            return False
+        self._log(f"# hang {self.hang_seconds}s at {point}")
+        time.sleep(self.hang_seconds)
         return True
 
     def note_lease(self) -> None:

@@ -34,6 +34,8 @@ relative to chunk size if duplicated steps matter.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Callable, Optional
 
@@ -61,12 +63,26 @@ class ResilientTrainer:
         thread that many records ahead of train_step (the input half of
         the async pipeline; read errors still surface at the consuming
         next() and settle the lease as task_failed).
+    guard / guard_executor: pass the GuardPolicy the train_step runs
+        under (``exe.run(..., guard=policy)``) plus that Executor, and
+        the trainer closes the recovery loop: a NonFiniteEscalation
+        (``escalate_after`` consecutive bad steps) is answered with
+        ``CheckpointManager.restore()`` instead of crashing the worker
+        (when no checkpoint exists yet the escalation propagates — a
+        storm from step 0 must fail loudly, not drain the queue
+        training on nothing), and every skip/rollback/escalation is
+        appended to
+        ``<checkpoint_dir>/guard.journal`` (JSON lines) — the durable
+        record of which batches the run dropped.  Lease settlement is
+        untouched: a skipped batch still advances the chunk, a raising
+        policy still charges task_failed through the normal path.
     """
 
     def __init__(self, checkpoint_dir: str, queue, read_chunk,
                  *, program=None, scope=None, worker: str = "worker-0",
                  save_interval_steps: int = 1, max_to_keep: int = 3,
-                 poll_interval: float = 0.05, prefetch: int = 0):
+                 poll_interval: float = 0.05, prefetch: int = 0,
+                 guard=None, guard_executor=None):
         self.manager = CheckpointManager(
             checkpoint_dir, max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps)
@@ -81,6 +97,8 @@ class ResilientTrainer:
         # surfaces at the consuming next() (utils.reader propagation)
         # and still charges task_failed, never a short chunk.
         self.prefetch = prefetch
+        self.guard = guard
+        self.guard_executor = guard_executor
 
     def resume(self) -> Optional[int]:
         """Restore the newest CRC-valid checkpoint into the scope;
@@ -100,6 +118,59 @@ class ResilientTrainer:
         return self.manager.save(step, self.program, self.scope,
                                  force=force)
 
+    # -- guardrail wiring ----------------------------------------------------
+    def guard_journal_path(self) -> str:
+        return os.path.join(self.manager.dirname, "guard.journal")
+
+    def _journal_guard(self, step: int, event: str, **extra) -> None:
+        rec = {"step": int(step), "event": event}
+        rec.update(extra)
+        try:
+            with open(self.guard_journal_path(), "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            # the journal is telemetry: a full disk during a NaN storm
+            # must not mask the in-flight recovery (this runs inside a
+            # finally) or abort an otherwise-successful step
+            import sys
+
+            print(f"[paddle_tpu] guard journal write failed at step "
+                  f"{step} ({event})", file=sys.stderr)
+
+    def _wrap_guarded(self, train_step: Callable) -> Callable:
+        """Close the guardrail recovery loop around train_step: journal
+        the executor's skip/rollback deltas per step, and answer a
+        NonFiniteEscalation with CheckpointManager.restore() (the
+        device-side recovery gave up; fall back to durable state) — the
+        batch is dropped, the lease keeps advancing."""
+        from .guardrails import NonFiniteEscalation
+
+        exe = self.guard_executor
+
+        def guarded(record, step):
+            before = exe.health_stats() if exe is not None else None
+            try:
+                train_step(record, step)
+            except NonFiniteEscalation:
+                restored = self.manager.restore(self.program, self.scope)
+                self._journal_guard(step, "escalate-restore",
+                                    restored_step=restored)
+                if restored is None:
+                    # nothing durable to fall back on (a storm before
+                    # the first save): swallowing here would drain the
+                    # whole queue while training on nothing — surface
+                    # the escalation; _drive_chunk charges the lease
+                    raise
+                return
+            finally:
+                if before is not None:
+                    after = exe.health_stats()
+                    for kind in ("skips", "rollbacks"):
+                        n = after[kind] - before[kind]
+                        if n > 0:
+                            self._journal_guard(step, kind[:-1], count=n)
+        return guarded
+
     def run(self, train_step: Callable, init_fn: Optional[Callable] = None,
             max_steps: Optional[int] = None) -> int:
         """resume() -> lease chunks -> train_step(record, step) ->
@@ -109,6 +180,8 @@ class ResilientTrainer:
         the final step (the queue drained, or `max_steps` reached)."""
         from .chaos import injector
 
+        if self.guard is not None:
+            train_step = self._wrap_guarded(train_step)
         restored = self.resume()
         if restored is None:
             if init_fn is not None:

@@ -73,13 +73,20 @@ class SGD:
     # -- API -----------------------------------------------------------------
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None, feeding=None,
-              prefetch: int = 2):
+              prefetch: int = 2, guard=None):
         """Drive passes over ``reader``.  ``prefetch`` > 0 routes the
         batches through a device-prefetch DataLoader (fluid/pipeline_io):
         feeding-map conversion and H2D transfer run on a background
         thread that many batches ahead, overlapping the device step —
         numerically identical to the synchronous path (prefetch=0), the
-        feeds are merely transferred early."""
+        feeds are merely transferred early.
+
+        ``guard`` (a ``paddle_tpu.resilience.GuardPolicy``) runs every
+        step under the training guardrails: fused NaN/Inf sentinel,
+        skip/rollback recovery, watchdog deadline.  A skipped batch
+        still fires EndIteration (its cost is the non-finite value the
+        sentinel caught); counters live on the executor —
+        ``trainer.health_stats()``."""
         event_handler = event_handler or default_event_handler
         feeder = self._feeder(feeding)
         self._ensure_init()
@@ -102,7 +109,8 @@ class SGD:
                                                           batch_id))
                     outs = self.__exe__.run(self.__topology__,
                                             feed=feed,
-                                            fetch_list=fetch)
+                                            fetch_list=fetch,
+                                            guard=guard)
                     cost = float(np.asarray(outs[0]))
                     metrics = {getattr(v, "name", f"extra_{i}"):
                                np.asarray(outs[1 + i])
@@ -139,6 +147,11 @@ class SGD:
         cost = (float(np.average(costs, weights=weights))
                 if costs else float("nan"))
         return v2_event.TestResult(cost)
+
+    def health_stats(self):
+        """Guardrail counters of the underlying executor (skips,
+        rollbacks, watchdog fires, ... — see Executor.health_stats)."""
+        return self.__exe__.health_stats()
 
     def save_parameter_to_tar(self, f):
         self._ensure_init()

@@ -1,0 +1,981 @@
+"""Training guardrails (resilience/guardrails.py + Executor guard=...):
+the fused finiteness sentinel, skip/rollback/raise/escalate recovery,
+the hung-step watchdog, transient-fault retry, the chaos points that
+drive them deterministically, and the ResilientTrainer/journal wiring.
+
+Everything here is fast and seeded; the NaN-storm end-to-end run is
+marked slow.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.parallel import TaskQueue
+from paddle_tpu.resilience import (FaultInjector, GuardPolicy,
+                                   NonFiniteError, NonFiniteEscalation,
+                                   ResilientTrainer, RetryPolicy,
+                                   StepTimeout, install)
+
+PARAM_PREFIX = "fc_0"
+
+
+def build_net(seed=7):
+    """A deterministic fc regression step: -> (main, startup, scope,
+    cost).  The rng-salt counter is reset so two builds are identical
+    program-for-program (the bitwise comparisons depend on it)."""
+    framework._rng_salt_counter[0] = 0
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        y = fluid.layers.data("y", [1], "float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return main, startup, scope, cost
+
+
+def clean_feed(seed=0):
+    r = np.random.RandomState(seed)
+    return {"x": r.rand(8, 4).astype(np.float32),
+            "y": r.rand(8, 1).astype(np.float32)}
+
+
+def bad_feed(value=np.nan, seed=0):
+    feed = clean_feed(seed)
+    feed["x"][0, 0] = value
+    return feed
+
+
+def params_of(scope):
+    return {n: np.asarray(scope.find_var(n)).copy()
+            for n in scope.vars if n.startswith(PARAM_PREFIX)}
+
+
+def assert_bitwise_equal(a, b):
+    assert set(a) == set(b)
+    for n in a:
+        assert a[n].tobytes() == b[n].tobytes(), f"{n} differs"
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos():
+    """Each test owns the process-global injector."""
+    prev = install(None)
+    yield
+    install(prev)
+
+
+def run_startup(exe, startup, scope):
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+
+# -- fused sentinel ----------------------------------------------------------
+
+class TestSentinel:
+    def test_clean_guarded_step_bitwise_identical_to_unguarded(self):
+        """The acceptance contract: on healthy batches the guard's
+        select-on-true publish and fused isfinite reductions change
+        NOTHING — fetches and params are bitwise those of run()."""
+        feed = clean_feed()
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            base_out, = exe.run(main, feed=feed, fetch_list=[cost])
+        base_params = params_of(scope)
+
+        for policy in (GuardPolicy("skip"), GuardPolicy("rollback"),
+                       GuardPolicy("raise", check=("loss", "grads"))):
+            m, st, sc, c = build_net()
+            e = fluid.Executor(fluid.CPUPlace())
+            run_startup(e, st, sc)
+            with fluid.scope_guard(sc):
+                out, = e.run(m, feed=feed, fetch_list=[c], guard=policy)
+            assert np.asarray(out).tobytes() == np.asarray(base_out).tobytes()
+            assert_bitwise_equal(base_params, params_of(sc))
+            stats = e.health_stats()
+            assert stats["guarded_steps"] == 1
+            assert stats["nonfinite_steps"] == 0
+
+    def test_guard_accepts_policy_string_shorthand(self):
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard="skip")
+        assert exe.health_stats()["guarded_steps"] == 1
+
+    def test_sentinel_catches_inf_not_just_nan(self):
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=bad_feed(np.inf), fetch_list=[cost],
+                    guard=GuardPolicy("skip"))
+        assert exe.health_stats()["nonfinite_steps"] == 1
+
+    def test_grads_only_check_catches_nonfinite_grad(self):
+        """check=("grads",) alone must flag the step — the @GRAD vars
+        feed the sentinel even when the fetched loss is finite-checked
+        off."""
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=bad_feed(), fetch_list=[cost],
+                    guard=GuardPolicy("skip", check=("grads",)))
+        assert exe.health_stats()["nonfinite_steps"] == 1
+
+
+# -- recovery policies -------------------------------------------------------
+
+class TestRecovery:
+    def test_skip_leaves_params_bitwise_unchanged(self):
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pol = GuardPolicy("skip")
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard=pol)
+            pre = params_of(scope)
+            out, = exe.run(main, feed=bad_feed(), fetch_list=[cost],
+                           guard=pol)
+        assert not np.isfinite(float(out))   # fetches still report the step
+        assert_bitwise_equal(pre, params_of(scope))
+        stats = exe.health_stats()
+        assert stats == {"guarded_steps": 2, "nonfinite_steps": 1,
+                         "skips": 1, "rollbacks": 0, "escalations": 0,
+                         "watchdog_fires": 0, "retries": 0}
+
+    def test_raise_surfaces_nonfinite_with_pre_step_scope(self):
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pre = params_of(scope)
+        with fluid.scope_guard(scope):
+            with pytest.raises(NonFiniteError):
+                exe.run(main, feed=bad_feed(), fetch_list=[cost],
+                        guard=GuardPolicy("raise"))
+        assert_bitwise_equal(pre, params_of(scope))
+        assert exe.health_stats()["nonfinite_steps"] == 1
+
+    def test_rollback_restores_snapshot_from_k_steps_ago(self):
+        """snapshot_every=3: the snapshot is taken before step 1 (the
+        initialized params); steps 1-2 train on clean batches; the bad
+        step 3 rolls the scope back to the SNAPSHOT — i.e. the init
+        params, not merely the pre-step-3 params (that would be skip)."""
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        init = params_of(scope)
+        pol = GuardPolicy("rollback", snapshot_every=3)
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=clean_feed(0), fetch_list=[cost], guard=pol)
+            exe.run(main, feed=clean_feed(1), fetch_list=[cost], guard=pol)
+            pre_bad = params_of(scope)
+            exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+        post = params_of(scope)
+        assert_bitwise_equal(init, post)
+        # and it genuinely rewound past the pre-step state
+        assert any(pre_bad[n].tobytes() != post[n].tobytes() for n in post)
+        stats = exe.health_stats()
+        assert stats["rollbacks"] == 1 and stats["nonfinite_steps"] == 1
+
+    def test_rollback_snapshot_refreshes_on_cadence(self):
+        """snapshot_every=1: every pre-step state is snapshotted, so a
+        bad step restores exactly the pre-step params — and training
+        continues cleanly afterwards (the snapshot copies survive the
+        next dispatch's buffer donation)."""
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pol = GuardPolicy("rollback", snapshot_every=1)
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=clean_feed(0), fetch_list=[cost], guard=pol)
+            pre = params_of(scope)
+            exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+            assert_bitwise_equal(pre, params_of(scope))
+            out, = exe.run(main, feed=clean_feed(1), fetch_list=[cost],
+                           guard=pol)
+        assert np.isfinite(float(out))
+        # the clean step after the rollback actually trained
+        assert any(params_of(scope)[n].tobytes() != pre[n].tobytes()
+                   for n in pre)
+        assert exe.health_stats()["rollbacks"] == 1
+
+    def test_escalation_after_m_consecutive_bad_steps(self):
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pol = GuardPolicy("skip", escalate_after=2)
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+            with pytest.raises(NonFiniteEscalation):
+                exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+            # a healthy step resets the consecutive counter
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard=pol)
+            exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+        stats = exe.health_stats()
+        assert stats["escalations"] == 1
+        assert stats["skips"] == 2          # bad steps 1 and 3 skipped
+        assert stats["nonfinite_steps"] == 3
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            GuardPolicy("explode")
+        with pytest.raises(ValueError):
+            GuardPolicy("skip", check=("loss", "vibes"))
+        with pytest.raises(ValueError):
+            GuardPolicy("skip", check=())
+        # 0 / negative are the conventional "watchdog off", never an
+        # instant-fire deadline
+        assert GuardPolicy("skip", step_timeout=0).step_timeout is None
+        assert GuardPolicy("skip", step_timeout=-1).step_timeout is None
+        assert GuardPolicy("skip", step_timeout=1.5).step_timeout == 1.5
+
+    def test_skip_drops_write_only_persistables(self):
+        """A persistable the program writes but never reads has no
+        pre-step twin for the gate — a bad step must drop it rather
+        than publish its non-finite value into the scope (where the
+        next checkpoint would durably record it)."""
+        framework._rng_salt_counter[0] = 0
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4], "float32")
+            y = fluid.layers.data("y", [1], "float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            cost = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            metric = fluid.layers.create_global_var(
+                [], 0.0, "float32", persistable=True, name="last_cost")
+            fluid.layers.assign(cost, metric)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pol = GuardPolicy("skip")
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard=pol)
+            good = float(np.asarray(scope.find_var("last_cost")))
+            exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+            after = float(np.asarray(scope.find_var("last_cost")))
+        assert np.isfinite(good)
+        assert after == good            # the poisoned write was dropped
+
+
+# -- seeded chaos ------------------------------------------------------------
+
+class TestChaos:
+    def test_guard_nan_schedule_yields_exact_skip_count(self):
+        """PADDLE_TPU_CHAOS guard.nan=p with a fixed seed: the fired
+        draws are a pure function of (seed, point, index), so the skip
+        counter after N steps equals the schedule's exact fire count."""
+        seed, prob, steps = 3, 0.5, 6
+        expected = sum(FaultInjector.decision(seed, "guard.nan", i) < prob
+                       for i in range(steps))
+        assert 0 < expected < steps      # a schedule that exercises both
+        install(FaultInjector(spec=f"guard.nan={prob}", seed=seed))
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pol = GuardPolicy("skip")
+        with fluid.scope_guard(scope):
+            for i in range(steps):
+                exe.run(main, feed=clean_feed(i), fetch_list=[cost],
+                        guard=pol)
+        stats = exe.health_stats()
+        assert stats["skips"] == expected
+        assert stats["nonfinite_steps"] == expected
+        assert stats["guarded_steps"] == steps
+        for v in params_of(scope).values():
+            assert np.isfinite(v).all()
+
+    def test_guard_inf_grad_poisons_with_inf(self):
+        install(FaultInjector(spec="guard.inf_grad=1.0", seed=1))
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pre = params_of(scope)
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=clean_feed(), fetch_list=[cost],
+                    guard=GuardPolicy("skip"))
+        assert_bitwise_equal(pre, params_of(scope))
+        assert exe.health_stats()["skips"] == 1
+
+    def test_chaos_points_inert_without_guard(self):
+        """An unguarded run must not consume chaos draws or poison
+        feeds — the guard points only exist on the guarded path."""
+        install(FaultInjector(spec="guard.nan=1.0", seed=1))
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            out, = exe.run(main, feed=clean_feed(), fetch_list=[cost])
+        assert np.isfinite(float(out))
+
+    def test_watchdog_fires_within_deadline_on_injected_hang(self):
+        import time
+
+        install(FaultInjector(spec="guard.hang=1.0", seed=1,
+                              hang_seconds=2.0))
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            # warm the executable so the deadline times the hang, not
+            # the compile
+            install(None)
+            exe.run(main, feed=clean_feed(), fetch_list=[cost],
+                    guard=GuardPolicy("skip", step_timeout=5.0))
+            install(FaultInjector(spec="guard.hang=1.0", seed=1,
+                                  hang_seconds=2.0))
+            t0 = time.monotonic()
+            with pytest.raises(StepTimeout):
+                exe.run(main, feed=clean_feed(), fetch_list=[cost],
+                        guard=GuardPolicy("skip", step_timeout=0.2))
+            elapsed = time.monotonic() - t0
+        assert elapsed < 1.5, "watchdog did not cut the 2s hang short"
+        assert exe.health_stats()["watchdog_fires"] == 1
+
+    def test_transient_fault_retried_successfully(self):
+        """guard.fault raises a transient ChaosError on the first
+        attempt and clears on the second (a probability straddling the
+        two seeded draws): the retry policy re-dispatches and the step
+        completes with the exact clean-run result."""
+        d0 = FaultInjector.decision(0, "guard.fault", 0)
+        d1 = FaultInjector.decision(0, "guard.fault", 1)
+        assert d0 < d1                    # seed 0 straddles at p between
+        prob = (d0 + d1) / 2
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            base, = exe.run(main, feed=clean_feed(), fetch_list=[cost])
+
+        main2, startup2, scope2, cost2 = build_net()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe2, startup2, scope2)
+        install(FaultInjector(spec=f"guard.fault={prob}", seed=0))
+        with fluid.scope_guard(scope2):
+            out, = exe2.run(
+                main2, feed=clean_feed(), fetch_list=[cost2],
+                guard=GuardPolicy("skip", retry=RetryPolicy(
+                    max_attempts=3, deadline=None, base_delay=0.001,
+                    max_delay=0.002, seed=0)))
+        assert np.asarray(out).tobytes() == np.asarray(base).tobytes()
+        stats = exe2.health_stats()
+        assert stats["retries"] == 1
+        assert stats["guarded_steps"] == 1
+
+    def test_hang_then_clear_is_retried_through_watchdog(self):
+        """A one-off hang: the watchdog fires StepTimeout (transient),
+        the retry re-dispatches, the second attempt has no hang and the
+        step completes — watchdog_fires and retries each count 1."""
+        seed = next(s for s in range(100)
+                    if FaultInjector.decision(s, "guard.hang", 0)
+                    < FaultInjector.decision(s, "guard.hang", 1))
+        d0 = FaultInjector.decision(seed, "guard.hang", 0)
+        d1 = FaultInjector.decision(seed, "guard.hang", 1)
+        prob = (d0 + d1) / 2
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        with fluid.scope_guard(scope):
+            # pre-compile outside the deadline
+            exe.run(main, feed=clean_feed(), fetch_list=[cost],
+                    guard=GuardPolicy("skip"))
+        install(FaultInjector(spec=f"guard.hang={prob}", seed=seed,
+                              hang_seconds=2.0))
+        with fluid.scope_guard(scope):
+            out, = exe.run(
+                main, feed=clean_feed(1), fetch_list=[cost],
+                guard=GuardPolicy("skip", step_timeout=0.2,
+                                  retry=RetryPolicy(
+                                      max_attempts=3, deadline=None,
+                                      base_delay=0.001, max_delay=0.002,
+                                      seed=0)))
+        assert np.isfinite(float(out))
+        stats = exe.health_stats()
+        assert stats["watchdog_fires"] == 1
+        assert stats["retries"] == 1
+
+    def test_fatal_error_not_retried(self):
+        """A non-transient dispatch error surfaces unchanged (and
+        unretried) — retry must not paper over real bugs."""
+        from paddle_tpu.resilience.guardrails import classify_step_error
+
+        assert not classify_step_error(ValueError("shape mismatch"))
+        assert classify_step_error(ConnectionError("reset"))
+        assert classify_step_error(TimeoutError("deadline"))
+        assert classify_step_error(StepTimeout("pre-device stall"))
+        # a timeout AFTER the donated buffers were consumed must not
+        # re-dispatch them under the still-running hung call
+        assert not classify_step_error(
+            StepTimeout("wedged in device", retry_safe=False))
+
+    def test_consumed_timeout_is_not_retried(self):
+        """A hang INSIDE the device call (ctl.consumed set) surfaces as
+        a non-retryable StepTimeout on the first fire — the retry
+        policy must not race the wedged dispatch for the donated
+        buffers."""
+        import time
+
+        from paddle_tpu.resilience.guardrails import dispatch_guarded
+
+        attempts = []
+
+        def thunk(ctl):
+            attempts.append(1)
+            ctl.consumed = True           # "reached the device"
+            time.sleep(0.5)               # ...and wedged there
+            return "late"
+
+        stats = {"watchdog_fires": 0, "retries": 0}
+        pol = GuardPolicy("skip", step_timeout=0.05,
+                          retry=RetryPolicy(max_attempts=5, deadline=None,
+                                            base_delay=0.001,
+                                            max_delay=0.002, seed=0))
+        with pytest.raises(StepTimeout) as ei:
+            dispatch_guarded(thunk, pol, stats)
+        assert ei.value.retry_safe is False
+        assert stats["watchdog_fires"] == 1
+        assert stats["retries"] == 0 and len(attempts) == 1
+
+    def test_abandoned_attempt_honors_cancellation(self):
+        """A pre-device stall that outlives the deadline IS retried —
+        and the abandoned worker sees ctl.cancelled and must not go on
+        to consume the buffers the retry now owns."""
+        import time
+
+        from paddle_tpu.resilience.guardrails import (StepFault,
+                                                      dispatch_guarded)
+
+        consumed_by = []
+        calls = {"n": 0}
+
+        def thunk(ctl):
+            calls["n"] += 1
+            if calls["n"] == 1:           # first attempt: stall host-side
+                time.sleep(0.3)
+                if ctl.cancelled.is_set():
+                    raise StepFault("abandoned")
+            consumed_by.append(id(ctl))
+            ctl.consumed = True
+            return "ok"
+
+        stats = {"watchdog_fires": 0, "retries": 0}
+        pol = GuardPolicy("skip", step_timeout=0.05,
+                          retry=RetryPolicy(max_attempts=3, deadline=None,
+                                            base_delay=0.001,
+                                            max_delay=0.002, seed=0))
+        assert dispatch_guarded(thunk, pol, stats) == "ok"
+        assert stats["watchdog_fires"] == 1 and stats["retries"] == 1
+        time.sleep(0.4)                   # let the abandoned worker wake
+        assert len(consumed_by) == 1      # it never consumed the buffers
+
+    def test_consumed_transient_error_not_retried_but_structured(self):
+        """A transient-shaped error raised AFTER the attempt claimed
+        the donated buffers must not re-dispatch them — it surfaces
+        once, wrapped as StepFault (so the executor republishes the
+        rollback snapshot), with zero retries."""
+        from paddle_tpu.resilience.guardrails import (StepFault,
+                                                      dispatch_guarded)
+
+        attempts = []
+
+        def thunk(ctl):
+            attempts.append(1)
+            assert ctl.begin_consume()
+            raise ConnectionError("UNAVAILABLE: device dropped mid-step")
+
+        stats = {"watchdog_fires": 0, "retries": 0}
+        pol = GuardPolicy("skip",
+                          retry=RetryPolicy(max_attempts=5, deadline=None,
+                                            base_delay=0.001,
+                                            max_delay=0.002, seed=0))
+        with pytest.raises(StepFault) as ei:
+            dispatch_guarded(thunk, pol, stats)
+        assert isinstance(ei.value.__cause__, ConnectionError)
+        assert len(attempts) == 1 and stats["retries"] == 0
+
+    def test_state_buffers_live_tracks_deletion(self):
+        """jax.Array.is_deleted is the ground truth for whether a
+        failed dispatch consumed the donated inputs."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.resilience.guardrails import state_buffers_live
+
+        a = jnp.ones((2, 2))
+        state = {"w": a, "host": np.ones(3)}
+        assert state_buffers_live(state)
+        a.delete()
+        assert not state_buffers_live(state)
+
+    def test_device_fault_with_live_buffers_is_retried(self):
+        """An error from inside the device call releases its buffer
+        claim (unconsume) when every donated input is verifiably live —
+        the PJRT-preemption retry path."""
+        from paddle_tpu.resilience.guardrails import dispatch_guarded
+
+        calls = {"n": 0}
+
+        def thunk(ctl):
+            calls["n"] += 1
+            assert ctl.begin_consume()
+            if calls["n"] == 1:
+                ctl.unconsume()       # inputs verified live after failure
+                raise ConnectionError("UNAVAILABLE: transient")
+            return "ok"
+
+        stats = {"watchdog_fires": 0, "retries": 0}
+        pol = GuardPolicy("skip",
+                          retry=RetryPolicy(max_attempts=3, deadline=None,
+                                            base_delay=0.001,
+                                            max_delay=0.002, seed=0))
+        assert dispatch_guarded(thunk, pol, stats) == "ok"
+        assert stats["retries"] == 1 and calls["n"] == 2
+
+    def test_explicit_check_nan_inf_flag_survives_narrow_guard(self):
+        """FLAGS check_nan_inf promises a raise on ANY non-finite; a
+        guard watching only the loss must not silently disable it,
+        while the full-check sentinel supersedes it."""
+        from paddle_tpu.utils.flags import FLAGS
+
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        old = FLAGS["check_nan_inf"]
+        FLAGS["check_nan_inf"] = True
+        try:
+            with fluid.scope_guard(scope):
+                # full check set: sentinel supersedes, skip absorbs
+                exe.run(main, feed=bad_feed(), fetch_list=[cost],
+                        guard=GuardPolicy("skip"))
+                # narrow check set: the explicit global scan still runs
+                with pytest.raises(FloatingPointError):
+                    exe.run(main, feed=bad_feed(), fetch_list=[cost],
+                            guard=GuardPolicy("skip", check=("loss",)))
+        finally:
+            FLAGS["check_nan_inf"] = old
+
+    def test_guard_ctx_is_per_scope(self):
+        """A rollback snapshot taken against one scope must never be
+        republished into another: switching scopes resets the guard
+        context, and the rollback restores the NEW scope's own
+        last-good state."""
+        main, startup, scope_a, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope_a)
+        pol = GuardPolicy("rollback", snapshot_every=100)
+        with fluid.scope_guard(scope_a):
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard=pol)
+
+        scope_b = fluid.Scope()
+        with fluid.scope_guard(scope_b):
+            exe.run(startup)
+            # make B's params unmistakably different from A's
+            for n in list(scope_b.vars):
+                if n.startswith(PARAM_PREFIX):
+                    scope_b.set_var(
+                        n, np.asarray(scope_b.find_var(n)) + 7.0)
+            b_init = params_of(scope_b)
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard=pol)
+            exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+        post = params_of(scope_b)
+        # rolled back to B's snapshot (its shifted init), not A's
+        assert_bitwise_equal(b_init, post)
+
+    def test_alternating_scopes_keep_separate_guard_contexts(self):
+        """Two models (same program, two scopes, one executor) run
+        guarded steps alternately: each keeps its own escalation
+        counter — the context is keyed per (program, scope), not
+        clobbered on every alternation."""
+        main, startup, scope_a, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope_a)
+        scope_b = fluid.Scope()
+        with fluid.scope_guard(scope_b):
+            exe.run(startup)
+        pol = GuardPolicy("skip", escalate_after=2)
+        # interleave: A-bad, B-clean, A-bad -> A escalates on its 2nd
+        # consecutive bad step despite B's healthy step in between
+        with fluid.scope_guard(scope_a):
+            exe.run(main, feed=bad_feed(), fetch_list=[cost], guard=pol)
+        with fluid.scope_guard(scope_b):
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard=pol)
+        with fluid.scope_guard(scope_a):
+            with pytest.raises(NonFiniteEscalation):
+                exe.run(main, feed=bad_feed(), fetch_list=[cost],
+                        guard=pol)
+        assert exe.health_stats()["escalations"] == 1
+
+    def test_timeout_escape_republishes_rollback_snapshot(self):
+        """A watchdog fire under a rollback policy leaves the scope
+        holding the last-good snapshot (fresh never-donated copies) —
+        the documented survival story for a wedged device."""
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        run_startup(exe, startup, scope)
+        pol = GuardPolicy("rollback", snapshot_every=1, step_timeout=2.0)
+        with fluid.scope_guard(scope):
+            # first step also pays the XLA compile — keep it outside
+            # the tight deadline used for the hang below
+            exe.run(main, feed=clean_feed(), fetch_list=[cost], guard=pol)
+            pre = params_of(scope)
+            pol = GuardPolicy("rollback", snapshot_every=1,
+                              step_timeout=0.2)
+            install(FaultInjector(spec="guard.hang=1.0", seed=1,
+                                  hang_seconds=1.5))
+            with pytest.raises(StepTimeout):
+                exe.run(main, feed=clean_feed(1), fetch_list=[cost],
+                        guard=pol)
+            install(None)
+        assert_bitwise_equal(pre, params_of(scope))
+        # and the scope is live: the next guarded step trains normally
+        with fluid.scope_guard(scope):
+            out, = exe.run(main, feed=clean_feed(2), fetch_list=[cost],
+                           guard=pol)
+        assert np.isfinite(float(out))
+
+
+# -- trainer integration -----------------------------------------------------
+
+def _guarded_trainer(tmp_path, q, policy, bad_records, max_steps=None,
+                     escalate_after=0):
+    """Drive ResilientTrainer over a NaN-poisoned record stream with a
+    guarded train_step; returns (trainer, final step, scope, cost
+    history)."""
+    main, startup, scope, cost = build_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    costs = []
+
+    def read_chunk(seed):
+        r = np.random.RandomState(seed)
+        out = []
+        for j in range(4):
+            xs = r.rand(8, 4).astype(np.float32)
+            ys = r.rand(8, 1).astype(np.float32)
+            if (seed, j) in bad_records:
+                xs[0, 0] = np.nan
+            out.append((xs, ys))
+        return out
+
+    def train_step(rec, step):
+        out, = exe.run(main, feed={"x": rec[0], "y": rec[1]},
+                       fetch_list=[cost], guard=policy)
+        costs.append(float(np.asarray(out)))
+
+    trainer = ResilientTrainer(str(tmp_path), q, read_chunk,
+                               program=main, scope=scope,
+                               save_interval_steps=2, poll_interval=0.02,
+                               guard=policy, guard_executor=exe)
+    with fluid.scope_guard(scope):
+        final = trainer.run(train_step, init_fn=lambda: exe.run(startup),
+                            max_steps=max_steps)
+    return trainer, final, scope, costs, exe
+
+
+def test_trainer_journals_skipped_batches(tmp_path):
+    q = TaskQueue(timeout_secs=30)
+    q.set_dataset([0, 1])
+    trainer, final, scope, costs, exe = _guarded_trainer(
+        tmp_path, q, GuardPolicy("skip"), bad_records={(0, 1), (1, 2)})
+    assert q.all_done() and final == 8
+    assert exe.health_stats()["skips"] == 2
+    lines = [json.loads(ln) for ln in
+             open(trainer.guard_journal_path())]
+    assert [ln["event"] for ln in lines] == ["skip", "skip"]
+    assert all(ln["count"] == 1 for ln in lines)
+    for v in params_of(scope).values():
+        assert np.isfinite(v).all()
+
+
+def test_trainer_escalation_restores_checkpoint_and_continues(tmp_path):
+    """escalate_after=1: the first bad batch raises NonFiniteEscalation
+    out of the guarded run; the trainer answers with
+    CheckpointManager.restore(), journals it, and keeps draining the
+    queue — the lease is never failed."""
+    q = TaskQueue(timeout_secs=30)
+    q.set_dataset([0, 1])
+    trainer, final, scope, costs, exe = _guarded_trainer(
+        tmp_path, q, GuardPolicy("skip", escalate_after=1),
+        bad_records={(1, 1)})
+    assert q.all_done() and q.counts()["failed"] == 0
+    assert exe.health_stats()["escalations"] == 1
+    events = [json.loads(ln)["event"]
+              for ln in open(trainer.guard_journal_path())]
+    assert "escalate-restore" in events
+    restored = [json.loads(ln) for ln in open(trainer.guard_journal_path())
+                if json.loads(ln)["event"] == "escalate-restore"]
+    assert restored[0]["restored_step"] is not None
+    for v in params_of(scope).values():
+        assert np.isfinite(v).all()
+
+
+def test_trainer_escalation_without_checkpoint_propagates(tmp_path):
+    """A storm before the first save has nothing to restore: the
+    escalation must surface (charging the lease) instead of silently
+    draining the queue while training on nothing."""
+    q = TaskQueue(timeout_secs=30, failure_max=1)
+    q.set_dataset([0])
+    with pytest.raises(NonFiniteEscalation):
+        _guarded_trainer(tmp_path, q,
+                         GuardPolicy("skip", escalate_after=1),
+                         bad_records={(0, 0)})   # very first record
+    assert q.counts()["failed"] == 1             # lease charged
+
+
+@pytest.mark.slow
+def test_nan_storm_end_to_end(tmp_path):
+    """A chaos NaN storm mid-training under ResilientTrainer: the run
+    completes, the loss still decreases, the journal records the
+    skipped batches, and the final parameters are finite."""
+    steps = 40
+    prob, seed = 0.3, 11
+    expected = sum(FaultInjector.decision(seed, "guard.nan", i) < prob
+                   for i in range(steps))
+    assert expected > 0
+    install(FaultInjector(spec=f"guard.nan={prob}", seed=seed))
+    try:
+        main, startup, scope, cost = build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        costs = []
+        W = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+
+        def read_chunk(seed_):
+            r = np.random.RandomState(seed_)
+            out = []
+            for _ in range(10):
+                xs = r.randn(8, 4).astype(np.float32)
+                out.append((xs, xs @ W[:, None]))
+            return out
+
+        policy = GuardPolicy("skip")
+
+        def train_step(rec, step):
+            out, = exe.run(main, feed={"x": rec[0], "y": rec[1]},
+                           fetch_list=[cost], guard=policy)
+            c = float(np.asarray(out))
+            if np.isfinite(c):
+                costs.append(c)
+
+        q = TaskQueue(timeout_secs=30)
+        q.set_dataset(list(range(4)))
+        trainer = ResilientTrainer(str(tmp_path), q, read_chunk,
+                                   program=main, scope=scope,
+                                   save_interval_steps=5,
+                                   poll_interval=0.02,
+                                   guard=policy, guard_executor=exe)
+        with fluid.scope_guard(scope):
+            final = trainer.run(train_step,
+                                init_fn=lambda: exe.run(startup))
+        assert final == steps and q.all_done()
+        stats = exe.health_stats()
+        assert stats["skips"] == expected
+        assert stats["guarded_steps"] == steps
+        skipped = sum(json.loads(ln)["count"]
+                      for ln in open(trainer.guard_journal_path()))
+        assert skipped == expected
+        assert costs[-1] < costs[0] * 0.5, (costs[0], costs[-1])
+        for v in params_of(scope).values():
+            assert np.isfinite(v).all()
+    finally:
+        install(None)
+
+
+# -- error clip (satellite) --------------------------------------------------
+
+class TestErrorClip:
+    def test_error_clip_bounds_upstream_gradient(self):
+        """var.error_clip = ErrorClipByValue(max): the gradient flowing
+        upstream from that var is clamped to [min, max] during
+        append_backward (reference clip.py semantics)."""
+        framework._rng_salt_counter[0] = 0
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4], "float32")
+            y = fluid.layers.data("y", [1], "float32")
+            hidden = fluid.layers.fc(input=x, size=8)
+            hidden.error_clip = fluid.clip.ErrorClipByValue(max=1e-3)
+            pred = fluid.layers.fc(input=hidden, size=1)
+            cost = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            # 100x scale guarantees unclipped grads exceed the bound
+            big = fluid.layers.scale(cost, scale=100.0)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(big)
+        exe = fluid.Executor(fluid.CPUPlace())
+        r = np.random.RandomState(0)
+        feed = {"x": r.rand(16, 4).astype(np.float32),
+                "y": r.rand(16, 1).astype(np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            g, = exe.run(main, feed=feed,
+                         fetch_list=[hidden.grad_name],
+                         return_numpy=True)
+        g = np.asarray(g)
+        assert np.abs(g).max() <= 1e-3 + 1e-9
+        # the clip actually bit: some entries sit exactly at the bound
+        assert np.isclose(np.abs(g).max(), 1e-3)
+
+    def test_error_clip_asymmetric_bounds(self):
+        clip = fluid.clip.ErrorClipByValue(max=0.5, min=-0.1)
+        assert clip.max == 0.5 and clip.min == -0.1
+        with pytest.raises(ValueError):
+            fluid.clip.ErrorClipByValue(max=-1.0, min=1.0)
+
+    def test_error_clip_rejects_wrong_type(self):
+        framework._rng_salt_counter[0] = 0
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4], "float32")
+            h = fluid.layers.fc(input=x, size=2)
+            h.error_clip = "not a clip"
+            cost = fluid.layers.mean(h)
+            with pytest.raises(TypeError):
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+
+    def test_no_error_clip_means_no_clip_ops(self):
+        framework._rng_salt_counter[0] = 0
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4], "float32")
+            h = fluid.layers.fc(input=x, size=2)
+            cost = fluid.layers.mean(h)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        assert not [op for op in main.global_block().ops
+                    if op.type == "clip"]
+
+
+# -- checkpoint durability (satellite) ---------------------------------------
+
+def test_checkpoint_save_fsyncs_every_file_before_publish(tmp_path,
+                                                          monkeypatch):
+    """save() must fsync each tensor file + META + the tmp directory
+    BEFORE the publish rename: the rename may not become durable ahead
+    of the bytes it names."""
+    from paddle_tpu.fluid.checkpoint import CheckpointManager
+
+    main, startup, scope, cost = build_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    run_startup(exe, startup, scope)
+
+    synced_fds = []
+    renames = []
+    real_fsync, real_rename = os.fsync, os.rename
+
+    def spy_fsync(fd):
+        synced_fds.append(fd)
+        return real_fsync(fd)
+
+    def spy_rename(src, dst):
+        renames.append((len(synced_fds), src, dst))
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(os, "rename", spy_rename)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    with fluid.scope_guard(scope):
+        assert mgr.save(1, main, scope, force=True)
+    n_files = len(os.listdir(tmp_path / "ck" / "ckpt-1"))  # tensors + META
+    assert n_files >= 3
+    # the publish rename happened...
+    publish = [r for r in renames if r[2].endswith("ckpt-1")]
+    assert len(publish) == 1
+    # ...strictly after >= one fsync per file written + the tmp dir
+    assert publish[0][0] >= n_files + 1
+    # and the checkpoint round-trips
+    fresh = fluid.Scope()
+    assert mgr.restore(main, fresh) == 1
+    for n, v in params_of(scope).items():
+        assert np.asarray(fresh.find_var(n)).tobytes() == v.tobytes()
+
+
+# -- layers.isfinite + guarded pipeline --------------------------------------
+
+def test_layers_isfinite_in_program(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.layers.data("x", [3], "float32")
+    flag = fluid.layers.isfinite(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    ok, = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                  fetch_list=[flag])
+    assert bool(np.asarray(ok)) is True
+    bad = np.ones((2, 3), np.float32)
+    bad[1, 2] = np.inf
+    notok, = exe.run(main, feed={"x": bad}, fetch_list=[flag])
+    assert bool(np.asarray(notok)) is False
+
+
+def test_run_pipeline_threads_guard(tmp_path):
+    """run_pipeline(guard=...) guards every step: a poisoned batch in
+    the stream is skipped and the loop keeps going."""
+    main, startup, scope, cost = build_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    run_startup(exe, startup, scope)
+    feeds = [clean_feed(0), bad_feed(), clean_feed(1)]
+    with fluid.scope_guard(scope):
+        outs = exe.run_pipeline(main, iter(feeds), fetch_list=[cost],
+                                guard=GuardPolicy("skip"))
+    assert len(outs) == 3
+    assert np.isfinite(float(outs[0][0]))
+    assert not np.isfinite(float(outs[1][0]))
+    assert np.isfinite(float(outs[2][0]))
+    assert exe.health_stats()["skips"] == 1
+
+
+def test_v2_sgd_train_guard(tmp_path):
+    """v2 SGD.train(guard=...): a NaN batch mid-pass is skipped, the
+    pass completes, and trainer.health_stats() reports it."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init(use_gpu=False, trainer_count=1, seed=7)
+    images = paddle.layer.data(name="x",
+                               type=paddle.data_type.dense_vector(4))
+    label = paddle.layer.data(name="y",
+                              type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=images, size=1,
+                           act=paddle.activation.Linear())
+    cost = paddle.layer.mse_cost(input=pred, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.01,
+                                                  momentum=0.9))
+
+    r = np.random.RandomState(0)
+    batches = []
+    for i in range(5):
+        xs = r.rand(4, 4).astype(np.float32)
+        if i == 2:
+            xs[0, 0] = np.nan
+        batches.append([(x, y) for x, y in
+                        zip(xs, r.rand(4, 1).astype(np.float32))])
+
+    def reader():
+        return iter(batches)
+
+    seen = []
+
+    def handler(evt):
+        if isinstance(evt, paddle.event.EndIteration):
+            seen.append(evt.cost)
+
+    trainer.train(reader, num_passes=1, event_handler=handler,
+                  feeding={"x": 0, "y": 1}, prefetch=0,
+                  guard=GuardPolicy("skip"))
+    assert len(seen) == 5
+    assert not np.isfinite(seen[2])
+    assert all(np.isfinite(c) for i, c in enumerate(seen) if i != 2)
+    stats = trainer.health_stats()
+    assert stats["skips"] == 1 and stats["guarded_steps"] == 5

@@ -387,3 +387,35 @@ def test_sampling_id_distribution(fresh_programs):
     assert set(np.unique(s1)) <= {0, 1, 2, 3}
     frac = (s1.ravel() == 2).mean()
     assert 0.6 < frac < 0.95, frac
+
+
+class TestIsfinite:
+    """reference isfinite_op.cc — the nan/inf check `layers.isfinite`
+    exposes and the guardrail sentinel fuses into the training step
+    (COMPAT.md "Training guardrails")."""
+
+    def test_all_finite_true(self):
+        t = OpTestCase("isfinite", {"X": [_r(3, 4), _r(2, 2, seed=1)]})
+        t.check_output({"Out": np.array(True)})
+
+    def test_nan_detected(self):
+        x = _r(3, 4)
+        x[1, 2] = np.nan
+        t = OpTestCase("isfinite", {"X": [x]})
+        t.check_output({"Out": np.array(False)})
+
+    def test_inf_detected_across_inputs(self):
+        clean, dirty = _r(3, 4), _r(2, 2, seed=1)
+        dirty[0, 0] = -np.inf
+        t = OpTestCase("isfinite", {"X": [clean, dirty]})
+        t.check_output({"Out": np.array(False)})
+
+    def test_int_inputs_vacuously_finite(self):
+        t = OpTestCase("isfinite",
+                       {"X": [np.arange(6, dtype=np.int32).reshape(2, 3)]})
+        t.check_output({"Out": np.array(True)})
+
+    def test_scalar_bool_shape(self):
+        out = OpTestCase("isfinite", {"X": [_r(3, 4)]}).run_single()
+        arr = np.asarray(out)
+        assert arr.shape == () and arr.dtype == np.bool_
