@@ -35,7 +35,8 @@ __all__ = ["save_tensor", "load_tensor", "save_tensors", "load_tensors",
            "save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
            "load_inference_model", "merge_inference_model",
-           "get_inference_program", "CheckpointCorrupt"]
+           "get_inference_program", "device_put_persistables",
+           "CheckpointCorrupt"]
 
 _MAGIC = b"PDTPU\x01"      # legacy: no checksum
 _MAGIC2 = b"PDTPU\x02"     # payload followed by crc32 trailer
@@ -377,8 +378,15 @@ def merge_inference_model(dirname: str, out_path: str) -> None:
 
 
 def load_inference_model(dirname: str, executor: Executor,
-                         scope: Optional[Scope] = None):
-    """reference io.py:370 -> (program, feed_names, fetch_targets)."""
+                         scope: Optional[Scope] = None,
+                         to_device: bool = False):
+    """reference io.py:370 -> (program, feed_names, fetch_targets).
+
+    ``to_device=True`` uploads every loaded persistable to the device
+    immediately (``jax.device_put``) instead of leaving host numpy in
+    the scope — the serving path (serving/engine.py) wants the weights
+    resident BEFORE the first request so no dispatch ever pays the H2D
+    transfer."""
     with open(os.path.join(dirname, "__model__"), "rb") as f:
         program = Program.parse_from_string(f.read())
     block = program.global_block()
@@ -387,8 +395,32 @@ def load_inference_model(dirname: str, executor: Executor,
     fetch_names = [op.output("Out")[0] for op in block.desc.ops
                    if op.type == "fetch"]
     load_persistables(executor, dirname, program, scope=scope)
+    if to_device:
+        device_put_persistables(scope or global_scope(), program)
     fetch_vars = [block.vars[n] for n in fetch_names]
     return program, feed_names, fetch_vars
+
+
+def device_put_persistables(scope: Scope,
+                            program: Optional[Program] = None) -> int:
+    """Upload every host-resident (numpy) value in ``scope`` to the
+    device — restricted to ``program``'s persistables when one is given.
+    THE single implementation behind ``load_inference_model(
+    to_device=True)`` and ``serving.InferenceEngine.place_weights``;
+    returns the number of arrays uploaded."""
+    import jax
+
+    if program is not None:
+        names = [v.name for v in program.list_vars() if v.persistable]
+    else:
+        names = list(scope.vars)
+    n = 0
+    for name in names:
+        val = scope.find_var(name)
+        if isinstance(val, np.ndarray):
+            scope.set_var(name, jax.device_put(val))
+            n += 1
+    return n
 
 
 def get_inference_program(target_vars, main_program=None):

@@ -21,7 +21,7 @@ __all__ = [
     "reduce_min", "reduce_prod", "reshape", "transpose", "matmul", "one_hot",
     "softmax_with_cross_entropy", "smooth_l1", "l2_normalize", "split",
     "nce", "im2sequence", "beam_search", "beam_search_decode", "batch_gather",
-    "gather", "expand", "multiplex", "fused_attention",
+    "gather", "expand", "multiplex", "fused_attention", "decode_attention",
     "pad", "crop", "lod_reset", "lrn", "label_smooth", "rank_loss",
     "margin_rank_loss", "log_loss", "conv_shift", "row_conv",
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
@@ -857,6 +857,26 @@ def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     if impl is not None:
         attrs["impl"] = impl
     helper.append_op("fused_attention", inputs, {"Out": out}, attrs)
+    return out
+
+
+def decode_attention(q, k_cache, v_cache, lengths, sm_scale=None,
+                     name=None):
+    """One decode step's attention against a preallocated KV cache with a
+    per-sequence length mask — the serving-path counterpart of
+    ``fused_attention`` (ops/cache_ops.decode_attention).  Layout is
+    head-interleaved 'blhd': q [B, Lq, H, D] (Lq=1 in steady state),
+    caches [B, Lmax, H, D], lengths [B] int32 = live cache rows.  O(Lmax)
+    per emitted token instead of the O(L^2) full causal re-run."""
+    helper = LayerHelper("decode_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype, stop_gradient=True)
+    attrs = {}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op("decode_attention",
+                     {"Q": q, "KCache": k_cache, "VCache": v_cache,
+                      "Lengths": lengths},
+                     {"Out": out}, attrs)
     return out
 
 

@@ -7,7 +7,7 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["create_tensor", "create_global_var", "fill_constant",
            "fill_constant_batch_size_like", "zeros", "ones", "concat",
-           "sums", "assign", "cast", "argmax", "isfinite"]
+           "sums", "assign", "cast", "argmax", "isfinite", "cache_write"]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -89,6 +89,23 @@ def argmax(x, axis=-1):
     helper = LayerHelper("argmax")
     out = helper.create_tmp_variable("int32", stop_gradient=True)
     helper.append_op("argmax", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def cache_write(cache, value, index, axis=1, out=None):
+    """Write ``value`` into the preallocated ``cache`` var at ``index``
+    along ``axis`` (ops/cache_ops.cache_write).  By default the op's
+    output IS the cache variable itself — the ParamOut-aliasing idiom —
+    so with a persistable cache the executor's donated state round-trip
+    makes this a true in-place HBM update.  ``index`` may be a scalar
+    var (shared offset) or, with axis=1, a [B] per-row position vector
+    (continuous batching: each slot decodes at its own position)."""
+    helper = LayerHelper("cache_write")
+    out = out or cache
+    out.stop_gradient = True
+    helper.append_op("cache_write",
+                     {"Cache": cache, "Value": value, "Index": index},
+                     {"Out": out}, {"axis": int(axis)})
     return out
 
 

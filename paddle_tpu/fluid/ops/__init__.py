@@ -7,6 +7,7 @@ USE_OP machinery (op_registry.h) becomes Python imports.
 from . import (  # noqa: F401
     activation_ops,
     beam_ops,
+    cache_ops,
     control_flow_ops,
     ctc_ops,
     detection_ops,

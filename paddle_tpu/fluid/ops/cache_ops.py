@@ -1,0 +1,74 @@
+"""KV-cache ops for incremental decoding (the serving hot path).
+
+The reference deploys inference through `paddle/capi` / the inference
+library by re-running the pruned forward per emitted token — O(L^2) work
+per sequence.  These two ops are the device-side primitives that make
+decode O(L) per token instead:
+
+* ``cache_write`` — functional in-place update of a preallocated cache
+  tensor (``lax.dynamic_update_slice`` / per-row scatter).  The op's
+  output is conventionally the SAME variable as its Cache input (the
+  ParamOut-aliasing idiom of sgd_op.cc), so under the executor's buffer
+  donation the update is a true in-place HBM write.
+* ``decode_attention`` — one decode step's attention against the cache
+  with a per-sequence length mask (kernels/flash_attention.py
+  decode_attention); replaces the materialised causal-bias re-run.
+
+Both are inference-only (``no_grad``): training never builds them, and
+``prune_program``'s backward slice never has to reason about them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import primitive
+
+
+@primitive("cache_write", inputs=["Cache", "Value", "Index"],
+           outputs=["Out"], no_grad=True)
+def cache_write(ctx, cache, value, index):
+    """Write ``value`` into ``cache`` at ``index`` along ``axis``.
+
+    Index forms (int32, may be traced — a new position never recompiles):
+      * scalar / [1]: one offset shared by every batch row
+        (``dynamic_update_slice`` along ``axis``) — also how a single
+        sequence's lane is admitted into a batched cache (axis=0);
+      * [B] with B == cache batch and axis == 1: per-row positions —
+        continuous batching writes each slot at its OWN decode position
+        (``Value`` must then be [B, k, ...]; rows scatter at index[b]).
+    """
+    import jax.lax as lax
+
+    axis = int(ctx.attr("axis", 1))
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    if idx.shape[0] == 1:
+        start = [jnp.int32(0)] * cache.ndim
+        start[axis] = idx[0]
+        return lax.dynamic_update_slice(
+            cache, value.astype(cache.dtype), tuple(start))
+    if axis != 1:
+        raise ValueError(
+            f"cache_write: per-row index vectors require axis=1, got "
+            f"axis={axis}")
+    b = cache.shape[0]
+    if idx.shape[0] != b:
+        raise ValueError(
+            f"cache_write: index vector length {idx.shape[0]} != cache "
+            f"batch {b}")
+    k = value.shape[1]
+    rows = idx[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]  # [B, k]
+    batch = jnp.arange(b, dtype=jnp.int32)[:, None]
+    return cache.at[batch, rows].set(value.astype(cache.dtype))
+
+
+@primitive("decode_attention", inputs=["Q", "KCache", "VCache", "Lengths"],
+           outputs=["Out"], no_grad=True)
+def decode_attention(ctx, q, k_cache, v_cache, lengths):
+    """Length-masked attention of a decode-step query block against the
+    KV cache — see kernels/flash_attention.decode_attention for the
+    layout contract (q [B, Lq, H, D], caches [B, Lmax, H, D])."""
+    from ...kernels.flash_attention import decode_attention as _da
+
+    sm_scale = ctx.attr("sm_scale", None)
+    return _da(q, k_cache, v_cache, lengths, sm_scale=sm_scale)

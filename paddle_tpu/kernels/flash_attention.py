@@ -53,7 +53,41 @@ LANES = 128   # stat tiles are [block, LANES] so no sublane transposes occur
 # Tests monkeypatch this to 0 to exercise the kernels at tiny shapes.
 PALLAS_BWD_MIN_L = 1024
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "decode_attention"]
+
+
+def decode_attention(q, k_cache, v_cache, lengths,
+                     sm_scale: Optional[float] = None) -> jax.Array:
+    """Decode-step attention against a preallocated KV cache.
+
+    The serving hot path: one (or a few) query tokens per sequence attend
+    over that sequence's cache prefix.  Shapes (layout 'blhd', matching
+    the interleave-heads convention the fused training path uses):
+
+        q        [B, Lq, H, D]   (Lq is 1 in steady-state decode)
+        k_cache  [B, Lmax, H, D] (preallocated; rows >= lengths are junk)
+        v_cache  [B, Lmax, H, D]
+        lengths  [B] int32       (valid cache rows per sequence)
+
+    Returns ctx [B, Lq, H, D].  Per-step work is O(Lmax) — the length
+    mask (additive -1e9 on rows >= lengths[b]) replaces the O(L^2)
+    causal-bias re-run of the full decoder.  No Pallas kernel: a
+    single-token step is a bandwidth-bound [H, 1, Lmax] matvec pair that
+    XLA already emits optimally; scores accumulate in f32 regardless of
+    the cache dtype (same rule as the flash kernels)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    lmax = k_cache.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores.astype(jnp.float32) * jnp.float32(sm_scale)
+    live = (jnp.arange(lmax, dtype=jnp.int32)[None, :]
+            < lengths.astype(jnp.int32)[:, None])          # [B, Lmax]
+    scores = jnp.where(live[:, None, None, :], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    return ctx.astype(q.dtype)
 
 
 def keep_scale(seed_u32, bh, rows, cols, rate):

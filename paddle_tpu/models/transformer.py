@@ -20,21 +20,43 @@ import numpy as np
 from ..fluid import ParamAttr, layers
 
 __all__ = ["transformer", "encoder", "wrap_encoder", "make_attn_bias",
-           "position_encoding_init"]
+           "position_encoding_init", "decode_prefill", "decode_step"]
 
 
-def _col_attr(mp_shard):
-    return ParamAttr(sharding=(None, "mp")) if mp_shard else None
+def _nm(prefix, key):
+    """Parameter name under an explicit prefix — None keeps auto-naming.
+
+    Explicit names are the sharing contract between the training graph
+    and the serving decode graphs (models/machine_translation.py does the
+    same for the seq2seq pair): ``transformer(param_prefix=...)`` names
+    every parameter, and ``decode_prefill``/``decode_step`` re-create the
+    same names so one scope serves all three programs."""
+    return None if prefix is None else f"{prefix}.{key}"
 
 
-def _row_attr(mp_shard):
-    return ParamAttr(sharding=("mp", None)) if mp_shard else None
+def _col_attr(mp_shard, name=None):
+    if name is None and not mp_shard:
+        return None
+    return ParamAttr(name=name,
+                     sharding=(None, "mp") if mp_shard else None)
+
+
+def _row_attr(mp_shard, name=None):
+    if name is None and not mp_shard:
+        return None
+    return ParamAttr(name=name,
+                     sharding=("mp", None) if mp_shard else None)
+
+
+def _plain_attr(name):
+    return None if name is None else ParamAttr(name=name)
 
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head=1, dropout_rate=0.0,
                          mp_shard=False, fused=False, seq_parallel=False,
-                         causal=False):
+                         causal=False, prefix=None, cache=None,
+                         static_kv=None):
     """Reference-shape MHA: project, split heads, scaled dot-product with
     additive bias, merge heads, output projection.
 
@@ -42,17 +64,63 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     instead of via a materialised [b, h, lq, lk] additive bias — on a
     bandwidth-bound chip the dense bias tensors are pure HBM traffic
     (3 biases x 6 layers x fwd+bwd reads; see BENCH_NOTES.md), so the
-    bench/perf path never materialises them."""
+    bench/perf path never materialises them.
+
+    Serving decode modes (O(L) per emitted token; see serving/decoder.py):
+      ``cache={"k","v","index","lengths"}`` — incremental self-attention:
+      only the current token's k/v are projected, written into the
+      preallocated cache vars at ``index`` (cache_write), and the query
+      attends over the cache prefix under the ``lengths`` mask.
+      ``static_kv={"k","v","lengths"}`` — cross-attention against K/V
+      projected ONCE at prefill (decode_prefill); no k/v fc here at all.
+    """
+    q_attr = _col_attr(mp_shard, _nm(prefix, "q.w"))
+    o_attr = _row_attr(mp_shard, _nm(prefix, "out.w"))
     q = layers.fc(input=queries, size=d_key * n_head, bias_attr=False,
-                  num_flatten_dims=2, param_attr=_col_attr(mp_shard))
-    k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
-                  num_flatten_dims=2, param_attr=_col_attr(mp_shard))
-    v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
-                  num_flatten_dims=2, param_attr=_col_attr(mp_shard))
+                  num_flatten_dims=2, param_attr=q_attr)
 
     def interleave_heads(x, d_head):
         b, l = x.shape[0], x.shape[1]
         return layers.reshape(x, [-1 if b == -1 else b, l, n_head, d_head])
+
+    def merge_heads_proj(ctx):
+        b, l = ctx.shape[0], ctx.shape[1]
+        return layers.fc(
+            input=layers.reshape(
+                ctx, [-1 if b == -1 else b, l, n_head * d_value]),
+            size=d_model, bias_attr=False, num_flatten_dims=2,
+            param_attr=o_attr)
+
+    if cache is not None or static_kv is not None:
+        if cache is not None and static_kv is not None:
+            raise ValueError("multi_head_attention: cache and static_kv "
+                             "are mutually exclusive")
+        q = interleave_heads(q, d_key)              # [b, lq, h, dk]
+        if static_kv is not None:
+            ctx = layers.decode_attention(
+                q, static_kv["k"], static_kv["v"], static_kv["lengths"],
+                sm_scale=float(d_key) ** -0.5)
+        else:
+            k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
+                          num_flatten_dims=2,
+                          param_attr=_col_attr(mp_shard, _nm(prefix, "k.w")))
+            v = layers.fc(input=values, size=d_value * n_head,
+                          bias_attr=False, num_flatten_dims=2,
+                          param_attr=_col_attr(mp_shard, _nm(prefix, "v.w")))
+            kc = layers.cache_write(cache["k"], interleave_heads(k, d_key),
+                                    cache["index"], axis=1)
+            vc = layers.cache_write(cache["v"], interleave_heads(v, d_value),
+                                    cache["index"], axis=1)
+            ctx = layers.decode_attention(q, kc, vc, cache["lengths"],
+                                          sm_scale=float(d_key) ** -0.5)
+        return merge_heads_proj(ctx)
+
+    k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
+                  num_flatten_dims=2,
+                  param_attr=_col_attr(mp_shard, _nm(prefix, "k.w")))
+    v = layers.fc(input=values, size=d_value * n_head, bias_attr=False,
+                  num_flatten_dims=2,
+                  param_attr=_col_attr(mp_shard, _nm(prefix, "v.w")))
 
     def split_heads(x, d_head):
         return layers.transpose(interleave_heads(x, d_head), [0, 2, 1, 3])
@@ -76,12 +144,7 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                                      sp_impl=(seq_parallel if isinstance(
                                          seq_parallel, str) else "ring"),
                                      layout="blhd")
-        b, l = ctx.shape[0], ctx.shape[1]
-        return layers.fc(
-            input=layers.reshape(
-                ctx, [-1 if b == -1 else b, l, n_head * d_value]),
-            size=d_model, bias_attr=False, num_flatten_dims=2,
-            param_attr=_row_attr(mp_shard))
+        return merge_heads_proj(ctx)
 
     q = split_heads(q, d_key)           # [b, h, lq, dk]
     k = split_heads(k, d_key)
@@ -102,26 +165,31 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
             weights = layers.dropout(weights, dropout_prob=dropout_rate)
         ctx = layers.matmul(weights, v)                   # [b, h, lq, dv]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
-    b, l = ctx.shape[0], ctx.shape[1]
-    ctx = layers.reshape(ctx, [-1 if b == -1 else b, l, n_head * d_value])
-    return layers.fc(input=ctx, size=d_model, bias_attr=False,
-                     num_flatten_dims=2, param_attr=_row_attr(mp_shard))
+    return merge_heads_proj(ctx)
 
 
-def positionwise_feed_forward(x, d_inner_hid, d_hid, mp_shard=False):
+def positionwise_feed_forward(x, d_inner_hid, d_hid, mp_shard=False,
+                              prefix=None):
     hidden = layers.fc(input=x, size=d_inner_hid, num_flatten_dims=2,
-                       act="relu", param_attr=_col_attr(mp_shard))
+                       act="relu",
+                       param_attr=_col_attr(mp_shard, _nm(prefix, "fc1.w")),
+                       bias_attr=_plain_attr(_nm(prefix, "fc1.b")))
     return layers.fc(input=hidden, size=d_hid, num_flatten_dims=2,
-                     param_attr=_row_attr(mp_shard))
+                     param_attr=_row_attr(mp_shard, _nm(prefix, "fc2.w")),
+                     bias_attr=_plain_attr(_nm(prefix, "fc2.b")))
 
 
-def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
+def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0,
+                           prefix=None):
     """reference transformer's a/n/d processing chain."""
-    for cmd in process_cmd:
+    for j, cmd in enumerate(process_cmd):
         if cmd == "a":
             out = layers.elementwise_add(out, prev_out) if prev_out is not None else out
         elif cmd == "n":
-            out = layers.layer_norm(out, begin_norm_axis=len(out.shape) - 1)
+            out = layers.layer_norm(
+                out, begin_norm_axis=len(out.shape) - 1,
+                param_attr=_plain_attr(_nm(prefix, f"ln{j}.w")),
+                bias_attr=_plain_attr(_nm(prefix, f"ln{j}.b")))
         elif cmd == "d" and dropout_rate:
             out = layers.dropout(out, dropout_prob=dropout_rate)
     return out
@@ -129,68 +197,89 @@ def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
 
 def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
                   d_inner_hid, dropout_rate=0.0, mp_shard=False,
-                  fused=False, seq_parallel=False):
+                  fused=False, seq_parallel=False, prefix=None):
     attn_output = multi_head_attention(
         enc_input, enc_input, enc_input, attn_bias, d_key, d_value, d_model,
-        n_head, dropout_rate, mp_shard, fused, seq_parallel)
+        n_head, dropout_rate, mp_shard, fused, seq_parallel,
+        prefix=_nm(prefix, "self"))
     attn_output = pre_post_process_layer(enc_input, attn_output, "dan",
-                                         dropout_rate)
+                                         dropout_rate,
+                                         prefix=_nm(prefix, "post_self"))
     ffd_output = positionwise_feed_forward(attn_output, d_inner_hid, d_model,
-                                           mp_shard)
+                                           mp_shard,
+                                           prefix=_nm(prefix, "ffn"))
     return pre_post_process_layer(attn_output, ffd_output, "dan",
-                                  dropout_rate)
+                                  dropout_rate,
+                                  prefix=_nm(prefix, "post_ffn"))
 
 
 def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
             d_inner_hid, dropout_rate=0.0, mp_shard=False, fused=False,
-            seq_parallel=False):
-    for _ in range(n_layer):
+            seq_parallel=False, prefix=None):
+    for i in range(n_layer):
         enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
                                   d_value, d_model, d_inner_hid,
                                   dropout_rate, mp_shard, fused,
-                                  seq_parallel)
+                                  seq_parallel, prefix=_nm(prefix, f"enc{i}"))
     return enc_input
 
 
 def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                   n_head, d_key, d_value, d_model, d_inner_hid,
                   dropout_rate=0.0, mp_shard=False, fused=False,
-                  seq_parallel=False, causal=False):
+                  seq_parallel=False, causal=False, prefix=None,
+                  cache=None, cross_kv=None):
+    """One decoder layer.  Training mode re-attends over the whole prefix
+    (``slf_attn_bias``/``causal``); serving decode mode passes ``cache``
+    (incremental self-attention against the layer's KV cache) and
+    ``cross_kv`` (prefill-computed cross K/V + source lengths)."""
     slf_attn = multi_head_attention(dec_input, dec_input, dec_input,
                                     slf_attn_bias, d_key, d_value, d_model,
                                     n_head, dropout_rate, mp_shard, fused,
-                                    seq_parallel, causal=causal)
+                                    seq_parallel, causal=causal,
+                                    prefix=_nm(prefix, "self"), cache=cache)
     slf_attn = pre_post_process_layer(dec_input, slf_attn, "dan",
-                                      dropout_rate)
+                                      dropout_rate,
+                                      prefix=_nm(prefix, "post_self"))
     cross = multi_head_attention(slf_attn, enc_output, enc_output,
                                  dec_enc_attn_bias, d_key, d_value, d_model,
                                  n_head, dropout_rate, mp_shard, fused,
-                                 seq_parallel)
-    cross = pre_post_process_layer(slf_attn, cross, "dan", dropout_rate)
-    ffd = positionwise_feed_forward(cross, d_inner_hid, d_model, mp_shard)
-    return pre_post_process_layer(cross, ffd, "dan", dropout_rate)
+                                 seq_parallel, prefix=_nm(prefix, "cross"),
+                                 static_kv=cross_kv)
+    cross = pre_post_process_layer(slf_attn, cross, "dan", dropout_rate,
+                                   prefix=_nm(prefix, "post_cross"))
+    ffd = positionwise_feed_forward(cross, d_inner_hid, d_model, mp_shard,
+                                    prefix=_nm(prefix, "ffn"))
+    return pre_post_process_layer(cross, ffd, "dan", dropout_rate,
+                                  prefix=_nm(prefix, "post_ffn"))
 
 
 def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
             dropout_rate=0.0, mp_shard=False, fused=False,
-            seq_parallel=False, causal=False):
-    for _ in range(n_layer):
+            seq_parallel=False, causal=False, prefix=None,
+            caches=None, cross_kvs=None):
+    for i in range(n_layer):
         dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
                                   dec_enc_attn_bias, n_head, d_key, d_value,
                                   d_model, d_inner_hid, dropout_rate,
                                   mp_shard, fused, seq_parallel,
-                                  causal=causal)
+                                  causal=causal, prefix=_nm(prefix, f"dec{i}"),
+                                  cache=None if caches is None else caches[i],
+                                  cross_kv=None if cross_kvs is None
+                                  else cross_kvs[i])
     return dec_input
 
 
 def prepare_embedding(word_ids, pos_ids, vocab_size, max_length, d_model,
-                      dropout_rate=0.0, emb_name=None, amp_dtype=None):
+                      dropout_rate=0.0, emb_name=None, amp_dtype=None,
+                      pos_name=None):
     word_emb = layers.embedding(
         input=word_ids, size=[vocab_size, d_model],
         param_attr=emb_name)
     word_emb = layers.scale(word_emb, scale=float(d_model) ** 0.5)
-    pos_emb = layers.embedding(input=pos_ids, size=[max_length, d_model])
+    pos_emb = layers.embedding(input=pos_ids, size=[max_length, d_model],
+                               param_attr=pos_name)
     out = layers.elementwise_add(word_emb, pos_emb)
     if amp_dtype:
         # one cast at the activation source: every downstream matmul /
@@ -206,12 +295,14 @@ def prepare_embedding(word_ids, pos_ids, vocab_size, max_length, d_model,
 def wrap_encoder(src_word, src_pos, src_slf_attn_bias, src_vocab_size,
                  max_length, n_layer, n_head, d_key, d_value, d_model,
                  d_inner_hid, dropout_rate=0.0, mp_shard=False, fused=False,
-                 seq_parallel=False, amp_dtype=None):
+                 seq_parallel=False, amp_dtype=None, prefix=None):
     emb = prepare_embedding(src_word, src_pos, src_vocab_size, max_length,
-                            d_model, dropout_rate, amp_dtype=amp_dtype)
+                            d_model, dropout_rate, amp_dtype=amp_dtype,
+                            emb_name=_nm(prefix, "src_emb.w"),
+                            pos_name=_nm(prefix, "src_pos_emb.w"))
     return encoder(emb, src_slf_attn_bias, n_layer, n_head, d_key, d_value,
                    d_model, d_inner_hid, dropout_rate, mp_shard, fused,
-                   seq_parallel)
+                   seq_parallel, prefix=prefix)
 
 
 def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
@@ -219,8 +310,13 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                 d_inner_hid=2048, dropout_rate=0.1, src_seq_len=32,
                 trg_seq_len=32, mp_shard=False, fused=False,
                 seq_parallel=False, materialize_attn_bias=True,
-                fused_vocab_loss=False, amp_dtype=None):
+                fused_vocab_loss=False, amp_dtype=None, param_prefix=None):
     """Build the full training graph; returns (avg_cost, predict, feed_vars).
+
+    ``param_prefix`` names EVERY parameter deterministically under the
+    prefix — the sharing contract with the serving decode graphs
+    (``decode_prefill``/``decode_step`` re-create the same names, so one
+    scope serves training, prefill and incremental decode).
 
     Data vars (dense, static seq lens — bucket on the host side):
       src_word/src_pos [b, slen], trg_word/trg_pos [b, tlen] int64,
@@ -261,18 +357,22 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
                               src_vocab_size, max_length, n_layer, n_head,
                               d_key, d_value, d_model, d_inner_hid,
                               dropout_rate, mp_shard, fused, seq_parallel,
-                              amp_dtype=amp_dtype)
+                              amp_dtype=amp_dtype, prefix=param_prefix)
     dec_emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size,
                                 max_length, d_model, dropout_rate,
-                                amp_dtype=amp_dtype)
+                                amp_dtype=amp_dtype,
+                                emb_name=_nm(param_prefix, "trg_emb.w"),
+                                pos_name=_nm(param_prefix, "trg_pos_emb.w"))
     dec_output = decoder(dec_emb, enc_output, trg_slf_attn_bias,
                          trg_src_attn_bias, n_layer, n_head, d_key, d_value,
                          d_model, d_inner_hid, dropout_rate, mp_shard,
                          fused, seq_parallel,
-                         causal=not materialize_attn_bias)
+                         causal=not materialize_attn_bias,
+                         prefix=param_prefix)
     from ..fluid import unique_name
 
-    proj_attr = ParamAttr(name=unique_name.generate("vocab_proj_w"),
+    proj_attr = ParamAttr(name=(_nm(param_prefix, "vocab_proj.w")
+                                or unique_name.generate("vocab_proj_w")),
                           sharding=(None, "mp") if mp_shard else None)
     predict = layers.fc(input=dec_output, size=trg_vocab_size,
                         num_flatten_dims=2, bias_attr=False,
@@ -300,6 +400,90 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
         feeds += [src_slf_attn_bias, trg_slf_attn_bias, trg_src_attn_bias]
     feeds += [lbl_word, lbl_weight]
     return avg_cost, predict, feeds
+
+
+# ---------------------------------------------------------------------------
+# serving decode graphs (KV-cache incremental decoding — serving/decoder.py)
+# ---------------------------------------------------------------------------
+
+def decode_prefill(src_word, src_pos, src_slf_attn_bias, src_vocab_size,
+                   max_length, n_layer, n_head, d_key, d_value, d_model,
+                   d_inner_hid, param_prefix, dropout_rate=0.0):
+    """Serving prefill tower: encode the source ONCE and project every
+    decoder layer's cross-attention K/V from the encoder output — the
+    O(S^2) work a request pays exactly once.  Parameter names match the
+    training graph built with the same ``param_prefix`` (the cross K/V
+    projections are the very ``dec{i}.cross.{k,v}.w`` weights the
+    training decoder creates), so the prefill program runs against the
+    trained scope unchanged.
+
+    Returns ``(enc_output, cross_kvs)`` with ``cross_kvs`` a list of
+    ``(k_i, v_i)`` vars, each [b, src_len, n_head, d] head-interleaved —
+    exactly the ``static_kv`` layout ``decode_step`` consumes."""
+    if not param_prefix:
+        raise ValueError("decode_prefill requires param_prefix (the "
+                         "explicit-name sharing contract with the "
+                         "training graph)")
+    enc_output = wrap_encoder(src_word, src_pos, src_slf_attn_bias,
+                              src_vocab_size, max_length, n_layer, n_head,
+                              d_key, d_value, d_model, d_inner_hid,
+                              dropout_rate, prefix=param_prefix)
+    b, s = enc_output.shape[0], enc_output.shape[1]
+
+    def heads(x, d_head):
+        return layers.reshape(x, [-1 if b == -1 else b, s, n_head, d_head])
+
+    cross_kvs = []
+    for i in range(n_layer):
+        pre = _nm(param_prefix, f"dec{i}.cross")
+        k = layers.fc(input=enc_output, size=d_key * n_head,
+                      bias_attr=False, num_flatten_dims=2,
+                      param_attr=_plain_attr(_nm(pre, "k.w")))
+        v = layers.fc(input=enc_output, size=d_value * n_head,
+                      bias_attr=False, num_flatten_dims=2,
+                      param_attr=_plain_attr(_nm(pre, "v.w")))
+        cross_kvs.append((heads(k, d_key), heads(v, d_value)))
+    return enc_output, cross_kvs
+
+
+def decode_step(trg_word, trg_pos, cache_index, self_lengths, src_lengths,
+                self_caches, cross_caches, trg_vocab_size, max_length,
+                n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
+                param_prefix):
+    """One incremental decode step — O(L) per emitted token.
+
+    Feeds: ``trg_word``/``trg_pos`` [b, 1] (the current token per lane),
+    ``cache_index`` [b] int32 (each lane's write position — continuous
+    batching decodes lanes at different depths), ``self_lengths`` [b]
+    int32 (= position + 1), ``src_lengths`` [b] int32 (live source rows
+    in the cross caches).  ``self_caches``: per layer ``{"k","v"}``
+    persistable vars [b, max_out_len, h, d] (written in place via
+    cache_write — donated state makes the update a true in-place HBM
+    write); ``cross_caches``: per layer ``{"k","v"}`` [b, src_len, h, d]
+    computed by ``decode_prefill``.  Returns logits [b, 1, vocab]."""
+    if not param_prefix:
+        raise ValueError("decode_step requires param_prefix (the "
+                         "explicit-name sharing contract with the "
+                         "training graph)")
+    emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size, max_length,
+                            d_model, 0.0,
+                            emb_name=_nm(param_prefix, "trg_emb.w"),
+                            pos_name=_nm(param_prefix, "trg_pos_emb.w"))
+    # [b, 1] ids embed to [b, d] (lookup_table squeezes the trailing 1);
+    # the decoder works on [b, lq=1, d]
+    emb = layers.reshape(emb, [-1, 1, d_model])
+    caches = [{"k": c["k"], "v": c["v"], "index": cache_index,
+               "lengths": self_lengths} for c in self_caches]
+    cross = [{"k": c["k"], "v": c["v"], "lengths": src_lengths}
+             for c in cross_caches]
+    dec_output = decoder(emb, None, None, None, n_layer, n_head, d_key,
+                         d_value, d_model, d_inner_hid, 0.0,
+                         prefix=param_prefix, caches=caches,
+                         cross_kvs=cross)
+    return layers.fc(input=dec_output, size=trg_vocab_size,
+                     num_flatten_dims=2, bias_attr=False,
+                     param_attr=_plain_attr(
+                         _nm(param_prefix, "vocab_proj.w")))
 
 
 def make_attn_bias(lengths, seq_len, n_head, causal=False):

@@ -1,0 +1,36 @@
+"""Serving engine — the inference-side counterpart of the training stack.
+
+The reference deploys trained models through `paddle/capi` and the C++
+inference library (`inference/io.h`): load a merged config+parameter
+blob, then call the GradientMachine forward per request, one request at
+a time, re-running the whole network per decode step.  On an XLA
+device that shape of serving loses twice: every new input shape
+recompiles, and sequence generation re-pays the full O(L^2) forward per
+emitted token.
+
+This package is the TPU-native replacement:
+
+* ``InferenceEngine`` (engine.py) — loads a ``save_inference_model``
+  artifact (or any pruned program), pads requests into a small set of
+  shape buckets with per-bucket compiled-executable reuse, keeps the
+  weights device-resident, and exposes bucket hit/miss counters — zero
+  recompiles in steady state.
+* ``TransformerGenerator`` / ``FullRerunDecoder`` (decoder.py) —
+  KV-cache incremental decoding for the Transformer: one O(S^2) prefill
+  per request, then O(L) per emitted token against preallocated
+  [B, L, h, d] caches, with greedy and beam front-ends reusing the
+  beam_search / beam_search_decode ops.  FullRerunDecoder is the honest
+  O(L^2) baseline the bench compares against.
+* ``ContinuousBatchingScheduler`` (scheduler.py) — a request queue
+  admitting prompts into fixed in-flight batch slots with per-slot done
+  masks; finished sequences retire and new requests backfill their slot
+  without recompilation; ``serve()`` runs the loop on a thread with
+  per-request latency accounting.
+"""
+
+from .engine import InferenceEngine  # noqa: F401
+from .decoder import FullRerunDecoder, TransformerGenerator  # noqa: F401
+from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
+
+__all__ = ["InferenceEngine", "TransformerGenerator", "FullRerunDecoder",
+           "ContinuousBatchingScheduler", "Request"]

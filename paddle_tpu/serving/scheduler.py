@@ -1,0 +1,272 @@
+"""Continuous-batching scheduler over fixed in-flight decode slots.
+
+The reference's capi serving demos handle one request per
+GradientMachine call; the common "batch then serve" upgrade still makes
+every request wait for the slowest member of its batch.  Continuous
+batching (the vLLM/Orca scheduling model; see PAPERS.md ragged-batching
+entries) removes both stalls: a fixed number of in-flight lanes decode
+in lockstep, finished sequences retire IMMEDIATELY, and queued requests
+backfill the freed lane at the next step boundary — without any
+recompilation, because the step executable's shapes never change (the
+per-lane ``cache_index``/``lengths`` vectors absorb the ragged decode
+depths).
+
+The scheduler is generic over a *slot model* — anything exposing
+``open_slots(n) / admit_slot(slot, prompt) / clear_slot(slot) /
+step_slots(tokens, positions, src_lengths) / start_id / end_id`` — which
+``TransformerGenerator`` implements.  ``serve()`` runs the admit/step
+loop on a daemon thread; ``submit()`` is thread-safe and returns a
+``Request`` whose ``wait()`` blocks until the sequence finishes, with
+per-request queue/decode latency accounting (p50/p95 in ``stats()``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "ContinuousBatchingScheduler"]
+
+
+class Request:
+    """One generation request and its lifecycle timestamps."""
+
+    # itertools.count is atomic under the GIL — submit() runs in caller
+    # threads, so a read-modify-write counter would hand out dup rids
+    _next_id = itertools.count(1)
+
+    def __init__(self, src_tokens, max_new_tokens: int):
+        self.rid = next(Request._next_id)
+        self.src = np.asarray(src_tokens)
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []
+        self.error: Optional[BaseException] = None
+        self.submitted = time.perf_counter()
+        self.admitted: Optional[float] = None
+        self.finished: Optional[float] = None
+        self.slot: Optional[int] = None
+        self._done = threading.Event()
+
+    # -- caller surface ------------------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def queue_latency(self) -> Optional[float]:
+        return None if self.admitted is None else \
+            self.admitted - self.submitted
+
+    @property
+    def total_latency(self) -> Optional[float]:
+        return None if self.finished is None else \
+            self.finished - self.submitted
+
+
+class ContinuousBatchingScheduler:
+    """Admit → step → retire/backfill loop over ``n_slots`` lanes."""
+
+    def __init__(self, model, n_slots: int, max_new_tokens: int = 32):
+        self.model = model
+        self.n_slots = int(n_slots)
+        self.default_max_new = int(max_new_tokens)
+        model.open_slots(self.n_slots)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._active: Dict[int, Request] = {}
+        self._free = list(range(self.n_slots))
+        # per-lane host state fed to every step (idle lanes hold benign
+        # values: position 0, the start token, source length 1)
+        self._tokens = np.full(self.n_slots, model.start_id, np.int64)
+        self._pos = np.zeros(self.n_slots, np.int64)
+        self._src_len = np.ones(self.n_slots, np.int32)
+        self._steps = 0
+        self._finished: List[Request] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, src_tokens, max_new_tokens: Optional[int] = None
+               ) -> Request:
+        src_cap = getattr(self.model, "src_len", None)
+        if src_cap is not None and len(np.asarray(src_tokens)) > src_cap:
+            # reject HERE, synchronously in the caller's thread — a
+            # too-long prompt failing inside the serve loop would kill
+            # the loop for every other in-flight request
+            raise ValueError(
+                f"submit: prompt length {len(np.asarray(src_tokens))} "
+                f"exceeds the model's src_len {src_cap}")
+        cap = getattr(self.model, "max_out_len", self.default_max_new)
+        req = Request(src_tokens,
+                      min(max_new_tokens or self.default_max_new, cap))
+        with self._work:
+            self._queue.append(req)
+            self._work.notify()
+        return req
+
+    # -- the loop ------------------------------------------------------------
+    def _admit_pending(self) -> int:
+        """Admit queued requests into free slots.  The model's prefill
+        dispatch runs OUTSIDE the lock (only the loop thread touches the
+        model), so concurrent submit() callers never stall behind a
+        device dispatch."""
+        admitted = 0
+        while True:
+            with self._lock:
+                if not (self._free and self._queue):
+                    return admitted
+                req = self._queue.popleft()
+                slot = self._free.pop()
+            try:
+                s_true = self.model.admit_slot(slot, req.src)
+            except BaseException as e:
+                # fail THIS request, give the slot back, keep serving —
+                # one bad prompt must not leak capacity or kill the loop
+                with self._lock:
+                    self._free.append(slot)
+                    req.error = e
+                    req.finished = time.perf_counter()
+                    self._finished.append(req)
+                req._done.set()
+                continue
+            with self._lock:
+                req.slot = slot
+                req.admitted = time.perf_counter()
+                self._active[slot] = req
+                self._tokens[slot] = self.model.start_id
+                self._pos[slot] = 0
+                self._src_len[slot] = s_true
+            admitted += 1
+
+    def _retire_locked(self, slot: int, req: Request) -> None:
+        # no device work in here (submit() blocks on this lock): the
+        # lane's caches stay stale until the next admit_slot, which
+        # re-zeroes them before use — lanes are row-independent, so a
+        # stale lane decoding garbage contaminates nothing
+        req.finished = time.perf_counter()
+        del self._active[slot]
+        self._tokens[slot] = self.model.start_id
+        self._pos[slot] = 0
+        self._src_len[slot] = 1
+        self._free.append(slot)
+        self._finished.append(req)
+        req._done.set()
+
+    def step_once(self) -> bool:
+        """Admit what fits, run ONE lockstep decode step, retire finished
+        lanes.  Returns False when there was nothing to do."""
+        self._admit_pending()
+        with self._lock:
+            if not self._active:
+                return False
+            tokens = self._tokens.copy()
+            pos = self._pos.copy()
+            src_len = self._src_len.copy()
+        try:
+            nxt = self.model.step_slots(tokens, pos, src_len)
+        except BaseException as e:
+            self._fail_in_flight(e)
+            return True
+        with self._lock:
+            self._steps += 1
+            for slot, req in list(self._active.items()):
+                tok = int(nxt[slot])
+                req.tokens.append(tok)
+                self._tokens[slot] = tok
+                self._pos[slot] += 1
+                if tok == self.model.end_id or \
+                        len(req.tokens) >= req.max_new_tokens:
+                    self._retire_locked(slot, req)
+        return True
+
+    def _fail_in_flight(self, exc: BaseException) -> None:
+        """A step dispatch failed: fail every in-flight request with the
+        error (their cache lanes are in an unknown state), free the
+        slots, and keep the loop alive for future traffic."""
+        with self._lock:
+            for slot, req in list(self._active.items()):
+                req.error = exc
+                self._retire_locked(slot, req)
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> int:
+        """Drive the loop inline until queue and slots drain; returns the
+        number of decode steps executed."""
+        steps = 0
+        while self.step_once():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    # -- threaded serving ----------------------------------------------------
+    def serve(self) -> "ContinuousBatchingScheduler":
+        """Start the admit/step loop on a daemon thread; returns self."""
+        if self._thread is not None:
+            raise RuntimeError("serve() already running")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    busy = self.step_once()
+                except BaseException as e:     # pragma: no cover - belt
+                    # and braces: step_once contains model failures
+                    # itself; anything else must not silently kill the
+                    # serving thread and strand every waiter
+                    self._fail_in_flight(e)
+                    busy = True
+                if not busy:
+                    with self._work:
+                        if not self._queue and not self._active:
+                            self._work.wait(timeout=0.05)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serving-scheduler")
+        self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    # -- accounting ----------------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            done = list(self._finished)
+            out: Dict[str, object] = {
+                "steps": self._steps,
+                "finished": len(done),
+                "queued": len(self._queue),
+                "in_flight": len(self._active),
+            }
+        out["failed"] = sum(1 for r in done if r.error is not None)
+        # latency percentiles cover successfully served requests only (a
+        # request failed at admission has no admitted timestamp)
+        ok = [r for r in done if r.error is None]
+        if ok:
+            total = np.asarray([r.total_latency for r in ok])
+            queued = np.asarray([r.queue_latency for r in ok])
+            toks = sum(len(r.tokens) for r in ok)
+            span = (max(r.finished for r in ok)
+                    - min(r.submitted for r in ok)) or 1e-9
+            out.update({
+                "p50_latency_s": round(float(np.percentile(total, 50)), 4),
+                "p95_latency_s": round(float(np.percentile(total, 95)), 4),
+                "p50_queue_s": round(float(np.percentile(queued, 50)), 4),
+                "decoded_tokens": toks,
+                "decoded_tok_per_s": round(toks / span, 2),
+            })
+        return out

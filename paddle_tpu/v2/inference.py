@@ -1,5 +1,14 @@
 """v2 inference (python/paddle/v2/inference.py): run a trained topology
-forward-only over a reader/array input and collect outputs."""
+forward-only over a reader/array input and collect outputs.
+
+Serving-path caching (ISSUE 5 satellite): pruning the program, walking
+its ops for the needed feed set, and (executor-side) compiling the step
+all happen ONCE per topology — ``Inference`` derives everything in
+``__init__`` and ``infer()`` only converts rows and dispatches, and the
+one-shot ``infer(...)`` helper memoizes ``Inference`` instances per
+(output_layer, parameters) identity so repeated calls reuse the pruned
+program AND the executor's compiled-executable cache instead of
+rebuilding both from scratch per call."""
 
 from __future__ import annotations
 
@@ -26,18 +35,27 @@ class Inference:
         from .layer import _data_types
 
         self._data_types = dict(_data_types)
-
-    def infer(self, input: Sequence[tuple], feeding=None, field="value"):
-        # only feed the data layers the pruned program still reads; restrict
-        # the feeder's data_types BEFORE conversion so the default feeding
-        # map (name -> column index) covers exactly the pruned inputs —
-        # label-less inference rows then need no explicit feeding map, like
-        # the reference whose topology exposes only reachable data layers.
+        # derive the pruned feed surface ONCE: the set of vars the pruned
+        # ops still read, and the restricted feeder type map (re-walking
+        # the block per infer() call was per-request python cost on the
+        # serving path)
         needed = set()
         for op in self._program.global_block().desc.ops:
             for names in op.inputs.values():
                 needed |= set(names)
-        types = {k: v for k, v in self._data_types.items() if k in needed}
+        self._needed = needed
+        self._types = {k: v for k, v in self._data_types.items()
+                       if k in needed}
+        self._feeders = {}      # feeding-map signature -> DataFeeder
+
+    def infer(self, input: Sequence[tuple], feeding=None, field="value"):
+        # only feed the data layers the pruned program still reads; the
+        # restricted data_types map (derived once in __init__) keeps the
+        # default feeding map (name -> column index) covering exactly the
+        # pruned inputs — label-less inference rows then need no explicit
+        # feeding map, like the reference whose topology exposes only
+        # reachable data layers.
+        types = self._types
         rows = list(input)
         # callers may still pass FULL training rows (all declared columns,
         # label included) — detect by row width and keep the full default
@@ -53,8 +71,12 @@ class Inference:
                     f"the topology declares {len(self._data_types)} "
                     f"({sorted(self._data_types)}); pass an explicit "
                     "feeding= map")
-        feeder = DataFeeder(types, feeding)
-        feed = {k: v for k, v in feeder(rows).items() if k in needed}
+        fkey = (types is self._data_types, None if feeding is None
+                else tuple(sorted(feeding.items())))
+        feeder = self._feeders.get(fkey)
+        if feeder is None:
+            feeder = self._feeders[fkey] = DataFeeder(types, feeding)
+        feed = {k: v for k, v in feeder(rows).items() if k in self._needed}
         with fluid.scope_guard(self._params.scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=[v.name for v in self._outputs],
@@ -70,8 +92,44 @@ class Inference:
         return outs[0] if len(outs) == 1 else outs
 
 
+# The memo lives ON the Parameters object (not a module global): when
+# the caller drops its Parameters — and with it the model's weight
+# scope — every cached Inference for it is collected too, so the memo
+# can never pin dead models in memory.  Entries key on the topology's
+# identity, verified through a weakref so a recycled id() can't alias.
+_INFER_CACHE_ATTR = "_v2_infer_cache"
+_INFER_CACHE_CAP = 8
+
+
+def _cached_inference(output_layer, parameters: Parameters) -> Inference:
+    import weakref
+
+    cache = getattr(parameters, _INFER_CACHE_ATTR, None)
+    if cache is None:
+        cache = {}
+        setattr(parameters, _INFER_CACHE_ATTR, cache)
+    outs = (list(output_layer) if isinstance(output_layer, (list, tuple))
+            else [output_layer])
+    key = tuple(id(o) for o in outs)
+    hit = cache.get(key)
+    # EVERY element re-verified through its weakref: a recycled id() of
+    # any output var must not alias a stale entry
+    if hit is not None and all(r() is o for r, o in zip(hit[0], outs)):
+        return hit[1]
+    for k, (refs, _) in list(cache.items()):   # drop dead topologies
+        if any(r() is None for r in refs):
+            del cache[k]
+    inst = Inference(output_layer, parameters)
+    cache[key] = (tuple(weakref.ref(o) for o in outs), inst)
+    while len(cache) > _INFER_CACHE_CAP:
+        del cache[next(iter(cache))]
+    return inst
+
+
 def infer(output_layer, parameters: Parameters, input, feeding=None,
           field="value"):
-    """reference inference.py:125 — one-shot helper."""
-    return Inference(output_layer, parameters).infer(input, feeding=feeding,
-                                                     field=field)
+    """reference inference.py:125 — one-shot helper.  Memoized per
+    (output_layer, parameters): repeated calls reuse the pruned program
+    and compiled executables instead of re-pruning and re-compiling."""
+    return _cached_inference(output_layer, parameters).infer(
+        input, feeding=feeding, field=field)

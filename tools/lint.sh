@@ -91,6 +91,20 @@ def resnet():
 build("digits_conv", digits_conv)
 build("word2vec", w2v)
 build("resnet_cifar", resnet)
+
+# serving sweep (ISSUE 5): the KV-cache decode-step program — cache_write /
+# decode_attention ops + the in-graph greedy head — must stay analyzer-clean
+from paddle_tpu.serving import TransformerGenerator
+
+gen = TransformerGenerator(30, 30, n_layer=2, n_head=2, d_key=4, d_value=4,
+                           d_model=16, d_inner_hid=32, max_length=64,
+                           src_len=8, max_out_len=8, param_prefix="tfs",
+                           place=fluid.CPUPlace())
+step_prog, _, next_ids, _ = gen._step
+with open(os.path.join(tmpdir, "serving_step.json"), "wb") as f:
+    f.write(step_prog.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "serving_step.fetch"), "w") as f:
+    f.write(next_ids.name + "\n")
 EOF
   for prog in "$tmpdir"/*.json; do
     name="$(basename "$prog" .json)"
