@@ -22,6 +22,7 @@ __all__ = [
     "softmax_with_cross_entropy", "smooth_l1", "l2_normalize", "split",
     "nce", "im2sequence", "beam_search", "beam_search_decode", "batch_gather",
     "gather", "expand", "multiplex", "fused_attention", "decode_attention",
+    "ragged_decode_attention",
     "pad", "crop", "lod_reset", "lrn", "label_smooth", "rank_loss",
     "margin_rank_loss", "log_loss", "conv_shift", "row_conv",
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
@@ -877,6 +878,32 @@ def decode_attention(q, k_cache, v_cache, lengths, sm_scale=None,
                      {"Q": q, "KCache": k_cache, "VCache": v_cache,
                       "Lengths": lengths},
                      {"Out": out}, attrs)
+    return out
+
+
+def ragged_decode_attention(q, pool, page_table, lengths, q_base=None,
+                            layer=0, n_layer=1, causal=True, sm_scale=None,
+                            impl=None, name=None):
+    """Attention of per-lane query blocks against the paged KV pool,
+    walking each lane's page list (ops/cache_ops.ragged_decode_attention;
+    the Pallas kernel lives in kernels/flash_attention).  q [B, C, H, D]
+    (C=1 steady-state decode, C=chunk during chunked prefill), pool
+    [H, R, page_size, D], page_table [B, P] int32 logical pages, lengths
+    [B] int32 live positions, q_base [B] int32 global query start
+    (required when causal)."""
+    helper = LayerHelper("ragged_decode_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype, stop_gradient=True)
+    attrs = {"layer": int(layer), "n_layer": int(n_layer),
+             "causal": bool(causal)}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    if impl is not None:
+        attrs["impl"] = impl
+    inputs = {"Q": q, "Pool": pool, "PageTable": page_table,
+              "Lengths": lengths}
+    if q_base is not None:
+        inputs["QBase"] = q_base
+    helper.append_op("ragged_decode_attention", inputs, {"Out": out}, attrs)
     return out
 
 

@@ -7,7 +7,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["create_tensor", "create_global_var", "fill_constant",
            "fill_constant_batch_size_like", "zeros", "ones", "concat",
-           "sums", "assign", "cast", "argmax", "isfinite", "cache_write"]
+           "sums", "assign", "cast", "argmax", "isfinite", "cache_write",
+           "paged_cache_write", "paged_page_copy"]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -106,6 +107,36 @@ def cache_write(cache, value, index, axis=1, out=None):
     helper.append_op("cache_write",
                      {"Cache": cache, "Value": value, "Index": index},
                      {"Out": out}, {"axis": int(axis)})
+    return out
+
+
+def paged_cache_write(pool, k, v, pages, offsets, layer, n_layer, out=None):
+    """Scatter one layer's K/V token values into the paged KV pool
+    (ops/cache_ops.paged_cache_write).  ``k``/``v`` [B, C, H, D] ride
+    head-interleaved; ``pages``/``offsets`` [B, C] int32 map each token
+    to (logical page, slot).  Like ``cache_write``, Out defaults to the
+    pool variable itself so donation makes it an in-place HBM scatter."""
+    helper = LayerHelper("paged_cache_write")
+    out = out or pool
+    out.stop_gradient = True
+    helper.append_op("paged_cache_write",
+                     {"Pool": pool, "K": k, "V": v, "Pages": pages,
+                      "Offsets": offsets},
+                     {"Out": out},
+                     {"layer": int(layer), "n_layer": int(n_layer)})
+    return out
+
+
+def paged_page_copy(pool, src, dst, n_layer, out=None):
+    """Whole-page device copy ``src[b] -> dst[b]`` (all layers, K and V)
+    — the in-dispatch half of copy-on-write page sharing.  ``src == dst``
+    encodes a per-lane no-op (ops/cache_ops.paged_page_copy)."""
+    helper = LayerHelper("paged_page_copy")
+    out = out or pool
+    out.stop_gradient = True
+    helper.append_op("paged_page_copy",
+                     {"Pool": pool, "Src": src, "Dst": dst},
+                     {"Out": out}, {"n_layer": int(n_layer)})
     return out
 
 

@@ -72,3 +72,81 @@ def decode_attention(ctx, q, k_cache, v_cache, lengths):
 
     sm_scale = ctx.attr("sm_scale", None)
     return _da(q, k_cache, v_cache, lengths, sm_scale=sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV-cache ops (ISSUE 6).  The pool is ONE persistable tensor
+# [H, R, page_size, D]; a *logical* page spans every layer and K+V of a
+# page_size-token span (physical row = (page*n_layer + layer)*2 (+1 for
+# V) — kernels/flash_attention.paged_kv_rows is the single source of
+# truth for that arithmetic).  Logical page 0 is the reserved trash page
+# dead lanes write into, so one compiled program serves any mix of
+# prefilling / decoding / idle lanes without recompiling.
+# ---------------------------------------------------------------------------
+
+
+@primitive("paged_cache_write",
+           inputs=["Pool", "K", "V", "Pages", "Offsets"], outputs=["Out"],
+           no_grad=True)
+def paged_cache_write(ctx, pool, k, v, pages, offsets):
+    """Scatter one layer's K/V for up to C tokens per lane into the
+    paged pool.
+
+    ``k``/``v`` [B, C, H, D] head-interleaved values, ``pages`` [B, C]
+    int32 logical page per token, ``offsets`` [B, C] int32 slot within
+    the page.  Attrs ``layer``/``n_layer`` resolve logical pages to
+    physical rows.  Out aliases Pool (the cache_write ParamOut idiom):
+    under donation this is an in-place HBM scatter; a traced page id
+    never recompiles."""
+    from ...kernels.flash_attention import paged_kv_rows
+
+    layer = int(ctx.attr("layer", 0))
+    n_layer = int(ctx.attr("n_layer", 1))
+    pages = jnp.asarray(pages).astype(jnp.int32)
+    offsets = jnp.asarray(offsets).astype(jnp.int32)
+    if pages.ndim == 1:               # one token per lane (decode step)
+        pages = pages[:, None]
+        offsets = offsets[:, None]
+        k = k if k.ndim == 4 else k[:, None]
+        v = v if v.ndim == 4 else v[:, None]
+    k_rows, v_rows = paged_kv_rows(pages, layer, n_layer)
+    # pool[h, rows[b,c], offs[b,c]] <- value[b,c,h,:]  (head-major pool)
+    kt = jnp.transpose(k.astype(pool.dtype), (2, 0, 1, 3))
+    vt = jnp.transpose(v.astype(pool.dtype), (2, 0, 1, 3))
+    pool = pool.at[:, k_rows, offsets].set(kt)
+    return pool.at[:, v_rows, offsets].set(vt)
+
+
+@primitive("ragged_decode_attention",
+           inputs=["Q", "Pool", "PageTable", "Lengths", "QBase?"],
+           outputs=["Out"], no_grad=True)
+def ragged_decode_attention(ctx, q, pool, page_table, lengths, q_base):
+    """Per-lane attention over the lane's page list — see
+    kernels/flash_attention.ragged_decode_attention (q [B, C, H, D],
+    pool [H, R, page_size, D], page_table [B, P] int32 logical pages,
+    lengths [B], optional q_base [B] for causal chunk queries)."""
+    from ...kernels.flash_attention import ragged_decode_attention as _ra
+
+    return _ra(q, pool, page_table, lengths, q_base,
+               layer=int(ctx.attr("layer", 0)),
+               n_layer=int(ctx.attr("n_layer", 1)),
+               causal=bool(ctx.attr("causal", True)),
+               sm_scale=ctx.attr("sm_scale", None),
+               impl=ctx.attr("impl", None))
+
+
+@primitive("paged_page_copy", inputs=["Pool", "Src", "Dst"],
+           outputs=["Out"], no_grad=True)
+def paged_page_copy(ctx, pool, src, dst):
+    """Copy whole logical pages (all layers, K and V) ``src[b] ->
+    dst[b]`` — the device half of copy-on-write: beam lanes that share a
+    parent's partially-filled page get their own copy IN the step
+    dispatch before writing.  ``src == dst`` rows are identity writes
+    (the no-op encoding for lanes that don't need a copy this step)."""
+    n_layer = int(ctx.attr("n_layer", 1))
+    src = jnp.asarray(src).astype(jnp.int32).reshape(-1)
+    dst = jnp.asarray(dst).astype(jnp.int32).reshape(-1)
+    span = jnp.arange(2 * n_layer, dtype=jnp.int32)[None, :]
+    src_rows = src[:, None] * (2 * n_layer) + span        # [B, 2L]
+    dst_rows = dst[:, None] * (2 * n_layer) + span
+    return pool.at[:, dst_rows].set(pool[:, src_rows])

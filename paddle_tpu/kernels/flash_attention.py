@@ -42,6 +42,12 @@ try:  # pallas TPU backend is absent on some CPU-only builds
 except ImportError:  # pragma: no cover
     pltpu = None
 
+# jax renamed TPUCompilerParams -> CompilerParams across versions; accept
+# either so interpret-mode tests run on every toolchain in the fleet
+_COMPILER_PARAMS_CLS = None if pltpu is None else (
+    getattr(pltpu, "CompilerParams", None)
+    or getattr(pltpu, "TPUCompilerParams", None))
+
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 LANES = 128   # stat tiles are [block, LANES] so no sublane transposes occur
 
@@ -53,7 +59,8 @@ LANES = 128   # stat tiles are [block, LANES] so no sublane transposes occur
 # Tests monkeypatch this to 0 to exercise the kernels at tiny shapes.
 PALLAS_BWD_MIN_L = 1024
 
-__all__ = ["flash_attention", "decode_attention"]
+__all__ = ["flash_attention", "decode_attention", "ragged_decode_attention",
+           "paged_kv_rows"]
 
 
 def decode_attention(q, k_cache, v_cache, lengths,
@@ -88,6 +95,219 @@ def decode_attention(q, k_cache, v_cache, lengths,
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
                      v_cache, preferred_element_type=jnp.float32)
     return ctx.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged decode attention (serving paged-KV hot path)
+# ---------------------------------------------------------------------------
+#
+# The paged KV pool is ONE persistable tensor [H, R, page_size, D]
+# (head-major — the layout the TPU paged-attention kernels index, so a
+# one-page block's trailing dims are (page_size, D), never a sub-lane
+# (1, d) tile).  A *logical* page spans every layer and both K and V of
+# a page_size-token span: physical row = (page * n_layer + layer) * 2
+# (+1 for V).  Per-request block tables hold logical page ids; row 0's
+# logical page 0 is the reserved trash page dead lanes write into.
+
+
+def paged_kv_rows(page_table, layer: int, n_layer: int):
+    """Logical page table [B, P] -> (k_rows, v_rows) physical row tables
+    for one layer.  Pure index arithmetic — shared by the XLA fallback,
+    the Pallas index maps, and the paged write op so the three can never
+    disagree on the pool layout."""
+    base = (jnp.asarray(page_table).astype(jnp.int32) * n_layer + layer) * 2
+    return base, base + 1
+
+
+def _ragged_mask(scores, lengths_b, base_b, p0, n_cols, causal, c):
+    """[C, n_cols] additive mask for global key positions p0..p0+n_cols
+    against live length ``lengths_b`` and (optionally) causal position
+    ``base_b + row``."""
+    cols = p0 + jax.lax.broadcasted_iota(jnp.int32, (c, n_cols), 1)
+    keep = cols < lengths_b
+    if causal:
+        rows = base_b + jax.lax.broadcasted_iota(jnp.int32, (c, n_cols), 0)
+        keep = jnp.logical_and(keep, cols <= rows)
+    return jnp.where(keep, scores, -1e9)
+
+
+def _ragged_xla(q, pool, page_table, lengths, q_base, layer, n_layer,
+                causal, sm_scale):
+    """Gather-based fallback: resolve each lane's pages to pool rows and
+    run length/causally-masked attention over the gathered prefix."""
+    h, _r, ps, d = pool.shape
+    b, c, _h, _d = q.shape
+    n_pages = page_table.shape[1]
+    k_rows, v_rows = paged_kv_rows(page_table, layer, n_layer)
+    k = pool[:, k_rows]                       # [h, B, P, ps, d]
+    v = pool[:, v_rows]
+    scores = jnp.einsum("bqhd,hbpsd->bhqps", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores.reshape(b, h, c, n_pages * ps).astype(jnp.float32)
+    scores = scores * jnp.float32(sm_scale)
+    cols = jnp.arange(n_pages * ps, dtype=jnp.int32)
+    keep = cols[None, :] < lengths.astype(jnp.int32)[:, None]     # [B, L]
+    if causal:
+        rows = (q_base.astype(jnp.int32)[:, None]
+                + jnp.arange(c, dtype=jnp.int32)[None, :])        # [B, C]
+        keep = jnp.logical_and(keep[:, None, :],
+                               cols[None, None, :] <= rows[:, :, None])
+        keep = keep[:, None]                                      # [B,1,C,L]
+    else:
+        keep = keep[:, None, None, :]
+    scores = jnp.where(keep, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # a fully-masked row (dead lane, lengths==0) must return 0, matching
+    # the Pallas kernel's dead-row contract — not the garbage mean a
+    # uniform softmax over -1e9 scores would produce
+    dead = jnp.logical_not(keep.any(axis=-1))                     # [B,?,C]
+    probs = jnp.where(dead[..., None], 0.0, probs)
+    probs = probs.reshape(b, h, c, n_pages, ps)
+    ctx = jnp.einsum("bhqps,hbpsd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return ctx.astype(q.dtype)
+
+
+def _ragged_kernel(krows_ref, vrows_ref, meta_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_scr, l_scr, acc_scr,
+                   *, h, c, ps, n_pages, causal, sm_scale):
+    """grid (B, P): per lane, walk its page list (scalar-prefetched
+    block table drives the k/v index maps) with an online softmax.
+    q rides head-major [B, h*C, d]; scratch rows j*C..(j+1)*C hold head
+    j's running stats."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = meta_ref[0, b]
+    base = meta_ref[1, b]
+
+    @pl.when(p * ps < length)
+    def _page():
+        q = q_ref[0]                       # [h*C, d]
+        k = k_ref[:, 0]                    # [h, ps, d]
+        v = v_ref[:, 0]
+        p0 = p * ps
+        for j in range(h):                 # static head loop
+            qj = q[j * c:(j + 1) * c]      # [C, d]
+            s = jax.lax.dot_general(qj, k[j], (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * sm_scale
+            s = _ragged_mask(s, length, base, p0, ps, causal, c)
+            m_prev = m_scr[j * c:(j + 1) * c]              # [C, LANES]
+            l_prev = l_scr[j * c:(j + 1) * c]
+            m_cur = jnp.max(s, axis=1)[:, None]
+            m_new = jnp.maximum(m_prev,
+                                jnp.broadcast_to(m_cur, m_prev.shape))
+            alpha = jnp.exp(m_prev - m_new)
+            pr = jnp.exp(s - m_new[:, :1])
+            l_new = alpha * l_prev + jnp.broadcast_to(
+                jnp.sum(pr, axis=1)[:, None], l_prev.shape)
+            m_scr[j * c:(j + 1) * c] = m_new
+            l_scr[j * c:(j + 1) * c] = l_new
+            pv = jax.lax.dot_general(pr.astype(v.dtype), v[j],
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            acc_scr[j * c:(j + 1) * c] = (
+                acc_scr[j * c:(j + 1) * c] * alpha[:, :1] + pv)
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        l_fin = l_scr[...]
+        dead = l_fin == 0.0                # lane with lengths==0
+        denom = jnp.where(dead, 1.0, l_fin)
+        out = jnp.where(dead[:, :1], 0.0, acc_scr[...] / denom[:, :1])
+        _st(o_ref, out.astype(o_ref.dtype))
+
+
+def _ragged_pallas(q, pool, page_table, lengths, q_base, layer, n_layer,
+                   causal, sm_scale, interpret):
+    h, _r, ps, d = pool.shape
+    b, c, _h, _d = q.shape
+    n_pages = page_table.shape[1]
+    k_rows, v_rows = paged_kv_rows(page_table, layer, n_layer)
+    meta = jnp.stack([jnp.asarray(lengths, jnp.int32).reshape(b),
+                      jnp.asarray(q_base, jnp.int32).reshape(b)])
+    # head-major query rows: head j's C queries are contiguous
+    qk = jnp.transpose(q, (0, 2, 1, 3)).reshape(b, h * c, d)
+
+    def q_map(bi, pi, kr, vr, mt):
+        return (bi, 0, 0)
+
+    def k_map(bi, pi, kr, vr, mt):
+        return (0, kr[bi, pi], 0, 0)
+
+    def v_map(bi, pi, kr, vr, mt):
+        return (0, vr[bi, pi], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, h * c, d), q_map),
+            pl.BlockSpec((h, 1, ps, d), k_map),
+            pl.BlockSpec((h, 1, ps, d), v_map),
+        ],
+        out_specs=pl.BlockSpec((1, h * c, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((h * c, LANES), jnp.float32),
+            pltpu.VMEM((h * c, LANES), jnp.float32),
+            pltpu.VMEM((h * c, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_ragged_kernel, h=h, c=c, ps=ps,
+                               n_pages=n_pages, causal=causal,
+                               sm_scale=sm_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h * c, d), q.dtype),
+        compiler_params=_COMPILER_PARAMS_CLS(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(k_rows, v_rows, meta, qk, pool, pool)
+    return jnp.transpose(out.reshape(b, h, c, d), (0, 2, 1, 3))
+
+
+def ragged_decode_attention(q, pool, page_table, lengths, q_base=None,
+                            *, layer: int, n_layer: int, causal: bool = True,
+                            sm_scale: Optional[float] = None,
+                            impl: Optional[str] = None) -> jax.Array:
+    """Attention of per-lane query blocks against a paged KV pool.
+
+    Shapes:
+        q           [B, C, H, D]  (C = 1 steady-state decode; C = chunk
+                                   size during chunked prefill)
+        pool        [H, R, page_size, D]  (see paged_kv_rows layout)
+        page_table  [B, P] int32  logical page ids (trash page 0 pads)
+        lengths     [B]    int32  live KV positions per lane
+        q_base      [B]    int32  global position of q[:, 0] (required
+                                  when causal — masks key > base + j)
+
+    Returns ctx [B, C, H, D].  Per-lane work is O(P * page_size) with
+    the page indirection resolved by the block table — bytes for pages a
+    lane never touched are never read on the Pallas path."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if causal and q_base is None:
+        raise ValueError("ragged_decode_attention: causal masking needs "
+                         "q_base (global position of the first query)")
+    if q_base is None:
+        q_base = jnp.zeros(q.shape[0], jnp.int32)
+    if impl is None:
+        impl = "pallas" if (pltpu is not None and
+                            jax.default_backend() == "tpu") else "xla"
+    if impl in ("pallas", "pallas_interpret"):
+        return _ragged_pallas(q, pool, page_table, lengths, q_base, layer,
+                              n_layer, causal, float(sm_scale),
+                              interpret=(impl == "pallas_interpret"))
+    return _ragged_xla(q, pool, page_table, lengths, q_base, layer, n_layer,
+                       causal, float(sm_scale))
 
 
 def keep_scale(seed_u32, bh, rows, cols, rate):
@@ -234,7 +454,7 @@ def _compiler_params():
     scoped-VMEM ceiling: v5e has far more physical VMEM than the default
     16 MiB scope, and 1024-blocks (the measured fwd+bwd winner at L >= 1k)
     need ~17-23 MiB once dropout's keep-mask tile joins s/p/dp."""
-    return pltpu.CompilerParams(
+    return _COMPILER_PARAMS_CLS(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
         vmem_limit_bytes=64 * 1024 * 1024)
 

@@ -20,7 +20,8 @@ import numpy as np
 from ..fluid import ParamAttr, layers
 
 __all__ = ["transformer", "encoder", "wrap_encoder", "make_attn_bias",
-           "position_encoding_init", "decode_prefill", "decode_step"]
+           "position_encoding_init", "decode_prefill", "decode_step",
+           "paged_prefill_chunk", "paged_decode_step"]
 
 
 def _nm(prefix, key):
@@ -56,7 +57,8 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head=1, dropout_rate=0.0,
                          mp_shard=False, fused=False, seq_parallel=False,
                          causal=False, prefix=None, cache=None,
-                         static_kv=None):
+                         static_kv=None, paged_cache=None,
+                         paged_static=None):
     """Reference-shape MHA: project, split heads, scaled dot-product with
     additive bias, merge heads, output projection.
 
@@ -73,6 +75,16 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
       attends over the cache prefix under the ``lengths`` mask.
       ``static_kv={"k","v","lengths"}`` — cross-attention against K/V
       projected ONCE at prefill (decode_prefill); no k/v fc here at all.
+
+    Paged decode modes (block-table page indirection over ONE pooled KV
+    tensor; see serving/paged_decoder.py):
+      ``paged_cache={"pool","table","pages","offsets","lengths","base",
+      "layer","n_layer"}`` — incremental self-attention: the chunk's K/V
+      are scattered into the pool at per-token (page, offset) and the
+      queries attend causally over the lane's page list
+      (``paged_cache_write`` + ``ragged_decode_attention``).
+      ``paged_static={"pool","table","lengths","layer","n_layer"}`` —
+      read-only cross-attention against pages written at prefill.
     """
     q_attr = _col_attr(mp_shard, _nm(prefix, "q.w"))
     o_attr = _row_attr(mp_shard, _nm(prefix, "out.w"))
@@ -90,6 +102,36 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                 ctx, [-1 if b == -1 else b, l, n_head * d_value]),
             size=d_model, bias_attr=False, num_flatten_dims=2,
             param_attr=o_attr)
+
+    if paged_cache is not None or paged_static is not None:
+        if sum(x is not None
+               for x in (cache, static_kv, paged_cache, paged_static)) > 1:
+            raise ValueError("multi_head_attention: pick ONE of cache / "
+                             "static_kv / paged_cache / paged_static")
+        q = interleave_heads(q, d_key)              # [b, lq, h, dk]
+        if paged_static is not None:
+            ps = paged_static
+            ctx = layers.ragged_decode_attention(
+                q, ps["pool"], ps["table"], ps["lengths"],
+                layer=ps["layer"], n_layer=ps["n_layer"], causal=False,
+                sm_scale=float(d_key) ** -0.5)
+        else:
+            pc = paged_cache
+            k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
+                          num_flatten_dims=2,
+                          param_attr=_col_attr(mp_shard, _nm(prefix, "k.w")))
+            v = layers.fc(input=values, size=d_value * n_head,
+                          bias_attr=False, num_flatten_dims=2,
+                          param_attr=_col_attr(mp_shard, _nm(prefix, "v.w")))
+            pool = layers.paged_cache_write(
+                pc["pool"], interleave_heads(k, d_key),
+                interleave_heads(v, d_value), pc["pages"], pc["offsets"],
+                layer=pc["layer"], n_layer=pc["n_layer"])
+            ctx = layers.ragged_decode_attention(
+                q, pool, pc["table"], pc["lengths"], pc["base"],
+                layer=pc["layer"], n_layer=pc["n_layer"], causal=True,
+                sm_scale=float(d_key) ** -0.5)
+        return merge_heads_proj(ctx)
 
     if cache is not None or static_kv is not None:
         if cache is not None and static_kv is not None:
@@ -197,11 +239,12 @@ def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0,
 
 def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
                   d_inner_hid, dropout_rate=0.0, mp_shard=False,
-                  fused=False, seq_parallel=False, prefix=None):
+                  fused=False, seq_parallel=False, prefix=None,
+                  paged_cache=None):
     attn_output = multi_head_attention(
         enc_input, enc_input, enc_input, attn_bias, d_key, d_value, d_model,
         n_head, dropout_rate, mp_shard, fused, seq_parallel,
-        prefix=_nm(prefix, "self"))
+        prefix=_nm(prefix, "self"), paged_cache=paged_cache)
     attn_output = pre_post_process_layer(enc_input, attn_output, "dan",
                                          dropout_rate,
                                          prefix=_nm(prefix, "post_self"))
@@ -215,12 +258,14 @@ def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
 
 def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
             d_inner_hid, dropout_rate=0.0, mp_shard=False, fused=False,
-            seq_parallel=False, prefix=None):
+            seq_parallel=False, prefix=None, paged_caches=None):
     for i in range(n_layer):
         enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
                                   d_value, d_model, d_inner_hid,
                                   dropout_rate, mp_shard, fused,
-                                  seq_parallel, prefix=_nm(prefix, f"enc{i}"))
+                                  seq_parallel, prefix=_nm(prefix, f"enc{i}"),
+                                  paged_cache=None if paged_caches is None
+                                  else paged_caches[i])
     return enc_input
 
 
@@ -228,16 +273,19 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                   n_head, d_key, d_value, d_model, d_inner_hid,
                   dropout_rate=0.0, mp_shard=False, fused=False,
                   seq_parallel=False, causal=False, prefix=None,
-                  cache=None, cross_kv=None):
+                  cache=None, cross_kv=None, paged_cache=None,
+                  paged_cross=None):
     """One decoder layer.  Training mode re-attends over the whole prefix
     (``slf_attn_bias``/``causal``); serving decode mode passes ``cache``
     (incremental self-attention against the layer's KV cache) and
-    ``cross_kv`` (prefill-computed cross K/V + source lengths)."""
+    ``cross_kv`` (prefill-computed cross K/V + source lengths) — or
+    their paged equivalents ``paged_cache``/``paged_cross``."""
     slf_attn = multi_head_attention(dec_input, dec_input, dec_input,
                                     slf_attn_bias, d_key, d_value, d_model,
                                     n_head, dropout_rate, mp_shard, fused,
                                     seq_parallel, causal=causal,
-                                    prefix=_nm(prefix, "self"), cache=cache)
+                                    prefix=_nm(prefix, "self"), cache=cache,
+                                    paged_cache=paged_cache)
     slf_attn = pre_post_process_layer(dec_input, slf_attn, "dan",
                                       dropout_rate,
                                       prefix=_nm(prefix, "post_self"))
@@ -245,7 +293,8 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                                  dec_enc_attn_bias, d_key, d_value, d_model,
                                  n_head, dropout_rate, mp_shard, fused,
                                  seq_parallel, prefix=_nm(prefix, "cross"),
-                                 static_kv=cross_kv)
+                                 static_kv=cross_kv,
+                                 paged_static=paged_cross)
     cross = pre_post_process_layer(slf_attn, cross, "dan", dropout_rate,
                                    prefix=_nm(prefix, "post_cross"))
     ffd = positionwise_feed_forward(cross, d_inner_hid, d_model, mp_shard,
@@ -258,7 +307,8 @@ def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
             dropout_rate=0.0, mp_shard=False, fused=False,
             seq_parallel=False, causal=False, prefix=None,
-            caches=None, cross_kvs=None):
+            caches=None, cross_kvs=None, paged_caches=None,
+            paged_crosses=None):
     for i in range(n_layer):
         dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
                                   dec_enc_attn_bias, n_head, d_key, d_value,
@@ -267,7 +317,11 @@ def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                                   causal=causal, prefix=_nm(prefix, f"dec{i}"),
                                   cache=None if caches is None else caches[i],
                                   cross_kv=None if cross_kvs is None
-                                  else cross_kvs[i])
+                                  else cross_kvs[i],
+                                  paged_cache=None if paged_caches is None
+                                  else paged_caches[i],
+                                  paged_cross=None if paged_crosses is None
+                                  else paged_crosses[i])
     return dec_input
 
 
@@ -480,6 +534,93 @@ def decode_step(trg_word, trg_pos, cache_index, self_lengths, src_lengths,
                          d_value, d_model, d_inner_hid, 0.0,
                          prefix=param_prefix, caches=caches,
                          cross_kvs=cross)
+    return layers.fc(input=dec_output, size=trg_vocab_size,
+                     num_flatten_dims=2, bias_attr=False,
+                     param_attr=_plain_attr(
+                         _nm(param_prefix, "vocab_proj.w")))
+
+
+def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
+                        enc_pages, cross_pages, w_offsets, pool,
+                        src_vocab_size, max_length, n_layer, n_head, d_key,
+                        d_value, d_model, d_inner_hid, param_prefix):
+    """One chunked-prefill tower step: encode up to C source tokens per
+    lane CAUSALLY against the lane's paged encoder-KV prefix, and
+    project + page-write the chunk's cross-attention K/V.
+
+    The paged serving path encodes the source causally (feed
+    ``make_attn_bias(..., causal=True)`` to the dense baseline for
+    parity) — the property that makes chunked prefill exact and prefix
+    K/V a function of the prefix alone (the soundness condition for
+    copy-on-write prefix sharing).
+
+    Feeds: ``pf_word``/``pf_pos`` [b, C] int64 (chunk tokens at GLOBAL
+    positions), ``pf_base`` [b] int32 (chunk start), ``pf_len`` [b]
+    int32 (encoded length INCLUDING this chunk), ``enc_table`` [b, P]
+    int32, ``enc_pages``/``cross_pages``/``w_offsets`` [b, C] int32
+    per-token write targets (trash page 0 for dead tokens/lanes).
+    Returns the chunk's encoder output [b, C, d_model]."""
+    if not param_prefix:
+        raise ValueError("paged_prefill_chunk requires param_prefix")
+    emb = prepare_embedding(pf_word, pf_pos, src_vocab_size, max_length,
+                            d_model, 0.0,
+                            emb_name=_nm(param_prefix, "src_emb.w"),
+                            pos_name=_nm(param_prefix, "src_pos_emb.w"))
+    paged = [{"pool": pool, "table": enc_table, "pages": enc_pages,
+              "offsets": w_offsets, "lengths": pf_len, "base": pf_base,
+              "layer": i, "n_layer": n_layer} for i in range(n_layer)]
+    enc_chunk = encoder(emb, None, n_layer, n_head, d_key, d_value,
+                        d_model, d_inner_hid, 0.0, prefix=param_prefix,
+                        paged_caches=paged)
+    b, c = enc_chunk.shape[0], enc_chunk.shape[1]
+
+    def heads(x, d_head):
+        return layers.reshape(x, [-1 if b == -1 else b, c, n_head, d_head])
+
+    for i in range(n_layer):
+        pre = _nm(param_prefix, f"dec{i}.cross")
+        k = layers.fc(input=enc_chunk, size=d_key * n_head,
+                      bias_attr=False, num_flatten_dims=2,
+                      param_attr=_plain_attr(_nm(pre, "k.w")))
+        v = layers.fc(input=enc_chunk, size=d_value * n_head,
+                      bias_attr=False, num_flatten_dims=2,
+                      param_attr=_plain_attr(_nm(pre, "v.w")))
+        pool = layers.paged_cache_write(pool, heads(k, d_key),
+                                        heads(v, d_value), cross_pages,
+                                        w_offsets, layer=i,
+                                        n_layer=n_layer)
+    return enc_chunk
+
+
+def paged_decode_step(trg_word, trg_pos, self_table, self_pages,
+                      self_offsets, self_lengths, self_base, cross_table,
+                      src_lengths, pool, trg_vocab_size, max_length,
+                      n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
+                      param_prefix):
+    """One paged incremental decode step — the page-indirected analog of
+    ``decode_step``: each lane's token K/V lands in its self pages
+    (``self_pages``/``self_offsets`` [b, 1] int32) and attention walks
+    ``self_table``/``cross_table`` [b, P] int32 under ``self_lengths``/
+    ``src_lengths`` masks.  Returns logits [b, 1, vocab]."""
+    if not param_prefix:
+        raise ValueError("paged_decode_step requires param_prefix")
+    emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size, max_length,
+                            d_model, 0.0,
+                            emb_name=_nm(param_prefix, "trg_emb.w"),
+                            pos_name=_nm(param_prefix, "trg_pos_emb.w"))
+    emb = layers.reshape(emb, [-1, 1, d_model])
+    paged_caches = [{"pool": pool, "table": self_table,
+                     "pages": self_pages, "offsets": self_offsets,
+                     "lengths": self_lengths, "base": self_base,
+                     "layer": i, "n_layer": n_layer}
+                    for i in range(n_layer)]
+    paged_crosses = [{"pool": pool, "table": cross_table,
+                      "lengths": src_lengths, "layer": i,
+                      "n_layer": n_layer} for i in range(n_layer)]
+    dec_output = decoder(emb, None, None, None, n_layer, n_head, d_key,
+                         d_value, d_model, d_inner_hid, 0.0,
+                         prefix=param_prefix, paged_caches=paged_caches,
+                         paged_crosses=paged_crosses)
     return layers.fc(input=dec_output, size=trg_vocab_size,
                      num_flatten_dims=2, bias_attr=False,
                      param_attr=_plain_attr(

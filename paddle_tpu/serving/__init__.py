@@ -25,12 +25,23 @@ This package is the TPU-native replacement:
   admitting prompts into fixed in-flight batch slots with per-slot done
   masks; finished sequences retire and new requests backfill their slot
   without recompilation; ``serve()`` runs the loop on a thread with
-  per-request latency accounting.
+  per-request latency accounting.  Page-aware models are admitted by
+  page budget (admit while free pages last; structurally infeasible
+  prompts reject with ``PoolCapacityError`` instead of hanging).
+* ``PagedTransformerGenerator`` (paged_decoder.py) + ``PageAllocator``
+  (paging.py) — the ISSUE-6 tentpole: block-table paged KV over ONE
+  pooled tensor, a Pallas ragged decode-attention kernel, chunked
+  causal prefill interleaved with decode in one compiled dispatch, and
+  copy-on-write prefix sharing with refcounts.  The dense decoder stays
+  as the differential parity baseline.
 """
 
 from .engine import InferenceEngine  # noqa: F401
 from .decoder import FullRerunDecoder, TransformerGenerator  # noqa: F401
+from .paged_decoder import PagedTransformerGenerator  # noqa: F401
+from .paging import PageAllocator, PoolCapacityError  # noqa: F401
 from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
 
 __all__ = ["InferenceEngine", "TransformerGenerator", "FullRerunDecoder",
-           "ContinuousBatchingScheduler", "Request"]
+           "PagedTransformerGenerator", "PageAllocator",
+           "PoolCapacityError", "ContinuousBatchingScheduler", "Request"]

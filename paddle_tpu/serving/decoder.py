@@ -88,6 +88,17 @@ class _Cfg:
         self.max_length = max_length
 
 
+def dense_kv_bytes_per_slot(cfg: "_Cfg", src_len: int,
+                            max_out_len: int) -> int:
+    """HBM one continuous-batching lane costs in the DENSE decoder:
+    worst-case cross K/V (src_len rows) + self K/V (max_out_len rows)
+    across every layer, float32 — reserved whether or not the request
+    uses it.  Shared by the dense decoder's own accounting and the paged
+    decoder's baseline comparison so the two can never drift."""
+    return (cfg.n_layer * cfg.n_head * (cfg.d_key + cfg.d_value) * 4
+            * (src_len + max_out_len))
+
+
 class TransformerGenerator:
     """Serving-side Transformer decoder over KV caches.
 
@@ -106,7 +117,7 @@ class TransformerGenerator:
                  d_inner_hid=2048, max_length=256, src_len=64,
                  max_out_len=64, scope=None, executor=None, place=None,
                  param_prefix="tf", start_id=0, end_id=1, src_bucket=8,
-                 topk_size=None):
+                 topk_size=None, causal_encoder=False):
         self.cfg = _Cfg(src_vocab_size, trg_vocab_size, n_layer, n_head,
                         d_key, d_value, d_model, d_inner_hid, max_length)
         self.src_len = int(src_len)
@@ -114,6 +125,11 @@ class TransformerGenerator:
         self.prefix = param_prefix
         self.start_id = int(start_id)
         self.end_id = int(end_id)
+        # causal_encoder is a FEED-level switch (the source attention
+        # bias gains the causal triangle): the math the paged serving
+        # path computes chunk-by-chunk, so parity tests run the dense
+        # decoder with causal_encoder=True as the differential baseline
+        self.causal_encoder = bool(causal_encoder)
         self.src_bucket = max(1, int(src_bucket))
         self.topk_size = topk_size
         self.scope = scope or fluid.Scope()
@@ -290,8 +306,8 @@ class TransformerGenerator:
         prog, _, fetches = self._prefills.get(s) or self._build_prefill(s)
         feed = {"src_word": src_tokens.astype(np.int64),
                 "src_pos": np.tile(np.arange(s, dtype=np.int64), (b, 1)),
-                "src_slf_attn_bias": T.make_attn_bias(src_lengths, s,
-                                                      c.n_head)}
+                "src_slf_attn_bias": T.make_attn_bias(
+                    src_lengths, s, c.n_head, causal=self.causal_encoder)}
         with fluid.scope_guard(self.scope):
             outs = self.exe.run(prog, feed=feed, fetch_list=fetches,
                                 return_numpy=False, mode="infer")
@@ -503,12 +519,19 @@ class TransformerGenerator:
                                 return_numpy=False, mode="infer")
         return np.asarray(nxt).reshape(b)
 
+    def kv_bytes_per_slot(self) -> int:
+        """HBM one continuous-batching lane costs in this dense decoder
+        (the waste the paged pool removes) — see dense_kv_bytes_per_slot."""
+        return dense_kv_bytes_per_slot(self.cfg, self.src_len,
+                                       self.max_out_len)
+
     def cache_stats(self) -> Dict[str, object]:
         """Prefill bucket hit/miss counters + the executor's
         executable-cache counters (the 0-recompile assertion surface)."""
         out: Dict[str, object] = dict(self._stats)
         out["buckets"] = dict(self._buckets)
         out["executable"] = self.exe.cache_stats()["executable"]
+        out["kv_bytes_per_slot"] = self.kv_bytes_per_slot()
         return out
 
 
@@ -523,7 +546,8 @@ class FullRerunDecoder:
                  n_head=8, d_key=64, d_value=64, d_model=512,
                  d_inner_hid=2048, max_length=256, src_len=64,
                  trg_len=64, scope=None, executor=None, place=None,
-                 param_prefix="tf", start_id=0, end_id=1):
+                 param_prefix="tf", start_id=0, end_id=1,
+                 causal_encoder=False):
         self.cfg = _Cfg(src_vocab_size, trg_vocab_size, n_layer, n_head,
                         d_key, d_value, d_model, d_inner_hid, max_length)
         self.src_len = int(src_len)
@@ -531,6 +555,7 @@ class FullRerunDecoder:
         self.prefix = param_prefix
         self.start_id = int(start_id)
         self.end_id = int(end_id)
+        self.causal_encoder = bool(causal_encoder)
         self.scope = scope or fluid.Scope()
         self.exe = executor or fluid.Executor(place or fluid.TPUPlace(0))
         main, startup = fluid.Program(), fluid.Program()
@@ -565,7 +590,8 @@ class FullRerunDecoder:
             "trg_pos": np.tile(np.arange(self.trg_len, dtype=np.int64),
                                (b, 1)),
             "src_slf_attn_bias": T.make_attn_bias(
-                src_lengths, self.src_len, c.n_head),
+                src_lengths, self.src_len, c.n_head,
+                causal=self.causal_encoder),
             "trg_slf_attn_bias": T.make_attn_bias(
                 np.full(b, self.trg_len), self.trg_len, c.n_head,
                 causal=True),

@@ -108,6 +108,12 @@ class InferenceEngine:
         self.time_bucket = max(1, int(time_bucket))
         self._stats = {"bucket_hits": 0, "bucket_misses": 0}
         self._buckets: Dict[tuple, int] = {}
+        # padding honesty counters (ISSUE 6 satellite): the rows/tokens
+        # the caller actually asked for vs what the bucket dispatched —
+        # before this, padded slots were invisible in cache_stats and the
+        # dense-vs-paged HBM comparison under-counted the dense waste
+        self._padding = {"true_rows": 0, "padded_rows": 0,
+                         "true_tokens": 0, "padded_tokens": 0}
         self._warming = False
 
     # -- bucketing -----------------------------------------------------------
@@ -142,12 +148,15 @@ class InferenceEngine:
         nb = self._batch_bucket(true_b)
         padded = {}
         key: List[tuple] = [("batch", nb)]
+        pad_tokens = [0, 0]      # [true, padded] across SeqArray feeds
         for name in sorted(feed):
             v = feed[name]
             if isinstance(v, SeqArray):
                 data = np.asarray(v.data)
                 lengths = np.asarray(v.lengths, np.int32)
                 t = self._time_pad(data.shape[1])
+                pad_tokens[0] += int(np.minimum(lengths, t).sum())
+                pad_tokens[1] += nb * t
                 data = _pad_rows(_pad_time(data, t), nb)
                 lengths = _pad_rows(lengths, nb)
                 padded[name] = SeqArray(data, lengths)
@@ -166,13 +175,13 @@ class InferenceEngine:
                 a = _pad_rows(a, nb)
                 padded[name] = a
                 key.append((name, a.shape, str(a.dtype)))
-        return padded, true_b, tuple(key)
+        return padded, true_b, tuple(key), pad_tokens
 
     def bucket_key(self, feed: Dict[str, Any]) -> tuple:
         """The bucket signature this feed lands on (host-side padding
         math only, no dispatch) — lets callers enumerate the distinct
         buckets of a traffic sample for targeted warmup."""
-        _, _, key = self._pad_feed(feed)
+        _, _, key, _ = self._pad_feed(feed)
         return key
 
     # -- execution -----------------------------------------------------------
@@ -181,13 +190,18 @@ class InferenceEngine:
               return_numpy: bool = True) -> List[Any]:
         """Run one request batch through the bucketed executable; outputs
         are sliced back to the true batch size."""
-        padded, true_b, key = self._pad_feed(feed)
+        padded, true_b, key, pad_tokens = self._pad_feed(feed)
         warming = self._warming
         if not warming:
             if key in self._buckets:
                 self._stats["bucket_hits"] += 1
             else:
                 self._stats["bucket_misses"] += 1
+            nb = key[0][1]
+            self._padding["true_rows"] += true_b
+            self._padding["padded_rows"] += nb
+            self._padding["true_tokens"] += pad_tokens[0]
+            self._padding["padded_tokens"] += pad_tokens[1]
         # warm-up registers the key (count 0) without counting a request:
         # sum(buckets.values()) == bucket_hits + bucket_misses always
         self._buckets[key] = self._buckets.get(key, 0) + (0 if warming
@@ -223,10 +237,22 @@ class InferenceEngine:
 
     def cache_stats(self) -> Dict[str, Any]:
         """{'bucket_hits', 'bucket_misses', 'buckets': {key: count},
-        'executable': executor executable-cache counters}.  In steady
-        state bucket_misses and the executable miss count both stop
-        moving — the 0-recompile serving contract."""
+        'padding': true-vs-padded row/token counters, 'executable':
+        executor executable-cache counters}.  In steady state
+        bucket_misses and the executable miss count both stop moving —
+        the 0-recompile serving contract.  The padding block is the
+        honest cost of that contract: every padded row/token is compute
+        and HBM spent on data nobody asked for (what the paged cache
+        eliminates on the decode path)."""
         out: Dict[str, Any] = dict(self._stats)
         out["buckets"] = dict(self._buckets)
+        pad = dict(self._padding)
+        pad["padded_row_fraction"] = round(
+            1.0 - pad["true_rows"] / pad["padded_rows"], 4) \
+            if pad["padded_rows"] else 0.0
+        pad["padded_token_fraction"] = round(
+            1.0 - pad["true_tokens"] / pad["padded_tokens"], 4) \
+            if pad["padded_tokens"] else 0.0
+        out["padding"] = pad
         out["executable"] = self.exe.cache_stats()["executable"]
         return out

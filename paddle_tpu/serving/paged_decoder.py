@@ -1,0 +1,688 @@
+"""Paged-KV Transformer serving: block-table page indirection, chunked
+prefill, and continuous batching in ONE compiled dispatch.
+
+``TransformerGenerator`` (PR 5) provisions dense per-lane caches —
+``[B, src_len, h, d]`` cross K/V plus ``[B, max_out_len, h, d]`` self
+K/V per layer — so HBM is reserved for the worst case whether or not a
+request uses it, and decode attention reads padded garbage bytes.
+``PagedTransformerGenerator`` replaces that with the Ragged-Paged-
+Attention model (PAPERS.md, arxiv 2604.15464):
+
+* **one pooled KV tensor** ``[h, R, page_size, d]`` shared by every
+  lane, layer, and role (encoder-KV, cross-KV, decoder-self-KV) — a
+  logical page spans all layers and K+V of a page_size-token span;
+* **per-request page tables** allocated/freed by the host-side
+  ``PageAllocator`` and fed as int32 data (a new page id never
+  recompiles anything);
+* **chunked prefill**: the source is encoded CAUSALLY in fixed-size
+  chunks through the SAME compiled program that decodes in-flight
+  lanes — admission no longer stalls decode behind a monolithic
+  prefill dispatch, and there is no separate prefill executable to
+  warm (feed the dense baseline ``make_attn_bias(..., causal=True)``
+  for exact parity);
+* **prefix sharing**: full prompt chunks are content-addressed
+  (chain hashes) so identical prompt prefixes — a common system
+  prompt — map to the same physical pages with refcounts; beam lanes
+  share parent pages after each reorder with copy-on-write instead of
+  the dense path's whole-cache ``batch_gather`` copy.
+
+The dense decoder stays as the differential baseline: greedy is
+token-for-token and beam score-for-score identical (tests/
+test_paged_serving.py) when both run the causal-encoder feeds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import layers
+from ..fluid.core.lod import SeqArray
+from ..models import transformer as T
+from .decoder import _Cfg, dense_kv_bytes_per_slot
+from .paging import (PageAllocator, PoolCapacityError, TRASH_PAGE,
+                     chunk_hashes)
+
+__all__ = ["PagedTransformerGenerator"]
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class _Lane:
+    """Host bookkeeping for one in-flight slot."""
+
+    __slots__ = ("phase", "src", "s_true", "max_new", "enc_done",
+                 "pending_chunk", "enc_table", "cross_table", "self_table",
+                 "hashes", "hit_hashes", "inserted_hashes", "enc_owned",
+                 "cross_owned", "cur", "pos")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.phase = "idle"        # idle | prefill | decode | hold
+        self.src = None
+        self.s_true = 0
+        self.max_new = 0
+        self.enc_done = 0
+        self.pending_chunk = 0
+        self.enc_table: List[int] = []
+        self.cross_table: List[int] = []
+        self.self_table: List[int] = []
+        self.hashes: List[str] = []
+        self.hit_hashes: List[str] = []
+        self.inserted_hashes: List[str] = []
+        self.enc_owned: List[int] = []
+        self.cross_owned: List[int] = []
+        self.cur = 0
+        self.pos = 0
+
+
+class PagedTransformerGenerator:
+    """Serving-side Transformer decoder over a paged KV pool.
+
+    Same parameter-sharing contract as ``TransformerGenerator`` (explicit
+    names under ``param_prefix``); the scheduler surface is page-aware:
+    ``open_slots / admit_slot / clear_slot / lane_step`` plus
+    ``can_admit / prompt_infeasible / pages_needed`` for admission
+    control.  ``greedy`` / ``beam`` mirror the dense front-ends for
+    parity testing and benchmarking."""
+
+    page_aware = True
+
+    def __init__(self, src_vocab_size, trg_vocab_size, *, n_layer=6,
+                 n_head=8, d_key=64, d_value=64, d_model=512,
+                 d_inner_hid=2048, max_length=256, src_len=64,
+                 max_out_len=64, scope=None, executor=None, place=None,
+                 param_prefix="tf", start_id=0, end_id=1,
+                 page_size=8, num_pages=None, chunk_size=8,
+                 prefix_sharing=True, topk_size=None):
+        if d_key != d_value:
+            raise ValueError("paged KV pool requires d_key == d_value "
+                             "(one pool row shape serves both)")
+        self.cfg = _Cfg(src_vocab_size, trg_vocab_size, n_layer, n_head,
+                        d_key, d_value, d_model, d_inner_hid, max_length)
+        self.src_len = int(src_len)
+        self.max_out_len = int(max_out_len)
+        self.prefix = param_prefix
+        self.start_id = int(start_id)
+        self.end_id = int(end_id)
+        self.page_size = int(page_size)
+        self.chunk = int(chunk_size)
+        self.prefix_sharing = bool(prefix_sharing)
+        self.topk_size = topk_size
+        self.p_src = _ceil_div(self.src_len, self.page_size)
+        self.p_out = _ceil_div(self.max_out_len, self.page_size)
+        if num_pages is None:
+            # default: room for ~8 worst-case requests (+ trash page)
+            num_pages = 8 * (2 * self.p_src + self.p_out) + 1
+        self.num_pages = int(num_pages)
+        self.alloc = PageAllocator(self.num_pages, self.page_size)
+        self.scope = scope or fluid.Scope()
+        self.exe = executor or fluid.Executor(place or fluid.TPUPlace(0))
+        self._pool_name = f"{param_prefix}@kv_pool"
+        self._pool_shape = (n_head, self.num_pages * n_layer * 2,
+                            self.page_size, d_key)
+        self.page_bytes = n_layer * 2 * self.page_size * n_head * d_key * 4
+        self._lanes: List[_Lane] = []
+        self._slots = 0
+        self._steps = 0
+        self._beam_steps: Dict[int, tuple] = {}
+        self._decode_prog = None
+        self._build_unified()
+        self._reset_pool()
+
+    # -- device pool ---------------------------------------------------------
+    def _reset_pool(self):
+        import jax.numpy as jnp
+
+        self.scope.set_var(self._pool_name,
+                           jnp.zeros(self._pool_shape, jnp.float32))
+
+    def _pool_var(self, block):
+        return block.create_var(name=self._pool_name,
+                                shape=list(self._pool_shape),
+                                dtype="float32", persistable=True)
+
+    # -- program builders ----------------------------------------------------
+    def _build_unified(self):
+        """ONE program = one dispatch: the chunked-prefill tower (causal
+        encoder chunk + cross-KV page writes) AND the paged decode step
+        over every lane.  Lanes not in a given phase ride along with
+        trash-page writes and length-1 masks — so any mix of admitting /
+        prefilling / decoding lanes replays the same executable."""
+        c = self.cfg
+        C = self.chunk
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+            pool = self._pool_var(prog.global_block())
+            pf_word = layers.data("pf_word", [C], "int64")
+            pf_pos = layers.data("pf_pos", [C], "int64")
+            pf_base = layers.data("pf_base", [], "int32")
+            pf_len = layers.data("pf_len", [], "int32")
+            enc_table = layers.data("enc_table", [self.p_src], "int32")
+            enc_pages = layers.data("enc_pages", [C], "int32")
+            cross_pages = layers.data("cross_pages", [C], "int32")
+            w_offsets = layers.data("w_offsets", [C], "int32")
+            T.paged_prefill_chunk(
+                pf_word, pf_pos, pf_base, pf_len, enc_table, enc_pages,
+                cross_pages, w_offsets, pool, c.src_vocab_size,
+                c.max_length, c.n_layer, c.n_head, c.d_key, c.d_value,
+                c.d_model, c.d_inner_hid, self.prefix)
+            trg_word = layers.data("trg_word", [1], "int64")
+            trg_pos = layers.data("trg_pos", [1], "int64")
+            self_table = layers.data("self_table", [self.p_out], "int32")
+            self_pages = layers.data("self_pages", [1], "int32")
+            self_offsets = layers.data("self_offsets", [1], "int32")
+            self_lengths = layers.data("self_lengths", [], "int32")
+            self_base = layers.data("self_base", [], "int32")
+            cross_table = layers.data("cross_table", [self.p_src], "int32")
+            src_lengths = layers.data("src_lengths", [], "int32")
+            logits = T.paged_decode_step(
+                trg_word, trg_pos, self_table, self_pages, self_offsets,
+                self_lengths, self_base, cross_table, src_lengths, pool,
+                c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
+                c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix)
+            next_ids = layers.argmax(logits, axis=-1)
+        self._unified = (prog, startup, next_ids, logits)
+
+    def _build_beam_step(self, W: int):
+        """Paged beam step: in-dispatch copy-on-write page copies, the
+        paged decode tower, and the beam_search selection op.  NO cache
+        reorder lives in the graph — the host reassigns page tables to
+        the parents' (shared, refcounted) pages instead of the dense
+        path's whole-cache batch_gather copy."""
+        c = self.cfg
+        K = self.topk_size or min(2 * W, c.trg_vocab_size)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+            pool = self._pool_var(prog.global_block())
+            pre_ids = layers.data("pre_ids", [W], "int64")
+            pre_scores = layers.data("pre_scores", [W], "float32")
+            tok = layers.data("trg_word", [1], "int64")       # [bW, 1]
+            tp = layers.data("trg_pos", [1], "int64")
+            cow_src = layers.data("cow_src", [], "int32")
+            cow_dst = layers.data("cow_dst", [], "int32")
+            self_table = layers.data("self_table", [self.p_out], "int32")
+            self_pages = layers.data("self_pages", [1], "int32")
+            self_offsets = layers.data("self_offsets", [1], "int32")
+            self_lengths = layers.data("self_lengths", [], "int32")
+            self_base = layers.data("self_base", [], "int32")
+            cross_table = layers.data("cross_table", [self.p_src], "int32")
+            src_lengths = layers.data("src_lengths", [], "int32")
+            pool = layers.paged_page_copy(pool, cow_src, cow_dst,
+                                          n_layer=c.n_layer)
+            logits = T.paged_decode_step(
+                tok, tp, self_table, self_pages, self_offsets,
+                self_lengths, self_base, cross_table, src_lengths, pool,
+                c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
+                c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix)
+            probs = layers.softmax(
+                layers.reshape(logits, [-1, W, c.trg_vocab_size]))
+            topk_scores, topk_idx = layers.topk(probs, k=K)
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, topk_idx, topk_scores, W,
+                end_id=self.end_id)
+        self._beam_steps[W] = (prog, startup, sel_ids, sel_scores, parent)
+        return self._beam_steps[W]
+
+    def _build_backtrace(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+            ids = layers.data("ids", [1], "int64", lod_level=1)
+            scores = layers.data("scores", [1], "float32", lod_level=1)
+            parents = layers.data("parents", [1], "int32", lod_level=1)
+            sent_ids, sent_scores = layers.beam_search_decode(
+                ids, scores, parents, end_id=self.end_id)
+        self._decode_prog = (prog, sent_ids, sent_scores)
+        return self._decode_prog
+
+    # -- parameter init ------------------------------------------------------
+    def init_params(self, seed: Optional[int] = None) -> None:
+        """Random-init every parameter (the unified program touches the
+        full set: encoder, cross projections, decoder, both embeddings,
+        vocab head)."""
+        if seed is not None:
+            self._unified[1].random_seed = seed
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self._unified[1])
+
+    # -- admission accounting ------------------------------------------------
+    def _prompt_pages(self, n_tokens: int) -> int:
+        return _ceil_div(max(1, int(n_tokens)), self.page_size)
+
+    def _self_pages(self, max_new: int) -> int:
+        return _ceil_div(int(max_new), self.page_size) if max_new else 0
+
+    def _resolve_max_new(self, max_new: Optional[int]) -> int:
+        """None -> the generator's cap; 0 stays 0 (beam reserves no self
+        pages at admission — it allocates them incrementally per lane)."""
+        if max_new is None:
+            return self.max_out_len
+        return min(int(max_new), self.max_out_len)
+
+    def pages_needed(self, src_tokens, max_new: Optional[int] = None) -> int:
+        """Pages an admission would allocate right now (prompt pages for
+        chunks the prefix cache does not already hold, x2 for enc+cross,
+        plus the reserved decode pages)."""
+        src = np.asarray(src_tokens).reshape(-1)
+        mn = self._resolve_max_new(max_new)
+        hits = 0
+        if self.prefix_sharing:
+            # count=False: this is an admission PROBE (the scheduler polls
+            # it every step for a blocked queue head) — it must not skew
+            # the prefix_hit_rate that cache_stats()/bench report
+            hits = len(self.alloc.lookup_chain(
+                chunk_hashes(src, self.page_size), count=False))
+        return (2 * (self._prompt_pages(len(src)) - hits)
+                + self._self_pages(mn))
+
+    def can_admit(self, src_tokens, max_new: Optional[int] = None) -> bool:
+        return self.pages_needed(src_tokens, max_new) <= \
+            self.alloc.available()
+
+    def prompt_infeasible(self, src_tokens,
+                          max_new: Optional[int] = None) -> bool:
+        """True when the request could NEVER be admitted: its prompt +
+        reserved decode pages exceed the whole pool even with every
+        other page free (prefix hits are not assumed — they can be
+        evicted before admission)."""
+        src = np.asarray(src_tokens).reshape(-1)
+        mn = self._resolve_max_new(max_new)
+        return (2 * self._prompt_pages(len(src)) + self._self_pages(mn)
+                > self.alloc.total_usable)
+
+    # -- continuous-batching surface -----------------------------------------
+    def open_slots(self, n_slots: int) -> None:
+        if self._lanes:
+            for slot in range(len(self._lanes)):
+                self.clear_slot(slot)
+        self._slots = int(n_slots)
+        self._lanes = [_Lane() for _ in range(self._slots)]
+
+    def admit_slot(self, slot: int, src_tokens_1d,
+                   max_new: Optional[int] = None) -> int:
+        """Allocate the lane's page tables (prefix-cache hits first) and
+        queue it for chunked prefill.  NO device dispatch happens here —
+        the prefill work rides subsequent ``lane_step`` dispatches,
+        interleaved with every other lane's decode."""
+        if not self._lanes:
+            raise RuntimeError("open_slots() before admit_slot()")
+        lane = self._lanes[slot]
+        if lane.phase != "idle":
+            raise RuntimeError(f"admit_slot: slot {slot} is busy")
+        src = np.asarray(src_tokens_1d).reshape(-1).astype(np.int64)
+        s_true = len(src)
+        if s_true > self.src_len:
+            raise ValueError(
+                f"admit_slot: prompt length {s_true} exceeds the "
+                f"generator's src_len {self.src_len}; raise src_len or "
+                f"truncate explicitly at the call site")
+        mn = self._resolve_max_new(max_new)
+        if self.prompt_infeasible(src, mn):
+            raise PoolCapacityError(
+                f"request needs {2 * self._prompt_pages(s_true) + self._self_pages(mn)} "
+                f"pages for its prompt + decode reservation alone, but the "
+                f"pool only has {self.alloc.total_usable} usable pages")
+        n_prompt = self._prompt_pages(s_true)
+        hashes = chunk_hashes(src, self.page_size)
+        hits = self.alloc.lookup_chain(hashes) if self.prefix_sharing \
+            else []
+        n_hit = len(hits)
+        # ref the hit chunks BEFORE allocating: alloc() evicts LRU
+        # refcount-0 chunks under pressure, and an un-reffed hit is
+        # exactly such a chunk — referencing first pins it (and its
+        # pages) so the allocation can never evict what we just counted
+        for h, _enc, _cross in hits:
+            self.alloc.ref_chunk(h)
+        try:
+            fresh = self.alloc.alloc(2 * (n_prompt - n_hit)
+                                     + self._self_pages(mn))
+        except PoolCapacityError:
+            for h, _enc, _cross in hits:
+                self.alloc.unref_chunk(h)
+            raise
+        n_own = n_prompt - n_hit
+        lane.src = src
+        lane.s_true = s_true
+        lane.max_new = mn
+        lane.hashes = hashes
+        lane.hit_hashes = [h for h, _, _ in hits]
+        lane.inserted_hashes = []
+        lane.enc_table = [e for _, e, _ in hits] + fresh[:n_own]
+        lane.cross_table = [x for _, _, x in hits] + fresh[n_own:2 * n_own]
+        lane.self_table = fresh[2 * n_own:]
+        lane.enc_owned = fresh[:n_own]
+        lane.cross_owned = fresh[n_own:2 * n_own]
+        lane.enc_done = n_hit * self.page_size
+        lane.pending_chunk = 0
+        lane.cur = self.start_id
+        lane.pos = 0
+        if lane.enc_done >= s_true:     # whole prompt served from cache
+            lane.phase = "decode"
+        else:
+            lane.phase = "prefill"
+        return s_true
+
+    def clear_slot(self, slot: int) -> None:
+        """Retire a lane: release every page reference immediately.
+        Prefix-cached chunks drop to the evictable list (still hittable,
+        reclaimed under pressure); everything else returns to the free
+        list."""
+        lane = self._lanes[slot]
+        if lane.phase == "idle":
+            return
+        for h in lane.hit_hashes + lane.inserted_hashes:
+            self.alloc.unref_chunk(h)
+        for p in lane.enc_owned + lane.cross_owned:
+            self.alloc.unref(p)
+        for p in lane.self_table:
+            self.alloc.unref(p)
+        lane.reset()
+
+    def _finish_prefill(self, lane: _Lane) -> None:
+        lane.phase = "decode"
+        if self.prefix_sharing:
+            full = lane.s_true // self.page_size
+            for i in range(len(lane.hit_hashes), full):
+                enc, cross = lane.enc_table[i], lane.cross_table[i]
+                if self.alloc.insert_chunk(lane.hashes[i], enc, cross):
+                    # ownership of BOTH pages transfers to the cache
+                    # entry (released when the chunk is evicted)
+                    lane.inserted_hashes.append(lane.hashes[i])
+                    lane.enc_owned.remove(enc)
+                    lane.cross_owned.remove(cross)
+        # decode only reads CROSS pages: the lane's non-cached encoder-KV
+        # pages (always at least the partial tail) are dead weight from
+        # here on — free them now so admission capacity tracks what a
+        # decoding request really holds (the dense baseline keeps no
+        # encoder K/V either)
+        for p in lane.enc_owned:
+            self.alloc.unref(p)
+        lane.enc_owned = []
+        lane.enc_table = []
+
+    def lane_step(self) -> Dict[int, int]:
+        """ONE dispatch over every lane: prefill lanes advance one
+        source chunk, decode lanes emit one token.  Returns
+        {slot: token} for the lanes that decoded."""
+        B = self._slots
+        if B == 0:
+            raise RuntimeError("open_slots() before lane_step()")
+        C = self.chunk
+        ps = self.page_size
+        pf_word = np.zeros((B, C), np.int64)
+        pf_pos = np.zeros((B, C), np.int64)
+        pf_base = np.zeros(B, np.int32)
+        pf_len = np.ones(B, np.int32)
+        enc_table = np.zeros((B, self.p_src), np.int32)
+        enc_pages = np.full((B, C), TRASH_PAGE, np.int32)
+        cross_pages = np.full((B, C), TRASH_PAGE, np.int32)
+        w_offsets = np.zeros((B, C), np.int32)
+        trg_word = np.zeros((B, 1), np.int64)
+        trg_pos = np.zeros((B, 1), np.int64)
+        self_table = np.zeros((B, self.p_out), np.int32)
+        self_pages = np.full((B, 1), TRASH_PAGE, np.int32)
+        self_offsets = np.zeros((B, 1), np.int32)
+        self_lengths = np.ones(B, np.int32)
+        self_base = np.zeros(B, np.int32)
+        cross_table = np.zeros((B, self.p_src), np.int32)
+        src_lengths = np.ones(B, np.int32)
+        decoding: List[int] = []
+        for slot, lane in enumerate(self._lanes):
+            if lane.phase == "prefill":
+                done = lane.enc_done
+                m = min(C, lane.s_true - done)
+                lane.pending_chunk = m
+                pf_word[slot, :m] = lane.src[done:done + m]
+                pf_pos[slot, :m] = np.arange(done, done + m)
+                pf_base[slot] = done
+                pf_len[slot] = done + m
+                enc_table[slot, :len(lane.enc_table)] = lane.enc_table
+                pos = done + np.arange(m)
+                enc_pages[slot, :m] = [lane.enc_table[p // ps] for p in pos]
+                cross_pages[slot, :m] = [lane.cross_table[p // ps]
+                                         for p in pos]
+                w_offsets[slot, :m] = pos % ps
+            elif lane.phase == "decode" and lane.self_table:
+                t = lane.pos
+                if t >= len(lane.self_table) * ps:
+                    raise RuntimeError(
+                        f"lane {slot} decoded past its reserved "
+                        f"{len(lane.self_table)} self pages")
+                trg_word[slot, 0] = lane.cur
+                trg_pos[slot, 0] = t
+                self_table[slot, :len(lane.self_table)] = lane.self_table
+                self_pages[slot, 0] = lane.self_table[t // ps]
+                self_offsets[slot, 0] = t % ps
+                self_lengths[slot] = t + 1
+                self_base[slot] = t
+                cross_table[slot, :len(lane.cross_table)] = lane.cross_table
+                src_lengths[slot] = lane.s_true
+                decoding.append(slot)
+        prog, _, next_ids, _logits = self._unified
+        feed = {"pf_word": pf_word, "pf_pos": pf_pos, "pf_base": pf_base,
+                "pf_len": pf_len, "enc_table": enc_table,
+                "enc_pages": enc_pages, "cross_pages": cross_pages,
+                "w_offsets": w_offsets, "trg_word": trg_word,
+                "trg_pos": trg_pos, "self_table": self_table,
+                "self_pages": self_pages, "self_offsets": self_offsets,
+                "self_lengths": self_lengths, "self_base": self_base,
+                "cross_table": cross_table, "src_lengths": src_lengths}
+        with fluid.scope_guard(self.scope):
+            nxt, = self.exe.run(prog, feed=feed, fetch_list=[next_ids],
+                                return_numpy=False, mode="infer")
+        ids = np.asarray(nxt).reshape(B)
+        self._steps += 1
+        emitted: Dict[int, int] = {}
+        for slot, lane in enumerate(self._lanes):
+            if lane.phase == "prefill":
+                lane.enc_done += lane.pending_chunk
+                lane.pending_chunk = 0
+                if lane.enc_done >= lane.s_true:
+                    self._finish_prefill(lane)
+            elif slot in decoding:
+                tok = int(ids[slot])
+                lane.cur = tok
+                lane.pos += 1
+                emitted[slot] = tok
+        return emitted
+
+    # -- greedy --------------------------------------------------------------
+    def greedy(self, src_tokens, src_lengths, max_new: Optional[int] = None,
+               stop_at_end: bool = True) -> np.ndarray:
+        """Paged greedy decode of a whole batch; token-for-token
+        identical to ``TransformerGenerator.greedy`` run with
+        causal-encoder feeds (tests assert it).  Internally this is just
+        the serving loop: admit every row, then lane_step until done."""
+        src_tokens = np.asarray(src_tokens)
+        src_lengths = np.asarray(src_lengths, np.int32)
+        b = src_tokens.shape[0]
+        max_new = min(max_new or self.max_out_len, self.max_out_len)
+        self.open_slots(b)
+        for i in range(b):
+            self.admit_slot(i, src_tokens[i, :src_lengths[i]],
+                            max_new=max_new)
+        out: List[List[int]] = [[] for _ in range(b)]
+        target = max_new
+        while True:
+            for i, lane in enumerate(self._lanes):
+                if lane.phase == "decode" and len(out[i]) >= target:
+                    lane.phase = "hold"
+            if all(lane.phase in ("hold", "idle") for lane in self._lanes):
+                break
+            for slot, tok in self.lane_step().items():
+                out[slot].append(tok)
+            if stop_at_end and target == max_new:
+                # dense semantics: stop at the first step where every
+                # lane has emitted end_id — i.e. columns = the latest
+                # first-end index + 1 (lanes keep decoding up to there)
+                firsts = [row.index(self.end_id) + 1
+                          if self.end_id in row else None for row in out]
+                if all(f is not None or len(out[i]) >= max_new
+                       for i, f in enumerate(firsts)):
+                    target = min(max_new,
+                                 max(f if f is not None else max_new
+                                     for f in firsts))
+        for i in range(b):
+            self.clear_slot(i)
+        return np.asarray([row[:target] for row in out], np.int64)
+
+    # -- beam ----------------------------------------------------------------
+    def beam(self, src_tokens, src_lengths, beam_size: int,
+             max_new: Optional[int] = None, return_trace: bool = False):
+        """Paged beam decode: prompts chunk-prefill through the unified
+        program, then b*W beam lanes decode over shared pages — a
+        reorder reassigns page tables (refcounted) and only a shared,
+        partially-written page is copied (copy-on-write), never the
+        whole cache."""
+        W = int(beam_size)
+        ps = self.page_size
+        src_tokens = np.asarray(src_tokens)
+        src_lengths = np.asarray(src_lengths, np.int32)
+        b = src_tokens.shape[0]
+        bw = b * W
+        max_new = min(max_new or self.max_out_len, self.max_out_len)
+        self.open_slots(b)
+        for i in range(b):
+            self.admit_slot(i, src_tokens[i, :src_lengths[i]], max_new=0)
+        while any(lane.phase == "prefill" for lane in self._lanes):
+            self.lane_step()
+        prog, _, sel_ids_v, sel_scores_v, parent_v = \
+            self._beam_steps.get(W) or self._build_beam_step(W)
+
+        lane_tables: List[List[int]] = [[] for _ in range(bw)]
+        lane_cross = np.zeros((bw, self.p_src), np.int32)
+        lane_srclen = np.repeat(src_lengths, W).astype(np.int32)
+        for i in range(b):
+            tbl = self._lanes[i].cross_table
+            for w in range(W):
+                lane_cross[i * W + w, :len(tbl)] = tbl
+        pre_ids = np.full((b, W), self.start_id, np.int64)
+        pre_scores = np.concatenate(
+            [np.zeros((b, 1), np.float32),
+             np.full((b, W - 1), -1e9, np.float32)], axis=1)
+        ids_steps = [pre_ids]
+        score_steps = [pre_scores]
+        parent_steps = [np.zeros((b, W), np.int32)]
+        try:
+            with fluid.scope_guard(self.scope):
+                for t in range(max_new):
+                    off = t % ps
+                    cow_src = np.full(bw, TRASH_PAGE, np.int32)
+                    cow_dst = np.full(bw, TRASH_PAGE, np.int32)
+                    for ln in range(bw):
+                        tbl = lane_tables[ln]
+                        if off == 0:
+                            tbl.append(self.alloc.alloc(1)[0])
+                        elif self.alloc.refcount(tbl[-1]) > 1:
+                            new = self.alloc.alloc(1)[0]
+                            cow_src[ln] = tbl[-1]
+                            cow_dst[ln] = new
+                            self.alloc.unref(tbl[-1])
+                            self.alloc.note_cow()
+                            tbl[-1] = new
+                    self_table = np.zeros((bw, self.p_out), np.int32)
+                    self_pages = np.zeros((bw, 1), np.int32)
+                    for ln in range(bw):
+                        tbl = lane_tables[ln]
+                        self_table[ln, :len(tbl)] = tbl
+                        self_pages[ln, 0] = tbl[t // ps]
+                    feed = {
+                        "pre_ids": pre_ids, "pre_scores": pre_scores,
+                        "trg_word": pre_ids.reshape(bw, 1),
+                        "trg_pos": np.full((bw, 1), t, np.int64),
+                        "cow_src": cow_src, "cow_dst": cow_dst,
+                        "self_table": self_table,
+                        "self_pages": self_pages,
+                        "self_offsets": np.full((bw, 1), off, np.int32),
+                        "self_lengths": np.full(bw, t + 1, np.int32),
+                        "self_base": np.full(bw, t, np.int32),
+                        "cross_table": lane_cross,
+                        "src_lengths": lane_srclen,
+                    }
+                    si, ss, pa = self.exe.run(
+                        prog, feed=feed,
+                        fetch_list=[sel_ids_v, sel_scores_v, parent_v],
+                        mode="infer")
+                    pre_ids = np.asarray(si).astype(np.int64)
+                    pre_scores = np.asarray(ss).astype(np.float32)
+                    parent = np.asarray(pa).astype(np.int32)
+                    # table reorder: each selected hypothesis continues
+                    # from its PARENT's pages — ref the new view of every
+                    # lane first, then drop the old references
+                    new_tables = []
+                    for i in range(b):
+                        for w in range(W):
+                            src_tbl = lane_tables[i * W + int(parent[i, w])]
+                            for p in src_tbl:
+                                self.alloc.ref(p)
+                            new_tables.append(list(src_tbl))
+                    for tbl in lane_tables:
+                        for p in tbl:
+                            self.alloc.unref(p)
+                    lane_tables = new_tables
+                    ids_steps.append(pre_ids)
+                    score_steps.append(pre_scores)
+                    parent_steps.append(parent)
+                    if (pre_ids == self.end_id).all():
+                        break
+        finally:
+            for tbl in lane_tables:
+                for p in tbl:
+                    self.alloc.unref(p)
+            for i in range(b):
+                self.clear_slot(i)
+        out_ids, out_scores = self._backtrace(ids_steps, score_steps,
+                                              parent_steps)
+        if return_trace:
+            return out_ids, out_scores, (ids_steps, score_steps,
+                                         parent_steps)
+        return out_ids, out_scores
+
+    def _backtrace(self, ids_steps, score_steps, parent_steps):
+        prog, sent_ids, sent_scores = self._decode_prog or \
+            self._build_backtrace()
+        steps = len(ids_steps)
+        lens = np.full(steps, 1, np.int32)
+        feed = {"ids": SeqArray(np.stack(ids_steps), lens),
+                "scores": SeqArray(np.stack(score_steps), lens),
+                "parents": SeqArray(np.stack(parent_steps), lens)}
+        with fluid.scope_guard(self.scope):
+            out_ids, out_scores = self.exe.run(
+                prog, feed=feed, fetch_list=[sent_ids, sent_scores],
+                mode="infer")
+        return out_ids, np.asarray(out_scores)
+
+    # -- accounting ----------------------------------------------------------
+    def kv_bytes_per_slot_dense(self) -> int:
+        """What ONE dense lane costs in the PR 5 decoder — the baseline
+        the paged pool's bytes-in-use is compared against (shared
+        formula: decoder.dense_kv_bytes_per_slot)."""
+        return dense_kv_bytes_per_slot(self.cfg, self.src_len,
+                                       self.max_out_len)
+
+    def cache_stats(self) -> Dict[str, object]:
+        """Page / prefix / HBM accounting next to the executor's
+        executable-cache counters (the 0-recompile assertion surface)."""
+        pages = self.alloc.stats()
+        active = sum(1 for lane in self._lanes
+                     if lane.phase not in ("idle",))
+        in_use_bytes = self.page_bytes * pages["in_use"]
+        return {
+            "executable": self.exe.cache_stats()["executable"],
+            "pages": pages,
+            "steps": self._steps,
+            "hbm": {
+                "page_bytes": self.page_bytes,
+                "pool_bytes": self.page_bytes * self.num_pages,
+                "bytes_in_use": in_use_bytes,
+                "bytes_per_active_slot": (in_use_bytes // active)
+                if active else 0,
+                "dense_bytes_per_slot": self.kv_bytes_per_slot_dense(),
+            },
+        }

@@ -18,6 +18,20 @@ step_slots(tokens, positions, src_lengths) / start_id / end_id`` — which
 loop on a daemon thread; ``submit()`` is thread-safe and returns a
 ``Request`` whose ``wait()`` blocks until the sequence finishes, with
 per-request queue/decode latency accounting (p50/p95 in ``stats()``).
+
+Page-aware models (``model.page_aware`` — ``PagedTransformerGenerator``)
+extend the protocol two ways:
+
+* **admission by page budget**: ``can_admit(src, max_new)`` gates each
+  admission (admit while free pages last; retirement frees pages and
+  unblocks the queue at the next step boundary), and a prompt that could
+  NEVER fit (``prompt_infeasible``) is rejected with
+  ``PoolCapacityError`` — synchronously in ``submit()`` and again at
+  admission time — instead of hanging at the head of the queue forever;
+* **self-managed stepping**: the model exposes ``lane_step()`` → one
+  dispatch over every lane (chunked prefill interleaved with decode)
+  returning ``{slot: token}`` for the lanes that actually emitted; the
+  scheduler keeps only the request bookkeeping.
 """
 
 from __future__ import annotations
@@ -29,6 +43,8 @@ from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from .paging import PoolCapacityError
 
 __all__ = ["Request", "ContinuousBatchingScheduler"]
 
@@ -78,11 +94,14 @@ class ContinuousBatchingScheduler:
         self.model = model
         self.n_slots = int(n_slots)
         self.default_max_new = int(max_new_tokens)
+        self._page_aware = bool(getattr(model, "page_aware", False))
+        self._managed = callable(getattr(model, "lane_step", None))
         model.open_slots(self.n_slots)
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queue: deque = deque()
         self._active: Dict[int, Request] = {}
+        self._peak_in_flight = 0
         self._free = list(range(self.n_slots))
         # per-lane host state fed to every step (idle lanes hold benign
         # values: position 0, the start token, source length 1)
@@ -108,6 +127,15 @@ class ContinuousBatchingScheduler:
         cap = getattr(self.model, "max_out_len", self.default_max_new)
         req = Request(src_tokens,
                       min(max_new_tokens or self.default_max_new, cap))
+        if self._page_aware and self.model.prompt_infeasible(
+                req.src, req.max_new_tokens):
+            # structurally unserveable: the prompt + decode reservation
+            # exceed the WHOLE page pool — queueing it would park it at
+            # the queue head forever (admission can never succeed)
+            raise PoolCapacityError(
+                f"submit: request needs more pages than the entire pool "
+                f"holds (prompt {len(req.src)} tokens, max_new "
+                f"{req.max_new_tokens})")
         with self._work:
             self._queue.append(req)
             self._work.notify()
@@ -124,10 +152,34 @@ class ContinuousBatchingScheduler:
             with self._lock:
                 if not (self._free and self._queue):
                     return admitted
-                req = self._queue.popleft()
+                req = self._queue[0]
+                if self._page_aware:
+                    if self.model.prompt_infeasible(req.src,
+                                                    req.max_new_tokens):
+                        # reject-with-error, never hang: this prompt can
+                        # NEVER fit, so park-at-head would starve the
+                        # whole queue (satellite: seeded error-path test)
+                        self._queue.popleft()
+                        req.error = PoolCapacityError(
+                            "prompt + decode reservation exceed the "
+                            "entire page pool")
+                        req.finished = time.perf_counter()
+                        self._finished.append(req)
+                        req._done.set()
+                        continue
+                    if not self.model.can_admit(req.src,
+                                                req.max_new_tokens):
+                        # pool momentarily full: stay queued; the next
+                        # retirement frees pages and re-runs admission
+                        return admitted
+                self._queue.popleft()
                 slot = self._free.pop()
             try:
-                s_true = self.model.admit_slot(slot, req.src)
+                if self._page_aware:
+                    s_true = self.model.admit_slot(
+                        slot, req.src, max_new=req.max_new_tokens)
+                else:
+                    s_true = self.model.admit_slot(slot, req.src)
             except BaseException as e:
                 # fail THIS request, give the slot back, keep serving —
                 # one bad prompt must not leak capacity or kill the loop
@@ -142,6 +194,8 @@ class ContinuousBatchingScheduler:
                 req.slot = slot
                 req.admitted = time.perf_counter()
                 self._active[slot] = req
+                self._peak_in_flight = max(self._peak_in_flight,
+                                           len(self._active))
                 self._tokens[slot] = self.model.start_id
                 self._pos[slot] = 0
                 self._src_len[slot] = s_true
@@ -151,9 +205,17 @@ class ContinuousBatchingScheduler:
         # no device work in here (submit() blocks on this lock): the
         # lane's caches stay stale until the next admit_slot, which
         # re-zeroes them before use — lanes are row-independent, so a
-        # stale lane decoding garbage contaminates nothing
+        # stale lane decoding garbage contaminates nothing.  Page-aware
+        # models DO free their pages here (host-side bookkeeping only):
+        # "retire frees pages immediately" is what lets the very next
+        # admission round backfill under page pressure.
         req.finished = time.perf_counter()
         del self._active[slot]
+        if self._page_aware:
+            try:
+                self.model.clear_slot(slot)
+            except BaseException as e:      # pragma: no cover - belt and
+                req.error = req.error or e  # braces; never lose the slot
         self._tokens[slot] = self.model.start_id
         self._pos[slot] = 0
         self._src_len[slot] = 1
@@ -168,9 +230,30 @@ class ContinuousBatchingScheduler:
         with self._lock:
             if not self._active:
                 return False
-            tokens = self._tokens.copy()
-            pos = self._pos.copy()
-            src_len = self._src_len.copy()
+            if not self._managed:   # managed models read lane state
+                tokens = self._tokens.copy()    # themselves; skip the
+                pos = self._pos.copy()          # copies under the lock
+                src_len = self._src_len.copy()
+        if self._managed:
+            # self-managed model: one dispatch interleaves chunked
+            # prefill and decode over every lane; only lanes that
+            # actually emitted a token come back
+            try:
+                emitted = self.model.lane_step()
+            except BaseException as e:
+                self._fail_in_flight(e)
+                return True
+            with self._lock:
+                self._steps += 1
+                for slot, tok in emitted.items():
+                    req = self._active.get(slot)
+                    if req is None:
+                        continue
+                    req.tokens.append(int(tok))
+                    if int(tok) == self.model.end_id or \
+                            len(req.tokens) >= req.max_new_tokens:
+                        self._retire_locked(slot, req)
+            return True
         try:
             nxt = self.model.step_slots(tokens, pos, src_len)
         except BaseException as e:
@@ -251,6 +334,7 @@ class ContinuousBatchingScheduler:
                 "finished": len(done),
                 "queued": len(self._queue),
                 "in_flight": len(self._active),
+                "peak_in_flight": self._peak_in_flight,
             }
         out["failed"] = sum(1 for r in done if r.error is not None)
         # latency percentiles cover successfully served requests only (a

@@ -1,0 +1,589 @@
+"""Paged-KV serving tests (ISSUE 6): ragged paged attention ops/kernel,
+token-for-token greedy and score-for-score beam parity against the PR 5
+dense-cache decoder, chunked-prefill interleaving in one dispatch,
+copy-on-write prefix sharing, page-refcount invariants under random
+admit/retire interleavings, page-aware admission (more in-flight than
+dense under the same HBM budget, reject-with-error on infeasible
+prompts), and the engine's true-vs-padded accounting satellite."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                InferenceEngine, PagedTransformerGenerator,
+                                PageAllocator, PoolCapacityError,
+                                TransformerGenerator)
+from paddle_tpu.serving.decoder import pack_sources
+from paddle_tpu.serving.paging import chunk_hashes
+
+V, NL, NH, DK, DM, DI = 24, 2, 2, 4, 16, 32
+SRC, OUT, PS, CHUNK = 8, 8, 4, 4
+
+
+@pytest.fixture(scope="module")
+def paged_pair():
+    """A paged generator and the PR 5 dense-cache decoder sharing one
+    randomly-initialized scope.  The dense decoder runs with
+    causal-encoder feeds — the same math the paged path computes
+    chunk-by-chunk — making it the differential parity baseline."""
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    kw = dict(n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+              d_inner_hid=DI, max_length=64, src_len=SRC, scope=scope,
+              executor=exe, param_prefix="tfp")
+    dense = TransformerGenerator(V, V, max_out_len=OUT,
+                                 causal_encoder=True, **kw)
+    paged = PagedTransformerGenerator(V, V, max_out_len=OUT, page_size=PS,
+                                      chunk_size=CHUNK, num_pages=64, **kw)
+    dense.init_params(seed=7)
+    return paged, dense
+
+
+def _sources(seed=0, n=4):
+    rng = np.random.RandomState(seed)
+    seqs = [rng.randint(2, V, rng.randint(3, SRC + 1)) for _ in range(n)]
+    return seqs, pack_sources(seqs, bucket=4)
+
+
+# -- ops / kernel -------------------------------------------------------------
+
+def test_paged_cache_write_and_page_copy(fresh_programs):
+    """paged_cache_write lands each token's K/V at its (page, offset)
+    rows for the right layer; paged_page_copy moves whole logical pages
+    and src==dst encodes a no-op."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import paged_kv_rows
+
+    main, startup, scope = fresh_programs
+    H, D, NPAGES, L = 2, 3, 4, 2
+    pool_shape = (H, NPAGES * L * 2, PS, D)
+    pool = main.global_block().create_var(
+        name="pool", shape=list(pool_shape), dtype="float32",
+        persistable=True)
+    k = layers.data("k", [1, H, D], "float32")
+    v = layers.data("v", [1, H, D], "float32")
+    pages = layers.data("pages", [1], "int32")
+    offs = layers.data("offs", [1], "int32")
+    layers.paged_cache_write(pool, k, v, pages, offs, layer=1, n_layer=L)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope.set_var("pool", jnp.zeros(pool_shape))
+    rng = np.random.RandomState(0)
+    kv = rng.randn(2, 1, H, D).astype(np.float32)
+    vv = rng.randn(2, 1, H, D).astype(np.float32)
+    pg = np.array([[1], [3]], np.int32)
+    of = np.array([[2], [0]], np.int32)
+    exe.run(main, feed={"k": kv, "v": vv, "pages": pg, "offs": of},
+            fetch_list=["pool"])
+    got = np.asarray(scope.find_var("pool"))
+    k_rows, v_rows = paged_kv_rows(pg, 1, L)
+    for b in range(2):
+        np.testing.assert_array_equal(
+            got[:, int(k_rows[b, 0]), int(of[b, 0])], kv[b, 0])
+        np.testing.assert_array_equal(
+            got[:, int(v_rows[b, 0]), int(of[b, 0])], vv[b, 0])
+    assert np.count_nonzero(got) == 2 * 2 * H * D  # nothing else written
+
+    # page copy: dst page 2 <- page 1, lane 1 no-op (src == dst == 0)
+    main2 = fluid.Program()
+    with fluid.program_guard(main2, fluid.Program()), \
+            fluid.unique_name.guard():
+        pool2 = main2.global_block().create_var(
+            name="pool", shape=list(pool_shape), dtype="float32",
+            persistable=True)
+        src = layers.data("src", [], "int32")
+        dst = layers.data("dst", [], "int32")
+        layers.paged_page_copy(pool2, src, dst, n_layer=L)
+    before = got.copy()
+    exe.run(main2, feed={"src": np.array([1, 0], np.int32),
+                         "dst": np.array([2, 0], np.int32)},
+            fetch_list=["pool"])
+    after = np.asarray(scope.find_var("pool"))
+    rows = np.arange(2 * L)
+    np.testing.assert_array_equal(after[:, 2 * 2 * L + rows],
+                                  before[:, 1 * 2 * L + rows])
+    np.testing.assert_array_equal(after[:, :2 * 2 * L],
+                                  before[:, :2 * 2 * L])
+
+
+def test_ragged_attention_matches_masked_reference(fresh_programs):
+    """ragged_decode_attention (layer op, XLA path) == dense gather +
+    per-row causally/length-masked softmax attention."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import paged_kv_rows
+
+    main, startup, scope = fresh_programs
+    H, D, L, NPAGES, P, C = 2, 4, 2, 6, 2, 2
+    pool_shape = (H, NPAGES * L * 2, PS, D)
+    rng = np.random.RandomState(1)
+    pool_np = rng.randn(*pool_shape).astype(np.float32)
+    pool = main.global_block().create_var(
+        name="pool", shape=list(pool_shape), dtype="float32",
+        persistable=True)
+    q = layers.data("q", [C, H, D], "float32")
+    tbl = layers.data("tbl", [P], "int32")
+    ln = layers.data("ln", [], "int32")
+    qb = layers.data("qb", [], "int32")
+    out = layers.ragged_decode_attention(q, pool, tbl, ln, qb, layer=1,
+                                         n_layer=L, causal=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope.set_var("pool", jnp.asarray(pool_np))
+    B = 2
+    qv = rng.randn(B, C, H, D).astype(np.float32)
+    tv = np.array([[1, 2], [4, 5]], np.int32)
+    lv = np.array([5, 7], np.int32)
+    bv = np.array([3, 5], np.int32)
+    got, = exe.run(main, feed={"q": qv, "tbl": tv, "ln": lv, "qb": bv},
+                   fetch_list=[out])
+    got = np.asarray(got)
+    k_rows, v_rows = paged_kv_rows(tv, 1, L)
+    scale = D ** -0.5
+    for b in range(B):
+        k = np.transpose(pool_np[:, np.asarray(k_rows)[b]],
+                         (1, 2, 0, 3)).reshape(P * PS, H, D)
+        v = np.transpose(pool_np[:, np.asarray(v_rows)[b]],
+                         (1, 2, 0, 3)).reshape(P * PS, H, D)
+        for c in range(C):
+            n = min(int(lv[b]), int(bv[b]) + c + 1)
+            s = np.einsum("hd,khd->hk", qv[b, c], k[:n]) * scale
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            want = np.einsum("hk,khd->hd", p, v[:n])
+            np.testing.assert_allclose(got[b, c], want, rtol=1e-5,
+                                       atol=1e-5)
+
+
+def test_ragged_pallas_interpret_matches_xla():
+    """The Pallas ragged kernel (scalar-prefetched block tables driving
+    the page index maps) agrees with the XLA gather fallback, including
+    dead lanes (lengths == 0 -> zero output on both paths)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import ragged_decode_attention
+
+    rng = np.random.RandomState(3)
+    H, D, L, NPAGES, P, C, B = 2, 4, 3, 6, 3, 2, 3
+    pool = jnp.asarray(rng.randn(H, NPAGES * L * 2, PS, D)
+                       .astype(np.float32))
+    q = jnp.asarray(rng.randn(B, C, H, D).astype(np.float32))
+    tbl = jnp.asarray(rng.randint(0, NPAGES, (B, P)).astype(np.int32))
+    lengths = jnp.asarray(np.array([7, 0, 11], np.int32))
+    base = jnp.asarray(np.array([5, 0, 9], np.int32))
+    for causal in (True, False):
+        a = ragged_decode_attention(q, pool, tbl, lengths, base, layer=2,
+                                    n_layer=L, causal=causal, impl="xla")
+        b = ragged_decode_attention(q, pool, tbl, lengths, base, layer=2,
+                                    n_layer=L, causal=causal,
+                                    impl="pallas_interpret")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+        assert (np.asarray(a)[1] == 0).all()       # dead lane contract
+
+
+# -- parity vs the dense decoder ----------------------------------------------
+
+def test_greedy_parity_token_for_token(paged_pair):
+    """The paged decoder (chunked causal prefill + ragged paged decode)
+    must emit EXACTLY the tokens the dense-cache decoder emits with
+    causal-encoder feeds, on mixed-length prompts."""
+    paged, dense = paged_pair
+    _, (tok, lens) = _sources(0)
+    g_dense = dense.greedy(tok, lens, max_new=OUT, stop_at_end=False)
+    g_paged = paged.greedy(tok, lens, max_new=OUT, stop_at_end=False)
+    np.testing.assert_array_equal(g_paged, g_dense)
+
+
+def test_greedy_parity_with_early_stop(paged_pair):
+    paged, dense = paged_pair
+    _, (tok, lens) = _sources(4)
+    g_dense = dense.greedy(tok, lens, max_new=OUT, stop_at_end=True)
+    g_paged = paged.greedy(tok, lens, max_new=OUT, stop_at_end=True)
+    np.testing.assert_array_equal(g_paged, g_dense)
+
+
+def test_beam_parity_with_shared_pages(paged_pair):
+    """Beam over paged caches: the host-side table reorder (refcounted
+    page sharing + in-dispatch copy-on-write) must reproduce the dense
+    path's in-graph batch_gather cache reorder — identical ids/parents
+    every step, scores to float tolerance, same backtrace."""
+    paged, dense = paged_pair
+    W = 3
+    _, (tok, lens) = _sources(2, n=2)
+    cow0 = paged.cache_stats()["pages"]["cow_copies"]
+    p_ids, p_scores, (pi, pscore, pp) = paged.beam(
+        tok, lens, beam_size=W, max_new=OUT, return_trace=True)
+    d_ids, d_scores, (di, ds, dp) = dense.beam(
+        tok, lens, beam_size=W, max_new=OUT, return_trace=True)
+    assert len(di) == len(pi)
+    for t in range(len(di)):
+        np.testing.assert_array_equal(pi[t], di[t])
+        np.testing.assert_array_equal(pp[t], dp[t])
+        np.testing.assert_allclose(pscore[t], ds[t], rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(p_ids), np.asarray(d_ids))
+    np.testing.assert_allclose(p_scores, d_scores, rtol=1e-4, atol=1e-5)
+    # parent lanes genuinely shared pages: reorders forced COW copies
+    assert paged.cache_stats()["pages"]["cow_copies"] > cow0
+    # and nothing leaked: every beam/self/prompt page went back
+    assert paged.cache_stats()["pages"]["in_use"] == 0
+    paged.alloc.check_invariants()
+
+
+# -- chunked prefill / unified dispatch ---------------------------------------
+
+def test_prefill_and_decode_interleave_in_one_dispatch(paged_pair):
+    """A lane mid-prefill and a lane mid-decode advance in the SAME
+    lane_step dispatch (the no-separate-prefill-program contract), and
+    the interleaving compiles nothing new once warm."""
+    paged, dense = paged_pair
+    seqs, (tok, lens) = _sources(6, n=4)
+    ref = dense.greedy(tok, lens, max_new=OUT, stop_at_end=False)
+    paged.greedy(tok, lens, max_new=OUT, stop_at_end=False)  # warm B=4
+    misses0 = paged.cache_stats()["executable"]["misses"]
+    paged.open_slots(4)
+    paged.admit_slot(0, seqs[0], max_new=OUT)
+    # drive lane 0 through prefill into decode
+    while paged._lanes[0].phase == "prefill":
+        assert paged.lane_step() == {}
+    got0 = []
+    emitted = paged.lane_step()
+    got0.append(emitted[0])
+    # admit lane 1 (prompt > chunk so it needs >= 2 prefill steps)
+    long_prompt = seqs[np.argmax([len(s) for s in seqs])]
+    assert len(long_prompt) > CHUNK
+    slot1 = 1
+    paged.admit_slot(slot1, long_prompt, max_new=OUT)
+    interleaved = 0
+    while paged._lanes[slot1].phase == "prefill":
+        emitted = paged.lane_step()       # ONE dispatch, both lanes
+        if 0 in emitted:
+            got0.append(emitted[0])
+            interleaved += 1
+    assert interleaved >= 1, "decode lane must advance during prefill"
+    np.testing.assert_array_equal(
+        got0, ref[0][:len(got0)])         # interleaving changed nothing
+    for i in range(4):
+        paged.clear_slot(i)
+    assert paged.cache_stats()["executable"]["misses"] == misses0
+    paged.alloc.check_invariants()
+
+
+# -- prefix sharing -----------------------------------------------------------
+
+def test_prefix_sharing_dedups_with_unchanged_outputs(paged_pair):
+    """Two requests sharing a system-prompt prefix occupy the SAME
+    physical pages (asserted via page tables + chunk refcounts) and
+    decode exactly what a sharing-disabled generator decodes."""
+    paged, dense = paged_pair
+    rng = np.random.RandomState(11)
+    system = rng.randint(2, V, 6)
+    a = np.concatenate([system, [7, 9]])[:SRC]
+    b = np.concatenate([system, [11, 3]])[:SRC]
+    # seed the cache with a's chunks
+    ga = paged.greedy(*pack_sources([a]), max_new=OUT, stop_at_end=False)
+    paged.open_slots(2)
+    paged.admit_slot(0, a, max_new=OUT)
+    paged.admit_slot(1, b, max_new=OUT)
+    l0, l1 = paged._lanes[0], paged._lanes[1]
+    # a re-admitted: full prefix hit; b: shares the first chunk only
+    assert l0.enc_table[0] == l1.enc_table[0]
+    assert l0.cross_table[0] == l1.cross_table[0]
+    assert l0.enc_table[1] != l1.enc_table[1]
+    shared_hash = chunk_hashes(a, PS)[0]
+    assert paged.alloc._chunks[shared_hash][2] == 2       # both lanes ref
+    for i in (0, 1):
+        paged.clear_slot(i)
+    paged.alloc.check_invariants()
+    # outputs: sharing-enabled == sharing-disabled == dense baseline
+    st0 = paged.cache_stats()["pages"]
+    both = paged.greedy(*pack_sources([a, b]), max_new=OUT,
+                        stop_at_end=False)
+    st1 = paged.cache_stats()["pages"]
+    assert st1["prefix_hits"] > st0["prefix_hits"]
+    np.testing.assert_array_equal(both[0], ga[0])
+    ref = dense.greedy(*pack_sources([a, b]), max_new=OUT,
+                       stop_at_end=False)
+    np.testing.assert_array_equal(both, ref)
+
+
+# -- allocator invariants -----------------------------------------------------
+
+def test_allocator_random_interleavings_never_leak():
+    """Property test: random interleavings of admit-like alloc/ref,
+    prefix insert/hit, beam-like share/COW, and retire/free keep the
+    free/held partition exact — no leaked page, no double free."""
+    rng = np.random.RandomState(42)
+    alloc = PageAllocator(num_pages=24, page_size=PS)
+    live = []          # [(pages, chunk_hashes_reffed, inserted)]
+    next_tok = [0]
+    for step in range(400):
+        op = rng.rand()
+        try:
+            if op < 0.45:          # admit: alloc pages, maybe share
+                toks = rng.randint(0, 9, int(rng.randint(PS, 4 * PS)))
+                hashes = chunk_hashes(toks, PS)
+                hits = alloc.lookup_chain(hashes)
+                pages = alloc.alloc(int(rng.randint(1, 4)))
+                for h, _, _ in hits:
+                    alloc.ref_chunk(h)
+                live.append([pages, [h for h, _, _ in hits], []])
+            elif op < 0.6 and live:     # beam-like page share + unshare
+                ent = live[int(rng.randint(len(live)))]
+                if ent[0]:
+                    p = ent[0][int(rng.randint(len(ent[0])))]
+                    alloc.ref(p)
+                    alloc.unref(p)
+            elif op < 0.75 and live:    # insert a computed chunk pair
+                ent = live[int(rng.randint(len(live)))]
+                if len(ent[0]) >= 2:
+                    h = f"synthetic-{next_tok[0]}"
+                    next_tok[0] += 1
+                    if alloc.insert_chunk(h, ent[0][0], ent[0][1]):
+                        ent[2].append(h)
+                        del ent[0][:2]
+            elif live:                  # retire
+                pages, hashes, inserted = live.pop(
+                    int(rng.randint(len(live))))
+                for h in hashes + inserted:
+                    alloc.unref_chunk(h)
+                for p in pages:
+                    alloc.unref(p)
+        except PoolCapacityError:
+            pass
+        alloc.check_invariants()
+    for pages, hashes, inserted in live:
+        for h in hashes + inserted:
+            alloc.unref_chunk(h)
+        for p in pages:
+            alloc.unref(p)
+    alloc.check_invariants()
+    st = alloc.stats()
+    # everything released: cached chunks are evictable (still hittable)
+    # and count as available capacity — nothing is leaked in-use
+    assert st["in_use"] == 0
+    assert st["free"] + st["evictable"] == st["total"]
+
+
+def test_admit_under_pressure_pins_hit_chunks():
+    """Regression: admit_slot refs its prefix-cache hits BEFORE
+    allocating fresh pages, so an allocation that must evict under pool
+    pressure can never evict the hit it just counted (which raised
+    KeyError from ref_chunk and leaked the fresh pages)."""
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    gen = PagedTransformerGenerator(
+        V, V, n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+        d_inner_hid=DI, max_length=64, src_len=SRC, max_out_len=4,
+        scope=scope, executor=exe, param_prefix="tfpin", page_size=PS,
+        chunk_size=CHUNK, num_pages=12)
+    gen.init_params(seed=2)
+    rng = np.random.RandomState(21)
+    a = rng.randint(2, V, PS)          # one FULL chunk -> cached
+    d = rng.randint(2, V, PS)
+    gen.greedy(*pack_sources([a]), max_new=2, stop_at_end=False)
+    gen.greedy(*pack_sources([d]), max_new=2, stop_at_end=False)
+    # both chunks sit refcount-0 on the evictable list (a is LRU-first);
+    # drain the free list to zero with admissions that never step
+    assert gen.alloc.stats()["free"] == 7
+    gen.open_slots(5)
+    gen.admit_slot(0, rng.randint(2, V, 2), max_new=4)      # 3 pages
+    gen.admit_slot(1, rng.randint(2, V, 2), max_new=0)      # 2 pages
+    gen.admit_slot(2, rng.randint(2, V, 2), max_new=0)      # 2 pages
+    assert gen.alloc.stats()["free"] == 0
+    # re-admitting a: prefix hit on the LRU-FIRST evictable chunk, plus
+    # one fresh self page -> the alloc must evict; it must evict d's
+    # chunk, never the pinned hit
+    gen.admit_slot(3, a, max_new=4)
+    lane = gen._lanes[3]
+    assert lane.hit_hashes == [chunk_hashes(a, PS)[0]]
+    assert gen.alloc.stats()["evictions"] == 1       # d's chunk went
+    assert gen.alloc.lookup_chain(chunk_hashes(d, PS), count=False) == []
+    gen.alloc.check_invariants()
+    for i in range(4):
+        gen.clear_slot(i)
+    gen.alloc.check_invariants()
+    assert gen.alloc.stats()["in_use"] == 0
+
+
+def test_allocator_double_free_and_exhaustion():
+    alloc = PageAllocator(num_pages=4, page_size=PS)
+    pages = alloc.alloc(3)
+    with pytest.raises(PoolCapacityError):
+        alloc.alloc(1)
+    alloc.unref(pages[0])
+    with pytest.raises(ValueError, match="double free"):
+        alloc.unref(pages[0])
+    # all-or-nothing alloc rolled back cleanly
+    with pytest.raises(PoolCapacityError):
+        alloc.alloc(2)
+    assert alloc.available() == 1
+    alloc.check_invariants()
+
+
+# -- page-aware admission -----------------------------------------------------
+
+def test_paged_admits_more_in_flight_than_dense_same_hbm(paged_pair):
+    """Under the same simulated HBM budget and a mixed-length workload,
+    page-granular admission holds strictly more concurrent requests
+    than dense worst-case per-slot reservation."""
+    paged, dense = paged_pair
+    budget = 4 * dense.kv_bytes_per_slot()        # 4 dense slots' worth
+    n_dense = budget // dense.kv_bytes_per_slot()
+    scope = fluid.Scope()          # fresh pool sized to the budget
+    exe = fluid.Executor(fluid.CPUPlace())
+    gen = PagedTransformerGenerator(
+        V, V, n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+        d_inner_hid=DI, max_length=64, src_len=SRC, max_out_len=OUT,
+        scope=scope, executor=exe, param_prefix="tfcap", page_size=PS,
+        chunk_size=CHUNK, num_pages=budget // paged.page_bytes)
+    rng = np.random.RandomState(9)
+    admitted = 0
+    gen.open_slots(32)
+    while admitted < 32:
+        prompt = rng.randint(2, V, int(rng.randint(2, SRC // 2 + 1)))
+        if not gen.can_admit(prompt, max_new=PS):
+            break
+        gen.admit_slot(admitted, prompt, max_new=PS)
+        admitted += 1
+    assert admitted > n_dense, (admitted, n_dense)
+    st = gen.cache_stats()
+    assert st["hbm"]["bytes_in_use"] <= budget
+    assert st["hbm"]["bytes_per_active_slot"] < \
+        st["hbm"]["dense_bytes_per_slot"]
+
+
+def test_scheduler_paged_integrity_and_zero_recompiles(paged_pair):
+    """Seeded mixed-length traffic through the paged scheduler: every
+    request decodes exactly its own prompt's greedy tokens (admission,
+    chunked prefill, backfill at ragged depths can't cross-contaminate),
+    pages are freed at retire, and a second full round compiles
+    NOTHING (including across chunked-prefill interleaving)."""
+    paged, _ = paged_pair
+    seqs, (tok, lens) = _sources(5, n=5)
+    ref = paged.greedy(tok, lens, max_new=OUT, stop_at_end=False)
+    ref_rows = {tuple(s.tolist()): ref[i].tolist()
+                for i, s in enumerate(seqs)}
+    rng = np.random.RandomState(9)
+    sched = ContinuousBatchingScheduler(paged, n_slots=4,
+                                        max_new_tokens=OUT)
+    order = [seqs[int(rng.randint(len(seqs)))] for _ in range(9)]
+    reqs = []
+    it = iter(order)
+    for burst in (3, 2, 3, 1):
+        for _ in range(burst):
+            reqs.append(sched.submit(next(it)))
+        for _ in range(int(rng.randint(1, 5))):
+            sched.step_once()
+    sched.run_until_idle()
+    assert all(r.done and r.error is None for r in reqs)
+    for req, src in zip(reqs, order):
+        want = ref_rows[tuple(np.asarray(src).tolist())]
+        got = req.tokens
+        assert got == want[:len(got)], (got, want)
+        if len(got) < OUT:
+            assert got[-1] == paged.end_id
+    st = sched.stats()
+    assert st["finished"] == len(order)
+    assert st["queued"] == 0 and st["in_flight"] == 0
+    assert paged.cache_stats()["pages"]["in_use"] == 0   # retire freed
+    misses0 = paged.cache_stats()["executable"]["misses"]
+    sched2 = ContinuousBatchingScheduler(paged, n_slots=4,
+                                         max_new_tokens=OUT)
+    for s in order[::-1]:
+        sched2.submit(s)
+    sched2.run_until_idle()
+    assert paged.cache_stats()["executable"]["misses"] == misses0
+    paged.alloc.check_invariants()
+
+
+def test_scheduler_rejects_infeasible_prompt_seeded(paged_pair):
+    """Satellite: a prompt whose pages can NEVER fit the pool rejects
+    with PoolCapacityError at submit instead of hanging the queue; a
+    feasible-but-currently-blocked prompt waits and is admitted once
+    retirement frees pages."""
+    paged, _ = paged_pair
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    # pool fits ONE worst-case request (2*2 prompt pages + 2 self pages)
+    tiny = PagedTransformerGenerator(
+        V, V, n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+        d_inner_hid=DI, max_length=64, src_len=SRC, max_out_len=OUT,
+        scope=scope, executor=exe, param_prefix="tftiny", page_size=PS,
+        chunk_size=CHUNK, num_pages=6, prefix_sharing=False)
+    sched = ContinuousBatchingScheduler(tiny, n_slots=2,
+                                        max_new_tokens=OUT)
+    rng = np.random.RandomState(13)
+    # a full-length request needs 2*2 prompt + 2 self pages = 6, but
+    # only 5 of the 6 pool pages are usable (page 0 is trash)
+    with pytest.raises(PoolCapacityError):
+        sched.submit(rng.randint(2, V, SRC), max_new_tokens=OUT)
+    # belt-and-braces: the admission-time guard also rejects (a request
+    # that slipped past submit, e.g. queued before a pool resize)
+    bad = sched.submit(rng.randint(2, V, 2), max_new_tokens=2)
+    sched._queue[0].src = rng.randint(2, V, SRC)
+    sched._queue[0].max_new_tokens = OUT
+    sched.run_until_idle()
+    assert bad.done and isinstance(bad.error, PoolCapacityError)
+    assert tiny.cache_stats()["pages"]["in_use"] == 0
+
+
+def test_scheduler_backpressure_waits_then_admits(paged_pair):
+    """Two feasible requests that cannot fit TOGETHER: the second waits
+    (no hang, no error) and admits as soon as the first retires."""
+    paged, _ = paged_pair
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    tiny = PagedTransformerGenerator(
+        V, V, n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+        d_inner_hid=DI, max_length=64, src_len=SRC, max_out_len=OUT,
+        scope=scope, executor=exe, param_prefix="tfbp", page_size=PS,
+        chunk_size=CHUNK, num_pages=8, prefix_sharing=False)
+    tiny.init_params(seed=5)
+    sched = ContinuousBatchingScheduler(tiny, n_slots=2,
+                                        max_new_tokens=4)
+    rng = np.random.RandomState(17)
+    r1 = sched.submit(rng.randint(2, V, SRC), max_new_tokens=4)
+    r2 = sched.submit(rng.randint(2, V, SRC), max_new_tokens=4)
+    sched.step_once()
+    assert r1.slot is not None and r2.slot is None     # r2 queued
+    sched.run_until_idle()
+    assert r1.done and r1.error is None
+    assert r2.done and r2.error is None and len(r2.tokens) >= 1
+    assert sched.stats()["peak_in_flight"] == 1
+
+
+# -- engine padding accounting (satellite) ------------------------------------
+
+def test_engine_padding_accounting_reports_true_vs_padded():
+    """cache_stats()['padding'] exposes what bucketing really costs:
+    true rows/tokens requested vs rows/tokens dispatched."""
+    from paddle_tpu.fluid.core.lod import make_seq
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        w = fluid.layers.data("w", [1], "int64", lod_level=1)
+        emb = fluid.layers.embedding(input=w, size=[V, 8])
+        pooled = fluid.layers.sequence_pool(input=emb, pool_type="sum")
+        y = fluid.layers.fc(input=pooled, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    infer = fluid.io.get_inference_program([y], main)
+    eng = InferenceEngine(program=infer, feed_names=["w"], fetch_vars=[y],
+                          scope=scope, executor=exe, batch_buckets=(4,),
+                          time_bucket=8)
+    lens = [3, 5]                      # 2 rows -> bucket 4; times -> 8
+    rng = np.random.RandomState(0)
+    eng.infer({"w": make_seq([rng.randint(0, V, n) for n in lens],
+                             dtype=np.int64)})
+    pad = eng.cache_stats()["padding"]
+    assert pad["true_rows"] == 2 and pad["padded_rows"] == 4
+    assert pad["true_tokens"] == 8 and pad["padded_tokens"] == 32
+    assert pad["padded_row_fraction"] == 0.5
+    assert pad["padded_token_fraction"] == 0.75
+    # warmup dispatches stay invisible — the counters stay honest
+    eng.warmup([{"w": make_seq([rng.randint(0, V, 4)], dtype=np.int64)}])
+    assert eng.cache_stats()["padding"] == pad

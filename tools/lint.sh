@@ -105,6 +105,23 @@ with open(os.path.join(tmpdir, "serving_step.json"), "wb") as f:
     f.write(step_prog.desc.serialize_to_string())
 with open(os.path.join(tmpdir, "serving_step.fetch"), "w") as f:
     f.write(next_ids.name + "\n")
+
+# paged sweep (ISSUE 6): the unified ragged decode-step program — chunked
+# prefill tower + paged_cache_write / ragged_decode_attention / page-copy
+# ops + greedy head, all in ONE dispatch — must also stay analyzer-clean
+from paddle_tpu.serving import PagedTransformerGenerator
+
+pgen = PagedTransformerGenerator(30, 30, n_layer=2, n_head=2, d_key=4,
+                                 d_value=4, d_model=16, d_inner_hid=32,
+                                 max_length=64, src_len=8, max_out_len=8,
+                                 page_size=4, chunk_size=4, num_pages=32,
+                                 param_prefix="tfpg",
+                                 place=fluid.CPUPlace())
+uni_prog, _, uni_ids, _ = pgen._unified
+with open(os.path.join(tmpdir, "serving_ragged_step.json"), "wb") as f:
+    f.write(uni_prog.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "serving_ragged_step.fetch"), "w") as f:
+    f.write(uni_ids.name + "\n")
 EOF
   for prog in "$tmpdir"/*.json; do
     name="$(basename "$prog" .json)"
