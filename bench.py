@@ -568,6 +568,30 @@ def bench_serving(batch: int, trials: int, seq_len: int = 256,
     hits = cs1["bucket_hits"]
     misses = cs1["bucket_misses"]
 
+    def _paged_contest(pgen):
+        """One measurement protocol for every paged generator (float and
+        int8 pools MUST be measured identically to compare): warm 4
+        prompts through a throwaway scheduler, then drive the full
+        prompt set sampling peak HBM/page stats per step.  Returns
+        (sched_stats, stats_before, stats_after, peak_bytes, peak_util)."""
+        n_slots = 4 * slots            # pages, not lanes, must bind
+        warm = ContinuousBatchingScheduler(pgen, n_slots=n_slots,
+                                           max_new_tokens=max_new)
+        for p in prompts[:4]:
+            warm.submit(p, max_new_tokens=max_new)
+        warm.run_until_idle()
+        c0 = pgen.cache_stats()
+        sched = ContinuousBatchingScheduler(pgen, n_slots=n_slots,
+                                            max_new_tokens=max_new)
+        reqs = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        peak_bytes = peak_util = 0
+        while sched.step_once():
+            st = pgen.cache_stats()
+            peak_bytes = max(peak_bytes, st["hbm"]["bytes_in_use"])
+            peak_util = max(peak_util, st["pages"]["utilization"])
+        assert all(r.done for r in reqs)
+        return sched.stats(), c0, pgen.cache_stats(), peak_bytes, peak_util
+
     # ---- paged sub-results (ISSUE 6): the same traffic through the
     # paged decoder, pool sized to the SAME HBM the dense scheduler
     # reserved (slots x dense bytes/slot) — the honest capacity contest.
@@ -575,40 +599,31 @@ def bench_serving(batch: int, trials: int, seq_len: int = 256,
     # numbers above.
     paged_out = None
     try:
-        from paddle_tpu.serving import PagedTransformerGenerator
+        # shared paged prelude lives INSIDE the guard: an import or
+        # bytes/slot failure must null only the paged/quantized
+        # sub-blocks (the quantized block hits NameError and reports),
+        # never the dense numbers above
+        from paddle_tpu.serving import (PagedTransformerGenerator,
+                                        kv_page_bytes)
 
         page_size, chunk = 16, 32
-        page_bytes = (cfg["n_layer"] * 2 * page_size * cfg["n_head"]
-                      * cfg["d_key"] * 4)
         budget = slots * gen.kv_bytes_per_slot()
+        page_bytes = kv_page_bytes(cfg["n_layer"], cfg["n_head"],
+                                   cfg["d_key"], page_size, "float32")
         paged = PagedTransformerGenerator(
             vocab, vocab, max_length=seq_len + 1, src_len=seq_len,
             max_out_len=decode_len, scope=scope, executor=exe,
             param_prefix="tfserve", page_size=page_size, chunk_size=chunk,
             num_pages=max(8, budget // page_bytes), **cfg)
-        paged_slots = 4 * slots        # pages, not lanes, must bind
-        warm = ContinuousBatchingScheduler(paged, n_slots=paged_slots,
-                                           max_new_tokens=max_new)
-        for p in prompts[:4]:
-            warm.submit(p, max_new_tokens=max_new)
-        warm.run_until_idle()
-        p0 = paged.cache_stats()
-        sched_p = ContinuousBatchingScheduler(paged, n_slots=paged_slots,
-                                              max_new_tokens=max_new)
-        reqs_p = [sched_p.submit(p, max_new_tokens=max_new)
-                  for p in prompts]
-        peak_bytes = peak_util = 0
-        while sched_p.step_once():
-            st_p = paged.cache_stats()
-            peak_bytes = max(peak_bytes, st_p["hbm"]["bytes_in_use"])
-            peak_util = max(peak_util, st_p["pages"]["utilization"])
-        assert all(r.done for r in reqs_p)
-        stats_p = sched_p.stats()
-        p1 = paged.cache_stats()
+        stats_p, p0, p1, peak_bytes, peak_util = _paged_contest(paged)
         paged_out = {
             "page_size": page_size, "chunk_size": chunk,
             "num_pages": paged.num_pages,
             "pool_bytes": p1["hbm"]["pool_bytes"],
+            # bytes ONE cached token costs (ISSUE 7: the int8-KV halving
+            # must be readable straight off the trajectory)
+            "kv_dtype": p1["hbm"]["kv_dtype"],
+            "kv_bytes_per_token": p1["hbm"]["kv_bytes_per_token"],
             "decoded_tok_per_s": stats_p.get("decoded_tok_per_s"),
             "max_in_flight": stats_p["peak_in_flight"],
             "dense_slots_same_hbm": slots,
@@ -623,6 +638,46 @@ def bench_serving(batch: int, trials: int, seq_len: int = 256,
         }
     except Exception as e:  # noqa: BLE001 - report, keep dense results
         paged_out = {"error": f"{type(e).__name__}: {e}"}
+
+    # ---- quantized sub-results (ISSUE 7): the same traffic through an
+    # int8-KV paged decoder — quantize-on-write pages + fp32 block
+    # scales, dequant inside the ragged attention walk.  Weights are
+    # copied into a private scope (the pool var name is shared with the
+    # float generator above).  Quality deltas live with the quality
+    # benches (mnist_quality.top1_int8_delta, nmt_quality.bleu_int8_delta).
+    quant_out = None
+    try:
+        from paddle_tpu.serving import copy_weights
+
+        i8_page = kv_page_bytes(cfg["n_layer"], cfg["n_head"],
+                                cfg["d_key"], page_size, "int8")
+        scope_q = fluid.Scope()
+        copy_weights(scope, scope_q, prefix="tfserve")
+        quant = PagedTransformerGenerator(
+            vocab, vocab, max_length=seq_len + 1, src_len=seq_len,
+            max_out_len=decode_len, scope=scope_q, executor=exe,
+            param_prefix="tfserve", page_size=page_size, chunk_size=chunk,
+            num_pages=max(8, budget // i8_page), kv_dtype="int8", **cfg)
+        stats_q, q0, q1, q_peak_bytes, q_peak_util = _paged_contest(quant)
+        quant_out = {
+            "kv_dtype": "int8",
+            "num_pages": quant.num_pages,
+            "pool_bytes": q1["hbm"]["pool_bytes"],
+            "kv_bytes_per_token": q1["hbm"]["kv_bytes_per_token"],
+            "float_kv_bytes_per_token": kv_page_bytes(
+                cfg["n_layer"], cfg["n_head"], cfg["d_key"], page_size,
+                "float32") // page_size,
+            "decoded_tok_per_s": stats_q.get("decoded_tok_per_s"),
+            "max_in_flight": stats_q["peak_in_flight"],
+            "dense_slots_same_hbm": slots,
+            "hbm_bytes_per_slot_peak": (
+                q_peak_bytes // max(1, stats_q["peak_in_flight"])),
+            "page_utilization_peak": q_peak_util,
+            "recompiles_after_warmup": (q1["executable"]["misses"]
+                                        - q0["executable"]["misses"]),
+        }
+    except Exception as e:  # noqa: BLE001 - report, keep dense results
+        quant_out = {"error": f"{type(e).__name__}: {e}"}
 
     return {
         "seq_len": seq_len, "batch": batch, "decode_len": decode_len,
@@ -640,6 +695,7 @@ def bench_serving(batch: int, trials: int, seq_len: int = 256,
         "prefill_bucket_hit_rate": round(hits / max(1, hits + misses), 4),
         "recompiles_after_warmup": recompiles,
         "paged": paged_out,
+        "quantized": quant_out,
     }
 
 
@@ -747,9 +803,39 @@ def bench_mnist_quality(steps_cap_secs: float = MNIST_TOP1_TARGET_SECS):
                      fetch_list=[pred], mode="infer")
         correct += int((np.asarray(p).argmax(-1) == yt[i:, 0]).sum())
         total = len(xt)
-    return {"tier": tier, "top1": round(correct / total, 4),
+    top1 = round(correct / total, 4)
+
+    # int8 PTQ delta (ISSUE 7): the SAME trained weights through the
+    # quantized engine (conv + fc weights per-channel int8, dequant
+    # folded into the output scale) — the top-1 cost of the 4x smaller
+    # weight stream, reported next to the float number.  Guarded so a
+    # quantized-path failure cannot null the float quality headline.
+    quant_out = {}
+    try:
+        from paddle_tpu.serving import InferenceEngine
+
+        pruned = fluid.io.prune_program(main_prog, [pred])
+        eng_q = InferenceEngine(program=pruned, feed_names=["img"],
+                                fetch_vars=[pred], scope=scope,
+                                executor=exe, quantize="int8",
+                                batch_buckets=(eval_bs,))
+        correct_q = 0
+        for i in range(0, len(xt), eval_bs):
+            p, = eng_q.infer({"img": xt[i:i + eval_bs]})
+            correct_q += int((np.asarray(p).argmax(-1)
+                              == yt[i:i + eval_bs, 0]).sum())
+        top1_q = round(correct_q / total, 4)
+        qs = eng_q.cache_stats()["quant"]
+        quant_out = {"top1_int8": top1_q,
+                     "top1_int8_delta": round(top1_q - top1, 4),
+                     "weights_quantized": qs["weights_quantized"],
+                     "weight_bytes_saved": qs["weight_bytes_saved"]}
+    except Exception as e:  # noqa: BLE001
+        quant_out = {"int8_error": f"{type(e).__name__}: {e}"}
+
+    return {"tier": tier, "top1": top1,
             "n_train": len(xs), "n_test": total, "epochs": epochs,
-            "train_secs": round(_t.time() - t0, 1)}
+            "train_secs": round(_t.time() - t0, 1), **quant_out}
 
 
 def bench_nmt_quality(dict_size: int = 2000, max_epochs: int = 45,
@@ -877,7 +963,39 @@ def bench_nmt_quality(dict_size: int = 2000, max_epochs: int = 45,
         engine_rate = len(hyps) / engine_secs
         est = engine.cache_stats()
     bleu = corpus_bleu(hyps, refs)
-    return {"tier": tier, "bleu": round(float(bleu), 4),
+
+    # int8 PTQ delta (ISSUE 7): the same beam decode through the
+    # quantized engine — BLEU cost of the int8 weight stream, next to
+    # the float number.  Guarded: a quantized failure must not null the
+    # float BLEU headline.
+    quant_out = {}
+    try:
+        engine_q = InferenceEngine(program=infer_prog, feed_names=["src"],
+                                   fetch_vars=[ids_out], scope=scope,
+                                   executor=exe, quantize="int8",
+                                   batch_buckets=(16, 32, 64, bs),
+                                   time_bucket=8)
+        engine_q.warmup(warm_feeds)
+        hyps_q = []
+        t_q = _t.time()
+        for i in range(0, len(test_rows), bs):
+            s, n, _ = batch(test_rows[i:i + bs])
+            out, = engine_q.infer({"src": s}, return_numpy=False)
+            best = np.asarray(out)[:, 0]
+            for b in range(best.shape[0]):
+                hyps_q.append([int(w) for w in best[b] if w > 1])
+        rate_q = len(hyps_q) / (_t.time() - t_q)
+        bleu_q = corpus_bleu(hyps_q, refs)
+        quant_out = {
+            "bleu_int8": round(float(bleu_q), 4),
+            "bleu_int8_delta": round(float(bleu_q) - float(bleu), 4),
+            "engine_int8_sentences_per_s": round(rate_q, 2),
+            "weights_quantized": engine_q.cache_stats()["quant"]
+                                         ["weights_quantized"]}
+    except Exception as e:  # noqa: BLE001
+        quant_out = {"int8_error": f"{type(e).__name__}: {e}"}
+
+    return {"tier": tier, "bleu": round(float(bleu), 4), **quant_out,
             "n_train": len(train_rows), "n_test": len(hyps),
             "beam_size": beam_size, "epochs": epochs,
             "train_secs": round(_t.time() - t0, 1),
@@ -1058,6 +1176,14 @@ def main() -> None:
         # batching p50/p95 at a fixed offered load, bucket hit rate and
         # the steady-state recompile count (must be 0)
         "serving": serving_cmp,
+        # int8 PTQ rollup (ISSUE 7): the int8-KV paged serving block plus
+        # the measured quality cost of the quantized weight stream (full
+        # detail under serving.quantized / *_quality)
+        "quantized": {
+            "serving": (serving_cmp or {}).get("quantized"),
+            "mnist_top1_delta": (quality or {}).get("top1_int8_delta"),
+            "nmt_bleu_delta": (nmt_quality or {}).get("bleu_int8_delta"),
+        },
         "transformer_long_context": long_ctx,
         # real-data trained quality — 'real' tier with egress, else the
         # committed real-data fixture tier (never synthetic, never None

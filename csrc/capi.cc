@@ -76,6 +76,12 @@ Tensor from_raw(const ptpu::RawTensor& r, const std::string& what) {
       std::memcpy(&v, raw + i * 4, 4);
       t.data[i] = (float)v;
     }
+  } else if (r.dtype == "int8") {
+    // quantized weights (io.py PTQ artifacts): the raw int8 VALUES are
+    // kept — dequantization is the quantized_* op's job (out * scale),
+    // exactly as on the XLA tier
+    for (int64_t i = 0; i < n; ++i)
+      t.data[i] = (float)(int8_t)raw[i];
   } else {
     throw std::runtime_error(what + ": unsupported dtype " + r.dtype +
                              " (native serving engine is float32)");
@@ -156,7 +162,7 @@ struct Engine {
 void Engine::run_op(const OpDesc& op) {
   const std::string& t = op.type;
   if (t == "feed" || t == "fetch") return;  // handled by forward()
-  if (t == "mul") {
+  if (t == "mul" || t == "quantized_mul") {
     Tensor& x = in(op, "X");
     Tensor& y = in(op, "Y");
     int64_t xnum = op.attr_int("x_num_col_dims", 1);
@@ -167,12 +173,26 @@ void Engine::run_op(const OpDesc& op) {
     for (size_t i = 0; i < y.shape.size(); ++i)
       ((int64_t)i < ynum ? k2 : n) *= y.shape[i];
     if (k != k2)
-      throw std::runtime_error("mul: inner dim mismatch");
+      throw std::runtime_error(t + ": inner dim mismatch");
     Tensor r;
     r.shape.assign(x.shape.begin(), x.shape.begin() + xnum);
     r.shape.insert(r.shape.end(), y.shape.begin() + ynum, y.shape.end());
     r.data.resize(m * n);
     matmul2d(x.data.data(), y.data.data(), r.data.data(), m, k, n);
+    if (t == "quantized_mul") {
+      // the int8 weight loaded as raw quantized values; fold the
+      // per-output-channel (or scalar) fp32 scale into the result —
+      // the same dequant-into-output-scale the XLA emitter does
+      Tensor& sc = in(op, "Scale");
+      if (sc.numel() != 1 && sc.numel() != n)
+        throw std::runtime_error("quantized_mul: Scale has " +
+                                 std::to_string(sc.numel()) +
+                                 " elements, want 1 or " +
+                                 std::to_string(n));
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t j = 0; j < n; ++j)
+          r.data[i * n + j] *= sc.data[sc.numel() == 1 ? 0 : j];
+    }
     out(op) = std::move(r);
   } else if (t == "elementwise_add" || t == "elementwise_sub" ||
              t == "elementwise_mul" || t == "elementwise_div") {
@@ -311,9 +331,21 @@ void Engine::run_op(const OpDesc& op) {
           rp[i] = (xp[i] - mu) * sc + sh;
       }
     out(op, "Y") = std::move(r);
-  } else if (t == "conv2d") {
+  } else if (t == "conv2d" || t == "quantized_conv2d") {
     Tensor& x = in(op, "Input");
     Tensor& w = in(op, "Filter");
+    // int8 filter loaded as raw quantized values; fold the per-output-
+    // channel (or scalar) fp32 scale into each output channel, same as
+    // quantized_mul folds it into the matmul result
+    const Tensor* sc = nullptr;
+    if (t == "quantized_conv2d") {
+      sc = &in(op, "Scale");
+      if (sc->numel() != 1 && sc->numel() != w.shape[0])
+        throw std::runtime_error("quantized_conv2d: Scale has " +
+                                 std::to_string(sc->numel()) +
+                                 " elements, want 1 or " +
+                                 std::to_string(w.shape[0]));
+    }
     auto st = op.attr_ints("strides");
     auto pd = op.attr_ints("paddings");
     auto dil = op.attr_ints("dilations");
@@ -337,6 +369,7 @@ void Engine::run_op(const OpDesc& op) {
     for (int64_t b = 0; b < B; ++b)
       for (int64_t o = 0; o < O; ++o) {
         int64_t gi = o / opg;
+        float oc_scale = sc ? sc->data[sc->numel() == 1 ? 0 : o] : 1.f;
         for (int64_t oh = 0; oh < OH; ++oh)
           for (int64_t ow = 0; ow < OW; ++ow) {
             float acc = 0;
@@ -353,7 +386,7 @@ void Engine::run_op(const OpDesc& op) {
                 }
               }
             }
-            r.data[((b * O + o) * OH + oh) * OW + ow] = acc;
+            r.data[((b * O + o) * OH + oh) * OW + ow] = acc * oc_scale;
           }
       }
     out(op, "Output") = std::move(r);
@@ -701,16 +734,18 @@ void Engine::run_op(const OpDesc& op) {
   } else {
     throw std::runtime_error(
         "native inference engine: unsupported op '" + t +
-        "' (supported: feed/fetch, mul, elementwise_*, activations, "
-        "softmax, scale, reshape, transpose, mean, dropout, batch_norm, "
-        "conv2d, pool2d, lookup_table, sequence_pool, dynamic_lstm, "
-        "dynamic_gru, concat, sum — use the PJRT/StableHLO tier for "
-        "anything XLA can run)");
+        "' (supported: feed/fetch, mul, quantized_mul, elementwise_*, "
+        "activations, softmax, scale, reshape, transpose, mean, dropout, "
+        "batch_norm, conv2d, quantized_conv2d, pool2d, lookup_table, "
+        "sequence_pool, "
+        "dynamic_lstm, dynamic_gru, concat, sum — use the PJRT/StableHLO "
+        "tier for anything XLA can run)");
   }
   // sequence lengths ride along ops that keep the [batch, time] leading
   // dims (the reference copies lod input->output in these kernels)
   static const char* kSeqTransparent[] = {
-      "mul", "elementwise_add", "elementwise_sub", "elementwise_mul",
+      "mul", "quantized_mul", "elementwise_add", "elementwise_sub",
+      "elementwise_mul",
       "elementwise_div", "relu", "tanh", "sigmoid", "exp", "sqrt", "abs",
       "softmax", "scale", "dropout"};
   for (auto* st : kSeqTransparent)

@@ -10,7 +10,7 @@ from . import ops as _ops  # registers all op emitters  # noqa: F401
 from . import (analysis, checkpoint, clip, debugger, evaluator, initializer,
                io, layers, learning_rate_decay,
                memory_optimization_transpiler, nets, optimizer, profiler,
-               regularizer, unique_name)
+               regularizer, transforms, unique_name)
 from .analysis import analyze_program
 from .memory_optimization_transpiler import memory_optimize
 from .backward import append_backward, calc_gradient
@@ -31,6 +31,7 @@ __all__ = [
     "layers", "optimizer", "initializer", "regularizer", "clip", "io",
     "nets", "unique_name", "evaluator", "profiler", "learning_rate_decay",
     "memory_optimize", "debugger", "analysis", "analyze_program",
+    "transforms",
     "append_backward", "calc_gradient",
     "Executor", "Scope", "global_scope", "scope_guard",
     "TPUPlace", "CPUPlace",

@@ -22,7 +22,8 @@ __all__ = [
     "softmax_with_cross_entropy", "smooth_l1", "l2_normalize", "split",
     "nce", "im2sequence", "beam_search", "beam_search_decode", "batch_gather",
     "gather", "expand", "multiplex", "fused_attention", "decode_attention",
-    "ragged_decode_attention",
+    "ragged_decode_attention", "quantize", "dequantize", "quantized_mul",
+    "quantized_matmul", "quantized_conv2d",
     "pad", "crop", "lod_reset", "lrn", "label_smooth", "rank_loss",
     "margin_rank_loss", "log_loss", "conv_shift", "row_conv",
     "dynamic_lstmp", "roi_pool", "spp", "unpool", "prior_box",
@@ -883,14 +884,15 @@ def decode_attention(q, k_cache, v_cache, lengths, sm_scale=None,
 
 def ragged_decode_attention(q, pool, page_table, lengths, q_base=None,
                             layer=0, n_layer=1, causal=True, sm_scale=None,
-                            impl=None, name=None):
+                            impl=None, scales=None, name=None):
     """Attention of per-lane query blocks against the paged KV pool,
     walking each lane's page list (ops/cache_ops.ragged_decode_attention;
     the Pallas kernel lives in kernels/flash_attention).  q [B, C, H, D]
     (C=1 steady-state decode, C=chunk during chunked prefill), pool
     [H, R, page_size, D], page_table [B, P] int32 logical pages, lengths
     [B] int32 live positions, q_base [B] int32 global query start
-    (required when causal)."""
+    (required when causal).  ``scales`` ([1, R, page_size] fp32) rides
+    along for int8 pools — K/V dequantize in-register during the walk."""
     helper = LayerHelper("ragged_decode_attention", name=name)
     out = helper.create_tmp_variable(q.dtype, stop_gradient=True)
     attrs = {"layer": int(layer), "n_layer": int(n_layer),
@@ -903,7 +905,84 @@ def ragged_decode_attention(q, pool, page_table, lengths, q_base=None,
               "Lengths": lengths}
     if q_base is not None:
         inputs["QBase"] = q_base
+    if scales is not None:
+        inputs["Scales"] = scales
     helper.append_op("ragged_decode_attention", inputs, {"Out": out}, attrs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# post-training quantization wrappers (ops/quant_ops.py; transform in
+# fluid/transforms/quantize.py)
+# ---------------------------------------------------------------------------
+
+def quantize(x, axis=None, name=None):
+    """Symmetric max-abs int8 quantization: returns (int8 out, fp32
+    scale).  ``axis`` selects the per-channel dim; None = one per-tensor
+    scalar scale."""
+    helper = LayerHelper("quantize", name=name)
+    out = helper.create_tmp_variable("int8", stop_gradient=True)
+    scale = helper.create_tmp_variable("float32", stop_gradient=True)
+    attrs = {}
+    if axis is not None:
+        attrs["axis"] = int(axis)
+    helper.append_op("quantize", {"X": x}, {"Out": out, "Scale": scale},
+                     attrs)
+    return out, scale
+
+
+def dequantize(x, scale, axis=None, out_dtype="float32", name=None):
+    """int8 x * scale -> float (inverse of ``quantize``; ``axis`` must
+    match)."""
+    helper = LayerHelper("dequantize", name=name)
+    out = helper.create_tmp_variable(out_dtype, stop_gradient=True)
+    attrs = {"out_dtype": str(out_dtype)}
+    if axis is not None:
+        attrs["axis"] = int(axis)
+    helper.append_op("dequantize", {"X": x, "Scale": scale}, {"Out": out},
+                     attrs)
+    return out
+
+
+def quantized_mul(x, y, scale, x_num_col_dims=1, y_num_col_dims=1,
+                  name=None):
+    """``mul`` with an int8 ``y`` and per-output-channel fp32 ``scale``
+    (ops/quant_ops.quantized_mul) — the op the PTQ transform rewrites
+    projection matmuls into."""
+    helper = LayerHelper("quantized_mul", name=name)
+    out = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("quantized_mul", {"X": x, "Y": y, "Scale": scale},
+                     {"Out": out},
+                     {"x_num_col_dims": x_num_col_dims,
+                      "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def quantized_matmul(x, y, scale, transpose_x=False, transpose_y=False,
+                     alpha=1.0, name=None):
+    """``matmul`` with an int8 ``y``; ``scale`` is per the result's last
+    dim (the output channel after any transpose) or scalar."""
+    helper = LayerHelper("quantized_matmul", name=name)
+    out = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("quantized_matmul", {"X": x, "Y": y, "Scale": scale},
+                     {"Out": out},
+                     {"transpose_X": bool(transpose_x),
+                      "transpose_Y": bool(transpose_y),
+                      "alpha": float(alpha)})
+    return out
+
+
+def quantized_conv2d(x, w, scale, strides=(1, 1), paddings=(0, 0),
+                     dilations=(1, 1), groups=1, name=None):
+    """``conv2d`` with an int8 OIHW filter and per-output-channel fp32
+    scale (dequantized in-register — HBM moves 1/4 the filter bytes)."""
+    helper = LayerHelper("quantized_conv2d", name=name)
+    out = helper.create_tmp_variable(x.dtype, stop_gradient=True)
+    helper.append_op("quantized_conv2d",
+                     {"Input": x, "Filter": w, "Scale": scale},
+                     {"Output": out},
+                     {"strides": list(strides), "paddings": list(paddings),
+                      "dilations": list(dilations), "groups": int(groups)})
     return out
 
 

@@ -8,7 +8,8 @@ from ..layer_helper import LayerHelper
 __all__ = ["create_tensor", "create_global_var", "fill_constant",
            "fill_constant_batch_size_like", "zeros", "ones", "concat",
            "sums", "assign", "cast", "argmax", "isfinite", "cache_write",
-           "paged_cache_write", "paged_page_copy"]
+           "paged_cache_write", "quantized_paged_cache_write",
+           "paged_page_copy"]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -127,10 +128,46 @@ def paged_cache_write(pool, k, v, pages, offsets, layer, n_layer, out=None):
     return out
 
 
-def paged_page_copy(pool, src, dst, n_layer, out=None):
+def quantized_paged_cache_write(pool, scales, k, v, pages, offsets, layer,
+                                n_layer, out=None, scales_out=None):
+    """``paged_cache_write`` for an int8 pool: K/V quantize on write (one
+    fp32 max-abs scale per token block, landing in the ``scales`` sidecar
+    [1, R, page_size] at the same (row, slot) as the int8 bytes — see
+    ops/cache_ops.quantized_paged_cache_write).  Out/ScalesOut default to
+    the pool/scales vars themselves (the ParamOut in-place idiom), and
+    returns (pool, scales)."""
+    helper = LayerHelper("quantized_paged_cache_write")
+    out = out or pool
+    scales_out = scales_out or scales
+    out.stop_gradient = True
+    scales_out.stop_gradient = True
+    helper.append_op("quantized_paged_cache_write",
+                     {"Pool": pool, "Scales": scales, "K": k, "V": v,
+                      "Pages": pages, "Offsets": offsets},
+                     {"Out": out, "ScalesOut": scales_out},
+                     {"layer": int(layer), "n_layer": int(n_layer)})
+    return out, scales_out
+
+
+def paged_page_copy(pool, src, dst, n_layer, out=None, scales=None,
+                    scales_out=None):
     """Whole-page device copy ``src[b] -> dst[b]`` (all layers, K and V)
     — the in-dispatch half of copy-on-write page sharing.  ``src == dst``
-    encodes a per-lane no-op (ops/cache_ops.paged_page_copy)."""
+    encodes a per-lane no-op (ops/cache_ops.paged_page_copy).  Pass the
+    int8 pool's ``scales`` sidecar to move the fp32 block scales with
+    the bytes (quantized_paged_page_copy); returns (pool, scales) then."""
+    if scales is not None:
+        helper = LayerHelper("quantized_paged_page_copy")
+        out = out or pool
+        scales_out = scales_out or scales
+        out.stop_gradient = True
+        scales_out.stop_gradient = True
+        helper.append_op("quantized_paged_page_copy",
+                         {"Pool": pool, "Scales": scales, "Src": src,
+                          "Dst": dst},
+                         {"Out": out, "ScalesOut": scales_out},
+                         {"n_layer": int(n_layer)})
+        return out, scales_out
     helper = LayerHelper("paged_page_copy")
     out = out or pool
     out.stop_gradient = True

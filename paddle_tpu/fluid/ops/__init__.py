@@ -19,6 +19,7 @@ from . import (  # noqa: F401
     moe_ops,
     nn_ops,
     optimizer_ops,
+    quant_ops,
     rnn_ops,
     sequence_ops,
     tensor_ops,

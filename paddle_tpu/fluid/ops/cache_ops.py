@@ -117,14 +117,58 @@ def paged_cache_write(ctx, pool, k, v, pages, offsets):
     return pool.at[:, v_rows, offsets].set(vt)
 
 
+@primitive("quantized_paged_cache_write",
+           inputs=["Pool", "Scales", "K", "V", "Pages", "Offsets"],
+           outputs=["Out", "ScalesOut"], no_grad=True)
+def quantized_paged_cache_write(ctx, pool, scales, k, v, pages, offsets):
+    """``paged_cache_write`` for an int8 pool: each token's K (and V)
+    [H, D] slab quantizes symmetrically on write — one fp32 max-abs
+    scale per (token, layer, role) block, stored in the ``scales``
+    sidecar [1, R, page_size] at the SAME (physical row, slot) the int8
+    bytes land in — so the block scales ride the exact page indirection
+    the pool does (paged_page_copy moves both with the same row math).
+    Out/ScalesOut alias Pool/Scales (the cache_write ParamOut idiom)."""
+    from ...kernels.flash_attention import paged_kv_rows
+    from .quant_ops import abs_max_scale, quantize_array
+
+    layer = int(ctx.attr("layer", 0))
+    n_layer = int(ctx.attr("n_layer", 1))
+    pages = jnp.asarray(pages).astype(jnp.int32)
+    offsets = jnp.asarray(offsets).astype(jnp.int32)
+    if pages.ndim == 1:               # one token per lane (decode step)
+        pages = pages[:, None]
+        offsets = offsets[:, None]
+        k = k if k.ndim == 4 else k[:, None]
+        v = v if v.ndim == 4 else v[:, None]
+    k_rows, v_rows = paged_kv_rows(pages, layer, n_layer)
+
+    def tok_quant(val):
+        """[B, C, H, D] float -> (int8 [H, B, C, D], scale [B, C]) via
+        quant_ops' shared max-abs rule (one block scale per token)."""
+        vf = val.astype(jnp.float32)
+        sc = abs_max_scale(vf, axis=(0, 1))                 # [B, C]
+        q = quantize_array(vf, sc, axis=(0, 1))
+        return jnp.transpose(q.astype(pool.dtype), (2, 0, 1, 3)), sc
+
+    kq, ks = tok_quant(k)
+    vq, vs = tok_quant(v)
+    pool = pool.at[:, k_rows, offsets].set(kq)
+    pool = pool.at[:, v_rows, offsets].set(vq)
+    scales = scales.at[0, k_rows, offsets].set(ks)
+    scales = scales.at[0, v_rows, offsets].set(vs)
+    return pool, scales
+
+
 @primitive("ragged_decode_attention",
-           inputs=["Q", "Pool", "PageTable", "Lengths", "QBase?"],
+           inputs=["Q", "Pool", "PageTable", "Lengths", "QBase?", "Scales?"],
            outputs=["Out"], no_grad=True)
-def ragged_decode_attention(ctx, q, pool, page_table, lengths, q_base):
+def ragged_decode_attention(ctx, q, pool, page_table, lengths, q_base,
+                            scales):
     """Per-lane attention over the lane's page list — see
     kernels/flash_attention.ragged_decode_attention (q [B, C, H, D],
     pool [H, R, page_size, D], page_table [B, P] int32 logical pages,
-    lengths [B], optional q_base [B] for causal chunk queries)."""
+    lengths [B], optional q_base [B] for causal chunk queries, optional
+    Scales [1, R, page_size] fp32 block scales for an int8 pool)."""
     from ...kernels.flash_attention import ragged_decode_attention as _ra
 
     return _ra(q, pool, page_table, lengths, q_base,
@@ -132,7 +176,16 @@ def ragged_decode_attention(ctx, q, pool, page_table, lengths, q_base):
                n_layer=int(ctx.attr("n_layer", 1)),
                causal=bool(ctx.attr("causal", True)),
                sm_scale=ctx.attr("sm_scale", None),
-               impl=ctx.attr("impl", None))
+               impl=ctx.attr("impl", None),
+               scales=scales)
+
+
+def _page_copy_rows(src, dst, n_layer):
+    src = jnp.asarray(src).astype(jnp.int32).reshape(-1)
+    dst = jnp.asarray(dst).astype(jnp.int32).reshape(-1)
+    span = jnp.arange(2 * n_layer, dtype=jnp.int32)[None, :]
+    return (src[:, None] * (2 * n_layer) + span,          # [B, 2L]
+            dst[:, None] * (2 * n_layer) + span)
 
 
 @primitive("paged_page_copy", inputs=["Pool", "Src", "Dst"],
@@ -143,10 +196,21 @@ def paged_page_copy(ctx, pool, src, dst):
     parent's partially-filled page get their own copy IN the step
     dispatch before writing.  ``src == dst`` rows are identity writes
     (the no-op encoding for lanes that don't need a copy this step)."""
-    n_layer = int(ctx.attr("n_layer", 1))
-    src = jnp.asarray(src).astype(jnp.int32).reshape(-1)
-    dst = jnp.asarray(dst).astype(jnp.int32).reshape(-1)
-    span = jnp.arange(2 * n_layer, dtype=jnp.int32)[None, :]
-    src_rows = src[:, None] * (2 * n_layer) + span        # [B, 2L]
-    dst_rows = dst[:, None] * (2 * n_layer) + span
+    src_rows, dst_rows = _page_copy_rows(src, dst,
+                                         int(ctx.attr("n_layer", 1)))
     return pool.at[:, dst_rows].set(pool[:, src_rows])
+
+
+@primitive("quantized_paged_page_copy",
+           inputs=["Pool", "Scales", "Src", "Dst"],
+           outputs=["Out", "ScalesOut"], no_grad=True)
+def quantized_paged_page_copy(ctx, pool, scales, src, dst):
+    """``paged_page_copy`` for an int8 pool: the fp32 block scales ride
+    the SAME physical-row move the int8 bytes do — a copied page is
+    bit-identical to its parent, scales included, so copy-on-write
+    never changes what a beam lane dequantizes."""
+    src_rows, dst_rows = _page_copy_rows(src, dst,
+                                         int(ctx.attr("n_layer", 1)))
+    pool = pool.at[:, dst_rows].set(pool[:, src_rows])
+    scales = scales.at[:, dst_rows].set(scales[:, src_rows])
+    return pool, scales

@@ -1,0 +1,184 @@
+"""Post-training quantization ops (the serving weight-stream diet).
+
+BENCH_NOTES shows decode and the conv nets are HBM-bound: the bytes the
+weight stream moves per dispatch are wall-clock, so halving (bf16) or
+quartering (int8 vs f32) them is throughput won — the economics framing
+of "Fine-Tuning and Serving Gemma" (PAPERS.md) and the block-scaling
+granularity lesson of EQuARX.  Four inference-only ops:
+
+* ``quantize``     — X (float) -> int8 Out + fp32 Scale, symmetric
+  max-abs calibration, per-output-channel (``axis``) or per-tensor.
+* ``dequantize``   — int8 X * Scale -> float Out (exact inverse modulo
+  the round).
+* ``quantized_mul`` / ``quantized_matmul`` — the ``mul``/``matmul``
+  emitters with an int8 weight: the 2-D dot consumes the int8 operand
+  directly on the MXU (``dot_general`` with mixed operand dtypes and
+  ``preferred_element_type=f32``) and the dequant folds into the
+  *output* scale — no dequantized weight tensor ever exists in HBM.
+  (The batched ``quantized_matmul`` path dequantizes the weight view
+  in-register first; HBM still moves only int8 bytes.)
+* ``quantized_conv2d`` — conv with an int8 filter; the per-channel
+  dequant happens in-register right before ``conv_general_dilated``
+  (XLA fuses the convert+scale into the conv's operand read), so HBM
+  still only moves int8 filter bytes.
+
+All are ``no_grad``: training never builds them, and ``append_backward``
+skips them (the inference-only exemption the reference's int8 path also
+relies on — you quantize AFTER training).
+
+Scale conventions (shared with transforms/quantize.py — the calibrator
+and the emitters must agree or outputs silently scale wrong):
+* symmetric, zero-point-free: q = clip(round(x / scale), -127, 127);
+* per-channel scale has the shape of the OUTPUT channel dim and
+  multiplies the matmul/conv result on that dim;
+* a zero max-abs channel gets scale 1.0 (all-zero rows quantize to 0,
+  and 0 * 1.0 dequantizes back to 0 — never a 0/0).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import primitive
+
+QMAX = 127.0
+
+
+def _keep_axes(x_ndim: int, axis):
+    return tuple(sorted(a % x_ndim for a in
+                        (axis if isinstance(axis, (tuple, list))
+                         else (axis,))))
+
+
+def _broadcast_scale(scale, x_ndim: int, axis):
+    """Reshape a kept-axes scale so it broadcasts against rank-x_ndim."""
+    if jnp.ndim(scale) == 0:
+        return scale
+    shape = [1] * x_ndim
+    for a, s in zip(_keep_axes(x_ndim, axis), scale.shape):
+        shape[a] = s
+    return scale.reshape(shape)
+
+
+def abs_max_scale(x, axis=None):
+    """Symmetric max-abs scale: per-tensor (axis None -> scalar) or one
+    scale per position of the kept ``axis`` (an int, or a tuple for
+    block scales like the KV pool's per-(lane, slot)).  Zero channels
+    get scale 1.0.  THE calibration rule — transforms/quantize.py and
+    cache_ops' quantize-on-write both call it, so the calibrator and
+    the emitters can never drift."""
+    if axis is None:
+        amax = jnp.max(jnp.abs(x))
+    else:
+        keep = _keep_axes(x.ndim, axis)
+        reduce_axes = tuple(i for i in range(x.ndim) if i not in keep)
+        amax = jnp.max(jnp.abs(x), axis=reduce_axes)
+    scale = amax.astype(jnp.float32) / QMAX
+    return jnp.where(scale == 0.0, jnp.float32(1.0), scale)
+
+
+def quantize_array(x, scale, axis=None):
+    """clip(round(x / scale)) -> int8, scale broadcast at ``axis``."""
+    xf = x.astype(jnp.float32)
+    if axis is not None:
+        scale = _broadcast_scale(scale, xf.ndim, axis)
+    q = jnp.round(xf / scale)
+    return jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+
+
+@primitive("quantize", inputs=["X"], outputs=["Out", "Scale"], no_grad=True,
+           seq_transparent=True)
+def quantize(ctx, x):
+    """X (float) -> (int8 Out, fp32 Scale).  ``axis`` attr selects the
+    per-channel dim (absent -> one per-tensor scalar scale)."""
+    axis = ctx.attr("axis", None)
+    scale = abs_max_scale(x, axis)
+    return quantize_array(x, scale, axis), scale
+
+
+@primitive("dequantize", inputs=["X", "Scale"], no_grad=True,
+           seq_transparent=True)
+def dequantize(ctx, x, scale):
+    """int8 X * Scale -> float Out (``out_dtype`` attr, default f32);
+    ``axis`` attr must match the quantize that produced Scale."""
+    axis = ctx.attr("axis", None)
+    out_dt = ctx.attr("out_dtype", "float32")
+    if out_dt == "float64":           # runtime narrows f64 (executor rule)
+        out_dt = "float32"
+    xf = x.astype(jnp.float32)
+    if axis is not None:
+        scale = _broadcast_scale(scale, xf.ndim, axis)
+    return (xf * scale).astype(out_dt)
+
+
+def _flatten_2d(x, num_col_dims: int):
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims else 1
+    return x.reshape(lead, -1)
+
+
+def int8_dot(x2, w2):
+    """[M, K] float x [K, N] int8 -> [M, N] f32 on the MXU's mixed
+    int8 path — the one dot shape every quantized matmul reduces to."""
+    return jax.lax.dot_general(x2, w2, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+@primitive("quantized_mul", inputs=["X", "Y", "Scale"], no_grad=True,
+           seq_transparent=True)
+def quantized_mul(ctx, x, y, scale):
+    """``mul`` with an int8 Y and a per-output-channel (or scalar) fp32
+    Scale: out = (X2 @ Y2_int8) * scale, computed f32, cast back to X's
+    dtype.  Same x/y_num_col_dims flattening contract as ``mul``."""
+    xd = ctx.attr("x_num_col_dims", 1)
+    yd = ctx.attr("y_num_col_dims", 1)
+    x2 = _flatten_2d(x, xd)
+    y2 = _flatten_2d(y, yd)
+    out = int8_dot(x2, y2) * scale.astype(jnp.float32)
+    out = out.astype(x.dtype)
+    return out.reshape(*x.shape[:xd], *y.shape[yd:])
+
+
+@primitive("quantized_matmul", inputs=["X", "Y", "Scale"], no_grad=True,
+           seq_transparent=True)
+def quantized_matmul(ctx, x, y, scale):
+    """``matmul`` with an int8 Y; Scale is per the RESULT's last dim (the
+    output channel after any transpose) or scalar."""
+    if ctx.attr("transpose_X", False) and x.ndim >= 2:
+        x = jnp.swapaxes(x, -1, -2)
+    if ctx.attr("transpose_Y", False) and y.ndim >= 2:
+        y = jnp.swapaxes(y, -1, -2)
+    if x.ndim == 2 and y.ndim == 2:
+        out = int8_dot(x, y) * scale.astype(jnp.float32)
+    else:
+        # batched: XLA's mixed batched-dot support varies, so dequantize
+        # the (small) weight view in-register and take the normal path
+        yf = y.astype(jnp.float32) * scale.astype(jnp.float32)
+        out = jnp.matmul(x.astype(jnp.float32), yf)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return out.astype(x.dtype)
+
+
+@primitive("quantized_conv2d", inputs=["Input", "Filter", "Scale"],
+           outputs=["Output"], no_grad=True)
+def quantized_conv2d(ctx, x, w, scale):
+    """``conv2d`` with an int8 OIHW Filter and per-output-channel Scale:
+    the filter dequantizes in-register (XLA fuses convert+scale into the
+    conv's weight read), so HBM moves 1/4 the filter bytes."""
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0])
+    dil = tuple(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1)
+    sc = scale.astype(jnp.float32)
+    if jnp.ndim(sc) > 0:
+        sc = sc.reshape(-1, 1, 1, 1)          # per-OC on OIHW dim 0
+    wf = w.astype(jnp.float32) * sc           # fp32 scales stay fp32
+    return jax.lax.conv_general_dilated(
+        x, wf, window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32).astype(x.dtype)

@@ -1,0 +1,10 @@
+"""Program-rewrite transforms over the ProgramDesc IR.
+
+Unlike the analysis passes (read-only) these rewrite programs — the
+first resident is the post-training quantization pass (quantize.py),
+the serving-side capacity doubler of ROADMAP item 3.
+"""
+
+from .quantize import QuantStats, quantize_program  # noqa: F401
+
+__all__ = ["quantize_program", "QuantStats"]

@@ -132,15 +132,25 @@ def _ragged_mask(scores, lengths_b, base_b, p0, n_cols, causal, c):
 
 
 def _ragged_xla(q, pool, page_table, lengths, q_base, layer, n_layer,
-                causal, sm_scale):
+                causal, sm_scale, scales=None):
     """Gather-based fallback: resolve each lane's pages to pool rows and
-    run length/causally-masked attention over the gathered prefix."""
+    run length/causally-masked attention over the gathered prefix.  An
+    int8 pool dequantizes right after the gather (``scales`` holds one
+    fp32 scale per (row, slot) block) — HBM moved int8 bytes; the f32
+    view exists only as a fused register-level convert."""
     h, _r, ps, d = pool.shape
     b, c, _h, _d = q.shape
     n_pages = page_table.shape[1]
     k_rows, v_rows = paged_kv_rows(page_table, layer, n_layer)
     k = pool[:, k_rows]                       # [h, B, P, ps, d]
     v = pool[:, v_rows]
+    if scales is not None:
+        sc = scales.reshape(scales.shape[-2], scales.shape[-1])  # [R, ps]
+        k = k.astype(jnp.float32) * sc[k_rows][None, :, :, :, None]
+        v = v.astype(jnp.float32) * sc[v_rows][None, :, :, :, None]
+    elif k.dtype != q.dtype:          # bf16 pool: upcast like the Pallas
+        k = k.astype(q.dtype)         # kernel so probs stay full precision
+        v = v.astype(q.dtype)         # (probs.astype(v.dtype) below)
     scores = jnp.einsum("bqhd,hbpsd->bhqps", q, k,
                         preferred_element_type=jnp.float32)
     scores = scores.reshape(b, h, c, n_pages * ps).astype(jnp.float32)
@@ -169,12 +179,15 @@ def _ragged_xla(q, pool, page_table, lengths, q_base, layer, n_layer,
 
 
 def _ragged_kernel(krows_ref, vrows_ref, meta_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_scr, l_scr, acc_scr,
+                   ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr,
                    *, h, c, ps, n_pages, causal, sm_scale):
     """grid (B, P): per lane, walk its page list (scalar-prefetched
     block table drives the k/v index maps) with an online softmax.
     q rides head-major [B, h*C, d]; scratch rows j*C..(j+1)*C hold head
-    j's running stats."""
+    j's running stats.  ks_ref/vs_ref (present for an int8 pool) carry
+    this page-row's [1, ps] fp32 block scales; dequant happens here in
+    VMEM — the page DMA moved int8 bytes, halving-again the decode read
+    stream vs bf16."""
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -192,6 +205,12 @@ def _ragged_kernel(krows_ref, vrows_ref, meta_ref, q_ref, k_ref, v_ref,
         q = q_ref[0]                       # [h*C, d]
         k = k_ref[:, 0]                    # [h, ps, d]
         v = v_ref[:, 0]
+        if ks_ref is not None:             # in-register dequant (int8 pool)
+            k = k.astype(jnp.float32) * ks_ref[0][None, :, None]
+            v = v.astype(jnp.float32) * vs_ref[0][None, :, None]
+        elif k.dtype != q.dtype:           # bf16 pool: VMEM-level upcast
+            k = k.astype(q.dtype)          # (the DMA moved bf16 bytes;
+            v = v.astype(q.dtype)          # lax.dot_general won't promote)
         p0 = p * ps
         for j in range(h):                 # static head loop
             qj = q[j * c:(j + 1) * c]      # [C, d]
@@ -226,7 +245,7 @@ def _ragged_kernel(krows_ref, vrows_ref, meta_ref, q_ref, k_ref, v_ref,
 
 
 def _ragged_pallas(q, pool, page_table, lengths, q_base, layer, n_layer,
-                   causal, sm_scale, interpret):
+                   causal, sm_scale, interpret, scales=None):
     h, _r, ps, d = pool.shape
     b, c, _h, _d = q.shape
     n_pages = page_table.shape[1]
@@ -235,6 +254,7 @@ def _ragged_pallas(q, pool, page_table, lengths, q_base, layer, n_layer,
                       jnp.asarray(q_base, jnp.int32).reshape(b)])
     # head-major query rows: head j's C queries are contiguous
     qk = jnp.transpose(q, (0, 2, 1, 3)).reshape(b, h * c, d)
+    have_scales = scales is not None
 
     def q_map(bi, pi, kr, vr, mt):
         return (bi, 0, 0)
@@ -245,14 +265,28 @@ def _ragged_pallas(q, pool, page_table, lengths, q_base, layer, n_layer,
     def v_map(bi, pi, kr, vr, mt):
         return (0, vr[bi, pi], 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, h * c, d), q_map),
+        pl.BlockSpec((h, 1, ps, d), k_map),
+        pl.BlockSpec((h, 1, ps, d), v_map),
+    ]
+    args = [qk, pool, pool]
+    if have_scales:
+        # [R, ps] fp32 block scales; each grid step DMAs the one [1, ps]
+        # scale row matching the k/v page row it just fetched
+        sc = scales.reshape(scales.shape[-2], scales.shape[-1])
+        in_specs.append(pl.BlockSpec((1, ps),
+                                     lambda bi, pi, kr, vr, mt:
+                                     (kr[bi, pi], 0)))
+        in_specs.append(pl.BlockSpec((1, ps),
+                                     lambda bi, pi, kr, vr, mt:
+                                     (vr[bi, pi], 0)))
+        args += [sc, sc]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, h * c, d), q_map),
-            pl.BlockSpec((h, 1, ps, d), k_map),
-            pl.BlockSpec((h, 1, ps, d), v_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h * c, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((h * c, LANES), jnp.float32),
@@ -260,9 +294,17 @@ def _ragged_pallas(q, pool, page_table, lengths, q_base, layer, n_layer,
             pltpu.VMEM((h * c, d), jnp.float32),
         ],
     )
-    kernel = functools.partial(_ragged_kernel, h=h, c=c, ps=ps,
-                               n_pages=n_pages, causal=causal,
-                               sm_scale=sm_scale)
+    base = functools.partial(_ragged_kernel, h=h, c=c, ps=ps,
+                             n_pages=n_pages, causal=causal,
+                             sm_scale=sm_scale)
+
+    def kernel(krows_ref, vrows_ref, meta_ref, q_ref, k_ref, v_ref, *rest):
+        rest = list(rest)
+        ks_ref = rest.pop(0) if have_scales else None
+        vs_ref = rest.pop(0) if have_scales else None
+        return base(krows_ref, vrows_ref, meta_ref, q_ref, k_ref, v_ref,
+                    ks_ref, vs_ref, *rest)
+
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -270,14 +312,15 @@ def _ragged_pallas(q, pool, page_table, lengths, q_base, layer, n_layer,
         compiler_params=_COMPILER_PARAMS_CLS(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(k_rows, v_rows, meta, qk, pool, pool)
+    )(k_rows, v_rows, meta, *args)
     return jnp.transpose(out.reshape(b, h, c, d), (0, 2, 1, 3))
 
 
 def ragged_decode_attention(q, pool, page_table, lengths, q_base=None,
                             *, layer: int, n_layer: int, causal: bool = True,
                             sm_scale: Optional[float] = None,
-                            impl: Optional[str] = None) -> jax.Array:
+                            impl: Optional[str] = None,
+                            scales=None) -> jax.Array:
     """Attention of per-lane query blocks against a paged KV pool.
 
     Shapes:
@@ -288,6 +331,10 @@ def ragged_decode_attention(q, pool, page_table, lengths, q_base=None,
         lengths     [B]    int32  live KV positions per lane
         q_base      [B]    int32  global position of q[:, 0] (required
                                   when causal — masks key > base + j)
+        scales      [1, R, page_size] fp32 (int8 pools only): one block
+                                  scale per (physical row, slot), written
+                                  by quantized_paged_cache_write; K/V
+                                  dequantize in-register during the walk
 
     Returns ctx [B, C, H, D].  Per-lane work is O(P * page_size) with
     the page indirection resolved by the block table — bytes for pages a
@@ -305,9 +352,10 @@ def ragged_decode_attention(q, pool, page_table, lengths, q_base=None,
     if impl in ("pallas", "pallas_interpret"):
         return _ragged_pallas(q, pool, page_table, lengths, q_base, layer,
                               n_layer, causal, float(sm_scale),
-                              interpret=(impl == "pallas_interpret"))
+                              interpret=(impl == "pallas_interpret"),
+                              scales=scales)
     return _ragged_xla(q, pool, page_table, lengths, q_base, layer, n_layer,
-                       causal, float(sm_scale))
+                       causal, float(sm_scale), scales=scales)
 
 
 def keep_scale(seed_u32, bh, rows, cols, rate):

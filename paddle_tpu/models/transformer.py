@@ -114,7 +114,7 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
             ctx = layers.ragged_decode_attention(
                 q, ps["pool"], ps["table"], ps["lengths"],
                 layer=ps["layer"], n_layer=ps["n_layer"], causal=False,
-                sm_scale=float(d_key) ** -0.5)
+                sm_scale=float(d_key) ** -0.5, scales=ps.get("scales"))
         else:
             pc = paged_cache
             k = layers.fc(input=keys, size=d_key * n_head, bias_attr=False,
@@ -123,14 +123,23 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
             v = layers.fc(input=values, size=d_value * n_head,
                           bias_attr=False, num_flatten_dims=2,
                           param_attr=_col_attr(mp_shard, _nm(prefix, "v.w")))
-            pool = layers.paged_cache_write(
-                pc["pool"], interleave_heads(k, d_key),
-                interleave_heads(v, d_value), pc["pages"], pc["offsets"],
-                layer=pc["layer"], n_layer=pc["n_layer"])
+            kv_scales = pc.get("scales")
+            if kv_scales is not None:       # int8 pool: quantize on write
+                pool, kv_scales = layers.quantized_paged_cache_write(
+                    pc["pool"], kv_scales, interleave_heads(k, d_key),
+                    interleave_heads(v, d_value), pc["pages"],
+                    pc["offsets"], layer=pc["layer"],
+                    n_layer=pc["n_layer"])
+            else:
+                pool = layers.paged_cache_write(
+                    pc["pool"], interleave_heads(k, d_key),
+                    interleave_heads(v, d_value), pc["pages"],
+                    pc["offsets"], layer=pc["layer"],
+                    n_layer=pc["n_layer"])
             ctx = layers.ragged_decode_attention(
                 q, pool, pc["table"], pc["lengths"], pc["base"],
                 layer=pc["layer"], n_layer=pc["n_layer"], causal=True,
-                sm_scale=float(d_key) ** -0.5)
+                sm_scale=float(d_key) ** -0.5, scales=kv_scales)
         return merge_heads_proj(ctx)
 
     if cache is not None or static_kv is not None:
@@ -543,7 +552,8 @@ def decode_step(trg_word, trg_pos, cache_index, self_lengths, src_lengths,
 def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
                         enc_pages, cross_pages, w_offsets, pool,
                         src_vocab_size, max_length, n_layer, n_head, d_key,
-                        d_value, d_model, d_inner_hid, param_prefix):
+                        d_value, d_model, d_inner_hid, param_prefix,
+                        kv_scales=None):
     """One chunked-prefill tower step: encode up to C source tokens per
     lane CAUSALLY against the lane's paged encoder-KV prefix, and
     project + page-write the chunk's cross-attention K/V.
@@ -559,7 +569,10 @@ def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
     int32 (encoded length INCLUDING this chunk), ``enc_table`` [b, P]
     int32, ``enc_pages``/``cross_pages``/``w_offsets`` [b, C] int32
     per-token write targets (trash page 0 for dead tokens/lanes).
-    Returns the chunk's encoder output [b, C, d_model]."""
+    ``kv_scales`` (int8 pools) is the [1, R, page_size] fp32 block-scale
+    sidecar: K/V quantize on write and dequantize inside the ragged
+    attention walk.  Returns the chunk's encoder output
+    [b, C, d_model]."""
     if not param_prefix:
         raise ValueError("paged_prefill_chunk requires param_prefix")
     emb = prepare_embedding(pf_word, pf_pos, src_vocab_size, max_length,
@@ -568,7 +581,8 @@ def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
                             pos_name=_nm(param_prefix, "src_pos_emb.w"))
     paged = [{"pool": pool, "table": enc_table, "pages": enc_pages,
               "offsets": w_offsets, "lengths": pf_len, "base": pf_base,
-              "layer": i, "n_layer": n_layer} for i in range(n_layer)]
+              "layer": i, "n_layer": n_layer, "scales": kv_scales}
+             for i in range(n_layer)]
     enc_chunk = encoder(emb, None, n_layer, n_head, d_key, d_value,
                         d_model, d_inner_hid, 0.0, prefix=param_prefix,
                         paged_caches=paged)
@@ -585,10 +599,15 @@ def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
         v = layers.fc(input=enc_chunk, size=d_value * n_head,
                       bias_attr=False, num_flatten_dims=2,
                       param_attr=_plain_attr(_nm(pre, "v.w")))
-        pool = layers.paged_cache_write(pool, heads(k, d_key),
-                                        heads(v, d_value), cross_pages,
-                                        w_offsets, layer=i,
-                                        n_layer=n_layer)
+        if kv_scales is not None:
+            pool, kv_scales = layers.quantized_paged_cache_write(
+                pool, kv_scales, heads(k, d_key), heads(v, d_value),
+                cross_pages, w_offsets, layer=i, n_layer=n_layer)
+        else:
+            pool = layers.paged_cache_write(pool, heads(k, d_key),
+                                            heads(v, d_value), cross_pages,
+                                            w_offsets, layer=i,
+                                            n_layer=n_layer)
     return enc_chunk
 
 
@@ -596,12 +615,14 @@ def paged_decode_step(trg_word, trg_pos, self_table, self_pages,
                       self_offsets, self_lengths, self_base, cross_table,
                       src_lengths, pool, trg_vocab_size, max_length,
                       n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
-                      param_prefix):
+                      param_prefix, kv_scales=None):
     """One paged incremental decode step — the page-indirected analog of
     ``decode_step``: each lane's token K/V lands in its self pages
     (``self_pages``/``self_offsets`` [b, 1] int32) and attention walks
     ``self_table``/``cross_table`` [b, P] int32 under ``self_lengths``/
-    ``src_lengths`` masks.  Returns logits [b, 1, vocab]."""
+    ``src_lengths`` masks.  ``kv_scales`` (int8 pools) rides into every
+    write and attention walk — the decode read stream moves int8 bytes.
+    Returns logits [b, 1, vocab]."""
     if not param_prefix:
         raise ValueError("paged_decode_step requires param_prefix")
     emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size, max_length,
@@ -612,11 +633,12 @@ def paged_decode_step(trg_word, trg_pos, self_table, self_pages,
     paged_caches = [{"pool": pool, "table": self_table,
                      "pages": self_pages, "offsets": self_offsets,
                      "lengths": self_lengths, "base": self_base,
-                     "layer": i, "n_layer": n_layer}
+                     "layer": i, "n_layer": n_layer, "scales": kv_scales}
                     for i in range(n_layer)]
     paged_crosses = [{"pool": pool, "table": cross_table,
                       "lengths": src_lengths, "layer": i,
-                      "n_layer": n_layer} for i in range(n_layer)]
+                      "n_layer": n_layer, "scales": kv_scales}
+                     for i in range(n_layer)]
     dec_output = decoder(emb, None, None, None, n_layer, n_head, d_key,
                          d_value, d_model, d_inner_hid, 0.0,
                          prefix=param_prefix, paged_caches=paged_caches,

@@ -38,10 +38,12 @@ This package is the TPU-native replacement:
 
 from .engine import InferenceEngine  # noqa: F401
 from .decoder import FullRerunDecoder, TransformerGenerator  # noqa: F401
-from .paged_decoder import PagedTransformerGenerator  # noqa: F401
+from .paged_decoder import (PagedTransformerGenerator,  # noqa: F401
+                            copy_weights, kv_page_bytes)
 from .paging import PageAllocator, PoolCapacityError  # noqa: F401
 from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
 
 __all__ = ["InferenceEngine", "TransformerGenerator", "FullRerunDecoder",
-           "PagedTransformerGenerator", "PageAllocator",
-           "PoolCapacityError", "ContinuousBatchingScheduler", "Request"]
+           "PagedTransformerGenerator", "PageAllocator", "copy_weights",
+           "kv_page_bytes", "PoolCapacityError",
+           "ContinuousBatchingScheduler", "Request"]

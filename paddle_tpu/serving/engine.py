@@ -89,16 +89,28 @@ class InferenceEngine:
                  dirname: Optional[str] = None, scope=None, place=None,
                  executor=None,
                  batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
-                 time_bucket: int = 8, mode: str = "infer"):
+                 time_bucket: int = 8, mode: str = "infer",
+                 quantize: str = "off"):
+        if quantize not in ("off", "int8"):
+            raise ValueError(f"quantize={quantize!r}: 'off' or 'int8'")
+        owns_scope = scope is None
         self.scope = scope or fluid.Scope()
         self.exe = executor or fluid.Executor(place or fluid.TPUPlace(0))
         if dirname is not None:
             if program is not None:
                 raise ValueError("pass program=... or dirname=..., not both")
+            # when quantizing, the fp32 weights are only calibration input
+            # on the host — _quantize_int8 re-places the int8 copies, so
+            # uploading the full fp32 model first would be discarded work
             program, feed_names, fetch_vars = fluid.io.load_inference_model(
-                dirname, self.exe, scope=self.scope, to_device=True)
+                dirname, self.exe, scope=self.scope,
+                to_device=(quantize != "int8"))
         if program is None:
             raise ValueError("InferenceEngine needs a program or a dirname")
+        self._quant_stats = None
+        if quantize == "int8":
+            program = self._quantize_int8(program, clone_scope=not owns_scope)
+        self.quantize = quantize
         self.program = program
         self.feed_names = list(feed_names or [])
         self.fetch_list = [f if isinstance(f, Variable) else str(f)
@@ -115,6 +127,39 @@ class InferenceEngine:
         self._padding = {"true_rows": 0, "padded_rows": 0,
                          "true_tokens": 0, "padded_tokens": 0}
         self._warming = False
+
+    # -- post-training quantization (ISSUE 7) --------------------------------
+    def _quantize_int8(self, program, clone_scope=True):
+        """Clone the program and the persistable slice of the scope, then
+        run the per-channel int8 PTQ rewrite over the PRIVATE copies —
+        a trained scope shared with the caller keeps its fp32 weights
+        (the transform replaces weight values in place, which must never
+        leak back into training).  ``clone_scope=False`` skips the scope
+        copy when the engine created the scope itself (dirname load with
+        no caller scope): it is already private, and cloning would
+        transiently double the host weight footprint for nothing."""
+        from ..fluid.transforms.quantize import quantize_program
+
+        program = program.clone(for_test=True)
+        if clone_scope:
+            private = fluid.Scope()
+            for v in program.list_vars():
+                if v.persistable:
+                    val = self.scope.find_var(v.name)
+                    if val is not None:
+                        # host COPY, not a reference: the donor scope's
+                        # device buffers get donated by its own executor
+                        # dispatches, and a shared jax.Array would be
+                        # left deleted under us
+                        private.set_var(v.name, np.array(np.asarray(val)))
+            self.scope = private
+        self._quant_stats = quantize_program(program, self.scope)
+        # the host copies above are host-resident (dirname loads skip the
+        # device upload when quantizing): place the int8 weights + scale
+        # sidecars so the first request doesn't pay the H2D upload the
+        # to_device contract exists to prevent
+        fluid.io.device_put_persistables(self.scope, program)
+        return program
 
     # -- bucketing -----------------------------------------------------------
     def _batch_bucket(self, b: int) -> int:
@@ -254,5 +299,8 @@ class InferenceEngine:
             1.0 - pad["true_tokens"] / pad["padded_tokens"], 4) \
             if pad["padded_tokens"] else 0.0
         out["padding"] = pad
+        out["quant"] = dict(self._quant_stats.to_dict(),
+                            mode=self.quantize) \
+            if self._quant_stats is not None else {"mode": self.quantize}
         out["executable"] = self.exe.cache_stats()["executable"]
         return out

@@ -45,11 +45,64 @@ from .decoder import _Cfg, dense_kv_bytes_per_slot
 from .paging import (PageAllocator, PoolCapacityError, TRASH_PAGE,
                      chunk_hashes)
 
-__all__ = ["PagedTransformerGenerator"]
+__all__ = ["PagedTransformerGenerator", "copy_weights", "kv_page_bytes"]
 
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
+
+
+_KV_ITEMSIZE = {"float32": 4, "bfloat16": 2, "int8": 1}
+
+
+def kv_page_bytes(n_layer: int, n_head: int, d_head: int, page_size: int,
+                  kv_dtype: str = "float32") -> int:
+    """HBM bytes ONE logical page costs: ``2 * n_layer`` physical rows of
+    ``[page_size, n_head * d_head]`` K/V in ``kv_dtype``, plus — for int8
+    pools — the fp32 block scale each (row, slot) carries in the sidecar.
+    The single bytes formula the generator, bench.py's capacity contest,
+    and the scheduler's HBM accounting all share (ISSUE 7: the int8
+    halving must be visible in one number, not re-derived per caller)."""
+    if kv_dtype not in _KV_ITEMSIZE:
+        raise ValueError(f"kv_page_bytes: unsupported kv_dtype "
+                         f"{kv_dtype!r} (one of {sorted(_KV_ITEMSIZE)})")
+    rows = 2 * n_layer
+    data = rows * page_size * n_head * d_head * _KV_ITEMSIZE[kv_dtype]
+    scales = rows * page_size * 4 if kv_dtype == "int8" else 0
+    return data + scales
+
+
+# decode-time cache state (paged pool + sidecar, dense per-lane caches):
+# never weights, so never copy_weights material — carrying them across
+# scopes would drag stale cache contents (and for the pool, the wrong
+# dtype) into the destination generator
+_CACHE_MARKERS = ("@kv_pool", "@kv_scales", "@kcache", "@vcache",
+                  "@crossk", "@crossv")
+
+
+def copy_weights(src_scope, dst_scope, prefix: Optional[str] = None) -> int:
+    """Host-copy vars from ``src_scope`` into ``dst_scope`` EXCEPT
+    cache-state vars (``_CACHE_MARKERS``): two generators sharing one
+    ``param_prefix`` (a float-pool and an int8-pool parity pair) share
+    weight NAMES, so each needs its own scope — but copying cache vars
+    would carry stale decode state across.  ``prefix`` restricts the
+    copy to one model's ``param_prefix`` — required when ``src_scope``
+    is shared with other models (their caches and params would
+    otherwise be dragged along and re-uploaded for nothing).  Unset
+    placeholders (``Scope.var()`` with no value) are skipped.  Returns
+    the number of vars copied."""
+    n = 0
+    for name in list(src_scope.vars):
+        if any(m in name for m in _CACHE_MARKERS):
+            continue
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        val = src_scope.find_var(name)
+        if val is None:
+            continue
+        dst_scope.set_var(name, np.array(np.asarray(val)))
+        n += 1
+    return n
 
 
 class _Lane:
@@ -100,10 +153,14 @@ class PagedTransformerGenerator:
                  max_out_len=64, scope=None, executor=None, place=None,
                  param_prefix="tf", start_id=0, end_id=1,
                  page_size=8, num_pages=None, chunk_size=8,
-                 prefix_sharing=True, topk_size=None):
+                 prefix_sharing=True, topk_size=None,
+                 kv_dtype="float32"):
         if d_key != d_value:
             raise ValueError("paged KV pool requires d_key == d_value "
                              "(one pool row shape serves both)")
+        if kv_dtype not in _KV_ITEMSIZE:
+            raise ValueError(f"kv_dtype={kv_dtype!r}: pick one of "
+                             f"{sorted(_KV_ITEMSIZE)}")
         self.cfg = _Cfg(src_vocab_size, trg_vocab_size, n_layer, n_head,
                         d_key, d_value, d_model, d_inner_hid, max_length)
         self.src_len = int(src_len)
@@ -124,10 +181,15 @@ class PagedTransformerGenerator:
         self.alloc = PageAllocator(self.num_pages, self.page_size)
         self.scope = scope or fluid.Scope()
         self.exe = executor or fluid.Executor(place or fluid.TPUPlace(0))
+        self.kv_dtype = kv_dtype
         self._pool_name = f"{param_prefix}@kv_pool"
+        self._scales_name = f"{param_prefix}@kv_scales"
         self._pool_shape = (n_head, self.num_pages * n_layer * 2,
                             self.page_size, d_key)
-        self.page_bytes = n_layer * 2 * self.page_size * n_head * d_key * 4
+        self._scales_shape = (1, self.num_pages * n_layer * 2,
+                              self.page_size)
+        self.page_bytes = kv_page_bytes(n_layer, n_head, d_key,
+                                        self.page_size, kv_dtype)
         self._lanes: List[_Lane] = []
         self._slots = 0
         self._steps = 0
@@ -141,11 +203,25 @@ class PagedTransformerGenerator:
         import jax.numpy as jnp
 
         self.scope.set_var(self._pool_name,
-                           jnp.zeros(self._pool_shape, jnp.float32))
+                           jnp.zeros(self._pool_shape, self.kv_dtype))
+        if self.kv_dtype == "int8":
+            self.scope.set_var(self._scales_name,
+                               jnp.zeros(self._scales_shape, jnp.float32))
 
     def _pool_var(self, block):
         return block.create_var(name=self._pool_name,
                                 shape=list(self._pool_shape),
+                                dtype=self.kv_dtype, persistable=True)
+
+    def _scales_var(self, block):
+        """The int8 pool's fp32 block-scale sidecar (None for float
+        pools): one scale per (physical row, slot), written by
+        quantized_paged_cache_write at the same page indirection the
+        int8 bytes land in."""
+        if self.kv_dtype != "int8":
+            return None
+        return block.create_var(name=self._scales_name,
+                                shape=list(self._scales_shape),
                                 dtype="float32", persistable=True)
 
     # -- program builders ----------------------------------------------------
@@ -160,6 +236,7 @@ class PagedTransformerGenerator:
         prog, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(prog, startup), fluid.unique_name.guard():
             pool = self._pool_var(prog.global_block())
+            kv_scales = self._scales_var(prog.global_block())
             pf_word = layers.data("pf_word", [C], "int64")
             pf_pos = layers.data("pf_pos", [C], "int64")
             pf_base = layers.data("pf_base", [], "int32")
@@ -172,7 +249,8 @@ class PagedTransformerGenerator:
                 pf_word, pf_pos, pf_base, pf_len, enc_table, enc_pages,
                 cross_pages, w_offsets, pool, c.src_vocab_size,
                 c.max_length, c.n_layer, c.n_head, c.d_key, c.d_value,
-                c.d_model, c.d_inner_hid, self.prefix)
+                c.d_model, c.d_inner_hid, self.prefix,
+                kv_scales=kv_scales)
             trg_word = layers.data("trg_word", [1], "int64")
             trg_pos = layers.data("trg_pos", [1], "int64")
             self_table = layers.data("self_table", [self.p_out], "int32")
@@ -186,7 +264,8 @@ class PagedTransformerGenerator:
                 trg_word, trg_pos, self_table, self_pages, self_offsets,
                 self_lengths, self_base, cross_table, src_lengths, pool,
                 c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
-                c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix)
+                c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix,
+                kv_scales=kv_scales)
             next_ids = layers.argmax(logits, axis=-1)
         self._unified = (prog, startup, next_ids, logits)
 
@@ -201,6 +280,7 @@ class PagedTransformerGenerator:
         prog, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(prog, startup), fluid.unique_name.guard():
             pool = self._pool_var(prog.global_block())
+            kv_scales = self._scales_var(prog.global_block())
             pre_ids = layers.data("pre_ids", [W], "int64")
             pre_scores = layers.data("pre_scores", [W], "float32")
             tok = layers.data("trg_word", [1], "int64")       # [bW, 1]
@@ -214,13 +294,19 @@ class PagedTransformerGenerator:
             self_base = layers.data("self_base", [], "int32")
             cross_table = layers.data("cross_table", [self.p_src], "int32")
             src_lengths = layers.data("src_lengths", [], "int32")
-            pool = layers.paged_page_copy(pool, cow_src, cow_dst,
-                                          n_layer=c.n_layer)
+            if kv_scales is not None:
+                pool, kv_scales = layers.paged_page_copy(
+                    pool, cow_src, cow_dst, n_layer=c.n_layer,
+                    scales=kv_scales)
+            else:
+                pool = layers.paged_page_copy(pool, cow_src, cow_dst,
+                                              n_layer=c.n_layer)
             logits = T.paged_decode_step(
                 tok, tp, self_table, self_pages, self_offsets,
                 self_lengths, self_base, cross_table, src_lengths, pool,
                 c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
-                c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix)
+                c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix,
+                kv_scales=kv_scales)
             probs = layers.softmax(
                 layers.reshape(logits, [-1, W, c.trg_vocab_size]))
             topk_scores, topk_idx = layers.topk(probs, k=K)
@@ -666,9 +752,19 @@ class PagedTransformerGenerator:
         return dense_kv_bytes_per_slot(self.cfg, self.src_len,
                                        self.max_out_len)
 
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one cached token costs across every layer, K and V
+        — ``page_bytes / page_size`` (int8 pools include their fp32
+        block-scale sidecar, so the bf16->int8 ratio is the honest
+        ~2x, not an idealised 2.0)."""
+        return self.page_bytes // self.page_size
+
     def cache_stats(self) -> Dict[str, object]:
         """Page / prefix / HBM accounting next to the executor's
-        executable-cache counters (the 0-recompile assertion surface)."""
+        executable-cache counters (the 0-recompile assertion surface).
+        The ``hbm`` block carries ``kv_dtype`` + pool-bytes accounting —
+        what the capacity-contest test ranks paged-int8 > paged-bf16 >
+        dense with."""
         pages = self.alloc.stats()
         active = sum(1 for lane in self._lanes
                      if lane.phase not in ("idle",))
@@ -678,7 +774,9 @@ class PagedTransformerGenerator:
             "pages": pages,
             "steps": self._steps,
             "hbm": {
+                "kv_dtype": self.kv_dtype,
                 "page_bytes": self.page_bytes,
+                "kv_bytes_per_token": self.kv_bytes_per_token(),
                 "pool_bytes": self.page_bytes * self.num_pages,
                 "bytes_in_use": in_use_bytes,
                 "bytes_per_active_slot": (in_use_bytes // active)

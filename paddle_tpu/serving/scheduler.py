@@ -337,6 +337,21 @@ class ContinuousBatchingScheduler:
                 "peak_in_flight": self._peak_in_flight,
             }
         out["failed"] = sum(1 for r in done if r.error is not None)
+        if self._page_aware and hasattr(self.model, "page_bytes"):
+            # capacity in BYTES, not just pages: int8 pools shrink
+            # page_bytes (ISSUE 7), so the same HBM budget holds more
+            # pages — surfaced here so a capacity report never re-derives
+            # the bytes/slot math per kv_dtype
+            out["kv"] = {
+                "kv_dtype": getattr(self.model, "kv_dtype", "float32"),
+                "page_bytes": self.model.page_bytes,
+                "pool_bytes": (self.model.page_bytes
+                               * self.model.num_pages),
+                "kv_bytes_per_token": (
+                    self.model.kv_bytes_per_token()
+                    if hasattr(self.model, "kv_bytes_per_token")
+                    else None),
+            }
         # latency percentiles cover successfully served requests only (a
         # request failed at admission has no admitted timestamp)
         ok = [r for r in done if r.error is None]

@@ -834,3 +834,76 @@ def test_merged_single_file_model(tmp_path):
     import pytest as _pytest
     with _pytest.raises(AssertionError, match="not a merged"):
         native_forward(bad, {"x": xs})
+
+
+def test_native_quantized_mul(tmp_path):
+    """The PTQ artifacts serve natively: int8 persistables load through
+    from_raw's int8 decode, quantized_mul folds the per-column fp32
+    Scale into the accumulated output, and the directory and merged
+    forms agree bit-for-bit with each other and closely with the XLA
+    quantized path."""
+    from paddle_tpu.fluid.transforms.quantize import quantize_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [10], "float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        y = fluid.layers.fc(h, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.random.RandomState(2).rand(5, 10).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    infer = fluid.io.prune_program(main, [y])
+    stats = quantize_program(infer, scope)
+    assert len(stats.quantized) == 2, (stats.quantized, stats.skipped)
+    with fluid.scope_guard(scope):
+        want, = exe.run(infer, feed={"x": xs}, fetch_list=[y],
+                        mode="infer")
+        model_dir = str(tmp_path / "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [y], exe,
+                                      main_program=infer)
+    merged = str(tmp_path / "model.ptpu")
+    fluid.io.merge_inference_model(model_dir, merged)
+    from_dir, = native_forward(model_dir, {"x": xs})
+    from_merged, = native_forward(merged, {"x": xs})
+    # C accumulates f32 over the same int8 weights + scale fold as XLA
+    np.testing.assert_allclose(from_dir, np.asarray(want), rtol=1e-4,
+                               atol=1e-5, err_msg="native vs Executor")
+    np.testing.assert_array_equal(from_dir, from_merged)
+
+
+def test_native_quantized_conv(tmp_path):
+    """quantized_conv2d serves natively too: the int8 OIHW filter loads
+    raw and the per-output-channel fp32 Scale folds into each output
+    channel, so a PTQ-rewritten conv net keeps its native-engine tier."""
+    from paddle_tpu.fluid.transforms.quantize import quantize_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = fluid.layers.data("img", [1, 12, 12], "float32")
+        c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        p = fluid.layers.pool2d(input=c, pool_size=2, pool_stride=2)
+        pred = fluid.layers.fc(p, 5, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.random.RandomState(3).rand(2, 1, 12, 12).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    infer = fluid.io.prune_program(main, [pred])
+    stats = quantize_program(infer, scope)
+    assert len(stats.quantized) == 2, (stats.quantized, stats.skipped)
+    assert any(op.type == "quantized_conv2d"
+               for op in infer.global_block().ops)
+    with fluid.scope_guard(scope):
+        want, = exe.run(infer, feed={"img": xs}, fetch_list=[pred],
+                        mode="infer")
+        model_dir = str(tmp_path / "model")
+        fluid.io.save_inference_model(model_dir, ["img"], [pred], exe,
+                                      main_program=infer)
+    got, = native_forward(model_dir, {"img": xs})
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4,
+                               atol=1e-5, err_msg="native vs Executor")

@@ -14,7 +14,7 @@ from paddle_tpu.fluid import layers
 from paddle_tpu.serving import (ContinuousBatchingScheduler,
                                 InferenceEngine, PagedTransformerGenerator,
                                 PageAllocator, PoolCapacityError,
-                                TransformerGenerator)
+                                TransformerGenerator, copy_weights)
 from paddle_tpu.serving.decoder import pack_sources
 from paddle_tpu.serving.paging import chunk_hashes
 
@@ -587,3 +587,276 @@ def test_engine_padding_accounting_reports_true_vs_padded():
     # warmup dispatches stay invisible — the counters stay honest
     eng.warmup([{"w": make_seq([rng.randint(0, V, 4)], dtype=np.int64)}])
     assert eng.cache_stats()["padding"] == pad
+
+
+# -- int8 quantized KV pages (ISSUE 7) ----------------------------------------
+
+def _kv_pool_pair(kv_dtype, prefix):
+    """A float32-pool and a ``kv_dtype``-pool paged generator sharing one
+    set of trained weights (copied by name into the second generator's
+    scope — the pool var name is shared, so the scopes must differ)."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    kw = dict(n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+              d_inner_hid=DI, max_length=64, src_len=SRC, executor=exe,
+              param_prefix=prefix, max_out_len=OUT, page_size=PS,
+              chunk_size=CHUNK, num_pages=64)
+    sa, sb = fluid.Scope(), fluid.Scope()
+    fp = PagedTransformerGenerator(V, V, scope=sa, **kw)
+    alt = PagedTransformerGenerator(V, V, scope=sb, kv_dtype=kv_dtype,
+                                    **kw)
+    fp.init_params(seed=7)
+    copy_weights(sa, sb)
+    return fp, alt
+
+
+@pytest.fixture(scope="module")
+def int8_pair():
+    return _kv_pool_pair("int8", "tfq")
+
+
+def test_quantized_paged_cache_write_roundtrip_and_scale_placement(
+        fresh_programs):
+    """quantized_paged_cache_write lands int8 bytes at the same
+    (row, slot) paged_cache_write would, with one fp32 max-abs block
+    scale per (token, layer, role) in the sidecar at that SAME
+    (row, slot); dequantizing the pool recovers the written K/V within
+    the symmetric-rounding bound scale/2.  quantized_paged_page_copy
+    moves pool bytes and scales together (the COW contract)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import paged_kv_rows
+
+    main, startup, scope = fresh_programs
+    H, D, NPAGES, L = 2, 3, 4, 2
+    pool_shape = (H, NPAGES * L * 2, PS, D)
+    scales_shape = (1, NPAGES * L * 2, PS)
+    pool = main.global_block().create_var(
+        name="pool", shape=list(pool_shape), dtype="int8",
+        persistable=True)
+    scales = main.global_block().create_var(
+        name="scales", shape=list(scales_shape), dtype="float32",
+        persistable=True)
+    k = layers.data("k", [1, H, D], "float32")
+    v = layers.data("v", [1, H, D], "float32")
+    pages = layers.data("pages", [1], "int32")
+    offs = layers.data("offs", [1], "int32")
+    layers.quantized_paged_cache_write(pool, scales, k, v, pages, offs,
+                                       layer=1, n_layer=L)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope.set_var("pool", jnp.zeros(pool_shape, jnp.int8))
+    scope.set_var("scales", jnp.zeros(scales_shape, jnp.float32))
+    rng = np.random.RandomState(0)
+    kv = (rng.randn(2, 1, H, D) * 3).astype(np.float32)
+    vv = (rng.randn(2, 1, H, D) * 0.2).astype(np.float32)
+    pg = np.array([[1], [3]], np.int32)
+    of = np.array([[2], [0]], np.int32)
+    exe.run(main, feed={"k": kv, "v": vv, "pages": pg, "offs": of},
+            fetch_list=["pool"])
+    got = np.asarray(scope.find_var("pool"))
+    got_sc = np.asarray(scope.find_var("scales"))
+    assert got.dtype == np.int8 and got_sc.dtype == np.float32
+    k_rows, v_rows = paged_kv_rows(pg, 1, L)
+    for b in range(2):
+        for rows, val in ((k_rows, kv), (v_rows, vv)):
+            r, s = int(np.asarray(rows)[b, 0]), int(of[b, 0])
+            sc = got_sc[0, r, s]
+            want_sc = np.abs(val[b, 0]).max() / 127.0
+            np.testing.assert_allclose(sc, want_sc, rtol=1e-6)
+            deq = got[:, r, s].astype(np.float32) * sc
+            assert (np.abs(deq - val[b, 0]) <= sc / 2 + 1e-7).all()
+    # unwritten slots: zero bytes AND zero scales
+    assert np.count_nonzero(got) > 0
+    mask = np.ones(scales_shape, bool)
+    for b in range(2):
+        for rows in (k_rows, v_rows):
+            mask[0, int(np.asarray(rows)[b, 0]), int(of[b, 0])] = False
+    assert (got_sc[mask] == 0).all()
+
+    # COW: page 2 <- page 1 moves int8 bytes and fp32 scales together
+    main2 = fluid.Program()
+    with fluid.program_guard(main2, fluid.Program()), \
+            fluid.unique_name.guard():
+        pool2 = main2.global_block().create_var(
+            name="pool", shape=list(pool_shape), dtype="int8",
+            persistable=True)
+        scales2 = main2.global_block().create_var(
+            name="scales", shape=list(scales_shape), dtype="float32",
+            persistable=True)
+        src = layers.data("src", [], "int32")
+        dst = layers.data("dst", [], "int32")
+        layers.paged_page_copy(pool2, src, dst, n_layer=L, scales=scales2)
+    exe.run(main2, feed={"src": np.array([1, 0], np.int32),
+                         "dst": np.array([2, 0], np.int32)},
+            fetch_list=["pool"])
+    after = np.asarray(scope.find_var("pool"))
+    after_sc = np.asarray(scope.find_var("scales"))
+    rows = np.arange(2 * L)
+    np.testing.assert_array_equal(after[:, 2 * 2 * L + rows],
+                                  got[:, 1 * 2 * L + rows])
+    np.testing.assert_array_equal(after_sc[:, 2 * 2 * L + rows],
+                                  got_sc[:, 1 * 2 * L + rows])
+
+
+def test_ragged_pallas_interpret_matches_xla_int8():
+    """The Pallas kernel's in-register dequant (block-scale rows DMA'd
+    alongside each page) agrees with the XLA gather fallback on an int8
+    pool, including the dead-lane zero contract (acceptance
+    criterion)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import ragged_decode_attention
+
+    rng = np.random.RandomState(13)
+    H, D, L, NPAGES, P, C, B = 2, 4, 3, 6, 3, 2, 3
+    R = NPAGES * L * 2
+    pool = jnp.asarray(rng.randint(-127, 128, (H, R, PS, D))
+                       .astype(np.int8))
+    scales = jnp.asarray(rng.uniform(1e-3, 0.1, (1, R, PS))
+                         .astype(np.float32))
+    q = jnp.asarray(rng.randn(B, C, H, D).astype(np.float32))
+    tbl = jnp.asarray(rng.randint(0, NPAGES, (B, P)).astype(np.int32))
+    lengths = jnp.asarray(np.array([7, 0, 11], np.int32))
+    base = jnp.asarray(np.array([5, 0, 9], np.int32))
+    for causal in (True, False):
+        a = ragged_decode_attention(q, pool, tbl, lengths, base, layer=2,
+                                    n_layer=L, causal=causal, impl="xla",
+                                    scales=scales)
+        b = ragged_decode_attention(q, pool, tbl, lengths, base, layer=2,
+                                    n_layer=L, causal=causal,
+                                    impl="pallas_interpret", scales=scales)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+        assert (np.asarray(a)[1] == 0).all()       # dead lane contract
+
+
+def test_ragged_pallas_interpret_matches_xla_bf16():
+    """A bfloat16 pool decodes through the kernel's VMEM-level upcast
+    branch (no scale sidecar): Pallas-interpret agrees with the XLA
+    fallback, dead-lane zero contract included."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import ragged_decode_attention
+
+    rng = np.random.RandomState(17)
+    H, D, L, NPAGES, P, C, B = 2, 4, 3, 6, 3, 2, 3
+    R = NPAGES * L * 2
+    pool = jnp.asarray(rng.randn(H, R, PS, D).astype(np.float32),
+                       jnp.bfloat16)
+    q = jnp.asarray(rng.randn(B, C, H, D).astype(np.float32))
+    tbl = jnp.asarray(rng.randint(0, NPAGES, (B, P)).astype(np.int32))
+    lengths = jnp.asarray(np.array([7, 0, 11], np.int32))
+    base = jnp.asarray(np.array([5, 0, 9], np.int32))
+    for causal in (True, False):
+        a = ragged_decode_attention(q, pool, tbl, lengths, base, layer=1,
+                                    n_layer=L, causal=causal, impl="xla")
+        b = ragged_decode_attention(q, pool, tbl, lengths, base, layer=1,
+                                    n_layer=L, causal=causal,
+                                    impl="pallas_interpret")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+        assert (np.asarray(a)[1] == 0).all()       # dead lane contract
+
+
+def test_bf16_kv_greedy_matches_float_pool():
+    """kv_dtype="bfloat16" is a real decode mode, not just capacity
+    math: greedy through a bf16 pool (cache writes cast into the pool,
+    the attention walk upcasts in-register) tracks the float32 pool on
+    seeded mixed-length prompts, with the hbm stats reporting the
+    2-byte stream."""
+    fp, bf = _kv_pool_pair("bfloat16", "tfb")
+    _, (tok, lens) = _sources(4)
+    g_fp = np.asarray(fp.greedy(tok, lens, max_new=OUT,
+                                stop_at_end=False))
+    g_bf = np.asarray(bf.greedy(tok, lens, max_new=OUT,
+                                stop_at_end=False))
+    assert (g_fp == g_bf).mean() >= 0.9, (g_fp, g_bf)
+    st = bf.cache_stats()["hbm"]
+    assert st["kv_dtype"] == "bfloat16"
+    assert st["kv_bytes_per_token"] == bf.page_bytes // PS
+    assert bf.page_bytes == fp.page_bytes // 2
+
+
+def test_int8_kv_greedy_close_to_float_pool(int8_pair):
+    """Greedy decode through the int8 pool (quantize-on-write, dequant
+    in the attention walk) tracks the float32-pool decode on seeded
+    mixed-length prompts, and the hbm stats expose the smaller stream:
+    kv_bytes_per_token ranks int8 < bf16 < f32 with the fp32 scale
+    sidecar honestly included."""
+    from paddle_tpu.serving import kv_page_bytes
+
+    fp, i8 = int8_pair
+    _, (tok, lens) = _sources(0)
+    g_fp = np.asarray(fp.greedy(tok, lens, max_new=OUT,
+                                stop_at_end=False))
+    g_i8 = np.asarray(i8.greedy(tok, lens, max_new=OUT,
+                                stop_at_end=False))
+    assert (g_fp == g_i8).mean() >= 0.9, (g_fp, g_i8)
+    st = i8.cache_stats()["hbm"]
+    assert st["kv_dtype"] == "int8"
+    assert st["kv_bytes_per_token"] == i8.page_bytes // PS
+    assert st["pool_bytes"] == i8.page_bytes * i8.num_pages
+    bpt = {dt: kv_page_bytes(NL, NH, DK, PS, dt) // PS
+           for dt in ("int8", "bfloat16", "float32")}
+    assert bpt["int8"] < bpt["bfloat16"] < bpt["float32"]
+    assert st["kv_bytes_per_token"] == bpt["int8"]
+    # steady state: a second round through the int8 path compiles nothing
+    misses0 = i8.cache_stats()["executable"]["misses"]
+    _, (tok2, lens2) = _sources(8)
+    i8.greedy(tok2, lens2, max_new=OUT, stop_at_end=False)
+    assert i8.cache_stats()["executable"]["misses"] == misses0
+
+
+def test_int8_beam_cow_keeps_scales_with_pages(int8_pair):
+    """Beam search over the int8 pool: the copy-on-write reorder moves
+    int8 pages + their block scales in one op, so shared-parent lanes
+    decode sensible tokens (close to the float-pool beam) and nothing
+    leaks."""
+    fp, i8 = int8_pair
+    W = 3
+    _, (tok, lens) = _sources(2, n=2)
+    f_ids, f_scores = fp.beam(tok, lens, beam_size=W, max_new=OUT)
+    cow0 = i8.cache_stats()["pages"]["cow_copies"]
+    q_ids, q_scores = i8.beam(tok, lens, beam_size=W, max_new=OUT)
+    assert i8.cache_stats()["pages"]["cow_copies"] > cow0
+    assert i8.cache_stats()["pages"]["in_use"] == 0
+    assert (np.asarray(f_ids) == np.asarray(q_ids)).mean() >= 0.9
+    np.testing.assert_allclose(np.asarray(q_scores),
+                               np.asarray(f_scores), rtol=0.05, atol=0.2)
+    i8.alloc.check_invariants()
+
+
+def test_capacity_contest_int8_gt_bf16_gt_dense():
+    """The PR 6 capacity contest extended per ISSUE 7: at the SAME
+    simulated HBM budget, the int8 pool (1 byte/elem + fp32 block-scale
+    sidecar) admits strictly more in-flight requests than the bf16 pool,
+    which admits strictly more than dense worst-case reservation."""
+    from paddle_tpu.serving import kv_page_bytes
+    from paddle_tpu.serving.decoder import _Cfg, dense_kv_bytes_per_slot
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    kw = dict(n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+              d_inner_hid=DI, max_length=64, src_len=SRC, executor=exe,
+              max_out_len=OUT, page_size=PS, chunk_size=CHUNK)
+    dense_slot = dense_kv_bytes_per_slot(
+        _Cfg(V, V, NL, NH, DK, DK, DM, DI, 64), SRC, OUT)
+    budget = 4 * dense_slot
+    admitted = {}
+    for dt in ("bfloat16", "int8"):
+        gen = PagedTransformerGenerator(
+            V, V, scope=fluid.Scope(), param_prefix=f"tfc_{dt}",
+            num_pages=budget // kv_page_bytes(NL, NH, DK, PS, dt),
+            kv_dtype=dt, **kw)
+        assert gen.cache_stats()["hbm"]["pool_bytes"] <= budget
+        rng = np.random.RandomState(9)
+        gen.open_slots(64)
+        n = 0
+        while n < 64:
+            prompt = rng.randint(2, V, int(rng.randint(2, SRC // 2 + 1)))
+            if not gen.can_admit(prompt, max_new=PS):
+                break
+            gen.admit_slot(n, prompt, max_new=PS)
+            n += 1
+        admitted[dt] = n
+    n_dense = budget // dense_slot
+    assert admitted["int8"] > admitted["bfloat16"] > n_dense, \
+        (admitted, n_dense)

@@ -1,0 +1,544 @@
+"""Quantized inference tests (ISSUE 7): the quantize/dequantize/
+quantized_* op quartet (per-channel vs per-tensor scale shapes, the
+inference-only no-grad exemption), the PTQ program-rewrite transform
+(eligibility rules, output closeness, analyzer cleanliness incl. the
+shape re-check actually re-running the quantized emitters), int8
+save/load/merge round trips through io.py, the InferenceEngine
+``quantize="int8"`` wire-through (private scope, quant stats,
+0-recompile steady state), and the slow fixture-trained quality gates:
+mnist top-1 and nmt BLEU through the quantized path must stay within a
+stated tolerance of the float baseline."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from op_test import OpTestCase
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.transforms.quantize import (SCALE_SUFFIX,
+                                                  quantize_program)
+from paddle_tpu.serving import InferenceEngine
+
+
+def _np_scale(x, axis=None):
+    ax = np.abs(np.asarray(x, np.float32))
+    amax = ax.max() if axis is None else \
+        ax.max(axis=tuple(i for i in range(x.ndim) if i != axis))
+    s = np.asarray(amax, np.float32) / 127.0
+    return np.where(s == 0.0, np.float32(1.0), s).astype(np.float32)
+
+
+def _np_quant(x, scale, axis=None):
+    xf = np.asarray(x, np.float32)
+    if axis is not None and np.ndim(scale) > 0:
+        shape = [1] * xf.ndim
+        shape[axis] = -1
+        scale = scale.reshape(shape)
+    return np.clip(np.round(xf / scale), -127, 127).astype(np.int8)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+class TestQuantizeOps:
+    def test_quantize_per_tensor(self):
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32) * 3
+        sc = _np_scale(x)
+        OpTestCase("quantize", {"X": x}).check_output(
+            {"Out": _np_quant(x, sc), "Scale": sc}, atol=0)
+
+    def test_quantize_per_channel_scale_shape(self):
+        """axis=1 -> one scale per output channel, shape [N] not []."""
+        x = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+        x[:, 2] = 0.0                       # zero channel -> scale 1.0
+        sc = _np_scale(x, axis=1)
+        assert sc.shape == (3,) and sc[2] == 1.0
+        case = OpTestCase("quantize", {"X": x}, attrs={"axis": 1})
+        outs = case.run_all()
+        got_q, got_s = outs["Out"][0], outs["Scale"][0]
+        assert np.asarray(got_s).shape == (3,)
+        np.testing.assert_array_equal(np.asarray(got_q), _np_quant(x, sc, 1))
+        np.testing.assert_allclose(np.asarray(got_s), sc)
+
+    def test_quantize_dequantize_roundtrip_error_bound(self):
+        """|x - dq(q(x))| <= scale/2 elementwise — the exact-parity bound
+        symmetric max-abs rounding guarantees (acceptance criterion)."""
+        rng = np.random.RandomState(2)
+        for axis in (None, 0, 1):
+            x = (rng.randn(6, 8) * rng.uniform(0.1, 10)).astype(np.float32)
+            sc = _np_scale(x, axis)
+            q = _np_quant(x, sc, axis)
+            attrs = {} if axis is None else {"axis": axis}
+            deq = OpTestCase("dequantize", {"X": q, "Scale": sc},
+                             attrs=attrs).run_single()
+            deq = np.asarray(deq)
+            bound = sc if axis is None else (
+                sc[:, None] if axis == 0 else sc[None, :])
+            assert (np.abs(deq - x) <= bound / 2 + 1e-7).all()
+
+    def test_quantized_mul_per_channel(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        w = rng.randn(4, 5).astype(np.float32)
+        sc = _np_scale(w, axis=1)
+        q = _np_quant(w, sc, axis=1)
+        want = (x.reshape(6, 4) @ (q.astype(np.float32))) * sc[None, :]
+        OpTestCase("quantized_mul", {"X": x, "Y": q, "Scale": sc},
+                   attrs={"x_num_col_dims": 2, "y_num_col_dims": 1}
+                   ).check_output({"Out": want.reshape(2, 3, 5)})
+
+    def test_quantized_mul_scalar_scale(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(3, 4).astype(np.float32)
+        w = rng.randn(4, 5).astype(np.float32)
+        sc = _np_scale(w)                   # per-tensor: 0-d scale
+        q = _np_quant(w, sc)
+        want = (x @ q.astype(np.float32)) * sc
+        OpTestCase("quantized_mul", {"X": x, "Y": q, "Scale": sc}
+                   ).check_output({"Out": want})
+
+    def test_quantized_matmul_transpose_y(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(3, 4).astype(np.float32)
+        w = rng.randn(5, 4).astype(np.float32)   # result col = w row
+        sc = _np_scale(w, axis=0)
+        q = _np_quant(w, sc, axis=0)
+        want = (x @ q.astype(np.float32).T) * sc[None, :]
+        OpTestCase("quantized_matmul", {"X": x, "Y": q, "Scale": sc},
+                   attrs={"transpose_Y": True}).check_output({"Out": want})
+
+    def test_quantized_matmul_batched(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        w = rng.randn(4, 5).astype(np.float32)
+        sc = _np_scale(w, axis=1)
+        q = _np_quant(w, sc, axis=1)
+        want = x @ (q.astype(np.float32) * sc[None, :])
+        OpTestCase("quantized_matmul", {"X": x, "Y": q, "Scale": sc}
+                   ).check_output({"Out": want})
+
+    def test_quantized_conv2d_matches_dequantized_conv(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 3, 6, 6).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        sc = _np_scale(w, axis=0)
+        q = _np_quant(w, sc, axis=0)
+        wf = q.astype(np.float32) * sc.reshape(-1, 1, 1, 1)
+        ref = OpTestCase("conv2d", {"Input": x, "Filter": wf},
+                         attrs={"strides": [1, 1], "paddings": [1, 1]}
+                         ).run_single()
+        OpTestCase("quantized_conv2d", {"Input": x, "Filter": q,
+                                        "Scale": sc},
+                   attrs={"strides": [1, 1], "paddings": [1, 1]}
+                   ).check_output({"Output": np.asarray(ref)})
+
+    def test_no_grad_exemption(self):
+        """The quantized quartet is inference-only: append_backward
+        skips them (no *_grad ops appear) while float paths around them
+        still differentiate — the exemption the PTQ rewrite relies on."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data("x", [4], "float32")
+            x.stop_gradient = False
+            y1 = layers.fc(input=x, size=3)
+            q, sc = layers.quantize(x, axis=1)
+            d = layers.dequantize(q, sc, axis=1)
+            loss = layers.elementwise_add(layers.reduce_sum(y1),
+                                          layers.reduce_sum(d))
+            fluid.append_backward(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "quantize" in types and "dequantize" in types
+        assert not any(t.startswith(("quantize_grad", "dequantize_grad",
+                                     "quantized_")) and t.endswith("_grad")
+                       for t in types), types
+        # the float fc path still produced a gradient for x
+        assert any(t == "mul_grad" for t in types), types
+
+
+# ---------------------------------------------------------------------------
+# the PTQ transform
+# ---------------------------------------------------------------------------
+
+def _fc_net(sizes=(16, 4)):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data("x", [6], "float32")
+        h = x
+        for i, s in enumerate(sizes[:-1]):
+            h = layers.fc(input=h, size=s, act="relu")
+        y = layers.fc(input=h, size=sizes[-1])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return main, scope, exe, y
+
+
+class TestQuantizeProgram:
+    def test_rewrite_outputs_close_and_stats(self):
+        main, scope, exe, y = _fc_net()
+        xv = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+        with fluid.scope_guard(scope):
+            ref, = exe.run(main, feed={"x": xv}, fetch_list=[y],
+                           mode="infer")
+        stats = quantize_program(main, scope)
+        assert stats.to_dict()["weights_quantized"] == 2
+        assert stats.to_dict()["weight_bytes_saved"] > 0
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("quantized_mul") == 2 and "mul" not in types
+        with fluid.scope_guard(scope):
+            got, = exe.run(main, feed={"x": xv}, fetch_list=[y],
+                           mode="infer")
+        ref, got = np.asarray(ref), np.asarray(got)
+        assert np.abs(got - ref).max() <= 0.05 * max(1.0, np.abs(ref).max())
+        # scope now holds int8 weights + fp32 sidecars under stable names
+        for name in stats.quantized:
+            assert np.asarray(scope.find_var(name)).dtype == np.int8
+            assert np.asarray(scope.find_var(name + SCALE_SUFFIX)).dtype \
+                == np.float32
+
+    def test_shared_weight_is_skipped(self):
+        """A weight with a non-quantizable reader keeps its float value —
+        retyping it would corrupt the other consumer."""
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data("x", [4], "float32")
+            w = fluid.ParamAttr(name="shared.w")
+            h = layers.fc(input=x, size=4, bias_attr=False, param_attr=w)
+            # same weight also read by an elementwise op
+            wvar = main.global_block().vars["shared.w"]
+            y = layers.elementwise_add(h, layers.reduce_sum(wvar))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        stats = quantize_program(main, scope)
+        assert not stats.quantized
+        assert "shared.w" in stats.skipped
+        assert np.asarray(scope.find_var("shared.w")).dtype == np.float32
+
+    def test_skip_and_min_elements(self):
+        main, scope, exe, y = _fc_net()
+        names = [op.input("Y")[0] for op in main.global_block().desc.ops
+                 if op.type == "mul"]
+        stats = quantize_program(main, scope, skip=[names[0]],
+                                 min_elements=10**9)
+        assert not stats.quantized
+        assert stats.skipped[names[0]] == "explicitly skipped"
+        assert "elements" in stats.skipped[names[1]]
+
+    def test_quantize_weight_inside_while_body(self):
+        """A weight consumed by a mul INSIDE a While sub-block — the
+        shape of the whole NMT beam-decode step — quantizes like any
+        global-block weight: the sub-block op is rewritten in place,
+        the fp32 scale sidecar rides the while op's P slot into the
+        body env, outputs stay close, and the rewritten program
+        analyzes clean."""
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data("x", [6], "float32")
+            i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+            i.stop_gradient = True
+            n = layers.fill_constant(shape=[1], dtype="int64", value=3)
+            n.stop_gradient = True
+            acc = layers.fill_constant(shape=[1], dtype="float32",
+                                       value=0.0)
+            cond = layers.less_than(x=i, y=n)
+            loop = layers.While(cond=cond)
+            with loop.block():
+                h = layers.fc(input=x, size=6, bias_attr=False,
+                              param_attr=fluid.ParamAttr(name="loop.w"))
+                layers.assign(layers.elementwise_add(
+                    x=acc, y=layers.reduce_sum(h)), acc)
+                layers.increment(x=i, in_place=True)
+                layers.less_than(x=i, y=n, cond=cond)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        xv = np.random.RandomState(4).randn(3, 6).astype(np.float32)
+        with fluid.scope_guard(scope):
+            ref, = exe.run(main, feed={"x": xv}, fetch_list=[acc],
+                           mode="infer")
+        stats = quantize_program(main, scope)
+        assert "loop.w" in stats.quantized, stats.skipped
+        # the sub-block mul was rewritten and the sidecar routed via P
+        sub_types = [od.type for b in main.desc.blocks[1:] for od in b.ops]
+        assert "quantized_mul" in sub_types and "mul" not in sub_types
+        while_op, = [od for od in main.global_block().desc.ops
+                     if od.type == "while"]
+        assert "loop.w" + SCALE_SUFFIX in while_op.inputs["P"]
+        assert np.asarray(scope.find_var("loop.w")).dtype == np.int8
+        with fluid.scope_guard(scope):
+            got, = exe.run(main, feed={"x": xv}, fetch_list=[acc],
+                           mode="infer")
+        ref, got = np.asarray(ref), np.asarray(got)
+        assert np.abs(got - ref).max() <= 0.05 * max(1.0, np.abs(ref).max())
+        diag = main.analyze(level="full", fetch_list=[acc])
+        assert not diag.has_errors, diag.render()
+
+    def test_quantized_program_analyzes_clean(self):
+        """Program.analyze(level='full') reports ZERO errors on the
+        rewritten program AND the shape re-check actually re-ran the
+        quantized emitters (no recheck-skipped info on them) — the
+        acceptance criterion plus its teeth."""
+        main, scope, exe, y = _fc_net()
+        quantize_program(main, scope)
+        diag = main.analyze(level="full", fetch_list=[y])
+        assert not diag.has_errors, diag.render()
+        skipped = [f for f in diag.findings
+                   if f.code == "recheck-skipped"
+                   and str(f.op_type).startswith(("quantize", "quantized_",
+                                                  "dequantize"))]
+        assert not skipped, [f.render() for f in skipped]
+
+    def test_cast_bearing_mixed_dtype_has_no_false_positives(self):
+        """bf16 AMP casts, int8 round trips and f64/i64 narrowing casts
+        in one program: the dtype re-check must not flag the runtime's
+        legitimate mixed-dtype promotions (ISSUE 7 satellite)."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data("x", [6], "float32")
+            xb = layers.cast(x, "bfloat16")
+            h = layers.fc(input=xb, size=8)
+            h32 = layers.cast(h, "float32")
+            qi, sc = layers.quantize(h32, axis=1)
+            dq = layers.dequantize(qi, sc, axis=1, out_dtype="float32")
+            i64 = layers.cast(layers.argmax(dq, axis=-1), "int64")
+            f64 = layers.cast(dq, "float64")
+            z = layers.elementwise_add(layers.reduce_sum(f64),
+                                       layers.cast(
+                                           layers.reduce_sum(
+                                               layers.cast(i64, "float32")),
+                                           "float64"))
+        diag = main.analyze(level="full", fetch_list=[z])
+        assert not diag.has_errors, diag.render()
+
+
+# ---------------------------------------------------------------------------
+# io round trip
+# ---------------------------------------------------------------------------
+
+def test_int8_inference_model_round_trip(tmp_path):
+    """save_inference_model -> load_inference_model keeps int8
+    persistables int8 and the fp32 scale sidecars fp32, and the loaded
+    program reproduces the quantized outputs bit-for-bit;
+    merge_inference_model packs the same artifacts (ISSUE 7
+    satellite)."""
+    main, scope, exe, y = _fc_net()
+    stats = quantize_program(main, scope)
+    d = str(tmp_path / "model")
+    xv = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    with fluid.scope_guard(scope):
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[y], mode="infer")
+        fluid.io.save_inference_model(d, ["x"], [y], exe,
+                                      main_program=main, scope=scope)
+    files = set(os.listdir(d))
+    for name in stats.quantized:
+        assert name in files and name + SCALE_SUFFIX in files
+    s2 = fluid.Scope()
+    prog2, feeds, fetches = fluid.io.load_inference_model(
+        d, exe, scope=s2, to_device=True)
+    for name in stats.quantized:
+        assert np.asarray(s2.find_var(name)).dtype == np.int8
+        assert np.asarray(s2.find_var(name + SCALE_SUFFIX)).dtype \
+            == np.float32
+        assert prog2.global_block().desc.vars[name].dtype == "int8"
+    with fluid.scope_guard(s2):
+        got, = exe.run(prog2, feed={feeds[0]: xv}, fetch_list=fetches,
+                       mode="infer")
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    merged = str(tmp_path / "model.merged")
+    fluid.io.merge_inference_model(d, merged)
+    assert os.path.getsize(merged) > 0
+
+
+def test_int8_tensor_file_dtype_preserved(tmp_path):
+    for dt in ("int8", "uint8"):
+        a = np.arange(-6 if dt == "int8" else 0, 6,
+                      dtype=dt).reshape(2, -1)
+        p = str(tmp_path / f"t.{dt}")
+        fluid.io.save_tensor(a, p)
+        b = fluid.io.load_tensor(p)
+        assert b.dtype == a.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# engine wire-through
+# ---------------------------------------------------------------------------
+
+class TestEngineQuantize:
+    def _engine_pair(self):
+        main, scope, exe, y = _fc_net()
+        infer = fluid.io.prune_program(main, [y])
+        base = InferenceEngine(program=infer, feed_names=["x"],
+                               fetch_vars=[y], scope=scope, executor=exe,
+                               batch_buckets=(4, 8), time_bucket=4)
+        quant = InferenceEngine(program=infer, feed_names=["x"],
+                                fetch_vars=[y], scope=scope, executor=exe,
+                                batch_buckets=(4, 8), time_bucket=4,
+                                quantize="int8")
+        return base, quant, scope
+
+    def test_outputs_close_and_caller_scope_untouched(self):
+        base, quant, scope = self._engine_pair()
+        xv = np.random.RandomState(2).randn(3, 6).astype(np.float32)
+        ref, = base.infer({"x": xv})
+        got, = quant.infer({"x": xv})
+        assert np.abs(ref - got).max() <= \
+            0.05 * max(1.0, np.abs(ref).max())
+        # PTQ ran on PRIVATE copies: the shared trained scope keeps fp32
+        for n in scope.vars:
+            assert np.asarray(scope.find_var(n)).dtype != np.int8, n
+        st = quant.cache_stats()["quant"]
+        assert st["mode"] == "int8" and st["weights_quantized"] == 2
+        assert st["weight_bytes_saved"] > 0
+        assert base.cache_stats()["quant"] == {"mode": "off"}
+
+    def test_zero_recompiles_after_warmup(self):
+        _, quant, _ = self._engine_pair()
+        rng = np.random.RandomState(3)
+        feeds = [{"x": rng.randn(b, 6).astype(np.float32)}
+                 for b in (2, 3, 4, 7)]
+        quant.warmup(feeds)
+        before = quant.cache_stats()["executable"]["misses"]
+        for f in feeds * 3:
+            quant.infer(f)
+        after = quant.cache_stats()["executable"]["misses"]
+        assert after - before == 0, (before, after)
+        diag = quant.program.analyze(level="full")
+        assert not diag.has_errors, diag.render()
+
+
+# ---------------------------------------------------------------------------
+# fixture-trained quality gates (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_mnist_top1_delta_through_quantized_path():
+    """Train the book conv net on the committed digits fixture, then
+    compare test top-1 through the float engine vs the int8-quantized
+    engine: |delta| <= 0.02 (acceptance criterion tolerance)."""
+    from paddle_tpu.datasets import mnist
+    from paddle_tpu.models import recognize_digits
+
+    train_rows = list(mnist.train()())
+    test_rows = list(mnist.test()())
+    if mnist.LAST_TIER not in ("real", "fixture"):
+        pytest.skip("no real/fixture digits available")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = layers.data("img", [1, 28, 28], "float32")
+        label = layers.data("label", [1], "int64")
+        pred, cost, _ = recognize_digits.conv_net(img, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
+    xs = np.stack([r[0].reshape(1, 28, 28) for r in train_rows]) \
+        .astype(np.float32)
+    ys = np.asarray([r[1] for r in train_rows], np.int64).reshape(-1, 1)
+    xt = np.stack([r[0].reshape(1, 28, 28) for r in test_rows]) \
+        .astype(np.float32)
+    yt = np.asarray([r[1] for r in test_rows], np.int64).reshape(-1, 1)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    bs = 128
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _epoch in range(12):
+            order = rng.permutation(len(xs))
+            for i in range(0, len(xs) - bs + 1, bs):
+                idx = order[i: i + bs]
+                exe.run(main, feed={"img": xs[idx], "label": ys[idx]},
+                        fetch_list=[cost])
+    infer = fluid.io.prune_program(main, [pred])
+
+    def top1(engine):
+        correct = 0
+        for i in range(0, len(xt), bs):
+            p, = engine.infer({"img": xt[i:i + bs]})
+            correct += int((np.asarray(p).argmax(-1)
+                            == yt[i:i + bs, 0]).sum())
+        return correct / len(xt)
+
+    kw = dict(program=infer, feed_names=["img"], fetch_vars=[pred],
+              scope=scope, executor=exe, batch_buckets=(32, 64, bs))
+    base = top1(InferenceEngine(**kw))
+    quant = top1(InferenceEngine(quantize="int8", **kw))
+    print(f"mnist top-1 float={base:.4f} int8={quant:.4f}")
+    assert base > 0.5, f"baseline degenerate ({base}) — gate meaningless"
+    assert abs(base - quant) <= 0.02, (base, quant)
+
+
+@pytest.mark.slow
+def test_nmt_bleu_delta_through_quantized_path():
+    """Train the attention seq2seq briefly on the committed CLDR corpus
+    fixture and compare held-out corpus BLEU of beam decodes through the
+    float engine vs the int8 engine: |delta| <= 0.05 (acceptance
+    criterion tolerance)."""
+    from paddle_tpu.datasets import wmt16
+    from paddle_tpu.fluid.core.lod import make_seq
+    from paddle_tpu.models import machine_translation as mt
+    from paddle_tpu.utils.bleu import corpus_bleu
+
+    dict_size = 2000
+    train_rows = list(wmt16.train(dict_size, dict_size)())[:2048]
+    test_rows = list(wmt16.test(dict_size, dict_size)())[:128]
+    if wmt16.LAST_TIER not in ("real", "fixture"):
+        pytest.skip("no real/fixture corpus available")
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        src = layers.data("src", [1], "int64", lod_level=1)
+        trg = layers.data("trg", [1], "int64", lod_level=1)
+        nxt = layers.data("nxt", [1], "int64", lod_level=1)
+        avg_cost, _ = mt.attention_train_model(src, trg, nxt, dict_size,
+                                               word_dim=64, hidden_dim=128)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+        ids_out, _ = mt.attention_decode_model(
+            src, dict_size, word_dim=64, hidden_dim=128, beam_size=3,
+            max_length=16)
+
+    def batch(rs):
+        return (make_seq([r[0] for r in rs], dtype=np.int64, bucket=8),
+                make_seq([r[1] for r in rs], dtype=np.int64, bucket=8),
+                make_seq([r[2] for r in rs], dtype=np.int64, bucket=8))
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    bs = 64
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _epoch in range(3):
+            order = rng.permutation(len(train_rows))
+            for i in range(0, len(train_rows) - bs + 1, bs):
+                s, n, t = batch([train_rows[j] for j in order[i:i + bs]])
+                exe.run(main, feed={"src": s, "trg": t, "nxt": n},
+                        fetch_list=[avg_cost])
+    infer = fluid.io.prune_program(main, [ids_out])
+
+    def bleu(engine):
+        hyps, refs = [], []
+        for i in range(0, len(test_rows), bs):
+            s, n, _ = batch(test_rows[i:i + bs])
+            out, = engine.infer({"src": s}, return_numpy=False)
+            best = np.asarray(out)[:, 0]
+            for b in range(best.shape[0]):
+                hyps.append([int(w) for w in best[b] if w > 1])
+                refs.append([[int(w) for w in np.asarray(n.data)[b]
+                              if w > 1]])
+        return float(corpus_bleu(hyps, refs, smooth=True))
+
+    kw = dict(program=infer, feed_names=["src"], fetch_vars=[ids_out],
+              scope=scope, executor=exe, batch_buckets=(32, bs),
+              time_bucket=8)
+    base = bleu(InferenceEngine(**kw))
+    quant = bleu(InferenceEngine(quantize="int8", **kw))
+    print(f"nmt BLEU float={base:.4f} int8={quant:.4f}")
+    assert abs(base - quant) <= 0.05, (base, quant)

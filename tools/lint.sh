@@ -122,6 +122,42 @@ with open(os.path.join(tmpdir, "serving_ragged_step.json"), "wb") as f:
     f.write(uni_prog.desc.serialize_to_string())
 with open(os.path.join(tmpdir, "serving_ragged_step.fetch"), "w") as f:
     f.write(uni_ids.name + "\n")
+
+# quantized sweep (ISSUE 7): (a) a PTQ-rewritten pruned program —
+# quantized_mul ops + int8 persistables + fp32 scale sidecars — and
+# (b) the int8-KV unified decode-step program (quantized_paged_cache_write
+# / scale-carrying ragged attention / quantized page copies) must both
+# stay analyzer-clean
+from paddle_tpu.fluid.transforms.quantize import quantize_program
+
+qmain, qstartup = fluid.Program(), fluid.Program()
+qscope = fluid.Scope()
+with fluid.program_guard(qmain, qstartup), fluid.unique_name.guard():
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu")
+    y = fluid.layers.fc(input=h, size=4)
+qexe = fluid.Executor(fluid.CPUPlace())
+with fluid.scope_guard(qscope):
+    qexe.run(qstartup)
+qpruned = fluid.io.prune_program(qmain, [y])
+stats = quantize_program(qpruned, qscope)
+assert stats.quantized, "PTQ rewrite quantized nothing — sweep is vacuous"
+with open(os.path.join(tmpdir, "quantized_pruned.json"), "wb") as f:
+    f.write(qpruned.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "quantized_pruned.fetch"), "w") as f:
+    f.write(y.name + "\n")
+
+qgen = PagedTransformerGenerator(30, 30, n_layer=2, n_head=2, d_key=4,
+                                 d_value=4, d_model=16, d_inner_hid=32,
+                                 max_length=64, src_len=8, max_out_len=8,
+                                 page_size=4, chunk_size=4, num_pages=32,
+                                 param_prefix="tfqg", kv_dtype="int8",
+                                 place=fluid.CPUPlace())
+qprog, _, qids, _ = qgen._unified
+with open(os.path.join(tmpdir, "serving_int8_ragged_step.json"), "wb") as f:
+    f.write(qprog.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "serving_int8_ragged_step.fetch"), "w") as f:
+    f.write(qids.name + "\n")
 EOF
   for prog in "$tmpdir"/*.json; do
     name="$(basename "$prog" .json)"
