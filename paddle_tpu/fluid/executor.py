@@ -204,6 +204,43 @@ class Executor:
         self._guard_ctxs: "OrderedDict[tuple, dict]" = OrderedDict()
         # (prog fp, fetch names, policy.check) -> sentinel check names
         self._guard_names: Dict[tuple, tuple] = {}
+        # the counter dicts above stay the hot-path source of truth;
+        # the registry reads them at SCRAPE time (bound method held
+        # weakly — a GC'd executor stops contributing)
+        from ..observability.metrics import registry as _obs_registry
+
+        _obs_registry().register_collector(self._collect_metrics)
+
+    def _collect_metrics(self):
+        """Scrape-time view of cache_stats()/health_stats() as labeled
+        series; samples from every live executor SUM into one process
+        rollup (see observability.metrics)."""
+        from ..observability.metrics import Sample
+
+        for cache in ("executable", "structure"):
+            st = self._stats[cache]
+            for ev in ("hits", "misses", "evictions"):
+                yield Sample(
+                    "paddle_executor_cache_events_total", "counter",
+                    (("cache", cache), ("event", ev)), float(st[ev]),
+                    "Compiled-step / structure-classification cache events")
+        for cache, size in (("executable", len(self._cache)),
+                            ("structure", len(self._cls_cache)),
+                            ("validated", len(self._validated))):
+            yield Sample("paddle_executor_cache_size", "gauge",
+                         (("cache", cache),), float(size),
+                         "Live entries per executor-side cache")
+        for ev in ("runs", "cached"):
+            yield Sample("paddle_executor_validate_total", "counter",
+                         (("event", ev),),
+                         float(self._stats["validate"][ev]),
+                         "Static-analysis pre-flight runs vs fingerprint "
+                         "cache hits")
+        for ev, v in self._health.items():
+            yield Sample("paddle_guardrail_events_total", "counter",
+                         (("event", ev),), float(v),
+                         "Guardrail sentinel/recovery counters "
+                         "(health_stats)")
 
     def health_stats(self) -> Dict[str, int]:
         """Guardrail counters (see resilience/guardrails.py):
@@ -508,9 +545,11 @@ class Executor:
         entries, which a real-hardware mid-execution hang may have
         consumed (pair step_timeout with on_nonfinite="rollback" when
         the scope must survive a wedged device)."""
+        from ..observability.tracing import tracer as _obs_tracer
         from ..resilience import guardrails as gr
         from ..resilience.chaos import injector
 
+        tr = _obs_tracer()
         inj = injector()
         if inj.enabled():
             feed = gr.poison_feed(feed, inj)
@@ -563,6 +602,7 @@ class Executor:
                 for n, v in gr.device_snapshot(gctx["snapshot"]).items():
                     scope.set_var(n, v)
                 gctx["since_snapshot"] = 0
+                tr.instant("guard/fault_rollback", cat="guard")
             raise
         self._health["guarded_steps"] += 1
         gctx["since_snapshot"] += 1
@@ -579,7 +619,10 @@ class Executor:
         new_state = {n: v for n, v in new_state.items() if n in state_vals}
         escalate = (policy.escalate_after > 0
                     and gctx["consecutive_bad"] >= policy.escalate_after)
+        tr.instant("guard/nonfinite_step", cat="guard",
+                   consecutive=gctx["consecutive_bad"])
         if escalate:
+            tr.instant("guard/escalation", cat="guard")
             self._health["escalations"] += 1
             gctx["consecutive_bad"] = 0
             gctx["snapshot"] = None     # the restorer will change the scope
@@ -596,6 +639,7 @@ class Executor:
                 "guarded step produced non-finite values (loss/grad/param "
                 "sentinel); scope holds the pre-step state")
         if policy.on_nonfinite == "rollback":
+            tr.instant("guard/rollback", cat="guard")
             self._health["rollbacks"] += 1
             # publish COPIES: the snapshot itself must survive the next
             # dispatch donating whatever sits in the scope
@@ -603,6 +647,7 @@ class Executor:
             new_state.update(gr.device_snapshot(gctx["snapshot"]))
             gctx["since_snapshot"] = 0  # scope now equals the snapshot
         else:                           # "skip": gated state IS pre-step
+            tr.instant("guard/skip", cat="guard")
             self._health["skips"] += 1
         return fetches, new_state, False
 
@@ -967,21 +1012,29 @@ class Executor:
         results: List[Any] = []
         n_steps = 0
 
+        from ..observability.tracing import tracer as _obs_tracer
+
+        tr = _obs_tracer()
+
         def _drain():
-            for outs in pending:
-                if return_numpy or force_numpy:
-                    outs = [_to_numpy(f) for f in outs]
-                else:
-                    # still a sync point: without it the device-fetch
-                    # path would let the host dispatch arbitrarily far
-                    # ahead, voiding the documented in-flight bound
-                    outs = list(outs)
-                    jax.block_until_ready(outs)
-                if on_fetch is not None:
-                    on_fetch(outs)
-                else:
-                    results.append(outs)
-            pending.clear()
+            if not pending:
+                return
+            with tr.span("executor/fetch_drain", cat="executor",
+                         steps=len(pending)):
+                for outs in pending:
+                    if return_numpy or force_numpy:
+                        outs = [_to_numpy(f) for f in outs]
+                    else:
+                        # still a sync point: without it the device-fetch
+                        # path would let the host dispatch arbitrarily far
+                        # ahead, voiding the documented in-flight bound
+                        outs = list(outs)
+                        jax.block_until_ready(outs)
+                    if on_fetch is not None:
+                        on_fetch(outs)
+                    else:
+                        results.append(outs)
+                pending.clear()
 
         try:
             for feed in loader:

@@ -11,7 +11,7 @@ equivalent of nvprof)."""
 from __future__ import annotations
 
 import contextlib
-import json
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -19,33 +19,55 @@ from typing import Dict, List, Optional
 __all__ = ["profiler", "cuda_profiler", "tpu_trace", "reset_profiler", "op_cost_table",
            "record_event", "get_profile_table"]
 
+# _events is appended from whatever thread runs the dispatch — the
+# serving scheduler's daemon thread, the guardrail watchdog's worker,
+# run_pipeline's caller — so every touch goes through _events_lock
+# (ISSUE 8 satellite: the bare defaultdict lost events under
+# concurrent append and could resize mid-iteration in
+# get_profile_table)
 _events: Dict[str, List[float]] = defaultdict(list)
+_events_lock = threading.Lock()
 _enabled = False
+
+from ..observability.tracing import tracer as _obs_tracer  # noqa: E402
 
 
 @contextlib.contextmanager
 def record_event(name: str):
     """RAII timing block — analog of platform::RecordEvent (profiler.h:25).
-    The executor wraps each compiled-step invocation in one of these."""
-    if not _enabled:
+    The executor wraps each compiled-step invocation in one of these.
+
+    Every event is ALSO emitted as an observability tracing span (same
+    name, cat="profiler"), so ``get_profile_table`` and the Chrome-trace
+    export describe the same timeline — the table aggregates, the trace
+    keeps per-occurrence timing."""
+    tr = _obs_tracer()
+    if not _enabled and not tr.enabled:
         yield
         return
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        _events[name].append(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        if _enabled:
+            with _events_lock:
+                _events[name].append(t1 - t0)
+        tr.complete(name, t0, t1, cat="profiler")
 
 
 def reset_profiler():
-    _events.clear()
+    with _events_lock:
+        _events.clear()
 
 
 def get_profile_table(sorted_key: Optional[str] = "total"):
     """Event table like the reference's ParseEvents output
     (platform/profiler.cc): name, calls, total, min, max, ave."""
+    with _events_lock:
+        snapshot = {name: list(times) for name, times in _events.items()}
     rows = []
-    for name, times in _events.items():
+    for name, times in snapshot.items():
         rows.append({
             "name": name, "calls": len(times),
             "total": sum(times), "min": min(times), "max": max(times),

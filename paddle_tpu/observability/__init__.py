@@ -1,0 +1,27 @@
+"""Unified telemetry (ISSUE 8): metrics registry, trace spans, and live
+HTTP endpoints across training and serving.
+
+Three pieces, all process-global by default so instrumented surfaces
+(executor, scheduler, page allocator, guardrails, engine, master)
+register once and a single scrape sees the whole process:
+
+* ``metrics``  — Counter/Gauge/Histogram registry with label sets,
+  Prometheus text exposition + JSON snapshot; existing dict stats
+  surfaces contribute via scrape-time collectors (zero hot-path cost).
+* ``tracing``  — ring-buffered spans with a ``span()`` context manager
+  and Chrome-trace/Perfetto export; every serving request gets a
+  submitted → admitted → prefill-chunks → per-token-decode → retired
+  timeline, every executor step a dispatch span.
+* ``server``   — ``ObservabilityServer`` exposing ``/metrics``,
+  ``/healthz``, ``/statusz``, ``/trace``; attach the scheduler, a
+  trainer, or a MasterServer in one line.  Scrape with
+  ``python -m paddle_tpu.tools.obs``.
+"""
+
+from . import metrics, tracing  # noqa: F401
+from .metrics import MetricsRegistry, Sample, registry  # noqa: F401
+from .server import ObservabilityServer, resolve_source  # noqa: F401
+from .tracing import Tracer, tracer  # noqa: F401
+
+__all__ = ["metrics", "tracing", "MetricsRegistry", "Sample", "registry",
+           "ObservabilityServer", "resolve_source", "Tracer", "tracer"]

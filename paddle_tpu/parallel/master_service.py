@@ -214,6 +214,12 @@ class MasterServer:
             queue = TaskQueue()
         self.queue = queue
         self._snapped_version = queue.version if recovered else None
+        # telemetry (ISSUE 8): task/lease state for /metrics — counts()
+        # already takes the queue lock, so the scrape is exact, and the
+        # collector is weak (a stopped, GC'd master stops reporting)
+        from ..observability.metrics import registry as _obs_registry
+
+        _obs_registry().register_collector(self._collect_metrics)
         handler = type("BoundHandler", (_Handler,),
                        {"queue": queue, "master": self})
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -240,6 +246,23 @@ class MasterServer:
     def address(self) -> str:
         h, p = self._httpd.server_address[:2]
         return f"{h}:{p}"
+
+    def _collect_metrics(self):
+        from ..observability.metrics import Sample
+
+        counts = self.queue.counts()
+        for state in ("todo", "pending", "done", "failed"):
+            yield Sample("paddle_master_tasks", "gauge",
+                         (("state", state),), float(counts[state]),
+                         "Master task-queue chunks by lease state")
+        # deliberately NO epoch gauge: same-series collector samples SUM
+        # across live masters, and an epoch is a per-instance position,
+        # not a summable quantity — read it from /statusz (counts())
+
+    def counts(self):
+        """The queue's live counts — lets an ObservabilityServer attach
+        the master as a /statusz source (duck-typed via ``counts``)."""
+        return self.queue.counts()
 
     def _maybe_snapshot(self) -> None:
         if not self.snapshot_path:

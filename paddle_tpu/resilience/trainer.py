@@ -99,6 +99,29 @@ class ResilientTrainer:
         self.prefetch = prefetch
         self.guard = guard
         self.guard_executor = guard_executor
+        # telemetry (ISSUE 8): live progress for /statusz (attach the
+        # trainer to an ObservabilityServer) + a counter per durable
+        # journal event next to the guardrail series
+        self._last_step: Optional[int] = None
+        self._last_saved_step: Optional[int] = None
+        from ..observability.metrics import registry as _obs_registry
+
+        self._m_journal = _obs_registry().counter(
+            "paddle_guard_journal_events_total",
+            "Guard-journal records written (skip/rollback/"
+            "escalate-restore)", labels=("event",))
+
+    def status(self) -> dict:
+        """JSON-able progress rollup — the ObservabilityServer /statusz
+        source for a training worker (duck-typed via ``status``)."""
+        out = {"worker": self.worker,
+               "checkpoint_dir": self.manager.dirname,
+               "last_step": self._last_step,
+               "last_saved_step": self._last_saved_step,
+               "guarded": self.guard is not None}
+        if self.guard_executor is not None:
+            out["health"] = self.guard_executor.health_stats()
+        return out
 
     def resume(self) -> Optional[int]:
         """Restore the newest CRC-valid checkpoint into the scope;
@@ -125,6 +148,7 @@ class ResilientTrainer:
     def _journal_guard(self, step: int, event: str, **extra) -> None:
         rec = {"step": int(step), "event": event}
         rec.update(extra)
+        self._m_journal.labels(event=event).inc()
         try:
             with open(self.guard_journal_path(), "a") as f:
                 f.write(json.dumps(rec) + "\n")
@@ -190,6 +214,7 @@ class ResilientTrainer:
         else:
             step = restored
         last_saved = restored
+        self._last_step, self._last_saved_step = step, last_saved
         stopping = False
         while not stopping:
             if max_steps is not None and step >= max_steps:
@@ -216,6 +241,7 @@ class ResilientTrainer:
             try:
                 step, last_saved, stopping = self._drive_chunk(
                     task, it, train_step, max_steps, step, last_saved)
+                self._last_step, self._last_saved_step = step, last_saved
             finally:
                 # unblock a prefetching producer on EVERY exit path
                 # (chunk done, failure break, train_step raise)
@@ -226,6 +252,8 @@ class ResilientTrainer:
         # never rewrite a checkpoint the loop just finished writing)
         if step > 0 and last_saved != step:
             self._save(step, force=True)
+            last_saved = step
+        self._last_step, self._last_saved_step = step, last_saved
         return step
 
     def _drive_chunk(self, task, it, train_step, max_steps, step,

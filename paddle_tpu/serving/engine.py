@@ -127,6 +127,29 @@ class InferenceEngine:
         self._padding = {"true_rows": 0, "padded_rows": 0,
                          "true_tokens": 0, "padded_tokens": 0}
         self._warming = False
+        # telemetry (ISSUE 8): the counter dicts above stay the source
+        # of truth; a weak scrape-time collector exports them labeled
+        from ..observability.metrics import registry as _obs_registry
+
+        _obs_registry().register_collector(self._collect_metrics)
+
+    def _collect_metrics(self):
+        from ..observability.metrics import Sample
+
+        for ev in ("bucket_hits", "bucket_misses"):
+            yield Sample("paddle_engine_bucket_events_total", "counter",
+                         (("event", ev.split("_", 1)[1]),),
+                         float(self._stats[ev]),
+                         "Shape-bucket reuse vs first-compile events")
+        yield Sample("paddle_engine_buckets", "gauge", (),
+                     float(len(self._buckets)),
+                     "Distinct compiled shape buckets registered")
+        for k, v in self._padding.items():
+            kind, what = k.split("_", 1)    # true/padded x rows/tokens
+            yield Sample(f"paddle_engine_padding_{what}_total",
+                         "counter", (("kind", kind),), float(v),
+                         "Requested vs dispatched rows/tokens (padding "
+                         "honesty counters)")
 
     # -- post-training quantization (ISSUE 7) --------------------------------
     def _quantize_int8(self, program, clone_scope=True):

@@ -40,6 +40,7 @@ import numpy as np
 from .. import fluid
 from ..fluid import layers
 from ..fluid.core.lod import SeqArray
+from ..observability import tracing as _obs_tracing
 from ..models import transformer as T
 from .decoder import _Cfg, dense_kv_bytes_per_slot
 from .paging import (PageAllocator, PoolCapacityError, TRASH_PAGE,
@@ -193,6 +194,7 @@ class PagedTransformerGenerator:
         self._lanes: List[_Lane] = []
         self._slots = 0
         self._steps = 0
+        self._tracer = _obs_tracing.tracer()
         self._beam_steps: Dict[int, tuple] = {}
         self._decode_prog = None
         self._build_unified()
@@ -567,6 +569,13 @@ class PagedTransformerGenerator:
         emitted: Dict[int, int] = {}
         for slot, lane in enumerate(self._lanes):
             if lane.phase == "prefill":
+                # emitted AFTER the dispatch returned: a chunk that
+                # never ran must not appear in the request timeline
+                self._tracer.instant(
+                    "lane/prefill_chunk", cat="serving", slot=slot,
+                    tokens=lane.pending_chunk,
+                    done=lane.enc_done + lane.pending_chunk,
+                    total=lane.s_true)
                 lane.enc_done += lane.pending_chunk
                 lane.pending_chunk = 0
                 if lane.enc_done >= lane.s_true:

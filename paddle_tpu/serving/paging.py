@@ -32,6 +32,8 @@ page a function of the whole prompt and sharing would corrupt outputs.
 from __future__ import annotations
 
 import hashlib
+import threading
+import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -41,6 +43,65 @@ __all__ = ["PageAllocator", "PoolCapacityError", "TRASH_PAGE",
            "chunk_hashes"]
 
 TRASH_PAGE = 0
+
+# -- telemetry (ISSUE 8) ------------------------------------------------------
+# ONE module-level collector aggregates every live allocator: per-pool
+# series would need unstable instance labels, and summing utilization
+# across pools is meaningless — so the collector emits summable page
+# counts per state plus ONE aggregate utilization over all live pools.
+# Allocators register weakly; a GC'd pool drops out of the rollup.
+_LIVE_ALLOCATORS: "weakref.WeakSet[PageAllocator]" = weakref.WeakSet()
+_collector_lock = threading.Lock()
+_collector_registered = False
+
+
+def _collect_pool_metrics():
+    from ..observability.metrics import Sample
+
+    allocs = list(_LIVE_ALLOCATORS)
+    states = {"free": 0, "in_use": 0, "evictable": 0, "total": 0}
+    counters = {"allocs": 0, "frees": 0, "evictions": 0, "cow_copies": 0}
+    prefix = {"lookups": 0, "hits": 0}
+    chunks = 0
+    for a in allocs:
+        try:
+            st = a.stats()
+        except Exception:
+            continue            # a mid-mutation pool must not kill the scrape
+        for k in states:
+            states[k] += st[k]
+        for k in counters:
+            counters[k] += st[k]
+        prefix["lookups"] += st["prefix_lookups"]
+        prefix["hits"] += st["prefix_hits"]
+        chunks += st["cached_chunks"]
+    for state, v in states.items():
+        yield Sample("paddle_kv_pages", "gauge", (("state", state),),
+                     float(v), "KV-pool pages by state, all live pools")
+    yield Sample("paddle_kv_page_utilization", "gauge", (),
+                 states["in_use"] / max(1, states["total"]),
+                 "in_use / total pages across all live KV pools")
+    for ev, v in counters.items():
+        yield Sample("paddle_kv_page_events_total", "counter",
+                     (("event", ev),), float(v),
+                     "Page allocator events (alloc/free/evict/COW)")
+    for ev, v in prefix.items():
+        yield Sample("paddle_kv_prefix_events_total", "counter",
+                     (("event", ev),), float(v),
+                     "Prefix-chunk cache lookups and hits")
+    yield Sample("paddle_kv_cached_chunks", "gauge", (), float(chunks),
+                 "Prompt-prefix chunks resident in the cache")
+
+
+def _register_pool_collector() -> None:
+    global _collector_registered
+    with _collector_lock:
+        if _collector_registered:
+            return
+        from ..observability.metrics import registry
+
+        registry().register_collector(_collect_pool_metrics)
+        _collector_registered = True
 
 
 class PoolCapacityError(RuntimeError):
@@ -85,6 +146,8 @@ class PageAllocator:
         self._stats = {"allocs": 0, "frees": 0, "evictions": 0,
                        "prefix_lookups": 0, "prefix_hits": 0,
                        "cow_copies": 0}
+        _LIVE_ALLOCATORS.add(self)
+        _register_pool_collector()
 
     # -- raw pages -----------------------------------------------------------
     @property

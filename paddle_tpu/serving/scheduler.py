@@ -39,14 +39,67 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import metrics as _obs_metrics
+from ..observability import tracing as _obs_tracing
 from .paging import PoolCapacityError
 
 __all__ = ["Request", "ContinuousBatchingScheduler"]
+
+# tokens-per-request is a count histogram, not a latency one
+_TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+# ONE module-level collector aggregates every live scheduler (the
+# paging.py pool-collector rule): queue depth and slot counts SUM
+# honestly, but a per-instance utilization RATIO would sum to nonsense
+# (two schedulers at 0.8 -> 1.6) — so the ratio is computed over the
+# aggregated counts.  Schedulers register weakly.
+_LIVE_SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
+_sched_collector_lock = threading.Lock()
+_sched_collector_registered = False
+
+
+def _collect_scheduler_metrics():
+    from ..observability.metrics import Sample
+
+    queued = active = free = total = 0
+    for s in list(_LIVE_SCHEDULERS):
+        try:
+            with s._lock:
+                queued += len(s._queue)
+                active += len(s._active)
+                free += len(s._free)
+                total += s.n_slots
+        except Exception:
+            continue
+    yield Sample("paddle_serving_queue_depth", "gauge", (),
+                 float(queued), "Requests waiting for a slot, all live "
+                 "schedulers")
+    yield Sample("paddle_serving_in_flight", "gauge", (), float(active),
+                 "Requests occupying a decode lane")
+    for state, v in (("free", free), ("active", active),
+                     ("total", total)):
+        yield Sample("paddle_serving_slots", "gauge",
+                     (("state", state),), float(v),
+                     "Decode lanes by state")
+    yield Sample("paddle_serving_slot_utilization", "gauge", (),
+                 active / max(1, total),
+                 "Occupied fraction of all live schedulers' lanes")
+
+
+def _register_scheduler_collector() -> None:
+    global _sched_collector_registered
+    with _sched_collector_lock:
+        if _sched_collector_registered:
+            return
+        _obs_metrics.registry().register_collector(
+            _collect_scheduler_metrics)
+        _sched_collector_registered = True
 
 
 class Request:
@@ -65,6 +118,11 @@ class Request:
         self.submitted = time.perf_counter()
         self.admitted: Optional[float] = None
         self.finished: Optional[float] = None
+        # first/last token marks (same clock as submitted/finished):
+        # TTFT = first_token - submitted, inter-token gaps feed the ITL
+        # histogram — the per-token signal end-to-end p50/p95 cannot see
+        self.first_token: Optional[float] = None
+        self.last_token: Optional[float] = None
         self.slot: Optional[int] = None
         self._done = threading.Event()
 
@@ -112,6 +170,37 @@ class ContinuousBatchingScheduler:
         self._finished: List[Request] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # -- telemetry (ISSUE 8): labeled instruments in the shared
+        # registry + per-request span timeline.  stats() stays the dict
+        # view; these are the exported series a /metrics scrape reads.
+        reg = _obs_metrics.registry()
+        self._tracer = _obs_tracing.tracer()
+        self._m_requests = reg.counter(
+            "paddle_serving_requests_total",
+            "Request lifecycle events (submitted/admitted/finished/"
+            "failed/rejected)", labels=("event",))
+        self._m_tokens = reg.counter(
+            "paddle_serving_tokens_total", "Decoded tokens emitted")
+        self._m_steps = reg.counter(
+            "paddle_serving_steps_total", "Lockstep scheduler steps run")
+        self._h_total = reg.histogram(
+            "paddle_serving_request_latency_seconds",
+            "submit -> finish latency of successful requests")
+        self._h_queue = reg.histogram(
+            "paddle_serving_queue_latency_seconds",
+            "submit -> admission latency")
+        self._h_ttft = reg.histogram(
+            "paddle_serving_ttft_seconds",
+            "submit -> first decoded token (time-to-first-token)")
+        self._h_itl = reg.histogram(
+            "paddle_serving_inter_token_seconds",
+            "gap between consecutive decoded tokens of one request")
+        self._h_tokens_per_req = reg.histogram(
+            "paddle_serving_tokens_per_request",
+            "decoded tokens per finished request",
+            buckets=_TOKEN_BUCKETS)
+        _LIVE_SCHEDULERS.add(self)
+        _register_scheduler_collector()
 
     # -- submission ----------------------------------------------------------
     def submit(self, src_tokens, max_new_tokens: Optional[int] = None
@@ -132,10 +221,20 @@ class ContinuousBatchingScheduler:
             # structurally unserveable: the prompt + decode reservation
             # exceed the WHOLE page pool — queueing it would park it at
             # the queue head forever (admission can never succeed)
+            self._m_requests.labels(event="rejected").inc()
+            self._tracer.instant("request/rejected", cat="serving",
+                                 rid=req.rid, reason="pool_capacity")
             raise PoolCapacityError(
                 f"submit: request needs more pages than the entire pool "
                 f"holds (prompt {len(req.src)} tokens, max_new "
                 f"{req.max_new_tokens})")
+        # telemetry BEFORE the queue append: once the request is queued
+        # the serve thread can admit it immediately, and the admitted
+        # instant must never precede the submitted one in the trace
+        self._m_requests.labels(event="submitted").inc()
+        self._tracer.instant("request/submitted", cat="serving",
+                             rid=req.rid, prompt_tokens=len(req.src),
+                             max_new=req.max_new_tokens)
         with self._work:
             self._queue.append(req)
             self._work.notify()
@@ -166,6 +265,10 @@ class ContinuousBatchingScheduler:
                         req.finished = time.perf_counter()
                         self._finished.append(req)
                         req._done.set()
+                        self._m_requests.labels(event="rejected").inc()
+                        self._tracer.instant(
+                            "request/rejected", cat="serving",
+                            rid=req.rid, reason="pool_capacity")
                         continue
                     if not self.model.can_admit(req.src,
                                                 req.max_new_tokens):
@@ -189,6 +292,10 @@ class ContinuousBatchingScheduler:
                     req.finished = time.perf_counter()
                     self._finished.append(req)
                 req._done.set()
+                self._m_requests.labels(event="failed").inc()
+                self._tracer.instant("request/admit_failed",
+                                     cat="serving", rid=req.rid,
+                                     error=type(e).__name__)
                 continue
             with self._lock:
                 req.slot = slot
@@ -199,6 +306,10 @@ class ContinuousBatchingScheduler:
                 self._tokens[slot] = self.model.start_id
                 self._pos[slot] = 0
                 self._src_len[slot] = s_true
+            self._m_requests.labels(event="admitted").inc()
+            self._h_queue.observe(req.admitted - req.submitted)
+            self._tracer.instant("request/admitted", cat="serving",
+                                 rid=req.rid, slot=slot)
             admitted += 1
 
     def _retire_locked(self, slot: int, req: Request) -> None:
@@ -222,6 +333,37 @@ class ContinuousBatchingScheduler:
         self._free.append(slot)
         self._finished.append(req)
         req._done.set()
+        ok = req.error is None
+        self._m_requests.labels(
+            event="finished" if ok else "failed").inc()
+        if ok:
+            self._h_total.observe(req.finished - req.submitted)
+            self._h_tokens_per_req.observe(len(req.tokens))
+        self._tracer.instant("request/retired", cat="serving",
+                             rid=req.rid, slot=slot,
+                             tokens=len(req.tokens), ok=ok)
+        # the whole-request span, stamped from the Request's own marks —
+        # one bar per request in the Chrome-trace view, submit to retire
+        self._tracer.complete("request", req.submitted, req.finished,
+                              cat="serving", rid=req.rid,
+                              tokens=len(req.tokens), ok=ok)
+
+    def _note_token(self, req: Request) -> None:
+        """Per-token telemetry (called under the lock, right after the
+        token was appended): TTFT on the first token, inter-token gap on
+        the rest, and one ``request/token`` trace instant — token
+        instants per rid reconstruct the exact decode timeline (the
+        test asserts count == len(req.tokens))."""
+        now = time.perf_counter()
+        if req.first_token is None:
+            req.first_token = now
+            self._h_ttft.observe(now - req.submitted)
+        else:
+            self._h_itl.observe(now - req.last_token)
+        req.last_token = now
+        self._m_tokens.inc()
+        self._tracer.instant("request/token", cat="serving", rid=req.rid,
+                             index=len(req.tokens))
 
     def step_once(self) -> bool:
         """Admit what fits, run ONE lockstep decode step, retire finished
@@ -239,31 +381,39 @@ class ContinuousBatchingScheduler:
             # prefill and decode over every lane; only lanes that
             # actually emitted a token come back
             try:
-                emitted = self.model.lane_step()
+                with self._tracer.span("scheduler/step", cat="serving",
+                                       managed=True):
+                    emitted = self.model.lane_step()
             except BaseException as e:
                 self._fail_in_flight(e)
                 return True
             with self._lock:
                 self._steps += 1
+                self._m_steps.inc()
                 for slot, tok in emitted.items():
                     req = self._active.get(slot)
                     if req is None:
                         continue
                     req.tokens.append(int(tok))
+                    self._note_token(req)
                     if int(tok) == self.model.end_id or \
                             len(req.tokens) >= req.max_new_tokens:
                         self._retire_locked(slot, req)
             return True
         try:
-            nxt = self.model.step_slots(tokens, pos, src_len)
+            with self._tracer.span("scheduler/step", cat="serving",
+                                   managed=False):
+                nxt = self.model.step_slots(tokens, pos, src_len)
         except BaseException as e:
             self._fail_in_flight(e)
             return True
         with self._lock:
             self._steps += 1
+            self._m_steps.inc()
             for slot, req in list(self._active.items()):
                 tok = int(nxt[slot])
                 req.tokens.append(tok)
+                self._note_token(req)
                 self._tokens[slot] = tok
                 self._pos[slot] += 1
                 if tok == self.model.end_id or \
@@ -368,4 +518,24 @@ class ContinuousBatchingScheduler:
                 "decoded_tokens": toks,
                 "decoded_tok_per_s": round(toks / span, 2),
             })
+            # ISSUE 8 satellite: percentiles from the per-token span
+            # marks (first_token/last_token are what the request/token
+            # trace instants are stamped from) — TTFT and tail latency
+            # the end-to-end numbers above cannot express.  Existing
+            # keys stay untouched (PR 5/6 tests key on them).
+            out["p99_latency_s"] = round(float(np.percentile(total, 99)),
+                                         4)
+            ttft = np.asarray([r.first_token - r.submitted for r in ok
+                               if r.first_token is not None])
+            if ttft.size:
+                out["ttft_p50_s"] = round(float(np.percentile(ttft, 50)),
+                                          4)
+                out["ttft_p95_s"] = round(float(np.percentile(ttft, 95)),
+                                          4)
+            ntok = np.asarray([len(r.tokens) for r in ok])
+            out["tokens_per_request"] = {
+                "p50": round(float(np.percentile(ntok, 50)), 2),
+                "p95": round(float(np.percentile(ntok, 95)), 2),
+                "max": int(ntok.max()),
+            }
         return out

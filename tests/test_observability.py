@@ -1,0 +1,595 @@
+"""Observability tests (ISSUE 8): registry correctness under concurrent
+writers, Prometheus exposition golden format, the per-request span
+timeline of a seeded scheduler run, Chrome-trace schema sanity, and the
+/metrics + /healthz + /statusz endpoint round-trips (including a live
+scrape during a serving run)."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.observability import (MetricsRegistry, ObservabilityServer,
+                                      Sample, Tracer, registry, tracer)
+from paddle_tpu.serving import ContinuousBatchingScheduler, PageAllocator
+
+
+class FakeModel:
+    """Minimal slot model (scheduler protocol): every lane emits token 5
+    until max_new_tokens retires it — deterministic, no device work."""
+
+    start_id, end_id = 0, 1
+
+    def __init__(self, n=0):
+        self.n = n
+
+    def open_slots(self, n):
+        self.n = n
+
+    def admit_slot(self, slot, prompt):
+        return len(prompt)
+
+    def clear_slot(self, slot):
+        pass
+
+    def step_slots(self, tokens, pos, src_len):
+        return np.full(self.n, 5, np.int64)
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_counter_concurrent_writers_exact():
+    """N threads x K increments lose nothing (the whole point of the
+    per-child lock: scheduler thread, watchdog, submitters all write)."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "t", labels=("who",))
+    h = reg.histogram("t_lat", "t")
+    n_threads, k = 8, 500
+
+    def work(i):
+        child = c.labels(who=f"w{i % 2}")
+        for _ in range(k):
+            child.inc()
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(c.labels(who=f"w{i}").value for i in range(2))
+    assert total == n_threads * k
+    _, _, count = h.labels().snapshot()
+    assert count == n_threads * k
+
+
+def test_instrument_type_and_label_conflicts_raise():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "x", labels=("a",))
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")                    # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("b",))   # label-set conflict
+    with pytest.raises(ValueError):
+        reg.counter("bad name")                 # invalid name
+    with pytest.raises(ValueError):
+        reg.counter("y_total").inc(-1)          # counters only go up
+
+
+def test_collector_weak_owner_and_accumulation():
+    """Two collectors agreeing on (name, labels) SUM; a dead owner's
+    collector drops out at the next scrape."""
+    reg = MetricsRegistry()
+
+    class Owner:
+        def __init__(self, v):
+            self.v = v
+
+        def collect(self):
+            yield Sample("pool_pages", "gauge", (("state", "free"),),
+                         float(self.v), "h")
+
+    a, b = Owner(3), Owner(4)
+    reg.register_collector(a.collect)
+    reg.register_collector(b.collect)
+    assert "pool_pages{state=\"free\"} 7" in reg.render_prometheus()
+    del b
+    assert "pool_pages{state=\"free\"} 3" in reg.render_prometheus()
+
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'  # escaped \" \\ \n ok
+_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                    # metric name
+    rf"(\{{{_LABEL}(,{_LABEL})*\}})?"               # label set
+    r" (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$")             # value
+
+
+def _assert_prometheus_valid(text):
+    """Golden-format check: every line is a comment or a valid sample;
+    every sample's family has HELP+TYPE; histograms are cumulative with
+    a +Inf bucket and _sum/_count."""
+    typed, helped = {}, set()
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            assert kind in ("counter", "gauge", "histogram"), line
+            typed[name] = kind
+            continue
+        assert _LINE.match(line), f"bad exposition line: {line!r}"
+        samples.append(line)
+    hist = {n for n, k in typed.items() if k == "histogram"}
+    for line in samples:
+        name = re.split(r"[{ ]", line, 1)[0]
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in hist:
+                base = name[:-len(suffix)]
+        assert base in typed and base in helped, f"untyped series {name}"
+    return typed
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests", labels=("event",))
+    c.labels(event="ok").inc(3)
+    c.labels(event='we"ird\nname').inc()         # label escaping
+    g = reg.gauge("depth", "queue depth")
+    g.set(2.5)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.render_prometheus()
+    typed = _assert_prometheus_valid(text)
+    assert typed == {"req_total": "counter", "depth": "gauge",
+                     "lat_seconds": "histogram"}
+    assert 'req_total{event="ok"} 3' in text
+    assert r'we\"ird\nname' in text
+    # histogram: cumulative buckets, +Inf == count, sum is the total
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+    assert "lat_seconds_sum 5.55" in text
+
+
+def test_gauge_function_and_snapshot_json():
+    reg = MetricsRegistry()
+    reg.gauge("lazy", "sampled at scrape").set_function(lambda: 41 + 1)
+    reg.histogram("h_seconds", "h").observe(0.2)
+    snap = reg.snapshot()
+    json.dumps(snap)                        # JSON-able, incl. bucket keys
+    by_name = {m["name"]: m for m in snap["metrics"]}
+    assert by_name["lazy"]["samples"][0]["value"] == 42
+    assert by_name["h_seconds"]["samples"][0]["count"] == 1
+    assert "+Inf" in by_name["h_seconds"]["samples"][0]["buckets"]
+
+
+def test_histogram_percentile_interpolation():
+    reg = MetricsRegistry()
+    h = reg.histogram("p_seconds", "p", buckets=(0.1, 1.0, 10.0))
+    for _ in range(90):
+        h.observe(0.05)
+    for _ in range(10):
+        h.observe(5.0)
+    assert h.percentile(50) <= 0.1
+    assert 1.0 <= h.percentile(99) <= 10.0
+    assert reg.histogram("empty_seconds", "e").percentile(50) is None
+
+
+# -- tracer ------------------------------------------------------------------
+
+def test_tracer_ring_bound_and_chrome_schema():
+    tr = Tracer(capacity=16)
+    for i in range(20):
+        with tr.span("work", cat="test", i=i):
+            pass
+    evs = tr.events()
+    assert len(evs) == 16 and tr.dropped == 4
+    assert evs[0]["args"]["i"] == 4              # oldest dropped first
+    ids = [e["id"] for e in evs]
+    assert ids == sorted(ids)                    # seeded, monotonic ids
+    trace = tr.chrome_trace()
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    for e in trace["traceEvents"]:
+        assert {"name", "cat", "ph", "ts", "pid", "tid"} <= set(e)
+        assert e["ph"] in ("X", "i")
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+
+
+def test_tracer_disable_is_noop_and_export(tmp_path):
+    tr = Tracer()
+    tr.disable()
+    with tr.span("skipped"):
+        pass
+    tr.instant("skipped2")
+    assert tr.events() == []
+    tr.enable()
+    tr.instant("kept")
+    path = tmp_path / "trace.json"
+    assert tr.export(str(path)) == 1
+    loaded = json.loads(path.read_text())
+    assert loaded["traceEvents"][0]["name"] == "kept"
+
+
+def test_profiler_record_event_threadsafe_and_traced():
+    """Satellite: concurrent record_event loses no events, and the same
+    events land in the tracer (table and trace agree on counts)."""
+    from paddle_tpu.fluid import profiler
+
+    tr = tracer()
+    tr.clear()
+    profiler.reset_profiler()
+    n_threads, k = 6, 200
+    with profiler.profiler(print_table=False):
+        def work():
+            for _ in range(k):
+                with profiler.record_event("conc"):
+                    pass
+
+        threads = [threading.Thread(target=work)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rows = {r["name"]: r for r in profiler.get_profile_table()}
+    assert rows["conc"]["calls"] == n_threads * k
+    assert len(tr.events("conc")) == n_threads * k
+
+
+# -- seeded scheduler timeline ------------------------------------------------
+
+def test_scheduler_span_timeline_reconstructs_lifecycle():
+    """The acceptance timeline: a seeded run's trace contains, per
+    request, submitted <= admitted <= token* <= retired with token
+    instants exactly equal to the emitted tokens, and the whole-request
+    X span matching the Request's own timestamps."""
+    tr = tracer()
+    tr.clear()
+    rng = np.random.RandomState(0)
+    sched = ContinuousBatchingScheduler(FakeModel(), n_slots=2,
+                                        max_new_tokens=6)
+    reqs = [sched.submit(rng.randint(2, 9, rng.randint(1, 5)),
+                         max_new_tokens=int(rng.randint(2, 6)))
+            for _ in range(5)]
+    sched.run_until_idle()
+    assert all(r.done and r.error is None for r in reqs)
+
+    def by_rid(name):
+        out = {}
+        for e in tr.events(name):
+            out.setdefault(e["args"]["rid"], []).append(e)
+        return out
+
+    subs, adms, toks, rets = (by_rid(n) for n in (
+        "request/submitted", "request/admitted", "request/token",
+        "request/retired"))
+    spans = by_rid("request")
+    for r in reqs:
+        assert len(subs[r.rid]) == len(adms[r.rid]) == 1
+        assert len(rets[r.rid]) == 1
+        # token instants == emitted tokens, indices 1..n in order
+        assert [e["args"]["index"] for e in toks[r.rid]] == \
+            list(range(1, len(r.tokens) + 1))
+        # ordering along the ring's timestamps
+        assert subs[r.rid][0]["ts"] <= adms[r.rid][0]["ts"]
+        assert adms[r.rid][0]["ts"] <= toks[r.rid][0]["ts"]
+        assert toks[r.rid][-1]["ts"] <= rets[r.rid][0]["ts"] + 1e-3
+        assert rets[r.rid][0]["args"]["tokens"] == len(r.tokens)
+        # the whole-request span is stamped from the Request's marks
+        (sp,) = spans[r.rid]
+        assert sp["ph"] == "X"
+        assert sp["ts"] == pytest.approx(r.submitted * 1e6)
+        assert sp["dur"] == pytest.approx(
+            (r.finished - r.submitted) * 1e6)
+        # and the Request's own clock ordering holds
+        assert r.submitted <= r.admitted <= r.first_token <= r.finished
+    # one scheduler/step span per lockstep step
+    assert len(tr.events("scheduler/step")) == sched.stats()["steps"]
+
+
+def test_scheduler_stats_percentiles_satellite():
+    sched = ContinuousBatchingScheduler(FakeModel(), n_slots=2,
+                                        max_new_tokens=4)
+    for _ in range(4):
+        sched.submit([2, 3])
+    sched.run_until_idle()
+    st = sched.stats()
+    # existing keys untouched (PR 5/6 contract)...
+    for k in ("steps", "finished", "p50_latency_s", "p95_latency_s",
+              "decoded_tok_per_s"):
+        assert k in st
+    # ...new percentile keys ride along
+    assert st["p99_latency_s"] >= st["p95_latency_s"] >= 0
+    assert 0 <= st["ttft_p50_s"] <= st["ttft_p95_s"]
+    assert st["ttft_p95_s"] <= st["p95_latency_s"] + 1e-9
+    assert st["tokens_per_request"] == {"p50": 4.0, "p95": 4.0, "max": 4}
+
+
+def test_paged_prefill_chunk_spans():
+    """The prefill leg of the timeline: a chunked-prefill admission
+    emits one lane/prefill_chunk instant per dispatched chunk, covering
+    the prompt exactly."""
+    from paddle_tpu.serving import PagedTransformerGenerator
+
+    tr = tracer()
+    gen = PagedTransformerGenerator(
+        24, 24, n_layer=2, n_head=2, d_key=4, d_value=4, d_model=16,
+        d_inner_hid=32, max_length=64, src_len=8, max_out_len=8,
+        page_size=4, chunk_size=4, num_pages=32, param_prefix="tfobs",
+        place=fluid.CPUPlace())
+    gen.init_params(seed=3)
+    gen.open_slots(1)
+    s_true = 7                                   # 2 chunks: 4 + 3
+    gen.admit_slot(0, np.arange(2, 2 + s_true), max_new=4)
+    tr.clear()
+    steps = 0
+    while gen._lanes[0].phase == "prefill":
+        gen.lane_step()
+        steps += 1
+    chunks = [e["args"] for e in tr.events("lane/prefill_chunk")]
+    assert len(chunks) == 2 == steps
+    assert [c["tokens"] for c in chunks] == [4, 3]
+    assert chunks[-1]["done"] == s_true == chunks[-1]["total"]
+    gen.clear_slot(0)
+
+
+# -- endpoints ----------------------------------------------------------------
+
+def _get(addr, route):
+    with urllib.request.urlopen(f"http://{addr}{route}", timeout=10) as r:
+        return r.read()
+
+
+def test_endpoints_roundtrip_live_scrape_during_run():
+    """The acceptance scrape: /metrics during a serving run exposes
+    labeled queue-depth, slot/page-utilization, TTFT, and guardrail
+    counters in valid Prometheus text; /healthz and /statusz answer."""
+    exe = fluid.Executor(fluid.CPUPlace())          # guardrail collector
+    pool = PageAllocator(num_pages=16, page_size=4)  # page collector
+    pool.alloc(3)
+    sched = ContinuousBatchingScheduler(FakeModel(), n_slots=2,
+                                        max_new_tokens=64)
+    srv = ObservabilityServer()
+    srv.attach("scheduler", sched).attach("executor", exe)
+    srv.attach("callable", lambda: {"custom": 1})
+    addr = srv.start()
+    try:
+        sched.serve()
+        try:
+            reqs = [sched.submit([2, 3, 4]) for _ in range(8)]
+            # live mid-run scrape (requests decode 64 tokens each, so
+            # the run comfortably outlasts the scrape)
+            text = _get(addr, "/metrics").decode()
+            for r in reqs:
+                assert r.wait(timeout=60)
+        finally:
+            sched.shutdown()
+        typed = _assert_prometheus_valid(text)
+        assert typed["paddle_serving_queue_depth"] == "gauge"
+        assert typed["paddle_serving_slot_utilization"] == "gauge"
+        assert typed["paddle_kv_page_utilization"] == "gauge"
+        assert typed["paddle_serving_ttft_seconds"] == "histogram"
+        assert typed["paddle_guardrail_events_total"] == "counter"
+        assert 'paddle_kv_pages{state="in_use"}' in text
+        assert 'paddle_serving_requests_total{event="submitted"}' in text
+
+        health = json.loads(_get(addr, "/healthz"))
+        assert health["ok"] is True and health["uptime_s"] >= 0
+
+        status = json.loads(_get(addr, "/statusz"))
+        assert set(status["sources"]) == {"callable", "executor",
+                                          "scheduler"}
+        assert status["callable"] == {"custom": 1}
+        # a single-stats-method source attaches flat (scheduler.stats);
+        # multi-method sources (the executor) nest under the method name
+        assert status["scheduler"]["finished"] == 8
+        assert "executable" in status["executor"]["cache_stats"]
+        assert "skips" in status["executor"]["health_stats"]
+
+        trace = json.loads(_get(addr, "/trace"))
+        assert any(e["name"] == "request/retired"
+                   for e in trace["traceEvents"])
+
+        # unknown route -> structured 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(addr, "/nope")
+        assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_statusz_broken_source_is_isolated():
+    srv = ObservabilityServer()
+    srv.attach("bad", lambda: 1 / 0)
+    srv.attach("good", lambda: {"v": 2})
+    addr = srv.start()
+    try:
+        status = json.loads(_get(addr, "/statusz"))
+        assert status["good"] == {"v": 2}
+        assert "ZeroDivisionError" in status["bad"]["error"]
+    finally:
+        srv.stop()
+
+
+def test_attach_rejects_unusable_source():
+    srv = ObservabilityServer()
+    try:
+        with pytest.raises(TypeError):
+            srv.attach("nope", object())
+    finally:
+        # stop() without start() must release the socket, not deadlock
+        # on shutdown()'s serve_forever handshake
+        srv.stop()
+
+
+def test_slot_utilization_aggregates_not_sums():
+    """Two live schedulers at full occupancy must report utilization
+    <= 1.0 (aggregate ratio over summed counts, the paging.py rule) —
+    a per-instance ratio collector would sum to 2.0."""
+    scheds = [ContinuousBatchingScheduler(FakeModel(), n_slots=1,
+                                          max_new_tokens=4)
+              for _ in range(2)]
+    for s in scheds:
+        s.submit([2, 3])
+        s._admit_pending()              # occupy the lane, don't decode
+    text = registry().render_prometheus()
+    m = re.search(r"^paddle_serving_slot_utilization (\S+)$", text,
+                  re.M)
+    assert m and 0.0 < float(m.group(1)) <= 1.0, m
+    for s in scheds:
+        s.run_until_idle()
+
+
+def test_server_start_after_stop_raises():
+    srv = ObservabilityServer()
+    srv.start()
+    srv.stop()
+    with pytest.raises(RuntimeError, match="after stop"):
+        srv.start()
+
+
+def test_histogram_bucket_conflict_raises():
+    reg = MetricsRegistry()
+    reg.histogram("hb_seconds", "h", buckets=(1, 2))
+    reg.histogram("hb_seconds", "h", buckets=(1, 2))      # same: fine
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("hb_seconds", "h", buckets=(5, 6))
+
+
+def test_nan_gauge_renders_instead_of_breaking_scrape():
+    """A broken set_function gauge reports NaN and the scrape survives
+    — one bad lazy gauge must not 500 every series."""
+    reg = MetricsRegistry()
+    reg.gauge("broken", "raises at scrape").set_function(
+        lambda: 1 / 0)
+    reg.gauge("fine", "ok").set(3)
+    text = reg.render_prometheus()
+    _assert_prometheus_valid(text)
+    assert "broken NaN" in text
+    assert "fine 3" in text
+
+
+def test_labels_mismatch_raises_valueerror_not_keyerror():
+    reg = MetricsRegistry()
+    c = reg.counter("lbl_total", "l", labels=("event",))
+    with pytest.raises(ValueError, match="missing \\['event'\\]"):
+        c.labels()                       # declared label omitted
+    with pytest.raises(ValueError, match="extra \\['evnt'\\]"):
+        c.labels(evnt="typo")            # misnamed label, right count
+
+
+def test_submitted_instant_precedes_queue_visibility():
+    """The submitted mark is emitted BEFORE the request becomes
+    admittable, so a threaded serve() can never trace admitted ahead of
+    submitted (reviewed race)."""
+    tr = tracer()
+    tr.clear()
+    sched = ContinuousBatchingScheduler(FakeModel(), n_slots=1,
+                                        max_new_tokens=2)
+    sched.serve()
+    try:
+        reqs = [sched.submit([2, 3]) for _ in range(6)]
+        for r in reqs:
+            assert r.wait(timeout=60)
+    finally:
+        sched.shutdown()
+    subs = {e["args"]["rid"]: e["ts"]
+            for e in tr.events("request/submitted")}
+    for e in tr.events("request/admitted"):
+        assert subs[e["args"]["rid"]] <= e["ts"]
+
+
+def test_master_server_metrics_and_statusz_attach():
+    from paddle_tpu.parallel.master import TaskQueue
+    from paddle_tpu.parallel.master_service import MasterServer
+
+    q = TaskQueue()
+    q.set_dataset(["a", "b", "c"])
+    master = MasterServer(q)
+    master.start()
+    try:
+        text = registry().render_prometheus()
+        assert 'paddle_master_tasks{state="todo"}' in text
+        srv = ObservabilityServer()
+        srv.attach("master", master)
+        addr = srv.start()
+        try:
+            status = json.loads(_get(addr, "/statusz"))
+            assert status["master"]["todo"] == 3
+        finally:
+            srv.stop()
+    finally:
+        master.stop()
+
+
+def test_obs_cli_roundtrip(tmp_path, capsys):
+    from paddle_tpu.tools import obs
+
+    tr = tracer()
+    tr.instant("cli/mark")
+    srv = ObservabilityServer()
+    srv.attach("demo", lambda: {"x": 1})
+    addr = srv.start()
+    try:
+        assert obs.main(["healthz", addr]) == 0
+        assert '"ok": true' in capsys.readouterr().out
+
+        assert obs.main(["metrics", addr,
+                         "--grep", "paddle_serving"]) == 0
+        out = capsys.readouterr().out
+        assert all("paddle_serving" in ln
+                   for ln in out.splitlines() if ln.strip())
+
+        assert obs.main(["statusz", addr]) == 0
+        assert json.loads(capsys.readouterr().out)["demo"] == {"x": 1}
+
+        dump = tmp_path / "t.json"
+        assert obs.main(["trace", addr, "-o", str(dump)]) == 0
+        names = [e["name"]
+                 for e in json.loads(dump.read_text())["traceEvents"]]
+        assert "cli/mark" in names
+    finally:
+        srv.stop()
+    # unreachable endpoint -> exit 2
+    assert obs.main(["healthz", "127.0.0.1:1", "--timeout", "0.2"]) == 2
+
+
+def test_guardrail_counters_exported_on_recovery():
+    """A skipped non-finite step shows up both in health_stats() (the
+    dict view) and the exported guardrail series + guard/skip trace
+    instant — one signal, three faces."""
+    from paddle_tpu.resilience import GuardPolicy
+
+    tr = tracer()
+    tr.clear()
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [2], "float32")
+        y = fluid.layers.mean(fluid.layers.fc(input=x, size=2))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.array([[np.nan, 1.0]], np.float32)}
+        exe.run(main, feed=feed, fetch_list=[y],
+                guard=GuardPolicy(on_nonfinite="skip", check=("loss",)))
+    assert exe.health_stats()["skips"] == 1
+    text = registry().render_prometheus()
+    m = re.search(
+        r'paddle_guardrail_events_total\{event="skips"\} (\d+)', text)
+    assert m and int(m.group(1)) >= 1
+    assert len(tr.events("guard/skip")) == 1

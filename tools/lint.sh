@@ -25,12 +25,17 @@ esac
 rc=0
 
 if [ "$want_ruff" = 1 ]; then
+  # paddle_tpu/ covers the observability package (ISSUE 8) too — the
+  # explicit second sweep just makes a regression there unmissable
   if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check paddle_tpu/"
     ruff check paddle_tpu/ || rc=1
+    ruff check paddle_tpu/observability/ paddle_tpu/tools/obs.py || rc=1
   elif python -c "import ruff" >/dev/null 2>&1; then
     echo "== python -m ruff check paddle_tpu/"
     python -m ruff check paddle_tpu/ || rc=1
+    python -m ruff check paddle_tpu/observability/ \
+      paddle_tpu/tools/obs.py || rc=1
   else
     echo "== ruff not installed; skipping style lint (pyproject.toml holds the config)"
   fi
@@ -105,6 +110,37 @@ with open(os.path.join(tmpdir, "serving_step.json"), "wb") as f:
     f.write(step_prog.desc.serialize_to_string())
 with open(os.path.join(tmpdir, "serving_step.fetch"), "w") as f:
     f.write(next_ids.name + "\n")
+
+# observability sweep (ISSUE 8): instrumentation must not perturb the
+# compiled program — the decode-step program built while the tracer is
+# recording must serialize BYTE-IDENTICAL to one built with telemetry
+# off, and the instrumented build goes through the analyzer like any
+# other program
+from paddle_tpu.observability import tracing as _obs_tracing
+
+_tr = _obs_tracing.tracer()
+_was = _tr.enabled
+_tr.disable()
+gen_bare = TransformerGenerator(30, 30, n_layer=2, n_head=2, d_key=4,
+                                d_value=4, d_model=16, d_inner_hid=32,
+                                max_length=64, src_len=8, max_out_len=8,
+                                param_prefix="tfs",
+                                place=fluid.CPUPlace())
+_tr.enabled = True
+gen_inst = TransformerGenerator(30, 30, n_layer=2, n_head=2, d_key=4,
+                                d_value=4, d_model=16, d_inner_hid=32,
+                                max_length=64, src_len=8, max_out_len=8,
+                                param_prefix="tfs",
+                                place=fluid.CPUPlace())
+_tr.enabled = _was
+bare_bytes = gen_bare._step[0].desc.serialize_to_string()
+inst_bytes = gen_inst._step[0].desc.serialize_to_string()
+assert bare_bytes == inst_bytes, \
+    "telemetry perturbed the compiled decode-step program"
+with open(os.path.join(tmpdir, "serving_step_instrumented.json"), "wb") as f:
+    f.write(inst_bytes)
+with open(os.path.join(tmpdir, "serving_step_instrumented.fetch"), "w") as f:
+    f.write(gen_inst._step[2].name + "\n")
 
 # paged sweep (ISSUE 6): the unified ragged decode-step program — chunked
 # prefill tower + paged_cache_write / ragged_decode_attention / page-copy
