@@ -774,6 +774,127 @@ def bench_serving(batch: int, trials: int, seq_len: int = 256,
     }
 
 
+def bench_gateway(trials: int, n_slots: int = 8, decode_len: int = 16):
+    """ISSUE 10 gateway measurement: per-tenant p50/p95 under a seeded
+    mixed load (a flooding ``bulk`` batch tenant beside a paced
+    ``interactive`` latency tenant), hot-swap continuity (zero lost
+    requests, zero steady-state recompiles on the new version, zero
+    samples where work was pending but nothing was in flight), and
+    streamed vs blocking TTFT.  The model is deliberately small — this
+    section measures the SCHEDULING layer (admission, preemption,
+    swap), not the compute the serving section already measures."""
+    import threading as _th
+    import time as _t
+
+    from paddle_tpu import fluid
+    from paddle_tpu.serving import PagedTransformerGenerator, copy_weights
+    from paddle_tpu.serving.gateway import (Gateway, TenantConfig,
+                                            TenantRouter)
+
+    vocab, src_len = 2048, 32
+    kw = dict(n_layer=2, n_head=4, d_key=32, d_value=32, d_model=128,
+              d_inner_hid=256, max_length=src_len + decode_len + 2,
+              src_len=src_len, max_out_len=decode_len, page_size=8,
+              chunk_size=8, num_pages=4 * n_slots * 16 + 1)
+    gen_v1 = PagedTransformerGenerator(vocab, vocab, param_prefix="gwb",
+                                       **kw)
+    gen_v1.init_params(seed=0)
+    gen_v2 = PagedTransformerGenerator(vocab, vocab, param_prefix="gwb",
+                                       **kw)
+    copy_weights(gen_v1.scope, gen_v2.scope, prefix="gwb")
+
+    router = TenantRouter(
+        tenants=[TenantConfig("interactive", slo="latency", weight=1.0),
+                 TenantConfig("bulk", slo="batch", weight=1.0)],
+        reserve_latency_slots=1)
+    gw = Gateway(router=router, n_slots=n_slots,
+                 max_new_tokens=decode_len)
+    gw.load_model("m", "1", instance=gen_v1)
+    gw.serve()
+    rng = np.random.RandomState(0)
+
+    def prompt():
+        return rng.randint(2, vocab, int(rng.randint(4, src_len + 1)))
+
+    try:
+        # streamed vs blocking TTFT on an idle gateway: the streaming
+        # caller sees the first token after ~prefill + 1 step; the
+        # blocking caller sees nothing until the whole request retires
+        stream_ttft = blocking_ttft = float("inf")
+        for _ in range(max(2, trials)):
+            t0 = _t.time()
+            s = gw.submit_stream("m", prompt(), tenant="interactive")
+            next(iter(s))
+            stream_ttft = min(stream_ttft, _t.time() - t0)
+            list(s)     # drain
+            t0 = _t.time()
+            r = gw.submit("m", prompt(), tenant="interactive")
+            r.wait(120)
+            blocking_ttft = min(blocking_ttft, _t.time() - t0)
+
+        # seeded mixed load: bulk floods, interactive arrives paced
+        flood = [gw.submit("m", prompt(), tenant="bulk")
+                 for _ in range(6 * n_slots)]
+        paced = []
+        for _ in range(12):
+            _t.sleep(0.05)
+            paced.append(gw.submit("m", prompt(), tenant="interactive"))
+        for r in flood + paced:
+            r.wait(300)
+        mixed = gw.tenant_latencies()
+
+        # hot swap under live traffic, sampling for downtime: a sample
+        # with work pending but nothing in flight = a dropped beat
+        stop = _th.Event()
+        downtime = [0, 0]
+
+        def sampler():
+            while not stop.is_set():
+                st = gw.sched.stats()
+                downtime[1] += 1
+                if st["queued"] > 0 and st["in_flight"] == 0:
+                    downtime[0] += 1
+                _t.sleep(0.001)
+
+        swap_flood = [gw.submit("m", prompt(), tenant="bulk")
+                      for _ in range(4 * n_slots)]
+        th = _th.Thread(target=sampler, daemon=True)
+        th.start()
+        t0 = _t.time()
+        gw.swap_model("m", "2", instance=gen_v2)
+        swap_wall = _t.time() - t0
+        miss0 = gen_v2.exe.cache_stats()["executable"]["misses"]
+        post = [gw.submit("m", prompt(), tenant="bulk")
+                for _ in range(n_slots)]
+        for r in swap_flood + post:
+            r.wait(300)
+        stop.set()
+        th.join(1)
+        lost = sum(1 for r in swap_flood + post if r.error is not None)
+        recompiles = gen_v2.exe.cache_stats()["executable"]["misses"] \
+            - miss0
+        sched = gw.sched.stats()
+    finally:
+        gw.shutdown(drain=True)
+    return {
+        "slots": n_slots,
+        "ttft_s": {"stream": round(stream_ttft, 4),
+                   "blocking_total": round(blocking_ttft, 4),
+                   "speedup_x": round(blocking_ttft
+                                      / max(stream_ttft, 1e-9), 2)},
+        "mixed_load": mixed,
+        "hot_swap": {
+            "lost_requests": lost,
+            "recompiles_after_warmup": int(recompiles),
+            "downtime_steps": downtime[0],
+            "samples": downtime[1],
+            "swap_wall_s": round(swap_wall, 3),
+        },
+        "router": gw.router.stats()["tenants"],
+        "decoded_tok_per_s": sched.get("decoded_tok_per_s"),
+    }
+
+
 MNIST_TOP1_TARGET_SECS = 150.0
 
 # exception texts that mean "the tunnel/RPC hiccuped", not "the program
@@ -1208,6 +1329,16 @@ def main() -> None:
         except Exception as e:
             print(f"serving bench failed: {e}", file=sys.stderr)
 
+    gateway_cmp = None
+    if os.environ.get("BENCH_SKIP_GATEWAY", "") != "1":
+        try:
+            gateway_cmp = retry_transient(
+                bench_gateway, trials,
+                int(os.environ.get("BENCH_GATEWAY_SLOTS", "8")),
+                int(os.environ.get("BENCH_GATEWAY_DECODE", "16")))
+        except Exception as e:
+            print(f"gateway bench failed: {e}", file=sys.stderr)
+
     quality = nmt_quality = None
     if os.environ.get("BENCH_SKIP_QUALITY", "") != "1":
         try:
@@ -1267,6 +1398,11 @@ def main() -> None:
         # batching p50/p95 at a fixed offered load, bucket hit rate and
         # the steady-state recompile count (must be 0)
         "serving": serving_cmp,
+        # multi-model/multi-tenant gateway (ISSUE 10): per-tenant
+        # p50/p95 under seeded mixed load, hot-swap continuity (zero
+        # lost requests / recompiles / dropped beats), streamed-vs-
+        # blocking TTFT
+        "gateway": gateway_cmp,
         # int8 PTQ rollup (ISSUE 7): the int8-KV paged serving block plus
         # the measured quality cost of the quantized weight stream (full
         # detail under serving.quantized / *_quality)
@@ -1306,6 +1442,9 @@ def main() -> None:
     if os.environ.get("BENCH_SKIP_SERVING", "") != "1" \
             and serving_cmp is None:
         missing.append("serving")
+    if os.environ.get("BENCH_SKIP_GATEWAY", "") != "1" \
+            and gateway_cmp is None:
+        missing.append("gateway")
     if os.environ.get("BENCH_SKIP_QUALITY", "") != "1":
         if quality is None:
             missing.append("mnist_quality")

@@ -423,6 +423,46 @@ def device_put_persistables(scope: Scope,
     return n
 
 
+# -- versioned artifact layout (ISSUE 10: the gateway's model store) --------
+
+def model_version_dir(root: str, model_name: str, version: str) -> str:
+    """``<root>/<model>/<version>/`` — one save_inference_model artifact
+    (or generator artifact, see serving.gateway.ModelRegistry) per
+    version, so hot-swap is "write the new version beside the old one,
+    flip the alias"."""
+    return os.path.join(root, str(model_name), str(version))
+
+
+def list_model_versions(root: str, model_name: str) -> List[str]:
+    """Versions on disk for ``model_name``, sorted (numeric versions
+    numerically: v2 < v10)."""
+    base = os.path.join(root, str(model_name))
+    if not os.path.isdir(base):
+        return []
+
+    def key(v: str):
+        digits = "".join(c for c in v if c.isdigit())
+        return (int(digits) if digits else 0, v)
+
+    return sorted((d for d in os.listdir(base)
+                   if os.path.isdir(os.path.join(base, d))), key=key)
+
+
+def save_versioned_inference_model(root: str, model_name: str,
+                                   version: str,
+                                   feeded_var_names: List[str],
+                                   target_vars: List[Variable],
+                                   executor: Executor,
+                                   main_program: Optional[Program] = None,
+                                   scope: Optional[Scope] = None) -> str:
+    """``save_inference_model`` into the versioned gateway layout;
+    returns the artifact directory."""
+    dirname = model_version_dir(root, model_name, version)
+    save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=main_program, scope=scope)
+    return dirname
+
+
 def get_inference_program(target_vars, main_program=None):
     program = main_program or default_main_program()
     if not isinstance(target_vars, (list, tuple)):

@@ -19,6 +19,10 @@ is the behavior half of that story over the repo's existing state half:
   guardrails.py  GuardPolicy + the fused finiteness sentinel, the
               device-side rollback-and-skip recovery, and the hung-step
               watchdog behind ``Executor.run(..., guard=...)``.
+  service.py  run_supervised — the PR 1 elastic launcher packaged for
+              single-process services (the serving gateway): respawn on
+              non-zero exit, journal-driven recovery owned by the
+              service itself.
 
 `ResilientTrainer` imports the fluid/parallel layers, which themselves
 use chaos hooks from here — it loads lazily to keep this package
@@ -29,10 +33,12 @@ from .retry import RetryPolicy
 from .chaos import ChaosError, FaultInjector, injector, install
 from .guardrails import (GuardPolicy, NonFiniteError, NonFiniteEscalation,
                          StepFault, StepTimeout)
+from .service import run_supervised
 
 __all__ = ["RetryPolicy", "ChaosError", "FaultInjector", "injector",
            "install", "ResilientTrainer", "GuardPolicy", "NonFiniteError",
-           "NonFiniteEscalation", "StepFault", "StepTimeout"]
+           "NonFiniteEscalation", "StepFault", "StepTimeout",
+           "run_supervised"]
 
 
 def __getattr__(name):

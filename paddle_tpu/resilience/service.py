@@ -1,0 +1,35 @@
+"""Supervised long-running services (ISSUE 10).
+
+The reference ran pservers and the master as externally supervised
+processes: a wedged or crashed service was restarted by its supervisor
+and recovered its state from a journal/snapshot.  PR 1 built the
+process half (``launch.py --max-restarts``: respawn-on-nonzero-exit
+with a shared restart budget); this module packages it for SERVICES —
+a single process that must stay up, restart in place when it exits
+non-zero, and recover its queue from a journal on the way back up (the
+gateway's ``RequestJournal.pending()`` + ``Gateway.recover()``).
+
+``run_supervised`` is deliberately thin: the service itself owns its
+durability (journal, artifact store); supervision only guarantees the
+process comes back.  A service that wants restart-on-wedge exits
+non-zero from its own health watchdog (``Gateway.wedged`` +
+``tools.gateway serve --exit-on-wedge``) and rides the same budget."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["run_supervised"]
+
+
+def run_supervised(argv: List[str], max_restarts: int = 2,
+                   log_dir: Optional[str] = None) -> int:
+    """Run ``python <argv...>`` as a supervised single-rank service:
+    non-zero exits respawn the process (same argv, same env) while the
+    restart budget lasts.  Returns the final exit code (0 = clean
+    exit).  Built on the PR 1 elastic launcher, so logs land per-rank
+    under ``log_dir`` and SIGTERM->SIGKILL escalation applies."""
+    from ..launch import launch
+
+    return launch(1, list(argv), max_restarts=int(max_restarts),
+                  log_dir=log_dir)

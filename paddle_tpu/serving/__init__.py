@@ -34,6 +34,13 @@ This package is the TPU-native replacement:
   causal prefill interleaved with decode in one compiled dispatch, and
   copy-on-write prefix sharing with refcounts.  The dense decoder stays
   as the differential parity baseline.
+* ``gateway/`` (ISSUE 10) — the production front door: ``ModelRegistry``
+  (versioned artifacts, HBM budget, zero-downtime hot swap),
+  ``TenantRouter`` (token buckets, SLO-class admission, fair share),
+  ``Gateway``/``TokenStream`` (streaming + cancellation + request
+  journal), and the ``GatewayServer`` HTTP surface — imported as
+  ``paddle_tpu.serving.gateway`` (kept out of this namespace so plain
+  serving users do not pay the HTTP imports).
 """
 
 from .engine import InferenceEngine  # noqa: F401
@@ -41,9 +48,11 @@ from .decoder import FullRerunDecoder, TransformerGenerator  # noqa: F401
 from .paged_decoder import (PagedTransformerGenerator,  # noqa: F401
                             copy_weights, kv_page_bytes)
 from .paging import PageAllocator, PoolCapacityError  # noqa: F401
-from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
+from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
+                        RequestCancelled, SchedulerShutdown)
 
 __all__ = ["InferenceEngine", "TransformerGenerator", "FullRerunDecoder",
            "PagedTransformerGenerator", "PageAllocator", "copy_weights",
            "kv_page_bytes", "PoolCapacityError",
-           "ContinuousBatchingScheduler", "Request"]
+           "ContinuousBatchingScheduler", "Request", "RequestCancelled",
+           "SchedulerShutdown"]

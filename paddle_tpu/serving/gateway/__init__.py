@@ -1,0 +1,34 @@
+"""Production serving gateway (ISSUE 10): one front door over the
+serving stack PRs 5-8 built.
+
+The reference shipped a real deployment tier — the capi inference
+library embedded models in long-running services, and pserver processes
+had a supervised lifecycle — while this repo stopped at a single
+blocking ``ContinuousBatchingScheduler.serve()`` for one model.  This
+package is the missing layer:
+
+* ``ModelRegistry`` (registry.py) — versioned ``save_inference_model``
+  / generator artifacts under ``<root>/<name>/<version>/``, loaded into
+  named ``InferenceEngine`` / ``PagedTransformerGenerator`` instances
+  under an HBM budget, with atomic alias flips for zero-downtime hot
+  swap.
+* ``TenantRouter`` (router.py) — per-tenant token buckets, SLO classes
+  (latency preempts batch AT ADMISSION only), weighted fair share.
+* ``Gateway`` + ``TokenStream`` (gateway.py) — submit/generate/
+  submit_stream with cancellation, the request journal for supervised
+  restarts, per-tenant latency accounting.
+* ``GatewayServer`` (server.py) — ``/v1/generate`` (blocking + chunked
+  streaming) and ``/v1/models`` (load/swap/unload) over
+  ThreadingHTTPServer; ``python -m paddle_tpu.tools.gateway`` is the
+  CLI client.
+"""
+
+from .gateway import Gateway, TokenStream  # noqa: F401
+from .journal import RequestJournal  # noqa: F401
+from .registry import HBMBudgetError, ModelRegistry  # noqa: F401
+from .router import RateLimited, TenantConfig, TenantRouter  # noqa: F401
+from .server import GatewayServer  # noqa: F401
+
+__all__ = ["Gateway", "TokenStream", "RequestJournal", "ModelRegistry",
+           "HBMBudgetError", "TenantRouter", "TenantConfig",
+           "RateLimited", "GatewayServer"]

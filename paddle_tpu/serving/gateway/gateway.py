@@ -1,0 +1,431 @@
+"""Gateway: the front door tying registry + router + scheduler together.
+
+One ``Gateway`` owns:
+
+* a ``ModelRegistry`` (versioned model instances + alias map),
+* a ``TenantRouter`` (rate limits, SLO preemption, fair share),
+* ONE multi-model ``ContinuousBatchingScheduler`` whose ``resolve``
+  hook is the registry's alias map and whose ``admission_policy`` is
+  the router,
+* an optional ``RequestJournal`` — every accepted request is journaled
+  before it queues and marked done when it retires, so a supervised
+  restart (PR 1 launcher) replays the incomplete tail with
+  ``recover()`` instead of dropping it.
+
+Request flow: ``submit`` debits the tenant's token bucket (RateLimited
+= HTTP 429 before any queueing), journals, then enqueues with the
+model ALIAS — version resolution happens at admission, which is what
+lets ``swap_model`` flip mid-traffic with zero lost requests.
+
+Token streaming (``submit_stream``): a ``TokenStream`` iterator yields
+tokens as decode steps retire them, riding the scheduler's per-token
+callback (the same marks the PR 8 span timeline stamps).  Closing the
+stream — or a client disconnect in the HTTP layer — cancels the
+request: the lane and (paged models) its pages free at the next step
+boundary, mid-prefill included."""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ...observability import metrics as _obs_metrics
+from ..scheduler import (ContinuousBatchingScheduler, Request,
+                         RequestCancelled)
+from .journal import RequestJournal
+from .registry import ModelRegistry
+from .router import TenantRouter
+
+__all__ = ["Gateway", "TokenStream"]
+
+
+class TokenStream:
+    """Iterator over one streaming request's tokens.
+
+    Yields each decoded token as the scheduler retires its step; raises
+    the request's error (if it failed) after the last token; supports
+    ``close()`` — also triggered by ``with`` exit and generator
+    teardown — which CANCELS the request, freeing its lane and pages
+    immediately."""
+
+    _DONE = object()
+
+    def __init__(self, request: Optional[Request] = None,
+                 timeout: float = 60.0):
+        # the queue exists BEFORE the request does: the serve thread can
+        # emit tokens between sched.submit() returning and the stream
+        # object being handed back, and none may be lost — submit_stream
+        # builds the stream first and binds the request after
+        self.request = request
+        self.timeout = float(timeout)
+        self._q: "_queue.Queue" = _queue.Queue()
+
+    # the scheduler-side callback (runs under the scheduler lock: a
+    # lock-free enqueue is all that happens here)
+    def _push(self, req: Request, tok: Optional[int]) -> None:
+        self._q.put(self._DONE if tok is None else int(tok))
+
+    def __iter__(self) -> Iterator[int]:
+        return self
+
+    def __next__(self) -> int:
+        if self.request.done and self._q.empty():
+            self._finish()
+        try:
+            item = self._q.get(timeout=self.timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"stream: no token for {self.timeout}s "
+                f"(rid {self.request.rid})")
+        if item is self._DONE:
+            self._finish()
+        return item
+
+    def _finish(self):
+        err = self.request.error
+        if err is not None and not isinstance(err, RequestCancelled):
+            raise err
+        raise StopIteration
+
+    def close(self) -> None:
+        """Cancel the request if it is still running (client went away:
+        its lane and pages must not keep decoding for nobody)."""
+        if not self.request.done:
+            self.request.cancel()
+
+    def __enter__(self) -> "TokenStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Gateway:
+    """Multi-model, multi-tenant serving front door."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 router: Optional[TenantRouter] = None,
+                 n_slots: int = 4, max_new_tokens: int = 32,
+                 journal_path: Optional[str] = None,
+                 journal_fsync: bool = False,
+                 check_invariants: bool = False):
+        self.registry = registry or ModelRegistry()
+        self.router = router or TenantRouter()
+        self.default_n_slots = int(n_slots)
+        self.sched = ContinuousBatchingScheduler(
+            max_new_tokens=max_new_tokens,
+            resolve=self.registry.resolve,
+            admission_policy=self.router.admission_policy)
+        self.router.bind(lambda: self.sched.n_slots,
+                         self.sched.queued_requests)
+        self.journal = (RequestJournal(journal_path, fsync=journal_fsync)
+                        if journal_path else None)
+        # PageAllocator.check_invariants after every retirement — the
+        # steady-state leak tripwire the cancellation tests run under
+        self.check_invariants = bool(check_invariants)
+        self._wedge_lock = threading.Lock()
+        self._wedge_mark = (0, time.monotonic())
+        reg = _obs_metrics.registry()
+        self._m_requests = reg.counter(
+            "paddle_gateway_requests_total",
+            "Gateway request lifecycle by tenant/model/version",
+            labels=("tenant", "model", "version", "event"))
+        self._m_tokens = reg.counter(
+            "paddle_gateway_tokens_total",
+            "Tokens streamed/delivered per tenant and model",
+            labels=("tenant", "model"))
+        self._h_latency = reg.histogram(
+            "paddle_gateway_request_latency_seconds",
+            "submit -> finish per tenant SLO class",
+            labels=("tenant", "slo"))
+
+    # -- model lifecycle -----------------------------------------------------
+    def _warm(self, key: str, n_slots: int) -> None:
+        """Compile the new version's program set BEFORE it takes
+        traffic: a paged generator runs one tiny admit/lane_step cycle
+        AT THE SERVING LANE COUNT (the unified program's batch dimension
+        is the lane count — warming at any other width would compile a
+        shape serving never uses and still pay the real compile on the
+        first request); an engine uploads its weights.  After this,
+        steady state must add zero executable-cache misses — the
+        ``recompiles_after_warmup == 0`` contract across a swap."""
+        inst = self.registry.instance(key)
+        if hasattr(inst, "lane_step"):
+            inst.open_slots(n_slots)
+            prompt = np.full(min(2, getattr(inst, "src_len", 2)),
+                             inst.start_id, np.int64)
+            inst.admit_slot(0, prompt, max_new=1)
+            for _ in range(64):          # bounded: prefill chunks + 1
+                if inst.lane_step():
+                    break
+            inst.clear_slot(0)
+        elif hasattr(inst, "warmup") and getattr(inst, "feed_names", None):
+            # engines need a shaped sample; without one we at least
+            # upload the weights so the first request pays no H2D
+            inst.place_weights()
+
+    def load_model(self, name: str, version: str,
+                   dirname: Optional[str] = None,
+                   n_slots: Optional[int] = None, warm: bool = True,
+                   instance=None, **overrides) -> str:
+        """Load a version and register its lane group; the first version
+        of a model becomes the alias target and starts taking traffic
+        immediately."""
+        if instance is not None:
+            key = self.registry.register(name, version, instance)
+        else:
+            key = self.registry.load(name, version, dirname=dirname,
+                                     **overrides)
+        try:
+            if warm:
+                self._warm(key, n_slots or self.default_n_slots)
+            inst = self.registry.instance(key)
+            if callable(getattr(inst, "open_slots", None)):
+                self.sched.add_model(key, inst,
+                                     n_slots or self.default_n_slots)
+        except BaseException:
+            # a failed warm/add must not leak registry budget
+            try:
+                self.registry.unload(key)
+            except Exception:
+                pass
+            raise
+        return key
+
+    def swap_model(self, name: str, version: str,
+                   dirname: Optional[str] = None,
+                   n_slots: Optional[int] = None,
+                   drain_timeout: float = 30.0, instance=None,
+                   **overrides) -> str:
+        """Zero-downtime hot swap: load + warm the new version BESIDE
+        the old one (both briefly budgeted), atomically flip the alias
+        so queued and new requests resolve to it, then drain the old
+        version's in-flight lanes and unload it — its pages and scope
+        free with the instance.  In-flight requests on the old version
+        run to completion: preemption never happens mid-request."""
+        old_key = self.registry.current_key(name)
+        new_key = self.load_model(name, version, dirname=dirname,
+                                  n_slots=n_slots, warm=True,
+                                  instance=instance, **overrides)
+        self.registry.set_alias(name, version)
+        if old_key is not None and old_key != new_key:
+            self.sched.remove_model(old_key, drain=True,
+                                    timeout=drain_timeout)
+            self.registry.unload(old_key)
+        return new_key
+
+    def unload_model(self, name_or_key: str,
+                     drain_timeout: float = 30.0) -> None:
+        key = self.registry.resolve(name_or_key)
+        # validate BEFORE touching lanes: a registry refusal (alias
+        # target with other versions loaded) after remove_model would
+        # leave an alias pointing at a group that no longer exists
+        self.registry.check_unload(key)
+        self.sched.remove_model(key, drain=True, timeout=drain_timeout)
+        self.registry.unload(key)
+
+    def models(self) -> List[Dict[str, object]]:
+        return self.registry.entries()
+
+    # -- request path --------------------------------------------------------
+    def _wrap_on_token(self, jid: Optional[str], slo: str, inst,
+                       user_cb=None):
+        """Compose journal completion + gateway metrics + the caller's
+        callback into the scheduler's per-token hook."""
+
+        def on_token(req: Request, tok: Optional[int]) -> None:
+            tenant = req.tenant or "default"
+            if tok is not None:
+                self._m_tokens.labels(tenant=tenant, model=req.model
+                                      ).inc()
+            else:
+                version = (req.group or "@unresolved").split("@", 1)[-1]
+                ok = req.error is None
+                event = ("finished" if ok else
+                         "cancelled"
+                         if isinstance(req.error, RequestCancelled)
+                         else "failed")
+                self._m_requests.labels(
+                    tenant=tenant, model=req.model, version=version,
+                    event=event).inc()
+                if ok and req.total_latency is not None:
+                    self._h_latency.labels(tenant=tenant, slo=slo
+                                           ).observe(req.total_latency)
+                if self.journal is not None and jid is not None:
+                    self.journal.record_done(
+                        jid, ok=ok,
+                        error=None if ok else type(req.error).__name__)
+                if self.check_invariants:
+                    alloc = getattr(inst, "alloc", None)
+                    if alloc is not None:
+                        alloc.check_invariants()
+            if user_cb is not None:
+                user_cb(req, tok)
+        return on_token
+
+    def submit(self, model: str, prompt, tenant: str = "default",
+               max_new: Optional[int] = None, on_token=None) -> Request:
+        """Rate-limit gate -> journal -> queue.  Returns the scheduler
+        ``Request`` (``wait()`` for blocking use)."""
+        cfg = self.router.tenant(tenant)
+        key = self.registry.resolve(model)
+        inst = self.registry.instance(key)  # KeyError on unknown model
+        if not callable(getattr(inst, "open_slots", None)):
+            raise TypeError(
+                f"model {model!r} is an engine artifact (batch "
+                f"inference); the generate path needs a generator — "
+                f"call registry.instance({model!r}).infer(feed) instead")
+        cap = getattr(inst, "max_out_len", self.sched.default_max_new)
+        eff_new = min(max_new or self.sched.default_max_new, cap)
+        self.router.check_submit(
+            tenant, self.router.request_cost(len(prompt), eff_new))
+        jid = None
+        if self.journal is not None:
+            jid = self.journal.new_jid()
+            self.journal.record_submit(jid, tenant, model, prompt,
+                                       eff_new)
+        try:
+            req = self.sched.submit(
+                prompt, max_new_tokens=eff_new, model=model,
+                tenant=tenant,
+                on_token=self._wrap_on_token(jid, cfg.slo, inst,
+                                             on_token))
+        except BaseException as e:
+            # the scheduler refused it (infeasible prompt, too long):
+            # close the journal entry, or a restart would replay a
+            # request that can never be served — a poison pill
+            if self.journal is not None and jid is not None:
+                self.journal.record_done(jid, ok=False,
+                                         error=type(e).__name__)
+            raise
+        req.jid = jid
+        version = key.split("@", 1)[-1] if "@" in key else "?"
+        self._m_requests.labels(tenant=tenant, model=model,
+                                version=version, event="submitted").inc()
+        return req
+
+    def generate(self, model: str, prompt, tenant: str = "default",
+                 max_new: Optional[int] = None,
+                 timeout: Optional[float] = 120.0) -> Dict[str, object]:
+        """Blocking path: submit, wait, return the full token list."""
+        req = self.submit(model, prompt, tenant=tenant, max_new=max_new)
+        if not req.wait(timeout):
+            req.cancel()
+            raise TimeoutError(f"generate: rid {req.rid} still running "
+                               f"after {timeout}s (cancelled)")
+        if req.error is not None:
+            raise req.error
+        return {"rid": req.rid, "model": req.model,
+                "version": (req.group or "@?").split("@", 1)[-1],
+                "tenant": tenant, "tokens": list(req.tokens),
+                "latency_s": round(req.total_latency or 0.0, 4)}
+
+    def submit_stream(self, model: str, prompt, tenant: str = "default",
+                      max_new: Optional[int] = None,
+                      timeout: float = 60.0) -> TokenStream:
+        """Streaming path: returns a ``TokenStream`` yielding tokens as
+        decode steps retire.  Token-for-token identical to the blocking
+        path (same scheduler, same lanes) — the acceptance test asserts
+        it."""
+        stream = TokenStream(timeout=timeout)
+        req = self.submit(model, prompt, tenant=tenant, max_new=max_new,
+                          on_token=stream._push)
+        stream.request = req
+        return stream
+
+    # -- recovery (supervised restart) ---------------------------------------
+    def recover(self) -> List[Request]:
+        """Resubmit every journaled-but-unfinished request (call AFTER
+        the models are loaded).  Rate limits are NOT re-debited — the
+        work was already admitted once; a restart must not double-charge
+        the tenant.  Returns the resubmitted requests."""
+        if self.journal is None:
+            return []
+        out = []
+        for entry in self.journal.pending():
+            cfg = self.router.tenant(entry["tenant"])
+            try:
+                inst = self.registry.instance(entry["model"])
+                req = self.sched.submit(
+                    np.asarray(entry["prompt"], np.int64),
+                    max_new_tokens=entry["max_new"],
+                    model=entry["model"], tenant=entry["tenant"],
+                    on_token=self._wrap_on_token(entry["jid"], cfg.slo,
+                                                 inst))
+            except Exception as e:
+                # the model is gone, the prompt no longer fits, or the
+                # pool can never hold it in the restarted process:
+                # close the journal entry and keep replaying the rest —
+                # one bad entry must never poison the whole recovery
+                self.journal.record_done(entry["jid"], ok=False,
+                                         error=type(e).__name__)
+                continue
+            req.jid = entry["jid"]
+            out.append(req)
+        return out
+
+    # -- serving loop --------------------------------------------------------
+    def serve(self) -> "Gateway":
+        self.sched.serve()
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: float = 30.0) -> List[Request]:
+        return self.sched.shutdown(timeout=timeout, drain=drain)
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> int:
+        return self.sched.run_until_idle(max_steps)
+
+    def wedged(self, stall_s: float = 30.0) -> bool:
+        """True when work is pending but the step counter has not moved
+        for ``stall_s`` — the supervised launcher's restart trigger (the
+        PR 4 hung-step watchdog idea applied to serving)."""
+        st = self.sched.stats()
+        busy = st["in_flight"] > 0 or st["queued"] > 0
+        now = time.monotonic()
+        with self._wedge_lock:
+            steps, since = self._wedge_mark
+            if st["steps"] != steps or not busy:
+                self._wedge_mark = (st["steps"], now)
+                return False
+            return (now - since) > stall_s
+
+    # -- accounting ----------------------------------------------------------
+    def tenant_latencies(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant p50/p95 over successfully finished requests — the
+        isolation numbers the flooding test asserts."""
+        by_tenant: Dict[str, List[float]] = {}
+        for r in self.sched.finished_requests():
+            if r.error is None and r.total_latency is not None:
+                by_tenant.setdefault(r.tenant or "default", []).append(
+                    r.total_latency)
+        out = {}
+        for tenant, vals in sorted(by_tenant.items()):
+            arr = np.asarray(vals)
+            out[tenant] = {
+                "count": int(arr.size),
+                "p50_latency_s": round(float(np.percentile(arr, 50)), 4),
+                "p95_latency_s": round(float(np.percentile(arr, 95)), 4),
+            }
+        return out
+
+    def stats(self) -> Dict[str, object]:
+        out = {
+            "registry": self.registry.stats(),
+            "router": self.router.stats(),
+            "scheduler": self.sched.stats(),
+            "tenants": self.tenant_latencies(),
+        }
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
+        return out

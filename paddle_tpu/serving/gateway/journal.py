@@ -1,0 +1,160 @@
+"""Durable request journal: the gateway's no-lost-requests contract.
+
+The reference's pserver services survived restarts because the master
+journaled task leases (master/service.go); the gateway applies the same
+idea one layer up: every ACCEPTED request is appended to a jsonl journal
+before it enters the scheduler queue, and marked done when its response
+is delivered.  A gateway process that wedges and is restarted by the
+supervised launcher (PR 1 ``launch.py --max-restarts`` /
+``resilience.run_supervised``) replays the journal on startup and
+resubmits every entry without a ``done`` record — queued and in-flight
+requests ride across the restart instead of vanishing with the process.
+
+Entries are self-contained (tenant, model alias, prompt tokens,
+max_new), so replay needs nothing but the journal file and a registry
+with the same model aliases loaded.  Writes are append-only single
+lines; ``fsync=True`` makes each append durable at the cost of one
+fsync per request (the CheckpointManager plain-write rule: publish
+nothing you have not flushed)."""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["RequestJournal"]
+
+
+class RequestJournal:
+    """Append-only jsonl of request lifecycles with replay.
+
+    ``record_submit`` is synchronous — the durability point is BEFORE
+    the request queues.  ``record_done`` is asynchronous (a background
+    writer drains a queue): it is called from the scheduler's
+    completion callback, which runs under the scheduler lock, and a
+    file write there would stall admission behind the filesystem.  The
+    at-least-once model absorbs the weaker ordering: a done record lost
+    to a crash merely replays one already-answered request."""
+
+    _uniq = itertools.count(1)
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = str(path)
+        self.fsync = bool(fsync)
+        self._lock = threading.Lock()
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # pid-qualified ids: rids restart at 1 in a respawned process,
+        # and a replayed entry must never collide with a fresh one
+        self._prefix = f"{os.getpid()}"
+        # async done-record writer state
+        self._cv = threading.Condition()
+        self._done_q: deque = deque()
+        self._writing = False
+        self._writer: Optional[threading.Thread] = None
+
+    def new_jid(self) -> str:
+        return f"{self._prefix}-{next(RequestJournal._uniq)}"
+
+    def _append(self, entry: Dict) -> None:
+        line = json.dumps(entry, separators=(",", ":")) + "\n"
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+
+    # -- lifecycle records ---------------------------------------------------
+    def record_submit(self, jid: str, tenant: str, model: str,
+                      prompt, max_new: int) -> None:
+        self._append({"op": "submit", "jid": jid, "tenant": tenant,
+                      "model": model,
+                      "prompt": [int(t) for t in prompt],
+                      "max_new": int(max_new), "t": time.time()})
+
+    def record_done(self, jid: str, ok: bool = True,
+                    error: Optional[str] = None) -> None:
+        """Queue a done record for the background writer (non-blocking —
+        safe under the scheduler lock).  ``flush()`` waits it out."""
+        entry: Dict = {"op": "done", "jid": jid, "ok": bool(ok)}
+        if error:
+            entry["error"] = str(error)
+        with self._cv:
+            self._done_q.append(entry)
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._drain, daemon=True,
+                    name="journal-writer")
+                self._writer.start()
+            self._cv.notify_all()
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                while not self._done_q:
+                    self._cv.notify_all()     # flushers: queue is dry
+                    self._cv.wait()
+                batch = list(self._done_q)
+                self._done_q.clear()
+                self._writing = True
+            for entry in batch:
+                try:
+                    self._append(entry)
+                except Exception:
+                    pass    # a failed done-append = one extra replay
+            with self._cv:
+                self._writing = False
+                self._cv.notify_all()
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until queued done records hit the file (False on
+        timeout).  ``pending()`` flushes first, so replay decisions and
+        stats always see a settled journal."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._done_q or self._writing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    # -- recovery ------------------------------------------------------------
+    def pending(self) -> List[Dict]:
+        """Submit entries with no matching done record, in submission
+        order — what a restarted gateway resubmits.  A torn final line
+        (crash mid-append) is skipped, not fatal: the journal must be
+        readable at exactly the moments the process died badly."""
+        self.flush()
+        if not os.path.exists(self.path):
+            return []
+        submits: Dict[str, Dict] = {}
+        order: List[str] = []
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                jid = entry.get("jid")
+                if entry.get("op") == "submit" and jid is not None:
+                    if jid not in submits:
+                        order.append(jid)
+                    submits[jid] = entry
+                elif entry.get("op") == "done" and jid in submits:
+                    del submits[jid]
+        return [submits[j] for j in order if j in submits]
+
+    def stats(self) -> Dict[str, object]:
+        return {"path": self.path, "pending": len(self.pending()),
+                "fsync": self.fsync}

@@ -1,0 +1,422 @@
+"""ModelRegistry: versioned model artifacts, HBM budgeting, alias flips.
+
+The reference's deployment unit was one merged config+parameter blob per
+process (`paddle/capi` + inference/io.h); rolling a new model meant
+rolling the process.  The gateway's registry makes models data, not
+processes:
+
+* **versioned artifact layout** (fluid/io.py helpers): each version of
+  a model lives at ``<root>/<name>/<version>/`` — either a standard
+  ``save_inference_model`` directory (served by an ``InferenceEngine``,
+  fp32 or int8 via the PTQ flag) or a *generator artifact*
+  (``save_generator_artifact``: the paged decoder's weights plus a
+  ``gateway.json`` manifest of its constructor config) served by a
+  ``PagedTransformerGenerator``.
+* **HBM budget**: every load is costed BEFORE construction — a paged
+  generator's KV pool via the shared ``kv_page_bytes`` formula (ISSUE
+  6/7 accounting), weights via artifact bytes on disk — and a load that
+  would exceed ``hbm_budget_bytes`` is refused with ``HBMBudgetError``
+  instead of OOMing the chip mid-traffic.
+* **atomic alias flip**: ``resolve("name")`` maps the model alias to
+  the key ``name@version`` of the CURRENT version; ``set_alias`` flips
+  it under the lock.  The scheduler resolves aliases at ADMISSION, so
+  queued requests follow the flip to the new version — the hot-swap
+  zero-loss contract.  Unloading a version drops the registry's
+  reference; its scope (and the paged KV pool inside it) is freed with
+  the instance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ... import fluid
+from ..engine import InferenceEngine
+from ..paged_decoder import (PagedTransformerGenerator, _CACHE_MARKERS,
+                             kv_page_bytes)
+
+__all__ = ["HBMBudgetError", "ModelRegistry", "MANIFEST_NAME"]
+
+MANIFEST_NAME = "gateway.json"
+
+# the paged generator's constructor surface a manifest may carry — kept
+# explicit so a stale manifest key fails loudly at load, not deep in the
+# builder
+_GENERATOR_KEYS = (
+    "src_vocab_size", "trg_vocab_size", "n_layer", "n_head", "d_key",
+    "d_value", "d_model", "d_inner_hid", "max_length", "src_len",
+    "max_out_len", "param_prefix", "start_id", "end_id", "page_size",
+    "num_pages", "chunk_size", "prefix_sharing", "topk_size", "kv_dtype")
+
+_LIVE_REGISTRIES: "weakref.WeakSet[ModelRegistry]" = weakref.WeakSet()
+_collector_lock = threading.Lock()
+_collector_registered = False
+
+
+def _collect_registry_metrics():
+    from ...observability.metrics import Sample
+
+    for reg in list(_LIVE_REGISTRIES):
+        try:
+            entries = reg.entries()
+            budget = reg.hbm_budget_bytes
+            used = reg.hbm_used()
+        except Exception:
+            continue
+        for e in entries:
+            yield Sample(
+                "paddle_gateway_model_hbm_bytes", "gauge",
+                (("model", e["name"]), ("version", e["version"]),
+                 ("kind", e["kind"])),
+                float(e["hbm_bytes"]),
+                "Budgeted HBM bytes per loaded model version")
+            yield Sample(
+                "paddle_gateway_model_current", "gauge",
+                (("model", e["name"]), ("version", e["version"])),
+                1.0 if e["current"] else 0.0,
+                "1 when this version is the model alias target")
+        yield Sample("paddle_gateway_hbm_bytes", "gauge",
+                     (("kind", "used"),), float(used),
+                     "Registry HBM accounting (budget vs used)")
+        if budget is not None:
+            yield Sample("paddle_gateway_hbm_bytes", "gauge",
+                         (("kind", "budget"),), float(budget),
+                         "Registry HBM accounting (budget vs used)")
+
+
+def _register_registry_collector() -> None:
+    global _collector_registered
+    with _collector_lock:
+        if _collector_registered:
+            return
+        from ...observability.metrics import registry as _m
+
+        _m().register_collector(_collect_registry_metrics)
+        _collector_registered = True
+
+
+class HBMBudgetError(RuntimeError):
+    """Loading this model version would exceed the registry's HBM
+    budget — unload something (or raise the budget) first."""
+
+
+def _artifact_bytes(dirname: str) -> int:
+    total = 0
+    for n in os.listdir(dirname):
+        p = os.path.join(dirname, n)
+        if os.path.isfile(p) and n != MANIFEST_NAME:
+            total += os.path.getsize(p)
+    return total
+
+
+class _Entry:
+    __slots__ = ("key", "name", "version", "kind", "instance",
+                 "hbm_bytes", "loaded_at", "dirname")
+
+    def __init__(self, key, name, version, kind, instance, hbm_bytes,
+                 dirname=None):
+        self.key = key
+        self.name = name
+        self.version = version
+        self.kind = kind
+        self.instance = instance
+        self.hbm_bytes = int(hbm_bytes)
+        self.dirname = dirname
+        self.loaded_at = time.time()
+
+
+class ModelRegistry:
+    """Loaded model versions + the alias map the scheduler resolves."""
+
+    def __init__(self, root: Optional[str] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 place=None):
+        self.root = root
+        self.hbm_budget_bytes = (None if hbm_budget_bytes is None
+                                 else int(hbm_budget_bytes))
+        self.place = place
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self._alias: Dict[str, str] = {}        # name -> version
+        _LIVE_REGISTRIES.add(self)
+        _register_registry_collector()
+
+    # -- artifact store ------------------------------------------------------
+    @staticmethod
+    def save_generator_artifact(generator: PagedTransformerGenerator,
+                                root: str, name: str, version: str) -> str:
+        """Persist a paged generator as a versioned artifact: every
+        persistable of its unified program EXCEPT cache state (the KV
+        pool/sidecar are decode-time state, rebuilt empty at load), plus
+        a manifest of the constructor config.  The artifact is exactly
+        what ``load`` needs to rebuild a byte-equivalent server."""
+        dirname = fluid.io.model_version_dir(root, name, version)
+        os.makedirs(dirname, exist_ok=True)
+        prog = generator._unified[0]
+        for v in prog.list_vars():
+            if not v.persistable or \
+                    any(m in v.name for m in _CACHE_MARKERS):
+                continue
+            val = generator.scope.find_var(v.name)
+            if val is None:
+                continue
+            fluid.io.save_tensor(np.asarray(val),
+                                 os.path.join(dirname, v.name))
+        cfg = {
+            "src_vocab_size": generator.cfg.src_vocab_size,
+            "trg_vocab_size": generator.cfg.trg_vocab_size,
+            "n_layer": generator.cfg.n_layer,
+            "n_head": generator.cfg.n_head,
+            "d_key": generator.cfg.d_key,
+            "d_value": generator.cfg.d_value,
+            "d_model": generator.cfg.d_model,
+            "d_inner_hid": generator.cfg.d_inner_hid,
+            "max_length": generator.cfg.max_length,
+            "src_len": generator.src_len,
+            "max_out_len": generator.max_out_len,
+            "param_prefix": generator.prefix,
+            "start_id": generator.start_id,
+            "end_id": generator.end_id,
+            "page_size": generator.page_size,
+            "num_pages": generator.num_pages,
+            "chunk_size": generator.chunk,
+            "prefix_sharing": generator.prefix_sharing,
+            "topk_size": generator.topk_size,
+            "kv_dtype": generator.kv_dtype,
+        }
+        with open(os.path.join(dirname, MANIFEST_NAME), "w",
+                  encoding="utf-8") as f:
+            json.dump({"kind": "generator", "config": cfg}, f, indent=1)
+        return dirname
+
+    def _manifest(self, dirname: str) -> Dict:
+        path = os.path.join(dirname, MANIFEST_NAME)
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        # a bare save_inference_model directory serves through the
+        # bucketed engine by default
+        return {"kind": "engine"}
+
+    # -- budgeting -----------------------------------------------------------
+    def hbm_used(self) -> int:
+        with self._lock:
+            return sum(e.hbm_bytes for e in self._entries.values())
+
+    def _charge(self, cost: int, what: str) -> None:
+        if self.hbm_budget_bytes is None:
+            return
+        used = self.hbm_used()
+        if used + cost > self.hbm_budget_bytes:
+            raise HBMBudgetError(
+                f"loading {what} needs {cost} HBM bytes but only "
+                f"{self.hbm_budget_bytes - used} of "
+                f"{self.hbm_budget_bytes} remain "
+                f"({used} in use) — unload a version first")
+
+    @staticmethod
+    def _estimate_cost(kind: str, dirname: Optional[str],
+                       config: Dict) -> int:
+        """Budget cost BEFORE any device allocation: weights from the
+        artifact bytes on disk, plus — for generators — the KV pool via
+        the shared kv_page_bytes formula (the ISSUE 6/7 accounting)."""
+        cost = _artifact_bytes(dirname) if dirname else 0
+        if kind == "generator":
+            cost += kv_page_bytes(
+                int(config["n_layer"]), int(config["n_head"]),
+                int(config["d_key"]), int(config.get("page_size", 8)),
+                config.get("kv_dtype", "float32")) \
+                * int(config["num_pages"])
+        return cost
+
+    # -- loading -------------------------------------------------------------
+    def load(self, name: str, version: str,
+             dirname: Optional[str] = None, **overrides) -> str:
+        """Load ``<name>/<version>`` from the artifact store (or an
+        explicit ``dirname``) into a live serving instance; returns the
+        lane-group key ``name@version``.  The first loaded version of a
+        model becomes its alias target."""
+        name, version = str(name), str(version)
+        key = f"{name}@{version}"
+        with self._lock:
+            if key in self._entries:
+                raise ValueError(f"{key} already loaded")
+        if dirname is None:
+            if self.root is None:
+                raise ValueError("registry has no root; pass dirname=")
+            dirname = fluid.io.model_version_dir(self.root, name, version)
+        if not os.path.isdir(dirname):
+            raise FileNotFoundError(f"no artifact at {dirname}")
+        manifest = self._manifest(dirname)
+        kind = manifest.get("kind", "engine")
+        config = dict(manifest.get("config", {}))
+        config.update(overrides)
+        cost = self._estimate_cost(kind, dirname, config)
+        self._charge(cost, key)
+        if kind == "generator":
+            instance = self._build_generator(dirname, config)
+        elif kind == "engine":
+            instance = InferenceEngine(
+                dirname=dirname, place=self.place,
+                quantize=config.pop("quantize", "off"), **config)
+        else:
+            raise ValueError(f"{dirname}: unknown artifact kind "
+                             f"{kind!r} (engine or generator)")
+        with self._lock:
+            self._entries[key] = _Entry(key, name, version, kind,
+                                        instance, cost, dirname)
+            self._alias.setdefault(name, version)
+        return key
+
+    def _build_generator(self, dirname: str,
+                         config: Dict) -> PagedTransformerGenerator:
+        bad = set(config) - set(_GENERATOR_KEYS)
+        if bad:
+            raise ValueError(f"{dirname}: unknown generator config keys "
+                             f"{sorted(bad)}")
+        gen = PagedTransformerGenerator(place=self.place, **config)
+        for n in os.listdir(dirname):
+            path = os.path.join(dirname, n)
+            if n == MANIFEST_NAME or not os.path.isfile(path):
+                continue
+            gen.scope.set_var(n, fluid.io.load_tensor(path))
+        # one upload at load, not per first request (the engine
+        # to_device contract); the pool vars are already device zeros
+        fluid.io.device_put_persistables(gen.scope, gen._unified[0])
+        return gen
+
+    def register(self, name: str, version: str, instance,
+                 hbm_bytes: Optional[int] = None) -> str:
+        """Adopt an already-constructed instance (in-process loads,
+        tests, bench).  Costed by its own accounting when available:
+        paged pool bytes or dense per-slot bytes."""
+        name, version = str(name), str(version)
+        key = f"{name}@{version}"
+        if hbm_bytes is None:
+            if hasattr(instance, "page_bytes"):
+                hbm_bytes = instance.page_bytes * instance.num_pages
+            elif hasattr(instance, "kv_bytes_per_slot"):
+                hbm_bytes = instance.kv_bytes_per_slot()
+            else:
+                hbm_bytes = 0
+        self._charge(int(hbm_bytes), key)
+        kind = ("generator"
+                if isinstance(instance, PagedTransformerGenerator)
+                else "engine" if isinstance(instance, InferenceEngine)
+                else type(instance).__name__)
+        with self._lock:
+            if key in self._entries:
+                raise ValueError(f"{key} already loaded")
+            self._entries[key] = _Entry(key, name, version, kind,
+                                        instance, hbm_bytes)
+            self._alias.setdefault(name, version)
+        return key
+
+    def _check_unload_locked(self, key: str) -> "_Entry":
+        entry = self._entries.get(key)
+        if entry is None:
+            raise KeyError(f"{key} not loaded")
+        if self._alias.get(entry.name) == entry.version:
+            others = [e for e in self._entries.values()
+                      if e.name == entry.name and e.key != key]
+            if others:
+                raise ValueError(
+                    f"{key} is the current alias target; "
+                    f"set_alias to another version first")
+        return entry
+
+    def check_unload(self, key: str) -> None:
+        """Raise exactly what ``unload`` would, without removing
+        anything — callers that must tear down OTHER state (scheduler
+        lanes) before the registry entry validate first, so a refused
+        unload never leaves the model half-torn."""
+        with self._lock:
+            self._check_unload_locked(str(key))
+
+    def unload(self, key: str):
+        """Forget a loaded version and release its budget; returns the
+        instance (the caller drops the last reference — the scope, and
+        the paged KV pool inside it, free with it).  Refuses to unload
+        the alias target: flip or remove the alias first."""
+        with self._lock:
+            entry = self._check_unload_locked(key)
+            if self._alias.get(entry.name) == entry.version:
+                del self._alias[entry.name]
+            del self._entries[key]
+            return entry.instance
+
+    # -- alias resolution (the scheduler's resolve hook) ---------------------
+    def set_alias(self, name: str, version: str) -> str:
+        """Atomically point ``name`` at ``version`` (must be loaded);
+        returns the previous key or None.  This is THE hot-swap flip:
+        submissions and queued requests resolve through it at admission,
+        so after the flip no new work reaches the old version."""
+        name, version = str(name), str(version)
+        key = f"{name}@{version}"
+        with self._lock:
+            if key not in self._entries:
+                raise KeyError(f"{key} not loaded")
+            prev = self._alias.get(name)
+            self._alias[name] = version
+        return f"{name}@{prev}" if prev is not None else None
+
+    def resolve(self, alias: str) -> str:
+        """Model alias -> lane-group key.  Pinned ``name@version``
+        addresses pass through; bare names follow the alias map.
+        Unknown names return themselves (the scheduler rejects unknown
+        groups with its own error path)."""
+        alias = str(alias)
+        if "@" in alias:
+            return alias
+        with self._lock:
+            version = self._alias.get(alias)
+        return f"{alias}@{version}" if version is not None else alias
+
+    def current_key(self, name: str) -> Optional[str]:
+        with self._lock:
+            version = self._alias.get(str(name))
+        return f"{name}@{version}" if version is not None else None
+
+    def instance(self, alias_or_key: str):
+        key = self.resolve(alias_or_key)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                raise KeyError(f"no model loaded for {alias_or_key!r}")
+            return entry.instance
+
+    # -- accounting ----------------------------------------------------------
+    def entries(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return [{
+                "key": e.key, "name": e.name, "version": e.version,
+                "kind": e.kind, "hbm_bytes": e.hbm_bytes,
+                "loaded_at": e.loaded_at,
+                "current": self._alias.get(e.name) == e.version,
+            } for e in sorted(self._entries.values(),
+                              key=lambda e: e.key)]
+
+    def stats(self) -> Dict[str, object]:
+        entries = self.entries()
+        out: Dict[str, object] = {
+            "models": entries,
+            "aliases": dict(sorted(self._alias.items())),
+            "hbm_used_bytes": sum(e["hbm_bytes"] for e in entries),
+        }
+        if self.hbm_budget_bytes is not None:
+            out["hbm_budget_bytes"] = self.hbm_budget_bytes
+        if self.root is not None:
+            out["root"] = self.root
+            with self._lock:
+                names = sorted({e.name for e in self._entries.values()})
+            out["versions_on_disk"] = {
+                n: fluid.io.list_model_versions(self.root, n)
+                for n in names}
+        return out

@@ -1,0 +1,224 @@
+"""GatewayServer — the HTTP front door (ThreadingHTTPServer idiom).
+
+Same serving shape as ``observability/server.py`` and the PR 1
+``MasterServer``: stdlib ``ThreadingHTTPServer`` on a daemon thread,
+JSON bodies, port 0 = pick-a-port.  Routes:
+
+* ``POST /v1/generate`` — body ``{"model", "prompt": [ids], "tenant",
+  "max_new", "stream"}``.  Blocking by default (one JSON response with
+  the full token list); ``"stream": true`` switches to chunked
+  transfer, one JSON line per token as the decode step retires it, with
+  a final ``{"done": ...}`` line.  A client that disconnects mid-stream
+  cancels the request — its lane and pages free at the next step
+  boundary.
+* ``GET /v1/models`` — registry rollup (loaded versions, aliases, HBM
+  budget); ``POST /v1/models`` with ``{"action": "load"|"swap"|
+  "unload", "model", "version", ...}`` drives the lifecycle — the
+  ``tools.gateway`` CLI is a thin client of this route.
+* ``GET /healthz`` — liveness only, never touches the scheduler (the
+  master_service /ping rule); ``GET /statusz`` — the gateway's full
+  stats rollup (registry, router, scheduler, per-tenant latencies).
+
+Error mapping: ``RateLimited`` → 429, unknown model → 404,
+``PoolCapacityError`` → 413, bad request → 400 — each with a JSON body
+naming the error, so a tenant can tell "slow down" from "gone"."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..paging import PoolCapacityError
+from .gateway import Gateway
+from .router import RateLimited
+
+__all__ = ["GatewayServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: "GatewayServer" = None      # bound per-server subclass
+    protocol_version = "HTTP/1.1"           # keep-alive + chunked
+
+    def log_message(self, *a):   # quiet
+        pass
+
+    # -- plumbing ------------------------------------------------------------
+    def _send_json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n <= 0:
+            return {}
+        return json.loads(self.rfile.read(n).decode() or "{}")
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self):
+        gw = self.server_ref.gateway
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/healthz":
+                return self._send_json({"ok": True})
+            if path == "/statusz":
+                return self._send_json(gw.stats())
+            if path == "/v1/models":
+                return self._send_json(
+                    {"models": gw.models(),
+                     "aliases": gw.registry.stats()["aliases"]})
+            return self._send_json(
+                {"error": f"unknown route {path}",
+                 "routes": ["/v1/generate", "/v1/models", "/healthz",
+                            "/statusz"]}, 404)
+        except Exception as e:
+            return self._send_json(
+                {"error": f"{type(e).__name__}: {e}"}, 500)
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        try:
+            body = self._read_json()
+        except Exception as e:
+            return self._send_json({"error": f"bad JSON body: {e}"}, 400)
+        try:
+            if path == "/v1/generate":
+                return self._generate(body)
+            if path == "/v1/models":
+                return self._models(body)
+            return self._send_json({"error": f"unknown route {path}"},
+                                   404)
+        except RateLimited as e:
+            return self._send_json({"error": str(e),
+                                    "reason": "rate_limit"}, 429)
+        except PoolCapacityError as e:
+            return self._send_json({"error": str(e),
+                                    "reason": "pool_capacity"}, 413)
+        except KeyError as e:
+            return self._send_json({"error": str(e),
+                                    "reason": "unknown_model"}, 404)
+        except (TypeError, ValueError) as e:
+            return self._send_json({"error": str(e)}, 400)
+        except Exception as e:      # diagnosable, never a bare 500 page
+            return self._send_json(
+                {"error": f"{type(e).__name__}: {e}"}, 500)
+
+    def _generate(self, body: dict):
+        gw = self.server_ref.gateway
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            raise ValueError("generate: 'prompt' must be a non-empty "
+                             "list of token ids")
+        model = str(body.get("model", "default"))
+        tenant = str(body.get("tenant", "default"))
+        max_new = body.get("max_new")
+        if not body.get("stream", False):
+            out = gw.generate(model, prompt, tenant=tenant,
+                              max_new=max_new,
+                              timeout=self.server_ref.request_timeout)
+            return self._send_json(out)
+        # chunked streaming: one JSON line per token, then a done line.
+        # BrokenPipe (client went away) cancels the request so the lane
+        # and its pages stop burning on an audience of zero.
+        stream = gw.submit_stream(model, prompt, tenant=tenant,
+                                  max_new=max_new,
+                                  timeout=self.server_ref.request_timeout)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        n = 0
+        try:
+            for tok in stream:
+                self._chunk(json.dumps({"token": int(tok)}).encode()
+                            + b"\n")
+                self.wfile.flush()
+                n += 1
+            req = stream.request
+            self._chunk(json.dumps(
+                {"done": True, "tokens": n, "rid": req.rid,
+                 "version": (req.group or "@?").split("@", 1)[-1]}
+                ).encode() + b"\n")
+            self._chunk(b"")
+        except (BrokenPipeError, ConnectionResetError):
+            stream.close()
+        except BaseException as e:
+            stream.close()
+            try:
+                self._chunk(json.dumps(
+                    {"done": True, "tokens": n,
+                     "error": f"{type(e).__name__}: {e}"}).encode()
+                    + b"\n")
+                self._chunk(b"")
+            except OSError:
+                pass
+
+    def _models(self, body: dict):
+        gw = self.server_ref.gateway
+        action = body.get("action")
+        model = body.get("model")
+        version = body.get("version")
+        if action == "load":
+            key = gw.load_model(model, version,
+                                dirname=body.get("dirname"),
+                                n_slots=body.get("n_slots"))
+            return self._send_json({"loaded": key})
+        if action == "swap":
+            key = gw.swap_model(model, version,
+                                dirname=body.get("dirname"),
+                                n_slots=body.get("n_slots"))
+            return self._send_json({"swapped": key})
+        if action == "unload":
+            gw.unload_model(f"{model}@{version}" if version else model)
+            return self._send_json({"unloaded": model})
+        raise ValueError(f"models: unknown action {action!r} "
+                         "(load/swap/unload)")
+
+
+class GatewayServer:
+    """Serve a ``Gateway`` over HTTP on a background thread."""
+
+    def __init__(self, gateway: Gateway, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout: float = 120.0):
+        self.gateway = gateway
+        self.request_timeout = float(request_timeout)
+        handler = type("BoundHandler", (_Handler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @property
+    def address(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def start(self) -> str:
+        if self._thread is not None:
+            raise RuntimeError("start() already running")
+        if self._closed:
+            raise RuntimeError("start() after stop(): build a new "
+                               "GatewayServer")
+        self.gateway.serve()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="gateway-server")
+        self._thread.start()
+        return self.address
+
+    def stop(self, drain: bool = True) -> None:
+        self._closed = True
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.gateway.shutdown(drain=drain)

@@ -32,6 +32,33 @@ extend the protocol two ways:
   dispatch over every lane (chunked prefill interleaved with decode)
   returning ``{slot: token}`` for the lanes that actually emitted; the
   scheduler keeps only the request bookkeeping.
+
+ISSUE 10 grows the scheduler into the gateway's shared execution core:
+
+* **multi-model lane ownership** — ``add_model(key, model, n_slots)``
+  registers any number of slot models, each owning its own lane group
+  (free list, per-lane host state); ONE admit/step loop drives them all,
+  so two models share the device through one front door.  The original
+  single-model constructor keeps working (its model is lane group
+  ``"default"``).  ``remove_model(key, drain=True)`` drains the group's
+  in-flight lanes and forgets it — the hot-swap unload path.
+* **routed admission** — queued requests carry a model *alias*; a
+  ``resolve`` hook maps alias → lane-group key AT ADMISSION, so a
+  registry can flip an alias mid-traffic and queued requests follow it
+  to the new version (zero lost requests across a hot swap).
+* **preemptive admission policy** — ``admission_policy(candidates,
+  active)`` picks WHICH admissible queued request gets the next free
+  slot (the TenantRouter's SLO-class preemption + weighted fair share).
+  Preemption happens ONLY at admission: an in-flight request is never
+  evicted, so a flooding tenant can delay another tenant's admission by
+  at most the residual decode time of the lanes ahead of it.
+* **cancellation** — ``Request.cancel()`` retires the lane at the next
+  step boundary (or dequeues immediately if still queued), freeing the
+  lane and — for page-aware models — its pages at once.
+* **clean shutdown** — ``shutdown(drain=True)`` stops admitting, drains
+  in-flight lanes to completion, joins the thread, and fails any
+  still-queued requests with ``SchedulerShutdown`` (returned to the
+  caller for journal-driven resubmission).
 """
 
 from __future__ import annotations
@@ -41,7 +68,7 @@ import threading
 import time
 import weakref
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -49,10 +76,22 @@ from ..observability import metrics as _obs_metrics
 from ..observability import tracing as _obs_tracing
 from .paging import PoolCapacityError
 
-__all__ = ["Request", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "ContinuousBatchingScheduler", "RequestCancelled",
+           "SchedulerShutdown", "DEFAULT_MODEL"]
+
+DEFAULT_MODEL = "default"
 
 # tokens-per-request is a count histogram, not a latency one
 _TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+class RequestCancelled(RuntimeError):
+    """The caller cancelled the request before it finished."""
+
+
+class SchedulerShutdown(RuntimeError):
+    """The scheduler shut down before this request was admitted."""
+
 
 # ONE module-level collector aggregates every live scheduler (the
 # paging.py pool-collector rule): queue depth and slot counts SUM
@@ -72,9 +111,10 @@ def _collect_scheduler_metrics():
         try:
             with s._lock:
                 queued += len(s._queue)
-                active += len(s._active)
-                free += len(s._free)
-                total += s.n_slots
+                for g in s._groups.values():
+                    active += len(g.active)
+                    free += len(g.free)
+                    total += g.n_slots
         except Exception:
             continue
     yield Sample("paddle_serving_queue_depth", "gauge", (),
@@ -109,10 +149,19 @@ class Request:
     # threads, so a read-modify-write counter would hand out dup rids
     _next_id = itertools.count(1)
 
-    def __init__(self, src_tokens, max_new_tokens: int):
+    def __init__(self, src_tokens, max_new_tokens: int,
+                 model: str = DEFAULT_MODEL, tenant: Optional[str] = None,
+                 on_token: Optional[Callable] = None):
         self.rid = next(Request._next_id)
         self.src = np.asarray(src_tokens)
         self.max_new_tokens = int(max_new_tokens)
+        self.model = str(model)          # alias as submitted; resolved
+        self.group: Optional[str] = None  # lane-group key at admission
+        self.tenant = tenant
+        # on_token(req, tok) per decoded token and on_token(req, None)
+        # once at completion — called under the scheduler lock, so it
+        # must be fast and non-blocking (the streaming layer enqueues)
+        self.on_token = on_token
         self.tokens: List[int] = []
         self.error: Optional[BaseException] = None
         self.submitted = time.perf_counter()
@@ -125,10 +174,22 @@ class Request:
         self.last_token: Optional[float] = None
         self.slot: Optional[int] = None
         self._done = threading.Event()
+        self._cancel = threading.Event()
 
     # -- caller surface ------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
+
+    def cancel(self) -> None:
+        """Ask the scheduler to drop this request: dequeued immediately
+        if still waiting, retired (lane + pages freed) at the next step
+        boundary if in flight.  ``error`` becomes ``RequestCancelled``;
+        tokens decoded so far stay readable."""
+        self._cancel.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
 
     @property
     def done(self) -> bool:
@@ -144,32 +205,72 @@ class Request:
         return None if self.finished is None else \
             self.finished - self.submitted
 
+    def _emit(self, tok: Optional[int]) -> None:
+        """Deliver one token (or the ``None`` completion sentinel) to the
+        streaming callback; a broken callback must never kill the serve
+        loop.  The callback is DROPPED after the sentinel: finished
+        Requests live on in the scheduler's history, and a retained
+        closure would pin whatever it captured (a gateway's callback
+        captures the model instance — keeping it would hold an unloaded
+        version's whole KV pool in HBM after a hot swap)."""
+        cb = self.on_token
+        if tok is None:
+            self.on_token = None
+        if cb is None:
+            return
+        try:
+            cb(self, tok)
+        except Exception:
+            pass
 
-class ContinuousBatchingScheduler:
-    """Admit → step → retire/backfill loop over ``n_slots`` lanes."""
 
-    def __init__(self, model, n_slots: int, max_new_tokens: int = 32):
+class _LaneGroup:
+    """One model's lanes inside the scheduler: the model, its free/active
+    slot bookkeeping, and the per-lane host state its step feed reads."""
+
+    def __init__(self, key: str, model, n_slots: int):
+        self.key = key
         self.model = model
         self.n_slots = int(n_slots)
-        self.default_max_new = int(max_new_tokens)
-        self._page_aware = bool(getattr(model, "page_aware", False))
-        self._managed = callable(getattr(model, "lane_step", None))
+        self.page_aware = bool(getattr(model, "page_aware", False))
+        self.managed = callable(getattr(model, "lane_step", None))
         model.open_slots(self.n_slots)
+        self.free = list(range(self.n_slots))
+        self.active: Dict[int, Request] = {}
+        # idle lanes hold benign values: position 0, the start token,
+        # source length 1
+        self.tokens = np.full(self.n_slots, model.start_id, np.int64)
+        self.pos = np.zeros(self.n_slots, np.int64)
+        self.src_len = np.ones(self.n_slots, np.int32)
+        self.draining = False      # no new admissions (unload/hot-swap)
+
+
+class ContinuousBatchingScheduler:
+    """Admit → step → retire/backfill loop over per-model lane groups."""
+
+    def __init__(self, model=None, n_slots: Optional[int] = None,
+                 max_new_tokens: int = 32,
+                 resolve: Optional[Callable[[str], str]] = None,
+                 admission_policy: Optional[Callable] = None):
+        self.default_max_new = int(max_new_tokens)
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
+        self._groups: Dict[str, _LaneGroup] = {}
         self._queue: deque = deque()
-        self._active: Dict[int, Request] = {}
         self._peak_in_flight = 0
-        self._free = list(range(self.n_slots))
-        # per-lane host state fed to every step (idle lanes hold benign
-        # values: position 0, the start token, source length 1)
-        self._tokens = np.full(self.n_slots, model.start_id, np.int64)
-        self._pos = np.zeros(self.n_slots, np.int64)
-        self._src_len = np.ones(self.n_slots, np.int32)
         self._steps = 0
         self._finished: List[Request] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._draining = False
+        # alias -> lane-group key, applied at admission time (and for
+        # submit-time feasibility checks); identity by default.  The
+        # gateway registry swaps versions by flipping what this returns.
+        self.resolve: Callable[[str], str] = resolve or (lambda name: name)
+        # admission_policy(candidates, active) -> Request|None picks among
+        # the ADMISSIBLE queued requests; None keeps strict FIFO with
+        # head-of-line blocking (the PR 5/6 semantics tests rely on)
+        self.admission_policy = admission_policy
         # -- telemetry (ISSUE 8): labeled instruments in the shared
         # registry + per-request span timeline.  stats() stays the dict
         # view; these are the exported series a /metrics scrape reads.
@@ -178,7 +279,7 @@ class ContinuousBatchingScheduler:
         self._m_requests = reg.counter(
             "paddle_serving_requests_total",
             "Request lifecycle events (submitted/admitted/finished/"
-            "failed/rejected)", labels=("event",))
+            "failed/rejected/cancelled)", labels=("event",))
         self._m_tokens = reg.counter(
             "paddle_serving_tokens_total", "Decoded tokens emitted")
         self._m_steps = reg.counter(
@@ -199,13 +300,85 @@ class ContinuousBatchingScheduler:
             "paddle_serving_tokens_per_request",
             "decoded tokens per finished request",
             buckets=_TOKEN_BUCKETS)
+        if model is not None:
+            if n_slots is None:
+                raise ValueError("single-model constructor needs n_slots")
+            self.add_model(DEFAULT_MODEL, model, n_slots)
         _LIVE_SCHEDULERS.add(self)
         _register_scheduler_collector()
 
+    # -- model registry surface ----------------------------------------------
+    def add_model(self, key: str, model, n_slots: int) -> None:
+        """Register a lane group for ``model`` under ``key``.  The
+        group's ``open_slots`` device work runs before the group becomes
+        visible, so the serve loop never steps a half-built group."""
+        group = _LaneGroup(str(key), model, n_slots)
+        with self._work:
+            if group.key in self._groups:
+                raise ValueError(f"model {key!r} already registered")
+            self._groups[group.key] = group
+            self._work.notify()
+
+    def remove_model(self, key: str, drain: bool = True,
+                     timeout: float = 30.0) -> None:
+        """Unregister lane group ``key``.  ``drain=True`` first stops
+        admissions into it and lets in-flight lanes finish (driving the
+        loop inline when ``serve()`` is not running); lanes still active
+        at the deadline are failed.  Queued requests that still resolve
+        to the group are rejected at their next admission attempt."""
+        with self._lock:
+            group = self._groups.get(str(key))
+            if group is None:
+                raise KeyError(f"no model {key!r} registered")
+            group.draining = True
+        if drain:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not group.active:
+                        break
+                if self._thread is None:
+                    if not self.step_once():
+                        break
+                else:
+                    time.sleep(0.005)
+        with self._lock:
+            for slot, req in list(group.active.items()):
+                req.error = req.error or RuntimeError(
+                    f"model {key!r} unloaded while request in flight")
+                self._retire_locked(group, slot, req)
+            del self._groups[group.key]
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._groups)
+
+    def _group_for(self, alias: str) -> Optional[_LaneGroup]:
+        try:
+            key = self.resolve(alias)
+        except Exception:
+            return None
+        return self._groups.get(key)
+
+    @property
+    def model(self):
+        """Single-model compatibility: the default lane group's model."""
+        g = self._groups.get(DEFAULT_MODEL)
+        return g.model if g is not None else None
+
+    @property
+    def n_slots(self) -> int:
+        return sum(g.n_slots for g in self._groups.values())
+
     # -- submission ----------------------------------------------------------
-    def submit(self, src_tokens, max_new_tokens: Optional[int] = None
-               ) -> Request:
-        src_cap = getattr(self.model, "src_len", None)
+    def submit(self, src_tokens, max_new_tokens: Optional[int] = None,
+               model: str = DEFAULT_MODEL, tenant: Optional[str] = None,
+               on_token: Optional[Callable] = None) -> Request:
+        with self._lock:
+            group = self._group_for(model)
+        if group is None:
+            raise KeyError(f"submit: no model registered for {model!r}")
+        src_cap = getattr(group.model, "src_len", None)
         if src_cap is not None and len(np.asarray(src_tokens)) > src_cap:
             # reject HERE, synchronously in the caller's thread — a
             # too-long prompt failing inside the serve loop would kill
@@ -213,10 +386,11 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"submit: prompt length {len(np.asarray(src_tokens))} "
                 f"exceeds the model's src_len {src_cap}")
-        cap = getattr(self.model, "max_out_len", self.default_max_new)
+        cap = getattr(group.model, "max_out_len", self.default_max_new)
         req = Request(src_tokens,
-                      min(max_new_tokens or self.default_max_new, cap))
-        if self._page_aware and self.model.prompt_infeasible(
+                      min(max_new_tokens or self.default_max_new, cap),
+                      model=model, tenant=tenant, on_token=on_token)
+        if group.page_aware and group.model.prompt_infeasible(
                 req.src, req.max_new_tokens):
             # structurally unserveable: the prompt + decode reservation
             # exceed the WHOLE page pool — queueing it would park it at
@@ -234,13 +408,81 @@ class ContinuousBatchingScheduler:
         self._m_requests.labels(event="submitted").inc()
         self._tracer.instant("request/submitted", cat="serving",
                              rid=req.rid, prompt_tokens=len(req.src),
-                             max_new=req.max_new_tokens)
+                             max_new=req.max_new_tokens, model=req.model)
         with self._work:
             self._queue.append(req)
             self._work.notify()
         return req
 
     # -- the loop ------------------------------------------------------------
+    def _finish_unadmitted_locked(self, req: Request,
+                                  error: BaseException,
+                                  event: str, reason: str) -> None:
+        """Fail a request that never reached a lane (still queued)."""
+        req.error = error
+        req.finished = time.perf_counter()
+        self._finished.append(req)
+        req._emit(None)
+        req._done.set()
+        self._m_requests.labels(event=event).inc()
+        self._tracer.instant(f"request/{event}", cat="serving",
+                             rid=req.rid, reason=reason)
+
+    def _pick_locked(self):
+        """-> (req, group) for the next queued request to admit, or None.
+        Walks the queue in submission order, rejecting dead entries
+        (cancelled / unknown model / structurally infeasible prompt)
+        inline.  Without an admission policy the head blocks the line
+        (the PR 5/6 backpressure semantics); with one, every admissible
+        request is a candidate and the policy picks."""
+        candidates = []
+        for req in list(self._queue):
+            if req.cancelled:
+                self._queue.remove(req)
+                self._finish_unadmitted_locked(
+                    req, RequestCancelled("cancelled before admission"),
+                    "cancelled", "cancelled")
+                continue
+            group = self._group_for(req.model)
+            if group is None or group.draining:
+                self._queue.remove(req)
+                self._finish_unadmitted_locked(
+                    req, KeyError(f"no model registered for "
+                                  f"{req.model!r}"),
+                    "rejected", "unknown_model")
+                continue
+            if group.page_aware and group.model.prompt_infeasible(
+                    req.src, req.max_new_tokens):
+                # reject-with-error, never hang: this prompt can NEVER
+                # fit, so park-at-head would starve the whole queue
+                self._queue.remove(req)
+                self._finish_unadmitted_locked(
+                    req, PoolCapacityError(
+                        "prompt + decode reservation exceed the entire "
+                        "page pool"),
+                    "rejected", "pool_capacity")
+                continue
+            blocked = not group.free or (
+                group.page_aware and not group.model.can_admit(
+                    req.src, req.max_new_tokens))
+            if not blocked:
+                if self.admission_policy is None:
+                    return req, group
+                candidates.append((req, group))
+            elif self.admission_policy is None:
+                # pool/slots momentarily full: stay queued; the next
+                # retirement frees capacity and re-runs admission
+                return None
+        if not candidates:
+            return None
+        active = [r for g in self._groups.values()
+                  for r in g.active.values()]
+        chosen = self.admission_policy([r for r, _ in candidates], active)
+        for r, g in candidates:
+            if r is chosen:
+                return r, g
+        return None
+
     def _admit_pending(self) -> int:
         """Admit queued requests into free slots.  The model's prefill
         dispatch runs OUTSIDE the lock (only the loop thread touches the
@@ -249,48 +491,29 @@ class ContinuousBatchingScheduler:
         admitted = 0
         while True:
             with self._lock:
-                if not (self._free and self._queue):
+                if self._draining:
                     return admitted
-                req = self._queue[0]
-                if self._page_aware:
-                    if self.model.prompt_infeasible(req.src,
-                                                    req.max_new_tokens):
-                        # reject-with-error, never hang: this prompt can
-                        # NEVER fit, so park-at-head would starve the
-                        # whole queue (satellite: seeded error-path test)
-                        self._queue.popleft()
-                        req.error = PoolCapacityError(
-                            "prompt + decode reservation exceed the "
-                            "entire page pool")
-                        req.finished = time.perf_counter()
-                        self._finished.append(req)
-                        req._done.set()
-                        self._m_requests.labels(event="rejected").inc()
-                        self._tracer.instant(
-                            "request/rejected", cat="serving",
-                            rid=req.rid, reason="pool_capacity")
-                        continue
-                    if not self.model.can_admit(req.src,
-                                                req.max_new_tokens):
-                        # pool momentarily full: stay queued; the next
-                        # retirement frees pages and re-runs admission
-                        return admitted
-                self._queue.popleft()
-                slot = self._free.pop()
+                picked = self._pick_locked()
+                if picked is None:
+                    return admitted
+                req, group = picked
+                self._queue.remove(req)
+                slot = group.free.pop()
             try:
-                if self._page_aware:
-                    s_true = self.model.admit_slot(
+                if group.page_aware:
+                    s_true = group.model.admit_slot(
                         slot, req.src, max_new=req.max_new_tokens)
                 else:
-                    s_true = self.model.admit_slot(slot, req.src)
+                    s_true = group.model.admit_slot(slot, req.src)
             except BaseException as e:
                 # fail THIS request, give the slot back, keep serving —
                 # one bad prompt must not leak capacity or kill the loop
                 with self._lock:
-                    self._free.append(slot)
+                    group.free.append(slot)
                     req.error = e
                     req.finished = time.perf_counter()
                     self._finished.append(req)
+                req._emit(None)
                 req._done.set()
                 self._m_requests.labels(event="failed").inc()
                 self._tracer.instant("request/admit_failed",
@@ -299,43 +522,51 @@ class ContinuousBatchingScheduler:
                 continue
             with self._lock:
                 req.slot = slot
+                req.group = group.key
                 req.admitted = time.perf_counter()
-                self._active[slot] = req
+                group.active[slot] = req
+                in_flight = sum(len(g.active)
+                                for g in self._groups.values())
                 self._peak_in_flight = max(self._peak_in_flight,
-                                           len(self._active))
-                self._tokens[slot] = self.model.start_id
-                self._pos[slot] = 0
-                self._src_len[slot] = s_true
+                                           in_flight)
+                group.tokens[slot] = group.model.start_id
+                group.pos[slot] = 0
+                group.src_len[slot] = s_true
             self._m_requests.labels(event="admitted").inc()
             self._h_queue.observe(req.admitted - req.submitted)
             self._tracer.instant("request/admitted", cat="serving",
-                                 rid=req.rid, slot=slot)
+                                 rid=req.rid, slot=slot, model=group.key)
             admitted += 1
 
-    def _retire_locked(self, slot: int, req: Request) -> None:
+    def _retire_locked(self, group: _LaneGroup, slot: int,
+                       req: Request) -> None:
         # no device work in here (submit() blocks on this lock): the
         # lane's caches stay stale until the next admit_slot, which
         # re-zeroes them before use — lanes are row-independent, so a
         # stale lane decoding garbage contaminates nothing.  Page-aware
         # models DO free their pages here (host-side bookkeeping only):
         # "retire frees pages immediately" is what lets the very next
-        # admission round backfill under page pressure.
+        # admission round backfill under page pressure — and what makes
+        # cancellation release a mid-prefill lane's pages at once.
         req.finished = time.perf_counter()
-        del self._active[slot]
-        if self._page_aware:
+        del group.active[slot]
+        if group.page_aware:
             try:
-                self.model.clear_slot(slot)
+                group.model.clear_slot(slot)
             except BaseException as e:      # pragma: no cover - belt and
                 req.error = req.error or e  # braces; never lose the slot
-        self._tokens[slot] = self.model.start_id
-        self._pos[slot] = 0
-        self._src_len[slot] = 1
-        self._free.append(slot)
+        group.tokens[slot] = group.model.start_id
+        group.pos[slot] = 0
+        group.src_len[slot] = 1
+        group.free.append(slot)
         self._finished.append(req)
+        req._emit(None)
         req._done.set()
         ok = req.error is None
-        self._m_requests.labels(
-            event="finished" if ok else "failed").inc()
+        event = ("finished" if ok else
+                 "cancelled" if isinstance(req.error, RequestCancelled)
+                 else "failed")
+        self._m_requests.labels(event=event).inc()
         if ok:
             self._h_total.observe(req.finished - req.submitted)
             self._h_tokens_per_req.observe(len(req.tokens))
@@ -348,7 +579,18 @@ class ContinuousBatchingScheduler:
                               cat="serving", rid=req.rid,
                               tokens=len(req.tokens), ok=ok)
 
-    def _note_token(self, req: Request) -> None:
+    def _reap_cancelled_locked(self) -> None:
+        """Retire cancelled in-flight requests BEFORE the next dispatch:
+        the lane (and, page-aware, its pages — including a lane still
+        mid-prefill) frees immediately rather than decoding to the cap."""
+        for group in self._groups.values():
+            for slot, req in list(group.active.items()):
+                if req.cancelled:
+                    req.error = req.error or RequestCancelled(
+                        "cancelled in flight")
+                    self._retire_locked(group, slot, req)
+
+    def _note_token(self, req: Request, tok: int) -> None:
         """Per-token telemetry (called under the lock, right after the
         token was appended): TTFT on the first token, inter-token gap on
         the rest, and one ``request/token`` trace instant — token
@@ -362,73 +604,94 @@ class ContinuousBatchingScheduler:
             self._h_itl.observe(now - req.last_token)
         req.last_token = now
         self._m_tokens.inc()
+        req._emit(tok)
         self._tracer.instant("request/token", cat="serving", rid=req.rid,
                              index=len(req.tokens))
 
-    def step_once(self) -> bool:
-        """Admit what fits, run ONE lockstep decode step, retire finished
-        lanes.  Returns False when there was nothing to do."""
-        self._admit_pending()
-        with self._lock:
-            if not self._active:
-                return False
-            if not self._managed:   # managed models read lane state
-                tokens = self._tokens.copy()    # themselves; skip the
-                pos = self._pos.copy()          # copies under the lock
-                src_len = self._src_len.copy()
-        if self._managed:
+    def _step_group(self, group: _LaneGroup, snap) -> None:
+        """One lockstep dispatch over ``group``'s lanes + retirement."""
+        if group.managed:
             # self-managed model: one dispatch interleaves chunked
             # prefill and decode over every lane; only lanes that
             # actually emitted a token come back
             try:
                 with self._tracer.span("scheduler/step", cat="serving",
-                                       managed=True):
-                    emitted = self.model.lane_step()
+                                       managed=True, model=group.key):
+                    emitted = group.model.lane_step()
             except BaseException as e:
-                self._fail_in_flight(e)
-                return True
+                self._fail_group(group, e)
+                return
             with self._lock:
                 self._steps += 1
                 self._m_steps.inc()
                 for slot, tok in emitted.items():
-                    req = self._active.get(slot)
+                    req = group.active.get(slot)
                     if req is None:
                         continue
                     req.tokens.append(int(tok))
-                    self._note_token(req)
-                    if int(tok) == self.model.end_id or \
+                    self._note_token(req, int(tok))
+                    if int(tok) == group.model.end_id or \
                             len(req.tokens) >= req.max_new_tokens:
-                        self._retire_locked(slot, req)
-            return True
+                        self._retire_locked(group, slot, req)
+            return
+        tokens, pos, src_len = snap
         try:
             with self._tracer.span("scheduler/step", cat="serving",
-                                   managed=False):
-                nxt = self.model.step_slots(tokens, pos, src_len)
+                                   managed=False, model=group.key):
+                nxt = group.model.step_slots(tokens, pos, src_len)
         except BaseException as e:
-            self._fail_in_flight(e)
-            return True
+            self._fail_group(group, e)
+            return
         with self._lock:
             self._steps += 1
             self._m_steps.inc()
-            for slot, req in list(self._active.items()):
+            for slot, req in list(group.active.items()):
                 tok = int(nxt[slot])
                 req.tokens.append(tok)
-                self._note_token(req)
-                self._tokens[slot] = tok
-                self._pos[slot] += 1
-                if tok == self.model.end_id or \
+                self._note_token(req, tok)
+                group.tokens[slot] = tok
+                group.pos[slot] += 1
+                if tok == group.model.end_id or \
                         len(req.tokens) >= req.max_new_tokens:
-                    self._retire_locked(slot, req)
+                    self._retire_locked(group, slot, req)
+
+    def step_once(self) -> bool:
+        """Admit what fits, run ONE lockstep decode step per lane group
+        with active lanes, retire finished lanes.  Returns False when
+        there was nothing to do."""
+        self._admit_pending()
+        with self._lock:
+            self._reap_cancelled_locked()
+            work = []
+            for group in self._groups.values():
+                if not group.active:
+                    continue
+                snap = None if group.managed else (
+                    group.tokens.copy(), group.pos.copy(),
+                    group.src_len.copy())
+                work.append((group, snap))
+            if not work:
+                return False
+        for group, snap in work:
+            self._step_group(group, snap)
         return True
 
-    def _fail_in_flight(self, exc: BaseException) -> None:
-        """A step dispatch failed: fail every in-flight request with the
-        error (their cache lanes are in an unknown state), free the
-        slots, and keep the loop alive for future traffic."""
+    def _fail_group(self, group: _LaneGroup, exc: BaseException) -> None:
+        """A step dispatch failed: fail every in-flight request of that
+        lane group with the error (their cache lanes are in an unknown
+        state), free the slots, and keep the loop alive."""
         with self._lock:
-            for slot, req in list(self._active.items()):
+            for slot, req in list(group.active.items()):
                 req.error = exc
-                self._retire_locked(slot, req)
+                self._retire_locked(group, slot, req)
+
+    def _fail_in_flight(self, exc: BaseException) -> None:
+        """Fail every in-flight request across all lane groups."""
+        with self._lock:
+            for group in self._groups.values():
+                for slot, req in list(group.active.items()):
+                    req.error = exc
+                    self._retire_locked(group, slot, req)
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> int:
         """Drive the loop inline until queue and slots drain; returns the
@@ -459,7 +722,8 @@ class ContinuousBatchingScheduler:
                     busy = True
                 if not busy:
                     with self._work:
-                        if not self._queue and not self._active:
+                        if not self._queue and not any(
+                                g.active for g in self._groups.values()):
                             self._work.wait(timeout=0.05)
 
         self._thread = threading.Thread(target=loop, daemon=True,
@@ -467,39 +731,105 @@ class ContinuousBatchingScheduler:
         self._thread.start()
         return self
 
-    def shutdown(self, timeout: float = 5.0) -> None:
+    def shutdown(self, timeout: float = 5.0,
+                 drain: bool = False) -> List[Request]:
+        """Stop the serve loop.  Default (``drain=False``) is the
+        immediate PR 5 behavior: the thread stops at the next step
+        boundary, in-flight lanes are simply abandoned (their waiters
+        keep waiting — callers that want clean completion use drain).
+
+        ``drain=True`` (ISSUE 10 satellite): stop admitting, let every
+        in-flight lane decode to completion (driving the loop inline
+        when ``serve()`` was never started), join the thread, then fail
+        any still-queued request with ``SchedulerShutdown``.  Returns
+        the failed queued requests so a gateway can resubmit their
+        journal entries after a restart."""
+        leftovers: List[Request] = []
+        if drain:
+            deadline = time.monotonic() + timeout
+            with self._lock:
+                self._draining = True
+            while time.monotonic() < deadline:
+                with self._lock:
+                    busy = any(g.active for g in self._groups.values())
+                if not busy:
+                    break
+                if self._thread is None:
+                    if not self.step_once():
+                        break
+                else:
+                    time.sleep(0.005)
         self._stop.set()
         with self._work:
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        if drain:
+            with self._lock:
+                while self._queue:
+                    req = self._queue.popleft()
+                    self._finish_unadmitted_locked(
+                        req, SchedulerShutdown(
+                            "scheduler shut down before admission"),
+                        "rejected", "shutdown")
+                    leftovers.append(req)
+                self._draining = False
+        return leftovers
 
     # -- accounting ----------------------------------------------------------
+    def queued_requests(self) -> List[Request]:
+        """Snapshot of the waiting queue in submission order (the
+        router's per-tenant queue-depth source)."""
+        with self._lock:
+            return list(self._queue)
+
+    def active_requests(self) -> List[Request]:
+        with self._lock:
+            return [r for g in self._groups.values()
+                    for r in g.active.values()]
+
+    def finished_requests(self) -> List[Request]:
+        """Every retired/rejected request so far (the gateway's
+        per-tenant latency-percentile source)."""
+        with self._lock:
+            return list(self._finished)
+
     def stats(self) -> Dict[str, object]:
         with self._lock:
             done = list(self._finished)
+            in_flight = sum(len(g.active) for g in self._groups.values())
             out: Dict[str, object] = {
                 "steps": self._steps,
                 "finished": len(done),
                 "queued": len(self._queue),
-                "in_flight": len(self._active),
+                "in_flight": in_flight,
                 "peak_in_flight": self._peak_in_flight,
             }
+            groups = list(self._groups.values())
         out["failed"] = sum(1 for r in done if r.error is not None)
-        if self._page_aware and hasattr(self.model, "page_bytes"):
+        out["cancelled"] = sum(1 for r in done
+                               if isinstance(r.error, RequestCancelled))
+        if len(groups) > 1 or (groups and groups[0].key != DEFAULT_MODEL):
+            out["models"] = {
+                g.key: {"n_slots": g.n_slots, "in_flight": len(g.active),
+                        "free": len(g.free), "draining": g.draining}
+                for g in groups}
+        default = self._groups.get(DEFAULT_MODEL)
+        if default is not None and default.page_aware \
+                and hasattr(default.model, "page_bytes"):
             # capacity in BYTES, not just pages: int8 pools shrink
             # page_bytes (ISSUE 7), so the same HBM budget holds more
             # pages — surfaced here so a capacity report never re-derives
             # the bytes/slot math per kv_dtype
+            model = default.model
             out["kv"] = {
-                "kv_dtype": getattr(self.model, "kv_dtype", "float32"),
-                "page_bytes": self.model.page_bytes,
-                "pool_bytes": (self.model.page_bytes
-                               * self.model.num_pages),
+                "kv_dtype": getattr(model, "kv_dtype", "float32"),
+                "page_bytes": model.page_bytes,
+                "pool_bytes": model.page_bytes * model.num_pages,
                 "kv_bytes_per_token": (
-                    self.model.kv_bytes_per_token()
-                    if hasattr(self.model, "kv_bytes_per_token")
+                    model.kv_bytes_per_token()
+                    if hasattr(model, "kv_bytes_per_token")
                     else None),
             }
         # latency percentiles cover successfully served requests only (a

@@ -1,0 +1,723 @@
+"""Serving-gateway tests (ISSUE 10): multi-model lane ownership, the
+versioned registry with HBM budgeting and hot swap, tenant admission
+control (token buckets, SLO preemption, weighted fair share), token
+streaming with cancellation, the request journal + supervised-restart
+recovery, clean scheduler shutdown, and the HTTP front end + CLI."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                PagedTransformerGenerator, Request,
+                                RequestCancelled, SchedulerShutdown,
+                                copy_weights)
+from paddle_tpu.serving.gateway import (Gateway, GatewayServer,
+                                        HBMBudgetError, ModelRegistry,
+                                        RateLimited, TenantConfig,
+                                        TenantRouter)
+
+V, NL, NH, DK, DM, DI = 24, 2, 2, 4, 16, 32
+SRC, OUT, PS, CHUNK = 8, 8, 4, 4
+
+GEN_KW = dict(n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+              d_inner_hid=DI, max_length=64, src_len=SRC,
+              max_out_len=OUT, page_size=PS, chunk_size=CHUNK,
+              num_pages=64)
+
+
+class EchoModel:
+    """Deterministic slot model: every lane repeats its prompt's first
+    token — so a response contaminated by another request's lane is
+    immediately visible (the cross-tenant integrity check)."""
+
+    start_id, end_id = 0, 1
+    src_len = 64
+
+    def __init__(self):
+        self.n = 0
+        self.slot_val = {}
+
+    def open_slots(self, n):
+        self.n = n
+
+    def admit_slot(self, slot, prompt):
+        self.slot_val[slot] = int(np.asarray(prompt).reshape(-1)[0])
+        return len(np.asarray(prompt).reshape(-1))
+
+    def clear_slot(self, slot):
+        self.slot_val.pop(slot, None)
+
+    def step_slots(self, tokens, pos, src_len):
+        return np.array([self.slot_val.get(i, 7777)
+                         for i in range(self.n)], np.int64)
+
+
+@pytest.fixture(scope="module")
+def gen_pair():
+    """Two distinct tiny paged generators (separate params) plus a
+    same-weights clone factory for hot-swap tests."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    a = PagedTransformerGenerator(V, V, param_prefix="gwa",
+                                  executor=exe, **GEN_KW)
+    a.init_params(seed=3)
+    b = PagedTransformerGenerator(V, V, param_prefix="gwb",
+                                  executor=exe, **GEN_KW)
+    b.init_params(seed=11)
+
+    def clone(src, prefix):
+        g = PagedTransformerGenerator(V, V, param_prefix=prefix,
+                                      place=fluid.CPUPlace(), **GEN_KW)
+        copy_weights(src.scope, g.scope, prefix=prefix)
+        return g
+
+    return a, b, clone
+
+
+def _prompts(seed=0, n=4, lo=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(2, V, rng.randint(lo, SRC + 1)) for _ in range(n)]
+
+
+def _until_end(tokens, end_id=1):
+    """Scheduler semantics applied to a stop_at_end=False greedy run:
+    decode retires at the first end_id (inclusive)."""
+    toks = [int(t) for t in tokens]
+    return toks[:toks.index(end_id) + 1] if end_id in toks else toks
+
+
+# -- scheduler satellites -----------------------------------------------------
+
+def test_shutdown_drain_completes_inflight_and_fails_queued():
+    """shutdown(drain=True): stops admitting, in-flight lanes decode to
+    completion, the thread joins, queued requests fail with
+    SchedulerShutdown and are returned for resubmission."""
+    sched = ContinuousBatchingScheduler(EchoModel(), n_slots=2,
+                                        max_new_tokens=6)
+    sched.serve()
+    reqs = [sched.submit([10 + i], max_new_tokens=6) for i in range(6)]
+    # wait until some are in flight, then drain
+    for r in reqs[:2]:
+        r.wait(10)
+    leftovers = sched.shutdown(drain=True, timeout=10)
+    assert sched._thread is None
+    done = [r for r in reqs if r.error is None]
+    failed = [r for r in reqs if isinstance(r.error, SchedulerShutdown)]
+    assert len(done) + len(failed) == len(reqs)
+    for r in done:
+        assert r.tokens == [r.src[0]] * 6
+    assert set(leftovers) == set(failed)
+    st = sched.stats()
+    assert st["in_flight"] == 0 and st["queued"] == 0
+
+
+def test_cancel_queued_and_inflight():
+    sched = ContinuousBatchingScheduler(EchoModel(), n_slots=1,
+                                        max_new_tokens=8)
+    r1 = sched.submit([5], max_new_tokens=8)
+    r2 = sched.submit([6], max_new_tokens=8)
+    sched.step_once()               # r1 admitted + 1 token; r2 queued
+    r2.cancel()
+    sched.step_once()               # queue reaped
+    assert r2.done and isinstance(r2.error, RequestCancelled)
+    assert r2.slot is None
+    r1.cancel()
+    sched.step_once()               # in-flight reaped at step boundary
+    assert r1.done and isinstance(r1.error, RequestCancelled)
+    assert 1 <= len(r1.tokens) < 8  # kept the tokens it had
+    st = sched.stats()
+    assert st["cancelled"] == 2
+    assert not sched._groups["default"].active
+
+
+def test_cancel_mid_prefill_frees_pages(gen_pair):
+    """ISSUE 10 satellite: cancelling a request whose lane is still in
+    chunked prefill must free every page it held — allocator invariants
+    clean, in_use back to baseline (regression seed for the refcount
+    path)."""
+    gen, _, _ = gen_pair
+    sched = ContinuousBatchingScheduler(gen, n_slots=2,
+                                        max_new_tokens=OUT)
+    base = gen.alloc.in_use()
+    req = sched.submit(np.arange(2, 2 + SRC), max_new_tokens=OUT)
+    sched.step_once()               # admit + FIRST prefill chunk only
+    lane = gen._lanes[req.slot]
+    assert lane.phase == "prefill"  # SRC=8 > chunk=4: still prefilling
+    req.cancel()
+    sched.step_once()               # reap: clear_slot mid-prefill
+    assert req.done and isinstance(req.error, RequestCancelled)
+    gen.alloc.check_invariants()
+    assert gen.alloc.in_use() == base
+    # the lane is reusable afterwards: a fresh request decodes fine
+    ok = sched.submit(np.arange(2, 2 + SRC), max_new_tokens=2)
+    sched.run_until_idle()
+    assert ok.error is None and len(ok.tokens) == 2
+    gen.alloc.check_invariants()
+
+
+def test_multi_model_lane_ownership():
+    """One scheduler, two lane groups: requests route by model key and
+    never cross lanes."""
+    sched = ContinuousBatchingScheduler(max_new_tokens=4)
+    sched.add_model("alpha", EchoModel(), 2)
+    sched.add_model("beta", EchoModel(), 1)
+    reqs = []
+    for i in range(4):
+        reqs.append(sched.submit([100 + i], model="alpha"))
+        reqs.append(sched.submit([200 + i], model="beta"))
+    sched.run_until_idle()
+    for r in reqs:
+        assert r.error is None
+        assert r.tokens == [r.src[0]] * 4, (r.model, r.tokens)
+    st = sched.stats()
+    assert set(st["models"]) == {"alpha", "beta"}
+    assert st["finished"] == 8
+    with pytest.raises(KeyError):
+        sched.submit([1], model="gamma")
+    sched.remove_model("beta")
+    assert sched.models() == ["alpha"]
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_versioned_generator_artifact_roundtrip(tmp_path, gen_pair):
+    gen, _, _ = gen_pair
+    root = str(tmp_path)
+    d = ModelRegistry.save_generator_artifact(gen, root, "nmt", "1")
+    assert os.path.exists(os.path.join(d, "gateway.json"))
+    assert fluid.io.list_model_versions(root, "nmt") == ["1"]
+    reg = ModelRegistry(root=root)
+    key = reg.load("nmt", "1")
+    assert key == "nmt@1" and reg.resolve("nmt") == "nmt@1"
+    loaded = reg.instance("nmt")
+    prompts = _prompts(seed=5, n=2)
+    for p in prompts:
+        want = gen.greedy(p.reshape(1, -1),
+                          np.array([len(p)], np.int32),
+                          max_new=4, stop_at_end=False)
+        got = loaded.greedy(p.reshape(1, -1),
+                            np.array([len(p)], np.int32),
+                            max_new=4, stop_at_end=False)
+        np.testing.assert_array_equal(want, got)
+    entry = reg.entries()[0]
+    assert entry["kind"] == "generator" and entry["hbm_bytes"] > 0
+
+
+def test_versioned_engine_artifact_load(tmp_path):
+    """A plain save_inference_model dir (no manifest) loads as a
+    bucketed engine with output parity; the io helpers lay out and
+    enumerate versions."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        y = fluid.layers.fc(input=h, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = fluid.io.save_versioned_inference_model(
+            str(tmp_path), "mlp", "7", ["x"], [y], exe,
+            main_program=main)
+        want, = exe.run(main, feed={"x": np.ones((3, 6), np.float32)},
+                        fetch_list=[y])
+    assert fluid.io.list_model_versions(str(tmp_path), "mlp") == ["7"]
+    reg = ModelRegistry(root=str(tmp_path))
+    reg.load("mlp", "7")
+    eng = reg.instance("mlp")
+    got, = eng.infer({"x": np.ones((3, 6), np.float32)})
+    np.testing.assert_allclose(np.asarray(want), got, rtol=1e-5)
+    assert reg.entries()[0]["kind"] == "engine"
+
+
+def test_hbm_budget_rejects_and_releases(tmp_path, gen_pair):
+    gen, _, _ = gen_pair
+    root = str(tmp_path)
+    ModelRegistry.save_generator_artifact(gen, root, "m", "1")
+    ModelRegistry.save_generator_artifact(gen, root, "m", "2")
+    one_cost = ModelRegistry._estimate_cost(
+        "generator", fluid.io.model_version_dir(root, "m", "1"),
+        json.load(open(os.path.join(root, "m", "1", "gateway.json")))
+        ["config"])
+    reg = ModelRegistry(root=root, hbm_budget_bytes=int(one_cost * 1.5))
+    reg.load("m", "1")
+    with pytest.raises(HBMBudgetError):
+        reg.load("m", "2")
+    # release by unload -> the second version now fits
+    reg.unload("m@1")
+    reg.load("m", "2")
+    assert reg.resolve("m") == "m@2"
+    assert reg.hbm_used() == one_cost
+
+
+def test_alias_flip_guards(tmp_path, gen_pair):
+    gen, _, _ = gen_pair
+    root = str(tmp_path)
+    ModelRegistry.save_generator_artifact(gen, root, "m", "1")
+    ModelRegistry.save_generator_artifact(gen, root, "m", "2")
+    reg = ModelRegistry(root=root)
+    reg.load("m", "1")
+    with pytest.raises(KeyError):
+        reg.set_alias("m", "2")          # not loaded yet
+    reg.load("m", "2")
+    prev = reg.set_alias("m", "2")
+    assert prev == "m@1" and reg.resolve("m") == "m@2"
+    assert reg.resolve("m@1") == "m@1"   # pinned addresses pass through
+    with pytest.raises(ValueError):
+        reg.unload("m@2")                # current alias target
+    reg.unload("m@1")
+
+
+# -- gateway integration ------------------------------------------------------
+
+def test_two_models_one_gateway_parity(gen_pair):
+    """Acceptance: two models served concurrently through ONE gateway
+    produce per-model outputs identical to direct engine calls."""
+    gen_a, gen_b, _ = gen_pair
+    prompts = _prompts(seed=1, n=3)
+    # golden BEFORE the gateway owns the instances: greedy() reopens the
+    # generator's lanes, which must not race the scheduler's bookkeeping
+    golden = {}
+    for name, g in (("mA", gen_a), ("mB", gen_b)):
+        golden[name] = [
+            _until_end(g.greedy(p.reshape(1, -1),
+                                np.array([len(p)], np.int32),
+                                max_new=4, stop_at_end=False)[0])
+            for p in prompts]
+    gw = Gateway(n_slots=2, max_new_tokens=OUT, check_invariants=True)
+    gw.load_model("mA", "1", instance=gen_a, n_slots=2)
+    gw.load_model("mB", "1", instance=gen_b, n_slots=2)
+    reqs = []
+    for i, p in enumerate(prompts):     # interleave the two models
+        reqs.append(("mA", i, gw.submit("mA", p, max_new=4)))
+        reqs.append(("mB", i, gw.submit("mB", p, max_new=4)))
+    gw.run_until_idle()
+    for name, i, r in reqs:
+        assert r.error is None
+        assert r.tokens == golden[name][i], (name, i)
+    st = gw.stats()
+    assert st["scheduler"]["finished"] == 6
+    gw.unload_model("mA")
+    gw.unload_model("mB")
+
+
+def test_hot_swap_zero_loss_zero_recompile(gen_pair):
+    """Acceptance: swapping a model mid-traffic loses zero in-flight or
+    queued requests, queued requests follow the alias to the new
+    version, and the new version needs zero steady-state recompiles
+    after its warmup."""
+    gen_a, _, clone = gen_pair
+    v2 = clone(gen_a, "gwa")            # same weights, fresh instance
+    prompts = _prompts(seed=2, n=6)
+    golden = [_until_end(gen_a.greedy(p.reshape(1, -1),
+                                      np.array([len(p)], np.int32),
+                                      max_new=4, stop_at_end=False)[0])
+              for p in prompts]
+    gw = Gateway(n_slots=2, max_new_tokens=OUT, check_invariants=True)
+    gw.load_model("m", "1", instance=gen_a, n_slots=2)
+    gw.serve()
+    try:
+        reqs = [gw.submit("m", p, max_new=4) for p in prompts[:4]]
+        gw.swap_model("m", "2", instance=v2)     # mid-traffic
+        # post-warmup counter mark on the NEW version's executor
+        miss0 = v2.exe.cache_stats()["executable"]["misses"]
+        reqs += [gw.submit("m", p, max_new=4) for p in prompts[4:]]
+        for r in reqs:
+            assert r.wait(60), "request lost across the hot swap"
+            assert r.error is None
+        for r, want in zip(reqs, golden):
+            assert r.tokens == want     # same weights => same tokens
+        assert v2.exe.cache_stats()["executable"]["misses"] == miss0, \
+            "steady-state recompile after hot-swap warmup"
+    finally:
+        gw.shutdown(drain=True)
+    # the old version is unloaded and off the books
+    assert [e["key"] for e in gw.registry.entries()] == ["m@2"]
+    # every post-swap request ran on the new version
+    assert all(r.group == "m@2" for r in reqs[4:])
+
+
+def test_streaming_token_parity_and_cancel(gen_pair):
+    """Acceptance: the streamed sequence is token-for-token the blocking
+    sequence; closing the stream cancels and frees the lane's pages."""
+    gen_a, _, _ = gen_pair
+    gw = Gateway(n_slots=2, max_new_tokens=OUT, check_invariants=True)
+    gw.load_model("m", "1", instance=gen_a)
+    p = _prompts(seed=4, n=1)[0]
+    blocking = gw.submit("m", p, max_new=6)
+    gw.run_until_idle()
+    gw.serve()
+    try:
+        with gw.submit_stream("m", p, max_new=6, timeout=30) as stream:
+            streamed = list(stream)
+        assert streamed == blocking.tokens
+        # cancellation: one token, then close -> pages released
+        s2 = gw.submit_stream("m", p, max_new=OUT, timeout=30)
+        first = next(s2)
+        assert first == blocking.tokens[0]
+        s2.close()
+        assert s2.request.wait(30)
+        assert isinstance(s2.request.error, RequestCancelled)
+    finally:
+        gw.shutdown(drain=True)
+    gen_a.alloc.check_invariants()
+    assert gen_a.alloc.in_use() == 0
+
+
+def test_journal_replay_resubmits_unfinished(tmp_path):
+    """Supervised-restart contract: requests journaled but unfinished in
+    a dead process are resubmitted by the next one; finished requests
+    are not replayed (no duplicates)."""
+    path = str(tmp_path / "gw.journal")
+    gw1 = Gateway(n_slots=1, max_new_tokens=4, journal_path=path)
+    gw1.load_model("m", "1", instance=EchoModel(), warm=False)
+    done = gw1.submit("m", [41], max_new=4)
+    gw1.run_until_idle()
+    assert done.error is None
+    # these two are journaled but the "process" dies before they run
+    gw1.submit("m", [42], max_new=4)
+    gw1.submit("m", [43], max_new=4)
+    assert len(gw1.journal.pending()) == 2
+    del gw1
+    # restarted process: same journal, fresh scheduler + model
+    gw2 = Gateway(n_slots=1, max_new_tokens=4, journal_path=path)
+    gw2.load_model("m", "1", instance=EchoModel(), warm=False)
+    recovered = gw2.recover()
+    assert [int(r.src[0]) for r in recovered] == [42, 43]
+    gw2.run_until_idle()
+    for r in recovered:
+        assert r.error is None and r.tokens == [r.src[0]] * 4
+    assert gw2.journal.pending() == []
+
+
+# -- tenant router ------------------------------------------------------------
+
+def test_token_bucket_rate_limit_deterministic():
+    clock = [0.0]
+    router = TenantRouter(
+        tenants=[TenantConfig("t", slo="latency", rate=10.0, burst=20.0)],
+        now_fn=lambda: clock[0])
+    router.check_submit("t", 15.0)       # burst covers it
+    with pytest.raises(RateLimited):
+        router.check_submit("t", 10.0)   # 5 left < 10
+    clock[0] = 1.0                       # +10 tokens refilled
+    router.check_submit("t", 10.0)
+    st = router.stats()["tenants"]["t"]
+    assert st["rejected"] == 1 and st["slo"] == "latency"
+
+
+def test_latency_preempts_batch_at_admission_only():
+    """A queued latency request takes the next free slot ahead of every
+    queued batch request; in-flight batch requests are never evicted."""
+    router = TenantRouter(
+        tenants=[TenantConfig("fast", slo="latency"),
+                 TenantConfig("bulk", slo="batch")],
+        reserve_latency_slots=1)
+    sched = ContinuousBatchingScheduler(
+        EchoModel(), n_slots=2, max_new_tokens=4,
+        admission_policy=router.admission_policy)
+    router.bind(lambda: sched.n_slots, sched.queued_requests)
+    bulk = [sched.submit([20 + i], tenant="bulk", max_new_tokens=4)
+            for i in range(6)]
+    sched.step_once()
+    # reserve holds one lane open even with batch work queued
+    assert len(sched._groups["default"].active) == 1
+    fast = sched.submit([9], tenant="fast", max_new_tokens=4)
+    sched.step_once()
+    assert fast.slot is not None, "latency request not admitted next"
+    first_bulk = bulk[0]
+    assert first_bulk.slot is not None and first_bulk.error is None
+    sched.run_until_idle()
+    for r in bulk + [fast]:
+        assert r.error is None and r.tokens == [r.src[0]] * 4
+
+
+def test_tenant_isolation_p95_bound_under_flood():
+    """ISSUE 10 satellite + acceptance: a flooding batch tenant runs
+    alongside a paced latency tenant.  STATED BOUND: with one reserved
+    latency lane and non-overlapping latency requests, a latency
+    request completes within (1 admission step + max_new) scheduler
+    steps of submission, independent of flood depth.  Also: zero lost,
+    duplicated, or cross-tenant-contaminated responses."""
+    rng = np.random.RandomState(7)
+    router = TenantRouter(
+        tenants=[TenantConfig("interactive", slo="latency"),
+                 TenantConfig("flood", slo="batch")],
+        reserve_latency_slots=1)
+    sched = ContinuousBatchingScheduler(
+        EchoModel(), n_slots=3, max_new_tokens=4,
+        admission_policy=router.admission_policy)
+    router.bind(lambda: sched.n_slots, sched.queued_requests)
+    MAX_NEW = 4
+    flood = [sched.submit([1000 + i], tenant="flood",
+                          max_new_tokens=MAX_NEW) for i in range(40)]
+    lat_reqs = []       # (request, submit_step, done_step)
+    pending = []
+    step = 0
+    next_lat = 0
+    while sched.step_once() or pending or next_lat < 8:
+        step += 1
+        if step % 6 == 1 and next_lat < 8:  # paced: no overlap
+            r = sched.submit([rng.randint(2, 999)],
+                             tenant="interactive",
+                             max_new_tokens=MAX_NEW)
+            pending.append((r, step))
+            next_lat += 1
+        for r, s0 in list(pending):
+            if r.done:
+                pending.remove((r, s0))
+                lat_reqs.append((r, s0, step))
+        if step > 500:
+            pytest.fail("scheduler failed to drain")
+    assert len(lat_reqs) == 8
+    BOUND = 1 + MAX_NEW              # the stated bound, in steps
+    waits = sorted(done - s0 for _, s0, done in lat_reqs)
+    p95 = waits[int(np.ceil(0.95 * len(waits))) - 1]
+    assert p95 <= BOUND, f"latency p95 {p95} steps > bound {BOUND}"
+    # integrity: every response echoes ITS OWN prompt, nothing lost
+    for r, _, _ in lat_reqs:
+        assert r.error is None
+        assert r.tokens == [r.src[0]] * MAX_NEW, "cross-tenant leak"
+    for r in flood:
+        assert r.error is None and r.tokens == [r.src[0]] * MAX_NEW
+    assert len({r.rid for r, _, _ in lat_reqs}) == 8
+
+
+def test_weighted_fair_share_between_tenants():
+    """Two batch tenants at weight 2:1 split admissions ~2:1 under
+    contention."""
+    router = TenantRouter(
+        tenants=[TenantConfig("heavy", slo="batch", weight=2.0),
+                 TenantConfig("light", slo="batch", weight=1.0)],
+        reserve_latency_slots=0)
+    sched = ContinuousBatchingScheduler(
+        EchoModel(), n_slots=1, max_new_tokens=2,
+        admission_policy=router.admission_policy)
+    router.bind(lambda: sched.n_slots, sched.queued_requests)
+    hv = [sched.submit([300 + i], tenant="heavy", max_new_tokens=2)
+          for i in range(12)]
+    lt = [sched.submit([400 + i], tenant="light", max_new_tokens=2)
+          for i in range(12)]
+    order = []
+    while sched.step_once():
+        for r in hv + lt:
+            if r.admitted is not None and r.rid not in [x[0]
+                                                        for x in order]:
+                order.append((r.rid, r.tenant))
+    first12 = [t for _, t in order[:12]]
+    heavy_share = first12.count("heavy")
+    assert 7 <= heavy_share <= 9, first12   # ~2/3 of early slots
+    st = router.stats()["tenants"]
+    assert st["heavy"]["admitted"] == 12     # everyone drains in the end
+    assert st["light"]["admitted"] == 12
+
+
+# -- HTTP front end + CLI -----------------------------------------------------
+
+def _post(addr, route, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://{addr}{route}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_http_generate_models_errors(gen_pair):
+    gen_a, _, _ = gen_pair
+    router = TenantRouter(tenants=[
+        TenantConfig("limited", slo="batch", rate=0.001, burst=6.0)])
+    gw = Gateway(router=router, n_slots=2, max_new_tokens=OUT)
+    gw.load_model("m", "1", instance=gen_a)
+    srv = GatewayServer(gw)
+    addr = srv.start()
+    try:
+        p = [int(t) for t in _prompts(seed=6, n=1)[0]]
+        blocking = json.loads(_post(addr, "/v1/generate",
+                                    {"model": "m", "prompt": p,
+                                     "max_new": 4}).read())
+        assert len(blocking["tokens"]) == 4
+        assert blocking["version"] == "1"
+        # chunked streaming parity
+        resp = _post(addr, "/v1/generate",
+                     {"model": "m", "prompt": p, "max_new": 4,
+                      "stream": True})
+        lines = [json.loads(ln) for ln in
+                 resp.read().decode().splitlines()]
+        toks = [ln["token"] for ln in lines if "token" in ln]
+        assert toks == blocking["tokens"]
+        assert lines[-1]["done"] and lines[-1]["tokens"] == 4
+        # /v1/models reflects the registry
+        got = json.loads(urllib.request.urlopen(
+            f"http://{addr}/v1/models", timeout=10).read())
+        assert got["aliases"] == {"m": "1"}
+        # error mapping: 404 unknown model, 429 rate limit, 400 bad body
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(addr, "/v1/generate", {"model": "nope", "prompt": [2]})
+        assert e.value.code == 404
+        _post(addr, "/v1/generate",
+              {"model": "m", "prompt": p[:2], "max_new": 2,
+               "tenant": "limited"}).read()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(addr, "/v1/generate",
+                  {"model": "m", "prompt": p, "max_new": OUT,
+                   "tenant": "limited"})
+        assert e.value.code == 429
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(addr, "/v1/generate", {"model": "m", "prompt": []})
+        assert e.value.code == 400
+        status = json.loads(urllib.request.urlopen(
+            f"http://{addr}/statusz", timeout=10).read())
+        assert "registry" in status and "router" in status
+    finally:
+        srv.stop()
+
+
+def test_gateway_cli_roundtrip(gen_pair, capsys):
+    from paddle_tpu.tools.gateway import main as cli
+    gen_a, _, _ = gen_pair
+    gw = Gateway(n_slots=2, max_new_tokens=OUT)
+    gw.load_model("m", "1", instance=gen_a)
+    srv = GatewayServer(gw)
+    addr = srv.start()
+    try:
+        assert cli(["models", addr]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["aliases"] == {"m": "1"}
+        assert cli(["status", addr]) == 0
+        assert "scheduler" in json.loads(capsys.readouterr().out)
+        assert cli(["generate", addr, "m", "--prompt", "3 5 7",
+                    "--max-new", "3"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert len(out["tokens"]) == 3
+        assert cli(["generate", addr, "m", "--prompt", "3 5 7",
+                    "--max-new", "3", "--stream"]) == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.splitlines()]
+        assert [ln["token"] for ln in lines
+                if "token" in ln] == out["tokens"]
+    finally:
+        srv.stop()
+    assert cli(["status", "127.0.0.1:1"]) == 2     # unreachable
+
+
+def test_journal_closes_rejected_submissions(tmp_path):
+    """A submit the scheduler refuses (infeasible prompt) must close its
+    journal entry, and recover() must skip — not crash on — any poison
+    entry that still slips through (review findings 2)."""
+    from paddle_tpu.serving import PoolCapacityError
+
+    path = str(tmp_path / "gw.journal")
+    gen = PagedTransformerGenerator(
+        V, V, n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+        d_inner_hid=DI, max_length=64, src_len=SRC, max_out_len=OUT,
+        page_size=PS, chunk_size=CHUNK, num_pages=6,  # tiny pool
+        param_prefix="gwj", place=fluid.CPUPlace())
+    gen.init_params(seed=5)
+    gw = Gateway(n_slots=1, max_new_tokens=OUT, journal_path=path)
+    gw.load_model("m", "1", instance=gen, warm=False)
+    with pytest.raises(PoolCapacityError):
+        gw.submit("m", np.arange(2, 2 + SRC), max_new=OUT)
+    assert gw.journal.pending() == []   # entry opened AND closed
+    # seed a poison entry by hand (as if written right before a crash)
+    gw.journal.record_submit("poison-1", "default", "m",
+                             list(range(2, 2 + SRC)), OUT)
+    gw.journal.record_submit("ok-1", "default", "m", [2, 3], 1)
+    gw2 = Gateway(n_slots=1, max_new_tokens=OUT, journal_path=path)
+    gw2.load_model("m", "1", instance=gen, warm=False)
+    recovered = gw2.recover()           # must not raise
+    assert [r.jid for r in recovered] == ["ok-1"]
+    gw2.run_until_idle()
+    assert gw2.journal.pending() == []  # poison closed as failed
+
+
+def test_completion_releases_on_token_closure():
+    """Finished requests must not pin their callback's captures (review
+    finding 3: a gateway callback captures the model instance — keeping
+    it would hold an unloaded version's KV pool after a hot swap)."""
+    sched = ContinuousBatchingScheduler(EchoModel(), n_slots=1,
+                                        max_new_tokens=2)
+    seen = []
+    req = sched.submit([5], on_token=lambda r, t: seen.append(t))
+    sched.run_until_idle()
+    assert seen == [5, 5, None]         # tokens + completion sentinel
+    assert req.on_token is None
+
+
+def test_unload_refusal_leaves_model_serving(gen_pair):
+    """unload_model of the alias target with another version loaded
+    must refuse BEFORE touching lanes (review finding 4) — the model
+    keeps serving afterwards."""
+    gen_a, _, clone = gen_pair
+    v2 = clone(gen_a, "gwa")
+    gw = Gateway(n_slots=1, max_new_tokens=OUT)
+    gw.load_model("m", "1", instance=gen_a, n_slots=1)
+    gw.load_model("m", "2", instance=v2, n_slots=1, warm=False)
+    with pytest.raises(ValueError):
+        gw.unload_model("m")            # alias target, v2 also loaded
+    # the lane group survived the refusal: the model still serves
+    r = gw.submit("m", _prompts(seed=9, n=1)[0], max_new=2)
+    gw.run_until_idle()
+    assert r.error is None and len(r.tokens) >= 1
+    gw.unload_model("m@2")              # non-alias version: fine
+    gw.unload_model("m@1")
+
+
+def test_cli_strip_supervise_keeps_subcommand():
+    """--supervise re-exec must keep the 'serve' subcommand (review
+    finding 1: dropping it made supervised mode unable to start)."""
+    from paddle_tpu.tools.gateway import _strip_supervise
+
+    argv = ["serve", "--root", "store", "--model", "m=1",
+            "--supervise", "2", "--exit-on-wedge", "30"]
+    assert _strip_supervise(argv) == [
+        "serve", "--root", "store", "--model", "m=1",
+        "--exit-on-wedge", "30"]
+    assert _strip_supervise(["serve", "--supervise=3", "--port",
+                             "1"]) == ["serve", "--port", "1"]
+
+
+# -- observability satellite --------------------------------------------------
+
+def test_gateway_metric_series_and_statusz_sources(gen_pair):
+    """paddle_gateway_* series carry tenant/model/version labels in the
+    shared registry; registry + router attach to /statusz as duck-typed
+    sources."""
+    from paddle_tpu.observability.metrics import registry as obs_registry
+    from paddle_tpu.observability.server import ObservabilityServer
+
+    gen_a, _, _ = gen_pair
+    router = TenantRouter(tenants=[TenantConfig("acme", slo="latency")])
+    gw = Gateway(router=router, n_slots=2, max_new_tokens=OUT)
+    # a model name no other test uses: collector samples SUM across
+    # every still-live registry, so shared names would skew the values
+    gw.load_model("obsM", "1", instance=gen_a)
+    r = gw.submit("obsM", _prompts(seed=8, n=1)[0], tenant="acme",
+                  max_new=3)
+    gw.run_until_idle()
+    assert r.error is None
+    text = obs_registry().render_prometheus()
+    assert 'paddle_gateway_requests_total{tenant="acme",model="obsM",' \
+           'version="1",event="finished"}' in text
+    assert 'paddle_gateway_tokens_total{tenant="acme",model="obsM"}' \
+        in text
+    assert 'paddle_gateway_model_hbm_bytes{model="obsM",version="1"' \
+        in text
+    assert 'paddle_gateway_model_current{model="obsM",version="1"} 1' \
+        in text
+    obs = ObservabilityServer()
+    obs.attach("gateway_registry", gw.registry)
+    obs.attach("gateway_router", gw.router)
+    obs.attach("gateway", gw)
+    try:
+        status = obs.statusz()
+        assert status["gateway_registry"]["aliases"] == {"obsM": "1"}
+        assert "acme" in status["gateway_router"]["tenants"]
+        assert status["gateway_router"]["tenants"]["acme"]["queued"] == 0
+        assert "scheduler" in status["gateway"]
+    finally:
+        obs.stop()
+    gw.unload_model("obsM")

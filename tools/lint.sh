@@ -194,6 +194,42 @@ with open(os.path.join(tmpdir, "serving_int8_ragged_step.json"), "wb") as f:
     f.write(qprog.desc.serialize_to_string())
 with open(os.path.join(tmpdir, "serving_int8_ragged_step.fetch"), "w") as f:
     f.write(qids.name + "\n")
+
+# gateway sweep (ISSUE 10): every program the registry builds for a
+# loaded model version must stay analyzer-clean — round-trip a
+# generator artifact AND an engine artifact through ModelRegistry.load
+# and plint what the loaded instances will actually dispatch
+from paddle_tpu.serving.gateway import ModelRegistry
+
+groot = os.path.join(tmpdir, "model-store")
+ModelRegistry.save_generator_artifact(pgen, groot, "gen", "1")
+greg = ModelRegistry(root=groot, place=fluid.CPUPlace())
+greg.load("gen", "1")
+ginst = greg.instance("gen")
+gw_prog, _, gw_ids, _ = ginst._unified
+with open(os.path.join(tmpdir, "gateway_generator_step.json"), "wb") as f:
+    f.write(gw_prog.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "gateway_generator_step.fetch"), "w") as f:
+    f.write(gw_ids.name + "\n")
+
+emain, estartup = fluid.Program(), fluid.Program()
+escope = fluid.Scope()
+with fluid.program_guard(emain, estartup), fluid.unique_name.guard():
+    ex = fluid.layers.data(name="ex", shape=[6], dtype="float32")
+    ey = fluid.layers.fc(input=ex, size=4)
+eexe = fluid.Executor(fluid.CPUPlace())
+with fluid.scope_guard(escope):
+    eexe.run(estartup)
+    fluid.io.save_versioned_inference_model(groot, "mlp", "1", ["ex"],
+                                            [ey], eexe,
+                                            main_program=emain)
+greg.load("mlp", "1")
+einst = greg.instance("mlp")
+with open(os.path.join(tmpdir, "gateway_engine.json"), "wb") as f:
+    f.write(einst.program.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "gateway_engine.fetch"), "w") as f:
+    f.write("".join(str(v.name if hasattr(v, "name") else v) + "\n"
+                    for v in einst.fetch_list))
 EOF
   for prog in "$tmpdir"/*.json; do
     name="$(basename "$prog" .json)"
