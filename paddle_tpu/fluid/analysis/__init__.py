@@ -18,7 +18,13 @@ Entry points:
 
 Levels: ``"structural"`` runs the desc-only passes (structural, dataflow,
 grad_link, sharding); ``"full"`` adds the abstract shape/dtype re-check,
-which traces every registered emitter with ``jax.eval_shape``.
+which traces every registered emitter with ``jax.eval_shape``;
+``"cost"`` runs the structural passes plus the static cost family —
+the liveness-based peak-HBM planner + roofline op cost model
+(cost.py), the recompile-hazard lint with closed bucket-set
+enumeration (recompile.py), and the sharded-collective estimator
+(comms.py).  Cost-family passes attach structured data to
+``Diagnostics.reports`` alongside their findings.
 """
 
 from __future__ import annotations
@@ -28,16 +34,24 @@ from typing import List, Optional, Sequence
 from .dataflow import ProgramView, block_liveness, live_ops
 from .diagnostics import ERROR, INFO, WARNING, Diagnostics, Finding
 from .passes import PASSES, AnalysisContext
+from .cost import (CHIP_SPECS, ChipSpec, OpCost, cost_rule, get_chip,
+                   plan_program, roofline)
+from .comms import estimate_comms
+from .recompile import enumerate_buckets
 
 __all__ = ["Diagnostics", "Finding", "ERROR", "WARNING", "INFO",
            "ProgramView", "block_liveness", "live_ops",
            "LEVELS", "analyze_program", "structural_errors",
-           "ProgramValidationError"]
+           "ProgramValidationError", "ChipSpec", "CHIP_SPECS",
+           "get_chip", "OpCost", "cost_rule", "plan_program",
+           "roofline", "estimate_comms", "enumerate_buckets"]
 
 LEVELS = {
     "structural": ("structural", "dataflow", "grad_link", "sharding"),
     "full": ("structural", "dataflow", "grad_link", "sharding",
              "shape_check"),
+    "cost": ("structural", "dataflow", "grad_link", "sharding",
+             "cost", "recompile", "comms"),
 }
 
 
@@ -66,13 +80,16 @@ def _fetch_names(fetch) -> List[str]:
 
 def analyze_program(program, level: str = "full",
                     fetch: Optional[Sequence] = None,
-                    passes: Optional[Sequence[str]] = None) -> Diagnostics:
+                    passes: Optional[Sequence[str]] = None,
+                    options: Optional[dict] = None) -> Diagnostics:
     """Run the pass suite over ``program`` (a Program, ProgramDesc, or
     anything with a ``.desc``).
 
     ``fetch`` (var names or Variables) seeds the liveness roots — pass the
     values you intend to read so dead-code findings reflect real intent.
     ``passes`` overrides the level's pass selection by name.
+    ``options`` feeds the cost-family passes (assume_batch, chip,
+    budget_bytes, batch_buckets/time_buckets, mesh_axes, dcn_axes).
     """
     if level not in LEVELS:
         raise ValueError(f"analyze_program: level must be one of "
@@ -82,7 +99,7 @@ def analyze_program(program, level: str = "full",
     if unknown:
         raise ValueError(f"analyze_program: unknown passes {sorted(unknown)}")
     ctx = AnalysisContext(_desc_of(program), fetch=_fetch_names(fetch),
-                          fetch_given=fetch is not None)
+                          fetch_given=fetch is not None, options=options)
     diag = Diagnostics()
     for name, fn in PASSES:
         if name in selected:

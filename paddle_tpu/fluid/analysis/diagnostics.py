@@ -93,6 +93,9 @@ class Diagnostics:
 
     def __init__(self, findings: Optional[Iterable[Finding]] = None):
         self.findings: List[Finding] = list(findings or ())
+        # structured pass outputs (cost/recompile/comms reports): data
+        # too rich for a Finding message — {pass_name: dict}
+        self.reports: Dict[str, Any] = {}
 
     def add(self, finding: Finding) -> Finding:
         self.findings.append(finding)
@@ -136,10 +139,13 @@ class Diagnostics:
         return "\n".join(lines + [counts]) if lines else counts
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"findings": [f.to_dict() for f in self.findings],
-                "counts": {"error": len(self.errors()),
-                           "warning": len(self.warnings()),
-                           "info": len(self.infos())}}
+        out = {"findings": [f.to_dict() for f in self.findings],
+               "counts": {"error": len(self.errors()),
+                          "warning": len(self.warnings()),
+                          "info": len(self.infos())}}
+        if self.reports:
+            out["reports"] = dict(self.reports)
+        return out
 
     def __iter__(self):
         return iter(self.findings)

@@ -24,7 +24,7 @@ and emit into one ``Diagnostics`` report (diagnostics.py):
 
 from __future__ import annotations
 
-from typing import Dict, Sequence, Set
+from typing import Dict, Optional, Sequence, Set
 
 from ..core.registry import GRAD_SUFFIX, get_op_info, has_op
 from ..core.types import VarType, canonical_dtype
@@ -33,20 +33,25 @@ from .dataflow import (CONTROL_FLOW_OPS, HOST_IO_OPS, ProgramView,
 from .diagnostics import ERROR, INFO, WARNING, Diagnostics, Finding
 
 __all__ = ["AnalysisContext", "PASSES", "structural_pass", "dataflow_pass",
-           "grad_link_pass", "sharding_pass", "shape_check_pass"]
+           "grad_link_pass", "sharding_pass", "shape_check_pass",
+           "cost_pass", "recompile_pass", "comms_pass"]
 
 
 class AnalysisContext:
-    """Everything a pass needs: the raw desc, the shared view, and the
+    """Everything a pass needs: the raw desc, the shared view, the
     fetch roots (vars the caller intends to read — executor fetch_list /
-    plint --fetch)."""
+    plint --fetch), and free-form ``options`` the cost-family passes
+    read (assume_batch, chip, budget_bytes, batch/time_buckets,
+    mesh_axes, dcn_axes — see cost.py / recompile.py / comms.py)."""
 
     def __init__(self, desc, fetch: Sequence[str] = (),
-                 fetch_given: bool = False):
+                 fetch_given: bool = False,
+                 options: Optional[Dict] = None):
         self.desc = desc
         self.view = ProgramView(desc)
         self.fetch = tuple(fetch)
         self.fetch_given = fetch_given or bool(fetch)
+        self.options = dict(options or {})
 
 
 # ---------------------------------------------------------------------------
@@ -509,6 +514,12 @@ def shape_check_pass(ctx: AnalysisContext, diag: Diagnostics) -> None:
                             slot=f"{slot}#{pos}", var=n))
 
 
+# the cost-family passes (ISSUE 11) live in their own modules; they
+# share AnalysisContext/Diagnostics and register here like any pass
+from .comms import comms_pass                              # noqa: E402
+from .cost import cost_pass                                # noqa: E402
+from .recompile import recompile_pass                      # noqa: E402
+
 # ordered registry: cheap structural truths first, tracing last
 PASSES = [
     ("structural", structural_pass),
@@ -516,4 +527,7 @@ PASSES = [
     ("grad_link", grad_link_pass),
     ("sharding", sharding_pass),
     ("shape_check", shape_check_pass),
+    ("cost", cost_pass),
+    ("recompile", recompile_pass),
+    ("comms", comms_pass),
 ]

@@ -189,6 +189,11 @@ class Executor:
             # because the (fingerprint, level) was already validated
             "validate": {"runs": 0, "cached": 0},
         }
+        # the same counters keyed by analysis level (ISSUE 11 satellite):
+        # a level="cost" run after a "structural" one is a fresh run, and
+        # the per-level split makes that visible instead of folding every
+        # level into one runs/cached pair
+        self._validate_by_level: Dict[str, Dict[str, int]] = {}
         # (program fingerprint, level) pairs already analyzed clean —
         # the analyzer runs once per program STRUCTURE, not per step
         self._validated: set = set()
@@ -264,18 +269,23 @@ class Executor:
         out["executable"]["size"] = len(self._cache)
         out["structure"]["size"] = len(self._cls_cache)
         out["validate"]["size"] = len(self._validated)
+        out["validate"]["by_level"] = {
+            lv: dict(c) for lv, c in self._validate_by_level.items()}
         return out
 
     # -- static-analysis pre-flight -----------------------------------------
     @staticmethod
     def _validate_level(validate: Optional[str]) -> str:
         """Resolve the effective pre-flight level: explicit arg wins, else
-        the PADDLE_TPU_VALIDATE env flag, else off."""
+        the PADDLE_TPU_VALIDATE env flag, else off.  Any analysis LEVELS
+        key is accepted — "cost" pre-flights the static cost family."""
         level = (validate if validate is not None
                  else os.environ.get("PADDLE_TPU_VALIDATE", "off"))
-        if level not in ("off", "structural", "full"):
+        from .analysis import LEVELS
+
+        if level != "off" and level not in LEVELS:
             raise ValueError(
-                f"validate must be 'off', 'structural' or 'full', "
+                f"validate must be 'off' or one of {sorted(LEVELS)}, "
                 f"got {level!r}")
         return level
 
@@ -284,12 +294,18 @@ class Executor:
         """Run the static analyzer once per (program fingerprint, level);
         raise ProgramValidationError on error-severity findings.  The
         fingerprint cache makes validate="full" effectively free on the
-        steps after the first (the <5% overhead contract)."""
+        steps after the first (the <5% overhead contract).  Counters key
+        on the LEVEL too: a "cost" run after a "structural" one of the
+        same program is a fresh analysis, not a cache hit."""
         key = (prog_fp, level)
+        by_level = self._validate_by_level.setdefault(
+            level, {"runs": 0, "cached": 0})
         if key in self._validated:
             self._stats["validate"]["cached"] += 1
+            by_level["cached"] += 1
             return
         self._stats["validate"]["runs"] += 1
+        by_level["runs"] += 1
         from .analysis import ProgramValidationError, analyze_program
 
         diag = analyze_program(program, level=level, fetch=fetch_names)
@@ -701,6 +717,46 @@ class Executor:
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else None
         return dict(ca or {})
+
+    def memory_analysis(self, program: Optional[Program] = None,
+                        feed: Optional[Dict[str, Any]] = None,
+                        fetch_list: Optional[Sequence] = None,
+                        scope: Optional[Scope] = None,
+                        mode: str = "train") -> Dict[str, float]:
+        """XLA's buffer-assignment view of one compiled step — argument/
+        output/temp/alias bytes — WITHOUT executing it.  ``peak_bytes``
+        (arguments + outputs + temps) is the measured counterpart of the
+        static planner's peak (fluid/analysis/cost.plan_program): the
+        pair is what bench.py's ``cost_model`` section gates against
+        each other.  Returns {} when the PJRT plugin exposes no memory
+        stats."""
+        import jax
+
+        feed, state_vals, step = self._prepare_step(program, feed,
+                                                    fetch_list, scope, mode)
+        import numpy as _np
+
+        lowered = jax.jit(step, donate_argnums=(1,)).lower(
+            feed, state_vals, _np.zeros(2, _np.int32))
+        try:
+            ma = lowered.compile().memory_analysis()
+        except Exception:
+            ma = None
+        if ma is None:
+            return {}
+        out = {
+            "argument_bytes": float(ma.argument_size_in_bytes),
+            "output_bytes": float(ma.output_size_in_bytes),
+            "temp_bytes": float(ma.temp_size_in_bytes),
+            "alias_bytes": float(ma.alias_size_in_bytes),
+            "generated_code_bytes": float(ma.generated_code_size_in_bytes),
+        }
+        # aliased (donated) buffers appear in argument_size and serve as
+        # outputs in place — arguments + outputs + temps double-counts
+        # exactly the aliased bytes
+        out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"] - out["alias_bytes"])
+        return out
 
     def device_time_per_step(self, program: Optional[Program] = None,
                              feed: Optional[Dict[str, Any]] = None,

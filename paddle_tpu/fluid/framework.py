@@ -527,17 +527,22 @@ class Program:
         self._seed = seed
 
     def analyze(self, level: str = "full", fetch_list=None,
-                passes=None):
+                passes=None, options=None):
         """Run the static analyzer (fluid/analysis) over this program —
         dataflow verification, grad-graph lint, sharding/donation safety,
         and (at ``level="full"``) abstract shape/dtype re-checking against
-        the recorded descs.  Returns a ``Diagnostics`` report; pass
-        ``fetch_list`` (vars or names you intend to read) so dead-code
-        findings reflect real intent."""
+        the recorded descs.  ``level="cost"`` instead runs the static
+        cost family (peak-HBM planner, roofline estimate, recompile-
+        hazard lint, comms estimator); ``options`` feeds those passes
+        (assume_batch, chip, budget_bytes, batch/time_buckets,
+        mesh_axes, dcn_axes) and their structured output lands in the
+        returned report's ``.reports``.  Returns a ``Diagnostics``
+        report; pass ``fetch_list`` (vars or names you intend to read)
+        so dead-code findings reflect real intent."""
         from .analysis import analyze_program
 
         return analyze_program(self, level=level, fetch=fetch_list,
-                               passes=passes)
+                               passes=passes, options=options)
 
     def list_vars(self):
         for b in self.blocks:

@@ -19,15 +19,17 @@ __all__ = ["memory_optimize", "liveness_stats"]
 
 
 def _python_stats(program: Program, block_idx: int = 0) -> dict:
-    """Fallback liveness — now a thin consumer of the analyzer's shared
-    liveness infrastructure (fluid/analysis/dataflow.block_liveness):
-    program order = schedule; live range [first def, last use]; greedy
-    interval coloring for slot count.  Walks the DESC ops — the same
-    view the native lib parses — so a desc-only op cannot make the two
-    backends disagree."""
-    from .analysis.dataflow import block_liveness
+    """Fallback liveness — a thin consumer of the cost planner's byte
+    timeline (fluid/analysis/cost.legacy_stats), which itself consumes
+    the ONE shared live-range derivation (dataflow.block_liveness): the
+    native-compatible keys (topo_order/level/live_range/reuse_slot/
+    num_slots) come straight through, plus the planner's byte view
+    (peak_transient_bytes / peak_op / byte_timeline).  Walks the DESC
+    ops — the same view the native lib parses — so a desc-only op
+    cannot make the two backends disagree."""
+    from .analysis.cost import legacy_stats
 
-    return block_liveness(program.blocks[block_idx].desc)
+    return legacy_stats(program.desc, block_idx)
 
 
 def liveness_stats(program: Program = None, block_idx: int = 0) -> dict:
@@ -52,8 +54,12 @@ def memory_optimize(input_program: Program = None, print_log: bool = False):
     n_vars = len(stats["live_range"])
     reusable = max(0, n_vars - stats["num_slots"])
     if print_log:
+        peak = stats.get("peak_transient_bytes")
+        extra = (f"; peak transient live set "
+                 f"{peak / 2**20:.2f} MiB at op#{stats.get('peak_op')}"
+                 if peak is not None else "")
         print(f"[memory_optimize] {n_vars} transient vars fit in "
-              f"{stats['num_slots']} buffer slots ({reusable} reuses); "
-              f"XLA buffer assignment performs the rewrite, no program "
-              f"mutation needed")
+              f"{stats['num_slots']} buffer slots ({reusable} reuses)"
+              f"{extra}; XLA buffer assignment performs the rewrite, no "
+              f"program mutation needed")
     return reusable

@@ -303,6 +303,38 @@ class InferenceEngine:
         shared with training may hold unrelated host values."""
         return fluid.io.device_put_persistables(self.scope, self.program)
 
+    # -- static cost surface (ISSUE 11) --------------------------------------
+    def static_hbm_estimate(self, batch: Optional[int] = None):
+        """Static peak-HBM plan of the served program at ``batch``
+        (default: the largest configured batch bucket — the worst
+        signature this engine will ever dispatch).  The gateway
+        registry and the scheduler budget with this number."""
+        from ..fluid.analysis.cost import plan_program
+
+        b = int(batch) if batch is not None else max(self.batch_buckets)
+        return plan_program(self.program, assume_batch=b)
+
+    def bucket_set(self, max_time: Optional[int] = None):
+        """Enumerate the closed set of compile signatures this engine
+        can dispatch — the recompile-hazard lint's enumeration (ISSUE
+        11), and exactly what an AOT executable cache must pre-compile.
+        Ragged (SeqArray) feeds need ``max_time`` to close the time
+        axis: the time buckets are the multiples of ``time_bucket`` up
+        to it."""
+        from ..fluid.analysis.dataflow import ProgramView
+        from ..fluid.analysis.recompile import enumerate_buckets
+
+        time_buckets = ()
+        if max_time is not None:
+            # top bucket rounds UP, matching _time_pad: a request of
+            # max_time tokens must land on an enumerated signature
+            time_buckets = tuple(range(self.time_bucket,
+                                       self._time_pad(int(max_time)) + 1,
+                                       self.time_bucket))
+        return enumerate_buckets(ProgramView(self.program.desc),
+                                 batch_buckets=self.batch_buckets,
+                                 time_buckets=time_buckets)
+
     def cache_stats(self) -> Dict[str, Any]:
         """{'bucket_hits', 'bucket_misses', 'buckets': {key: count},
         'padding': true-vs-padded row/token counters, 'executable':

@@ -12,11 +12,17 @@ processes:
   (``save_generator_artifact``: the paged decoder's weights plus a
   ``gateway.json`` manifest of its constructor config) served by a
   ``PagedTransformerGenerator``.
-* **HBM budget**: every load is costed BEFORE construction — a paged
-  generator's KV pool via the shared ``kv_page_bytes`` formula (ISSUE
-  6/7 accounting), weights via artifact bytes on disk — and a load that
-  would exceed ``hbm_budget_bytes`` is refused with ``HBMBudgetError``
-  instead of OOMing the chip mid-traffic.
+* **HBM budget**: every load is costed BEFORE construction by the
+  STATIC peak-HBM planner (fluid/analysis/cost.plan_program, ISSUE 11)
+  — a paged generator's program desc is built from the manifest config
+  alone (params + KV pool + int8 scale sidecar are persistable vars
+  with recorded shapes, activations priced at the planner's assumed
+  lane count), an engine's saved ``__model__`` program is planned at
+  its largest batch bucket — and a load that would exceed
+  ``hbm_budget_bytes`` is refused with ``HBMBudgetError`` carrying the
+  per-component breakdown instead of OOMing the chip mid-traffic.
+  (The pre-ISSUE-11 heuristic — artifact bytes + ``kv_page_bytes *
+  num_pages``, blind to activations — is gone.)
 * **atomic alias flip**: ``resolve("name")`` maps the model alias to
   the key ``name@version`` of the CURRENT version; ``set_alias`` flips
   it under the lock.  The scheduler resolves aliases at ADMISSION, so
@@ -38,9 +44,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ... import fluid
-from ..engine import InferenceEngine
+from ..engine import DEFAULT_BATCH_BUCKETS, InferenceEngine
 from ..paged_decoder import (PagedTransformerGenerator, _CACHE_MARKERS,
-                             kv_page_bytes)
+                             estimate_generator_hbm)
+from ..scheduler import HBMBudgetError
 
 __all__ = ["HBMBudgetError", "ModelRegistry", "MANIFEST_NAME"]
 
@@ -100,11 +107,6 @@ def _register_registry_collector() -> None:
 
         _m().register_collector(_collect_registry_metrics)
         _collector_registered = True
-
-
-class HBMBudgetError(RuntimeError):
-    """Loading this model version would exceed the registry's HBM
-    budget — unload something (or raise the budget) first."""
 
 
 def _artifact_bytes(dirname: str) -> int:
@@ -210,30 +212,58 @@ class ModelRegistry:
         with self._lock:
             return sum(e.hbm_bytes for e in self._entries.values())
 
-    def _charge(self, cost: int, what: str) -> None:
+    def _charge(self, cost: int, what: str,
+                components: Optional[Dict] = None) -> None:
         if self.hbm_budget_bytes is None:
             return
         used = self.hbm_used()
         if used + cost > self.hbm_budget_bytes:
+            detail = ""
+            if components:
+                detail = " (" + ", ".join(
+                    f"{k}={v}" for k, v in components.items() if v) + ")"
             raise HBMBudgetError(
-                f"loading {what} needs {cost} HBM bytes but only "
-                f"{self.hbm_budget_bytes - used} of "
+                f"loading {what} needs {cost} static peak-HBM bytes"
+                f"{detail} but only {self.hbm_budget_bytes - used} of "
                 f"{self.hbm_budget_bytes} remain "
                 f"({used} in use) — unload a version first")
 
     @staticmethod
+    def _estimate_cost_detail(kind: str, dirname: Optional[str],
+                              config: Dict):
+        """(static peak bytes, per-component breakdown) BEFORE any
+        device allocation, from the analyzer's peak-HBM planner (ISSUE
+        11): a generator's unified program desc is built straight from
+        the manifest config (the KV pool and its int8 scale sidecar are
+        persistable vars with recorded shapes — no separate
+        kv_page_bytes term), an engine's saved ``__model__`` program is
+        planned at its largest declared batch bucket."""
+        if kind == "generator":
+            plan = estimate_generator_hbm(config)
+            return int(plan.peak_bytes), dict(plan.components)
+        if kind == "engine" and dirname:
+            model_path = os.path.join(dirname, "__model__")
+            if os.path.isfile(model_path):
+                from ...fluid.analysis.cost import plan_program
+                from ...fluid.framework import Program
+
+                with open(model_path, "rb") as f:
+                    prog = Program.parse_from_string(f.read())
+                buckets = config.get("batch_buckets") \
+                    or DEFAULT_BATCH_BUCKETS
+                plan = plan_program(prog,
+                                    assume_batch=int(max(buckets)))
+                return int(plan.peak_bytes), dict(plan.components)
+        # no program to plan (adopted instance, bare artifact dir):
+        # artifact bytes are the only static signal left
+        cost = _artifact_bytes(dirname) if dirname else 0
+        return cost, {"artifact": cost}
+
+    @staticmethod
     def _estimate_cost(kind: str, dirname: Optional[str],
                        config: Dict) -> int:
-        """Budget cost BEFORE any device allocation: weights from the
-        artifact bytes on disk, plus — for generators — the KV pool via
-        the shared kv_page_bytes formula (the ISSUE 6/7 accounting)."""
-        cost = _artifact_bytes(dirname) if dirname else 0
-        if kind == "generator":
-            cost += kv_page_bytes(
-                int(config["n_layer"]), int(config["n_head"]),
-                int(config["d_key"]), int(config.get("page_size", 8)),
-                config.get("kv_dtype", "float32")) \
-                * int(config["num_pages"])
+        cost, _ = ModelRegistry._estimate_cost_detail(kind, dirname,
+                                                      config)
         return cost
 
     # -- loading -------------------------------------------------------------
@@ -258,8 +288,9 @@ class ModelRegistry:
         kind = manifest.get("kind", "engine")
         config = dict(manifest.get("config", {}))
         config.update(overrides)
-        cost = self._estimate_cost(kind, dirname, config)
-        self._charge(cost, key)
+        cost, components = self._estimate_cost_detail(kind, dirname,
+                                                      config)
+        self._charge(cost, key, components)
         if kind == "generator":
             instance = self._build_generator(dirname, config)
         elif kind == "engine":
@@ -295,18 +326,25 @@ class ModelRegistry:
     def register(self, name: str, version: str, instance,
                  hbm_bytes: Optional[int] = None) -> str:
         """Adopt an already-constructed instance (in-process loads,
-        tests, bench).  Costed by its own accounting when available:
-        paged pool bytes or dense per-slot bytes."""
+        tests, bench).  Costed by the instance's own static planner
+        estimate when it has one (the same number ``load`` computes
+        from a manifest), else its legacy byte accounting."""
         name, version = str(name), str(version)
         key = f"{name}@{version}"
+        components = None
         if hbm_bytes is None:
-            if hasattr(instance, "page_bytes"):
+            est = getattr(instance, "static_hbm_estimate", None)
+            if callable(est):
+                plan = est()
+                hbm_bytes = plan.peak_bytes
+                components = dict(plan.components)
+            elif hasattr(instance, "page_bytes"):
                 hbm_bytes = instance.page_bytes * instance.num_pages
             elif hasattr(instance, "kv_bytes_per_slot"):
                 hbm_bytes = instance.kv_bytes_per_slot()
             else:
                 hbm_bytes = 0
-        self._charge(int(hbm_bytes), key)
+        self._charge(int(hbm_bytes), key, components)
         kind = ("generator"
                 if isinstance(instance, PagedTransformerGenerator)
                 else "engine" if isinstance(instance, InferenceEngine)

@@ -46,7 +46,9 @@ from .decoder import _Cfg, dense_kv_bytes_per_slot
 from .paging import (PageAllocator, PoolCapacityError, TRASH_PAGE,
                      chunk_hashes)
 
-__all__ = ["PagedTransformerGenerator", "copy_weights", "kv_page_bytes"]
+__all__ = ["PagedTransformerGenerator", "copy_weights", "kv_page_bytes",
+           "build_unified_program", "estimate_generator_hbm",
+           "default_num_pages"]
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -104,6 +106,116 @@ def copy_weights(src_scope, dst_scope, prefix: Optional[str] = None) -> int:
         dst_scope.set_var(name, np.array(np.asarray(val)))
         n += 1
     return n
+
+
+def default_num_pages(src_len: int, max_out_len: int,
+                      page_size: int) -> int:
+    """The ctor's pool-sizing default: room for ~8 worst-case requests
+    (+ the trash page)."""
+    p_src = _ceil_div(src_len, page_size)
+    p_out = _ceil_div(max_out_len, page_size)
+    return 8 * (2 * p_src + p_out) + 1
+
+
+def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
+                          page_size: int, num_pages: int, chunk_size: int,
+                          param_prefix: str, kv_dtype: str = "float32"):
+    """Build the unified prefill+decode program DESC — pure Python, no
+    device allocation, no scope.  The generator's ``_build_unified``
+    calls this with its own config; the gateway registry calls it with
+    a manifest config to run the static peak-HBM planner BEFORE any
+    construction (the pool/sidecar are persistable vars with recorded
+    shapes, so the planner prices the full serving footprint from the
+    desc alone).  Returns ``(prog, startup, next_ids, logits)``."""
+    c = cfg
+    C = int(chunk_size)
+    p_src = _ceil_div(int(src_len), int(page_size))
+    p_out = _ceil_div(int(max_out_len), int(page_size))
+    pool_shape = [c.n_head, int(num_pages) * c.n_layer * 2,
+                  int(page_size), c.d_key]
+    scales_shape = [1, int(num_pages) * c.n_layer * 2, int(page_size)]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        block = prog.global_block()
+        pool = block.create_var(name=f"{param_prefix}@kv_pool",
+                                shape=pool_shape, dtype=kv_dtype,
+                                persistable=True)
+        kv_scales = None
+        if kv_dtype == "int8":
+            kv_scales = block.create_var(
+                name=f"{param_prefix}@kv_scales", shape=scales_shape,
+                dtype="float32", persistable=True)
+        pf_word = layers.data("pf_word", [C], "int64")
+        pf_pos = layers.data("pf_pos", [C], "int64")
+        pf_base = layers.data("pf_base", [], "int32")
+        pf_len = layers.data("pf_len", [], "int32")
+        enc_table = layers.data("enc_table", [p_src], "int32")
+        enc_pages = layers.data("enc_pages", [C], "int32")
+        cross_pages = layers.data("cross_pages", [C], "int32")
+        w_offsets = layers.data("w_offsets", [C], "int32")
+        T.paged_prefill_chunk(
+            pf_word, pf_pos, pf_base, pf_len, enc_table, enc_pages,
+            cross_pages, w_offsets, pool, c.src_vocab_size,
+            c.max_length, c.n_layer, c.n_head, c.d_key, c.d_value,
+            c.d_model, c.d_inner_hid, param_prefix,
+            kv_scales=kv_scales)
+        trg_word = layers.data("trg_word", [1], "int64")
+        trg_pos = layers.data("trg_pos", [1], "int64")
+        self_table = layers.data("self_table", [p_out], "int32")
+        self_pages = layers.data("self_pages", [1], "int32")
+        self_offsets = layers.data("self_offsets", [1], "int32")
+        self_lengths = layers.data("self_lengths", [], "int32")
+        self_base = layers.data("self_base", [], "int32")
+        cross_table = layers.data("cross_table", [p_src], "int32")
+        src_lengths = layers.data("src_lengths", [], "int32")
+        logits = T.paged_decode_step(
+            trg_word, trg_pos, self_table, self_pages, self_offsets,
+            self_lengths, self_base, cross_table, src_lengths, pool,
+            c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
+            c.d_key, c.d_value, c.d_model, c.d_inner_hid, param_prefix,
+            kv_scales=kv_scales)
+        next_ids = layers.argmax(logits, axis=-1)
+    return prog, startup, next_ids, logits
+
+
+# lanes assumed when pricing a generator's activations before any
+# scheduler attaches (matches the default_num_pages ~8-request sizing)
+HBM_ESTIMATE_LANES = 8
+
+
+def estimate_generator_hbm(config: Dict, assume_lanes: int = None):
+    """Static peak-HBM plan for a paged generator described by a
+    gateway manifest config — built and planned as a DESC, before any
+    device allocation.  Params, the KV pool, and the int8 scale sidecar
+    are persistable vars with recorded shapes; activations price at
+    ``assume_lanes`` in-flight lanes.  Returns the
+    ``analysis.cost.ProgramMemoryPlan``."""
+    from ..fluid.analysis.cost import plan_program
+
+    cfg = _Cfg(int(config["src_vocab_size"]),
+               int(config["trg_vocab_size"]),
+               int(config.get("n_layer", 6)),
+               int(config.get("n_head", 8)),
+               int(config.get("d_key", 64)),
+               int(config.get("d_value", 64)),
+               int(config.get("d_model", 512)),
+               int(config.get("d_inner_hid", 2048)),
+               int(config.get("max_length", 256)))
+    src_len = int(config.get("src_len", 64))
+    max_out_len = int(config.get("max_out_len", 64))
+    page_size = int(config.get("page_size", 8))
+    num_pages = config.get("num_pages")
+    if num_pages is None:
+        num_pages = default_num_pages(src_len, max_out_len, page_size)
+    prog, _, _, _ = build_unified_program(
+        cfg, src_len=src_len, max_out_len=max_out_len,
+        page_size=page_size, num_pages=int(num_pages),
+        chunk_size=int(config.get("chunk_size", 8)),
+        param_prefix=str(config.get("param_prefix", "tf")),
+        kv_dtype=str(config.get("kv_dtype", "float32")))
+    lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
+        else int(assume_lanes)
+    return plan_program(prog, assume_batch=lanes)
 
 
 class _Lane:
@@ -176,8 +288,10 @@ class PagedTransformerGenerator:
         self.p_src = _ceil_div(self.src_len, self.page_size)
         self.p_out = _ceil_div(self.max_out_len, self.page_size)
         if num_pages is None:
-            # default: room for ~8 worst-case requests (+ trash page)
-            num_pages = 8 * (2 * self.p_src + self.p_out) + 1
+            # shared with estimate_generator_hbm: the registry's static
+            # admission plan must price the pool the ctor allocates
+            num_pages = default_num_pages(self.src_len, self.max_out_len,
+                                          self.page_size)
         self.num_pages = int(num_pages)
         self.alloc = PageAllocator(self.num_pages, self.page_size)
         self.scope = scope or fluid.Scope()
@@ -233,43 +347,11 @@ class PagedTransformerGenerator:
         over every lane.  Lanes not in a given phase ride along with
         trash-page writes and length-1 masks — so any mix of admitting /
         prefilling / decoding lanes replays the same executable."""
-        c = self.cfg
-        C = self.chunk
-        prog, startup = fluid.Program(), fluid.Program()
-        with fluid.program_guard(prog, startup), fluid.unique_name.guard():
-            pool = self._pool_var(prog.global_block())
-            kv_scales = self._scales_var(prog.global_block())
-            pf_word = layers.data("pf_word", [C], "int64")
-            pf_pos = layers.data("pf_pos", [C], "int64")
-            pf_base = layers.data("pf_base", [], "int32")
-            pf_len = layers.data("pf_len", [], "int32")
-            enc_table = layers.data("enc_table", [self.p_src], "int32")
-            enc_pages = layers.data("enc_pages", [C], "int32")
-            cross_pages = layers.data("cross_pages", [C], "int32")
-            w_offsets = layers.data("w_offsets", [C], "int32")
-            T.paged_prefill_chunk(
-                pf_word, pf_pos, pf_base, pf_len, enc_table, enc_pages,
-                cross_pages, w_offsets, pool, c.src_vocab_size,
-                c.max_length, c.n_layer, c.n_head, c.d_key, c.d_value,
-                c.d_model, c.d_inner_hid, self.prefix,
-                kv_scales=kv_scales)
-            trg_word = layers.data("trg_word", [1], "int64")
-            trg_pos = layers.data("trg_pos", [1], "int64")
-            self_table = layers.data("self_table", [self.p_out], "int32")
-            self_pages = layers.data("self_pages", [1], "int32")
-            self_offsets = layers.data("self_offsets", [1], "int32")
-            self_lengths = layers.data("self_lengths", [], "int32")
-            self_base = layers.data("self_base", [], "int32")
-            cross_table = layers.data("cross_table", [self.p_src], "int32")
-            src_lengths = layers.data("src_lengths", [], "int32")
-            logits = T.paged_decode_step(
-                trg_word, trg_pos, self_table, self_pages, self_offsets,
-                self_lengths, self_base, cross_table, src_lengths, pool,
-                c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
-                c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix,
-                kv_scales=kv_scales)
-            next_ids = layers.argmax(logits, axis=-1)
-        self._unified = (prog, startup, next_ids, logits)
+        self._unified = build_unified_program(
+            self.cfg, src_len=self.src_len, max_out_len=self.max_out_len,
+            page_size=self.page_size, num_pages=self.num_pages,
+            chunk_size=self.chunk, param_prefix=self.prefix,
+            kv_dtype=self.kv_dtype)
 
     def _build_beam_step(self, W: int):
         """Paged beam step: in-dispatch copy-on-write page copies, the
@@ -767,6 +849,24 @@ class PagedTransformerGenerator:
         block-scale sidecar, so the bf16->int8 ratio is the honest
         ~2x, not an idealised 2.0)."""
         return self.page_bytes // self.page_size
+
+    def static_hbm_estimate(self, assume_lanes: int = None):
+        """Static peak-HBM plan of the unified serving program (params
+        + KV pool + int8 sidecar + per-dispatch activations at
+        ``assume_lanes``) — the number the gateway registry budgets
+        with and the scheduler surfaces per lane group (ISSUE 11:
+        admission runs on the planner, not a byte-count heuristic)."""
+        from ..fluid.analysis.cost import plan_program
+
+        lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
+            else int(assume_lanes)
+        key = ("_hbm_plan", lanes)
+        cached = getattr(self, "_static_hbm_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        plan = plan_program(self._unified[0], assume_batch=lanes)
+        self._static_hbm_cache = (key, plan)
+        return plan
 
     def cache_stats(self) -> Dict[str, object]:
         """Page / prefix / HBM accounting next to the executor's

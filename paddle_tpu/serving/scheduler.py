@@ -77,9 +77,17 @@ from ..observability import tracing as _obs_tracing
 from .paging import PoolCapacityError
 
 __all__ = ["Request", "ContinuousBatchingScheduler", "RequestCancelled",
-           "SchedulerShutdown", "DEFAULT_MODEL"]
+           "SchedulerShutdown", "HBMBudgetError", "DEFAULT_MODEL"]
 
 DEFAULT_MODEL = "default"
+
+
+class HBMBudgetError(RuntimeError):
+    """Admitting this model would exceed the declared HBM budget —
+    unload something (or raise the budget) first.  Raised by both the
+    scheduler's ``add_model`` (when constructed with
+    ``hbm_budget_bytes``) and the gateway registry's costed load; the
+    message carries the static planner's per-component breakdown."""
 
 # tokens-per-request is a count histogram, not a latency one
 _TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
@@ -228,12 +236,25 @@ class _LaneGroup:
     """One model's lanes inside the scheduler: the model, its free/active
     slot bookkeeping, and the per-lane host state its step feed reads."""
 
-    def __init__(self, key: str, model, n_slots: int):
+    def __init__(self, key: str, model, n_slots: int,
+                 hbm_bytes: Optional[int] = None):
         self.key = key
         self.model = model
         self.n_slots = int(n_slots)
         self.page_aware = bool(getattr(model, "page_aware", False))
         self.managed = callable(getattr(model, "lane_step", None))
+        # the static planner's peak-HBM estimate for this group (ISSUE
+        # 11): explicit override > model.static_hbm_estimate at the
+        # group's lane count > unknown (0).  The scheduler's model-level
+        # admission and stats() consult this, not a byte-count heuristic.
+        if hbm_bytes is None:
+            est = getattr(model, "static_hbm_estimate", None)
+            if callable(est):
+                try:
+                    hbm_bytes = est(assume_lanes=self.n_slots).peak_bytes
+                except TypeError:
+                    hbm_bytes = est().peak_bytes
+        self.static_hbm_bytes = int(hbm_bytes or 0)
         model.open_slots(self.n_slots)
         self.free = list(range(self.n_slots))
         self.active: Dict[int, Request] = {}
@@ -251,8 +272,17 @@ class ContinuousBatchingScheduler:
     def __init__(self, model=None, n_slots: Optional[int] = None,
                  max_new_tokens: int = 32,
                  resolve: Optional[Callable[[str], str]] = None,
-                 admission_policy: Optional[Callable] = None):
+                 admission_policy: Optional[Callable] = None,
+                 hbm_budget_bytes: Optional[int] = None):
         self.default_max_new = int(max_new_tokens)
+        # optional chip-level budget: add_model refuses a group whose
+        # static peak-HBM estimate would push the total past it.  The
+        # reservation counter holds a group's bytes from the (locked)
+        # budget check until the group registers, so two concurrent
+        # add_model calls cannot both pass against the same headroom.
+        self.hbm_budget_bytes = (None if hbm_budget_bytes is None
+                                 else int(hbm_budget_bytes))
+        self._hbm_reserved = 0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._groups: Dict[str, _LaneGroup] = {}
@@ -308,16 +338,72 @@ class ContinuousBatchingScheduler:
         _register_scheduler_collector()
 
     # -- model registry surface ----------------------------------------------
-    def add_model(self, key: str, model, n_slots: int) -> None:
+    def _hbm_committed_locked(self) -> int:
+        return (sum(g.static_hbm_bytes for g in self._groups.values())
+                + self._hbm_reserved)
+
+    def hbm_committed(self) -> int:
+        """Sum of the registered groups' static peak-HBM estimates
+        (plus in-flight add_model reservations)."""
+        with self._lock:
+            return self._hbm_committed_locked()
+
+    def can_admit_model(self, hbm_bytes: int) -> bool:
+        """Would a group with this static estimate fit the budget?
+        (Always true without a declared budget.)"""
+        if self.hbm_budget_bytes is None:
+            return True
+        return self.hbm_committed() + int(hbm_bytes) \
+            <= self.hbm_budget_bytes
+
+    def add_model(self, key: str, model, n_slots: int,
+                  hbm_bytes: Optional[int] = None) -> None:
         """Register a lane group for ``model`` under ``key``.  The
         group's ``open_slots`` device work runs before the group becomes
-        visible, so the serve loop never steps a half-built group."""
-        group = _LaneGroup(str(key), model, n_slots)
-        with self._work:
-            if group.key in self._groups:
-                raise ValueError(f"model {key!r} already registered")
-            self._groups[group.key] = group
-            self._work.notify()
+        visible, so the serve loop never steps a half-built group.
+        ``hbm_bytes`` overrides the group's static peak-HBM estimate
+        (default: ``model.static_hbm_estimate()`` when available); with
+        a declared ``hbm_budget_bytes``, an estimate that does not fit
+        raises ``HBMBudgetError`` before any lane opens.  The check and
+        the registration are atomic against concurrent add_model calls:
+        the estimate is reserved under the lock while the group builds."""
+        reserved = 0
+        if self.hbm_budget_bytes is not None:
+            est = hbm_bytes
+            if est is None:
+                fn = getattr(model, "static_hbm_estimate", None)
+                if callable(fn):
+                    try:
+                        est = fn(assume_lanes=int(n_slots)).peak_bytes
+                    except TypeError:
+                        est = fn().peak_bytes
+            est = int(est or 0)
+            with self._lock:
+                committed = self._hbm_committed_locked()
+                if committed + est > self.hbm_budget_bytes:
+                    raise HBMBudgetError(
+                        f"model {key!r} needs ~{est} static peak-HBM "
+                        f"bytes but only "
+                        f"{self.hbm_budget_bytes - committed} of "
+                        f"{self.hbm_budget_bytes} remain "
+                        f"({committed} committed)")
+                self._hbm_reserved += est
+            reserved = est
+            hbm_bytes = est
+        try:
+            group = _LaneGroup(str(key), model, n_slots,
+                               hbm_bytes=hbm_bytes)
+            with self._work:
+                if group.key in self._groups:
+                    raise ValueError(f"model {key!r} already registered")
+                self._hbm_reserved -= reserved
+                reserved = 0
+                self._groups[group.key] = group
+                self._work.notify()
+        finally:
+            if reserved:
+                with self._lock:
+                    self._hbm_reserved -= reserved
 
     def remove_model(self, key: str, drain: bool = True,
                      timeout: float = 30.0) -> None:
@@ -813,8 +899,15 @@ class ContinuousBatchingScheduler:
         if len(groups) > 1 or (groups and groups[0].key != DEFAULT_MODEL):
             out["models"] = {
                 g.key: {"n_slots": g.n_slots, "in_flight": len(g.active),
-                        "free": len(g.free), "draining": g.draining}
+                        "free": len(g.free), "draining": g.draining,
+                        "static_hbm_bytes": g.static_hbm_bytes}
                 for g in groups}
+        if self.hbm_budget_bytes is not None:
+            out["hbm"] = {
+                "budget_bytes": self.hbm_budget_bytes,
+                "committed_bytes": sum(g.static_hbm_bytes
+                                       for g in groups),
+            }
         default = self._groups.get(DEFAULT_MODEL)
         if default is not None and default.page_aware \
                 and hasattr(default.model, "page_bytes"):

@@ -5,15 +5,23 @@
     python -m paddle_tpu.tools.plint model/__model__.json
     python -m paddle_tpu.tools.plint prog.json --level structural
     python -m paddle_tpu.tools.plint prog.json --fetch mean_0.tmp_0 --json
+    python -m paddle_tpu.tools.plint prog.json --cost --budget 16000000000
+    python -m paddle_tpu.tools.plint prog.json --cost --batch-bucket 8 \
+        --fail-on unregistered-cost-rule --fail-on value-shape-op
 
 Programs that arrive via serialization (save_inference_model output,
 checkpoints, transpiled programs shipped between processes) are exactly
-the ones no build-time check ever saw — plint runs the full analyzer
-suite (fluid/analysis) over the canonical-JSON wire format and reports
-every finding with block/op coordinates.
+the ones no build-time check ever saw — plint runs the analyzer suite
+(fluid/analysis) over the canonical-JSON wire format and reports every
+finding with block/op coordinates.  ``--cost`` switches to the static
+cost family (peak-HBM planner, roofline estimate, recompile-hazard
+lint + bucket enumeration, sharded-comms tally); ``--budget BYTES``
+turns "static peak exceeds budget" into an error-severity finding, so
+the exit status doubles as an admission gate.
 
-Exit status: 0 = no error-severity findings, 1 = errors found,
-2 = could not read/parse the input.
+Exit status: 0 = no error-severity findings (and no ``--fail-on``
+matches), 1 = errors (or matches) found, 2 = could not read/parse the
+input.
 """
 
 from __future__ import annotations
@@ -40,13 +48,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("program", help="path to a serialized program "
                     "(canonical JSON, as written by "
                     "ProgramDesc.serialize_to_string / save_inference_model)")
-    ap.add_argument("--level", choices=("structural", "full"),
+    ap.add_argument("--level", choices=("structural", "full", "cost"),
                     default="full",
                     help="structural = desc-only passes; full adds the "
-                         "abstract shape/dtype re-check (default)")
+                         "abstract shape/dtype re-check (default); cost "
+                         "runs the static cost family instead")
+    ap.add_argument("--cost", action="store_true",
+                    help="shorthand for --level cost")
     ap.add_argument("--fetch", action="append", default=None,
                     metavar="VAR", help="var name you intend to fetch "
                     "(liveness root for dead-code findings; repeatable)")
+    ap.add_argument("--budget", type=int, default=None, metavar="BYTES",
+                    help="HBM budget: the statically planned peak "
+                    "exceeding it is an error (exit 1)")
+    ap.add_argument("--chip", default=None,
+                    help="chip spec for the roofline/comms estimate "
+                    "(v2/v3/v4/v5e/v5p/v6e; default: detected or v5e)")
+    ap.add_argument("--assume-batch", type=int, default=1, metavar="N",
+                    help="substitute N for dynamic batch dims in the "
+                    "byte/flop accounting (default 1)")
+    ap.add_argument("--batch-bucket", action="append", type=int,
+                    default=None, metavar="N",
+                    help="declared batch bucket for the bucket-set "
+                    "enumeration (repeatable)")
+    ap.add_argument("--time-bucket", action="append", type=int,
+                    default=None, metavar="N",
+                    help="declared time bucket for ragged feeds "
+                    "(repeatable)")
+    ap.add_argument("--mesh-axis", action="append", default=None,
+                    metavar="AXIS=N", help="mesh axis extent for the "
+                    "comms estimate, e.g. --mesh-axis dp=8 (repeatable)")
+    ap.add_argument("--dcn-axis", action="append", default=None,
+                    metavar="AXIS", help="mesh axis that crosses hosts "
+                    "(priced at DCN bandwidth; repeatable)")
+    ap.add_argument("--fail-on", action="append", default=None,
+                    metavar="CODE", help="exit 1 if any finding carries "
+                    "this code, regardless of severity (repeatable) — "
+                    "e.g. unregistered-cost-rule, value-shape-op")
     ap.add_argument("--json", action="store_true",
                     help="emit the findings as JSON instead of text")
     ap.add_argument("--max-findings", type=int, default=None,
@@ -64,13 +102,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"plint: cannot load {args.program!r}: {e}", file=sys.stderr)
         return 2
 
-    diag = program.analyze(level=args.level, fetch_list=args.fetch)
+    level = "cost" if args.cost else args.level
+    options = {"assume_batch": args.assume_batch}
+    if args.budget is not None:
+        options["budget_bytes"] = args.budget
+    if args.chip:
+        options["chip"] = args.chip
+    if args.batch_bucket:
+        options["batch_buckets"] = tuple(args.batch_bucket)
+    if args.time_bucket:
+        options["time_buckets"] = tuple(args.time_bucket)
+    if args.dcn_axis:
+        options["dcn_axes"] = tuple(args.dcn_axis)
+    if args.mesh_axis:
+        axes = {}
+        for spec in args.mesh_axis:
+            name, _, size = spec.partition("=")
+            if not size:
+                print(f"plint: --mesh-axis wants AXIS=N, got {spec!r}",
+                      file=sys.stderr)
+                return 2
+            axes[name] = int(size)
+        options["mesh_axes"] = axes
+
+    diag = program.analyze(level=level, fetch_list=args.fetch,
+                           options=options)
     if args.json:
         print(json.dumps(diag.to_dict(), indent=2, sort_keys=True))
     else:
         print(diag.render(max_findings=args.max_findings,
                           min_severity="warning" if args.quiet else "info"))
-    return 1 if diag.has_errors else 0
+    failed = diag.has_errors
+    for code in (args.fail_on or ()):
+        hits = diag.by_code(code)
+        if hits:
+            failed = True
+            print(f"plint: --fail-on {code}: {len(hits)} finding(s)",
+                  file=sys.stderr)
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

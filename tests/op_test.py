@@ -39,7 +39,7 @@ class OpTestCase:
         self.n_outputs = n_outputs
 
     # -- program construction ------------------------------------------------
-    def _build(self, out_slots: Dict[str, int]):
+    def _build(self, out_slots: Dict[str, int], infer_shape: bool = False):
         main = fluid.Program()
         startup = fluid.Program()
         scope = fluid.Scope()
@@ -72,7 +72,7 @@ class OpTestCase:
                     block.create_var(name=f"out_{slot}_{i}")
                     for i in range(n)]
             block.append_op(self.op_type, in_vars, out_vars, self.attrs,
-                            infer_shape=False)
+                            infer_shape=infer_shape)
         return main, startup, scope, feed, in_vars, out_vars
 
     @staticmethod
@@ -154,6 +154,42 @@ class OpTestCase:
                     g.astype(np.float64), np.asarray(e_arr, np.float64),
                     atol=atol, rtol=rtol,
                     err_msg=f"{self.op_type} output {slot}")
+
+    def check_cost(self, expect_flops: float = None,
+                   expect_bytes_read: float = None,
+                   expect_bytes_written: float = None,
+                   expect_registered: bool = True):
+        """Golden test for the op's registered analytic cost rule
+        (fluid/analysis/cost): build the one-op program and compare the
+        rule's flops / HBM bytes read / bytes written against
+        hand-computed expectations.  Exact equality — the cost model is
+        arithmetic over recorded descs, not a measurement."""
+        from paddle_tpu.fluid.analysis.cost import CostEnv, op_cost
+        from paddle_tpu.fluid.analysis.dataflow import ProgramView
+
+        out_slots = self._discover_outputs()
+        main, _startup, _scope, _feed, _ins, _outs = self._build(
+            out_slots, infer_shape=True)
+        view = ProgramView(main.desc)
+        od = main.global_block().desc.ops[-1]
+        assert od.type == self.op_type
+        env = CostEnv(view, 0)
+        cost = op_cost(env, od)
+        assert cost.registered == expect_registered, (
+            f"{self.op_type}: registered={cost.registered}")
+        if expect_flops is not None:
+            assert cost.flops == expect_flops, (
+                f"{self.op_type} flops: got {cost.flops}, "
+                f"want {expect_flops}")
+        if expect_bytes_read is not None:
+            assert cost.bytes_read == expect_bytes_read, (
+                f"{self.op_type} bytes_read: got {cost.bytes_read}, "
+                f"want {expect_bytes_read}")
+        if expect_bytes_written is not None:
+            assert cost.bytes_written == expect_bytes_written, (
+                f"{self.op_type} bytes_written: got "
+                f"{cost.bytes_written}, want {expect_bytes_written}")
+        return cost
 
     def check_grad(self, inputs_to_check: Sequence[str],
                    output_slots: Optional[Sequence[str]] = None,

@@ -242,6 +242,27 @@ EOF
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m paddle_tpu.tools.plint "$prog" --quiet $fetch_args || rc=1
   done
+
+  # cost sweep (ISSUE 11): the static cost family over the book
+  # programs AND the paged int8 decode-step program — recompile-hazard
+  # errors fail via the normal error exit, and an op one of these
+  # programs uses with no registered cost rule fails via --fail-on
+  # (the analyzer guessing about the flagship programs is a defect)
+  for name in digits_conv word2vec resnet_cifar serving_int8_ragged_step; do
+    prog="$tmpdir/$name.json"
+    [ -f "$prog" ] || { echo "-- plint --cost $name: MISSING"; rc=1; continue; }
+    fetch_args=""
+    while read -r v; do
+      [ -n "$v" ] && fetch_args="$fetch_args --fetch $v"
+    done < "$tmpdir/$name.fetch"
+    echo "-- plint --cost $name"
+    # shellcheck disable=SC2086
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m paddle_tpu.tools.plint "$prog" --cost --quiet \
+        --assume-batch 64 --batch-bucket 8 \
+        --fail-on unregistered-cost-rule --fail-on value-shape-op \
+        $fetch_args || rc=1
+  done
 fi
 
 exit $rc
