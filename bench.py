@@ -895,6 +895,130 @@ def bench_gateway(trials: int, n_slots: int = 8, decode_len: int = 16):
     }
 
 
+def bench_release(trials: int, n_slots: int = 4, decode_len: int = 8):
+    """ISSUE 12 lifecycle measurement: wall time of a full candidate →
+    canary → promote cycle and of a degraded-candidate auto-rollback
+    (the verdict read from the live paddle_gateway_* series), with the
+    loop's safety contract measured rather than asserted: zero lost
+    requests and zero steady-state recompiles on the stable executor
+    across both cycles.  The model is deliberately small — this
+    section measures the RELEASE layer (gating, canary slicing, alias
+    flips), not the compute."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.lifecycle import ReleaseConfig, ReleaseController
+    from paddle_tpu.serving import PagedTransformerGenerator, copy_weights
+    from paddle_tpu.serving.gateway import Gateway
+
+    vocab, src_len = 256, 16
+    kw = dict(n_layer=2, n_head=2, d_key=8, d_value=8, d_model=32,
+              d_inner_hid=64, max_length=src_len + decode_len + 2,
+              src_len=src_len, max_out_len=decode_len, page_size=8,
+              chunk_size=8, num_pages=4 * n_slots * 8 + 1)
+    gen1 = PagedTransformerGenerator(vocab, vocab, param_prefix="rlb",
+                                     **kw)
+    gen1.init_params(seed=0)
+    # candidates own their executors: the steady-state recompile claim
+    # is about the STABLE version's executor staying untouched while
+    # candidates come and go
+    good = PagedTransformerGenerator(vocab, vocab, param_prefix="rlb",
+                                     **kw)
+    copy_weights(gen1.scope, good.scope, prefix="rlb")
+    degraded = PagedTransformerGenerator(vocab, vocab,
+                                         param_prefix="rlb", **kw)
+    degraded.init_params(seed=99)
+    loader = {"1": gen1, "2": good, "3": degraded}
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, vocab, int(rng.randint(4, src_len + 1)))
+               for _ in range(12)]
+    probe_prompts = [[int(t) for t in p] for p in prompts[:3]]
+    golden = {}
+    for p in prompts:
+        toks = [int(t) for t in gen1.greedy(
+            np.asarray(p).reshape(1, -1),
+            np.array([len(p)], np.int32), max_new=decode_len,
+            stop_at_end=False)[0]]
+        golden[tuple(int(t) for t in p)] = (
+            toks[:toks.index(1) + 1] if 1 in toks else toks)
+
+    def quality_fn(prompt, tokens):
+        return 1.0 if tokens == golden[tuple(int(t) for t in prompt)] \
+            else 0.0
+
+    tmp = tempfile.mkdtemp(prefix="bench-release-")
+    gw = Gateway(n_slots=n_slots, max_new_tokens=decode_len,
+                 journal_path=os.path.join(tmp, "gw.journal"))
+    cfg = ReleaseConfig("relm", n_slots=n_slots, canary_fraction=0.5,
+                        canary_requests=max(4, n_slots),
+                        probe_prompts=probe_prompts,
+                        probe_max_new=decode_len, p95_floor_s=60.0,
+                        seed=7)
+    rc = ReleaseController(gw, cfg,
+                           journal_path=os.path.join(tmp, "rc.journal"),
+                           loader=lambda v: loader[v],
+                           quality_fn=quality_fn)
+    all_reqs = []
+
+    def submit_round(n=n_slots):
+        rs = [gw.submit("relm", prompts[i % len(prompts)],
+                        max_new=decode_len) for i in range(n)]
+        gw.run_until_idle()
+        all_reqs.extend(rs)
+        return rs
+
+    def drive_cycle(version, instance):
+        t0 = time.time()
+        rc.offer(version, instance)
+        verdict = rc.step()
+        rounds = 0
+        while verdict in ("canary-started", "canary") and rounds < 64:
+            submit_round()
+            verdict = rc.step()
+            rounds += 1
+        return verdict, time.time() - t0, rounds
+
+    try:
+        rc.offer("1", gen1)
+        assert rc.step() == "promoted"
+        submit_round()                              # warm steady state
+        miss_v1 = gen1.exe.cache_stats()["executable"]["misses"]
+        promote_verdict, promote_s, promote_rounds = drive_cycle(
+            "2", good)
+        # v1 served the stable half of the canary: its executor must
+        # not have compiled anything new while the candidate warmed
+        recompiles = gen1.exe.cache_stats()["executable"]["misses"] \
+            - miss_v1
+        submit_round()                              # steady on v2
+        miss_v2 = good.exe.cache_stats()["executable"]["misses"]
+        rollback_verdict, rollback_s, rollback_rounds = drive_cycle(
+            "3", degraded)
+        submit_round()                              # post-convergence
+        lost = sum(1 for r in all_reqs if r.error is not None)
+        # ... and v2's executor stays flat across the degraded
+        # candidate's whole canary + rollback
+        recompiles += good.exe.cache_stats()["executable"]["misses"] \
+            - miss_v2
+        events = [e["event"] for e in rc.journal.replay()]
+        return {
+            "slots": n_slots,
+            "promote_cycle": {"verdict": promote_verdict,
+                              "wall_s": round(promote_s, 3),
+                              "traffic_rounds": promote_rounds},
+            "rollback_cycle": {"verdict": rollback_verdict,
+                               "wall_s": round(rollback_s, 3),
+                               "traffic_rounds": rollback_rounds},
+            "current": gw.registry.resolve("relm"),
+            "lost_requests": lost,
+            "recompiles_after_warmup": int(recompiles),
+            "requests_served": len(all_reqs),
+            "journal_events": events,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _calibrated_chip():
     """Measured machine model for the roofline gate: achievable matmul
     FLOP/s and achievable copy bandwidth of THIS device (env overrides:
@@ -1579,6 +1703,16 @@ def main() -> None:
         except Exception as e:
             print(f"gateway bench failed: {e}", file=sys.stderr)
 
+    release_cmp = None
+    if os.environ.get("BENCH_SKIP_RELEASE", "") != "1":
+        try:
+            release_cmp = retry_transient(
+                bench_release, trials,
+                int(os.environ.get("BENCH_RELEASE_SLOTS", "4")),
+                int(os.environ.get("BENCH_RELEASE_DECODE", "8")))
+        except Exception as e:
+            print(f"release bench failed: {e}", file=sys.stderr)
+
     cost_model = None
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         try:
@@ -1658,6 +1792,10 @@ def main() -> None:
             "mnist_top1_delta": (quality or {}).get("top1_int8_delta"),
             "nmt_bleu_delta": (nmt_quality or {}).get("bleu_int8_delta"),
         },
+        # release lifecycle (ISSUE 12): candidate->canary->promote and
+        # degraded-candidate auto-rollback cycle walls, with zero lost
+        # requests and zero steady-state recompiles across both
+        "release": release_cmp,
         # static cost analyzer gate (ISSUE 11): planner peak HBM vs XLA
         # memory_analysis and roofline step time vs chained device time
         # on mnist / the NMT transformer / the paged int8 decode step,
@@ -1697,6 +1835,15 @@ def main() -> None:
     if os.environ.get("BENCH_SKIP_GATEWAY", "") != "1" \
             and gateway_cmp is None:
         missing.append("gateway")
+    if os.environ.get("BENCH_SKIP_RELEASE", "") != "1":
+        if release_cmp is None:
+            missing.append("release")
+        elif (release_cmp["lost_requests"] != 0
+              or release_cmp["promote_cycle"]["verdict"] != "promoted"
+              or release_cmp["rollback_cycle"]["verdict"] != "rollback"):
+            # the loop's safety contract IS the metric: a lost request
+            # or a wrong verdict is a failed run, like a band violation
+            missing.append("release_contract")
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         if cost_model is None:
             missing.append("cost_model")

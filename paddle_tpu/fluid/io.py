@@ -36,6 +36,9 @@ __all__ = ["save_tensor", "load_tensor", "save_tensors", "load_tensors",
            "load_params", "load_persistables", "save_inference_model",
            "load_inference_model", "merge_inference_model",
            "get_inference_program", "device_put_persistables",
+           "model_version_dir", "list_model_versions",
+           "publish_model_version", "save_versioned_inference_model",
+           "set_current_version", "current_model_version",
            "CheckpointCorrupt"]
 
 _MAGIC = b"PDTPU\x01"      # legacy: no checksum
@@ -425,6 +428,18 @@ def device_put_persistables(scope: Scope,
 
 # -- versioned artifact layout (ISSUE 10: the gateway's model store) --------
 
+# staging dirs end with this suffix so an unpublished (possibly torn)
+# artifact can never be mistaken for a version by list_model_versions
+# or ModelRegistry.load
+_STAGING_SUFFIX = ".staging.tmp"
+
+# on-disk deploy marker (ISSUE 12): the last PROMOTED version of a
+# model, written by the release controller / lifecycle CLI so a process
+# restart serves the last good version — not merely the newest artifact
+# on disk (which may be an unvetted or rolled-back candidate)
+CURRENT_MARKER = "CURRENT"
+
+
 def model_version_dir(root: str, model_name: str, version: str) -> str:
     """``<root>/<model>/<version>/`` — one save_inference_model artifact
     (or generator artifact, see serving.gateway.ModelRegistry) per
@@ -434,8 +449,10 @@ def model_version_dir(root: str, model_name: str, version: str) -> str:
 
 
 def list_model_versions(root: str, model_name: str) -> List[str]:
-    """Versions on disk for ``model_name``, sorted (numeric versions
-    numerically: v2 < v10)."""
+    """PUBLISHED versions on disk for ``model_name``, sorted (numeric
+    versions numerically: v2 < v10).  Staging dirs of in-flight or
+    crashed publishes (``*.staging.tmp``) are not versions and are
+    skipped."""
     base = os.path.join(root, str(model_name))
     if not os.path.isdir(base):
         return []
@@ -445,7 +462,96 @@ def list_model_versions(root: str, model_name: str) -> List[str]:
         return (int(digits) if digits else 0, v)
 
     return sorted((d for d in os.listdir(base)
-                   if os.path.isdir(os.path.join(base, d))), key=key)
+                   if os.path.isdir(os.path.join(base, d))
+                   and not d.endswith(".tmp")), key=key)
+
+
+def set_current_version(root: str, model_name: str, version: str) -> None:
+    """Atomically mark ``version`` as the deployed one (the release
+    controller's promote/rollback durability point)."""
+    _atomic_write(os.path.join(root, str(model_name), CURRENT_MARKER),
+                  str(version).encode())
+
+
+def current_model_version(root: str, model_name: str) -> Optional[str]:
+    """The marked deployed version, or None when no marker exists or it
+    points at a version no longer on disk (pruned — fall back to the
+    caller's own default, e.g. newest)."""
+    path = os.path.join(root, str(model_name), CURRENT_MARKER)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            version = f.read().strip()
+    except OSError:
+        return None
+    if not version or not os.path.isdir(
+            model_version_dir(root, model_name, version)):
+        return None
+    return version
+
+
+def publish_model_version(root: str, model_name: str, version: str,
+                          writer) -> str:
+    """Crash-safe versioned-artifact publish — the CheckpointManager
+    discipline applied to the model store: ``writer(staging_dir)``
+    builds the artifact into an unpublished staging dir, every file is
+    fsynced, then ONE atomic rename makes the version visible.  A crash
+    at any point leaves either no version or the complete version —
+    never a torn artifact for ``ModelRegistry.load`` to trip over.
+    Stale staging dirs from crashed publishes are swept on the next
+    publish of the same model.  Returns the published directory."""
+    final = model_version_dir(root, model_name, version)
+    base = os.path.dirname(final)
+    os.makedirs(base, exist_ok=True)
+    # GC staging leftovers of crashed publishes (any pid: a dead writer
+    # never comes back for them — same rule as CheckpointManager._prune)
+    for name in os.listdir(base):
+        if name.endswith(_STAGING_SUFFIX):
+            import shutil
+
+            shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+    staging = f"{final}.{os.getpid()}{_STAGING_SUFFIX}"
+    os.makedirs(staging)
+    try:
+        writer(staging)
+        # fsync EVERY staged file before the rename can make it
+        # reachable: save_inference_model's __model__ is a plain write,
+        # and the publish must never outrun the bytes it names
+        for name in os.listdir(staging):
+            path = os.path.join(staging, name)
+            if not os.path.isfile(path):
+                continue
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        _fsync_dir(staging)
+        # chaos point (ISSUE 12): a seeded "crash" after the artifact
+        # is fully staged but BEFORE it is published — the torn-publish
+        # regression tests inject here
+        from ..resilience.chaos import injector
+
+        injector().maybe_fail("io.publish")
+        # re-publish of the same version: move the published artifact
+        # ASIDE (a .tmp name the listing skips) rather than deleting it
+        # first — deleting before the rename would let a crash in the
+        # gap destroy the only copy of a possibly-serving version
+        replaced = None
+        if os.path.exists(final):
+            replaced = f"{final}.{os.getpid()}.replaced{_STAGING_SUFFIX}"
+            os.rename(final, replaced)
+        os.rename(staging, final)          # atomic publish
+        if replaced is not None:
+            import shutil
+
+            shutil.rmtree(replaced, ignore_errors=True)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    _fsync_dir(base)
+    return final
 
 
 def save_versioned_inference_model(root: str, model_name: str,
@@ -454,13 +560,25 @@ def save_versioned_inference_model(root: str, model_name: str,
                                    target_vars: List[Variable],
                                    executor: Executor,
                                    main_program: Optional[Program] = None,
-                                   scope: Optional[Scope] = None) -> str:
-    """``save_inference_model`` into the versioned gateway layout;
-    returns the artifact directory."""
-    dirname = model_version_dir(root, model_name, version)
-    save_inference_model(dirname, feeded_var_names, target_vars, executor,
-                         main_program=main_program, scope=scope)
-    return dirname
+                                   scope: Optional[Scope] = None,
+                                   manifest: Optional[Dict] = None) -> str:
+    """``save_inference_model`` into the versioned gateway layout via
+    the crash-safe staged publish; returns the artifact directory.
+    ``manifest`` (written as ``gateway.json``, the ModelRegistry
+    manifest) rides inside the same atomic publish — e.g.
+    ``{"kind": "engine", "config": {"quantize": "int8"}}`` for an int8
+    PTQ candidate."""
+
+    def writer(staging: str) -> None:
+        save_inference_model(staging, feeded_var_names, target_vars,
+                             executor, main_program=main_program,
+                             scope=scope)
+        if manifest is not None:
+            with open(os.path.join(staging, "gateway.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(manifest, f, indent=1)
+
+    return publish_model_version(root, model_name, version, writer)
 
 
 def get_inference_program(target_vars, main_program=None):

@@ -43,7 +43,7 @@ from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, \
     Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Sample",
-           "registry", "DEFAULT_BUCKETS"]
+           "registry", "bucket_percentile", "DEFAULT_BUCKETS"]
 
 # latency-shaped default buckets (seconds): sub-ms dispatch overheads up
 # through multi-second queue waits
@@ -162,6 +162,33 @@ class _GaugeChild(_Child):
             return self._value
 
 
+def bucket_percentile(buckets: Sequence[float], cum: Sequence[int],
+                      q: float) -> Optional[float]:
+    """Bucket-interpolated percentile over CUMULATIVE counts (the last
+    entry is the +Inf total).  Exposed as a module function so a reader
+    that differences two cumulative snapshots — the release
+    controller's canary window — can price the percentile of just that
+    window; ``_HistogramChild.percentile`` is the whole-history view of
+    the same math.  Returns None when the window is empty."""
+    buckets = tuple(buckets)
+    cum = list(cum)
+    count = cum[-1] if cum else 0
+    if count == 0:
+        return None
+    rank = q / 100.0 * count
+    edges = buckets + (buckets[-1] if buckets else 0.0,)
+    prev = 0
+    for i, c in enumerate(cum):
+        if c >= rank:
+            lo = edges[i - 1] if i > 0 else 0.0
+            hi = edges[min(i, len(buckets) - 1)] if buckets else 0.0
+            if c == prev:
+                return hi
+            return lo + (hi - lo) * (rank - prev) / (c - prev)
+        prev = c
+    return edges[-1]
+
+
 class _HistogramChild(_Child):
     __slots__ = ("_buckets", "_counts", "_sum", "_count")
 
@@ -194,27 +221,18 @@ class _HistogramChild(_Child):
                 cum.append(acc)
             return cum, self._sum, self._count
 
+    @property
+    def buckets(self) -> Tuple[float, ...]:
+        """The bucket edges (without +Inf) — for readers that window a
+        cumulative snapshot through ``bucket_percentile``."""
+        return self._buckets
+
     def percentile(self, q: float) -> Optional[float]:
         """Bucket-interpolated percentile (None when empty) — good
         enough for statusz rollups; exact percentiles stay with the
         surfaces that keep raw values."""
-        cum, _, count = self.snapshot()
-        if count == 0:
-            return None
-        rank = q / 100.0 * count
-        edges = self._buckets + (self._buckets[-1]
-                                 if self._buckets else 0.0,)
-        prev = 0
-        for i, c in enumerate(cum):
-            if c >= rank:
-                lo = edges[i - 1] if i > 0 else 0.0
-                hi = edges[min(i, len(self._buckets) - 1)] \
-                    if self._buckets else 0.0
-                if c == prev:
-                    return hi
-                return lo + (hi - lo) * (rank - prev) / (c - prev)
-            prev = c
-        return edges[-1]
+        cum, _, _ = self.snapshot()
+        return bucket_percentile(self._buckets, cum, q)
 
 
 _CHILD_TYPES = {"counter": _CounterChild, "gauge": _GaugeChild,
@@ -283,6 +301,26 @@ class _Family:
         with self._lock:
             return list(self._children.items())
 
+    def remove_matching(self, **labels) -> int:
+        """Drop every child whose label values match all the given
+        pairs; returns how many were removed.  The escape valve for
+        series whose label space grows without bound by design — the
+        gateway drops a model VERSION's children when the version
+        unloads, so a continual-publish loop cannot leak one histogram
+        per candidate it ever canaried."""
+        for k in labels:
+            if k not in self.label_names:
+                raise ValueError(f"{self.name} has no label {k!r} "
+                                 f"(declared: {list(self.label_names)})")
+        want = {self.label_names.index(k): str(v)
+                for k, v in labels.items()}
+        with self._lock:
+            doomed = [vals for vals in self._children
+                      if all(vals[i] == v for i, v in want.items())]
+            for vals in doomed:
+                del self._children[vals]
+            return len(doomed)
+
 
 Counter = Gauge = Histogram = _Family      # public aliases for isinstance
 
@@ -333,6 +371,14 @@ class MetricsRegistry:
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
         return self._family(name, "histogram", help, labels,
                             buckets=tuple(buckets))
+
+    def get(self, name: str) -> Optional[_Family]:
+        """Read access to an existing instrument family (None when
+        absent) — for consumers like the release controller that WATCH
+        series other surfaces write, without re-declaring kind/labels
+        (and without ever creating the family as a side effect)."""
+        with self._lock:
+            return self._families.get(name)
 
     # -- collectors ----------------------------------------------------------
     def register_collector(self, fn: Callable[[], Iterable[Sample]],

@@ -23,7 +23,18 @@ through the distributed stack (all no-ops unless configured):
   * ``guard.hang``    — sleep ``hang_seconds`` inside the step dispatch
                         (exercises the watchdog deadline -> StepTimeout);
   * ``guard.fault``   — raise a transient ChaosError at dispatch entry
-                        (exercises the guarded step's RetryPolicy).
+                        (exercises the guarded step's RetryPolicy);
+  * ``io.publish``    — "crash" a versioned-artifact publish after the
+                        staging dir is complete but BEFORE the atomic
+                        rename (fluid/io.publish_model_version: the
+                        torn-publish regression — no version may appear);
+  * ``registry.load`` — fail a ModelRegistry.load before construction
+                        (exercises the release controller's
+                        reject-candidate-and-keep-serving path);
+  * ``gateway.swap``  — "crash" a Gateway.swap_model after the new
+                        version loaded+warmed but before the alias flip
+                        (the old version must keep serving, the orphan
+                        must not linger).
 
 Every probabilistic decision is a pure function of (seed, point, draw
 index) — `FaultInjector.decision` — so the same seed yields the same

@@ -76,13 +76,26 @@ class ResilientTrainer:
         record of which batches the run dropped.  Lease settlement is
         untouched: a skipped batch still advances the chunk, a raising
         policy still charges task_failed through the normal path.
+    publisher / publish_every_steps: close the training half of the
+        release loop (ISSUE 12): every ``publish_every_steps`` steps —
+        and once more at the final step — ``publisher.publish(step,
+        program, scope)`` emits the live parameters as a versioned
+        candidate artifact (``lifecycle.CandidatePublisher`` /
+        ``GeneratorPublisher``: save_versioned_inference_model under
+        the crash-safe staged publish, optionally with an int8 PTQ
+        manifest).  Publication is advisory — the release controller
+        gates what serves — so a failed publish logs and training
+        continues; the torn-artifact case is impossible by
+        construction (the staged publish never exposes a partial
+        version).
     """
 
     def __init__(self, checkpoint_dir: str, queue, read_chunk,
                  *, program=None, scope=None, worker: str = "worker-0",
                  save_interval_steps: int = 1, max_to_keep: int = 3,
                  poll_interval: float = 0.05, prefetch: int = 0,
-                 guard=None, guard_executor=None):
+                 guard=None, guard_executor=None,
+                 publisher=None, publish_every_steps: int = 0):
         self.manager = CheckpointManager(
             checkpoint_dir, max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps)
@@ -99,6 +112,10 @@ class ResilientTrainer:
         self.prefetch = prefetch
         self.guard = guard
         self.guard_executor = guard_executor
+        self.publisher = publisher
+        self.publish_every_steps = int(publish_every_steps)
+        self._last_published_step: Optional[int] = None
+        self._last_published_version: Optional[str] = None
         # telemetry (ISSUE 8): live progress for /statusz (attach the
         # trainer to an ObservabilityServer) + a counter per durable
         # journal event next to the guardrail series
@@ -110,6 +127,10 @@ class ResilientTrainer:
             "paddle_guard_journal_events_total",
             "Guard-journal records written (skip/rollback/"
             "escalate-restore)", labels=("event",))
+        self._m_published = _obs_registry().counter(
+            "paddle_lifecycle_candidates_published_total",
+            "Versioned candidate artifacts emitted by the trainer",
+            labels=("outcome",))
 
     def status(self) -> dict:
         """JSON-able progress rollup — the ObservabilityServer /statusz
@@ -119,6 +140,9 @@ class ResilientTrainer:
                "last_step": self._last_step,
                "last_saved_step": self._last_saved_step,
                "guarded": self.guard is not None}
+        if self.publisher is not None:
+            out["last_published_step"] = self._last_published_step
+            out["last_published_version"] = self._last_published_version
         if self.guard_executor is not None:
             out["health"] = self.guard_executor.health_stats()
         return out
@@ -140,6 +164,33 @@ class ResilientTrainer:
     def _save(self, step: int, force: bool = False) -> bool:
         return self.manager.save(step, self.program, self.scope,
                                  force=force)
+
+    def _maybe_publish(self, step: int, force: bool = False) -> None:
+        """Emit a versioned candidate artifact from the live scope.
+        Advisory by design: a failed publish is counted + logged, never
+        raised — the release controller decides what serves, and a full
+        artifact disk must not take training down with it."""
+        if self.publisher is None or step <= 0:
+            return
+        if self._last_published_step == step:
+            return
+        if not force and (self.publish_every_steps <= 0
+                          or step % self.publish_every_steps != 0):
+            return
+        try:
+            version = self.publisher.publish(step, self.program,
+                                             self.scope)
+        except Exception as e:
+            self._m_published.labels(outcome="failed").inc()
+            import sys
+
+            print(f"[paddle_tpu] candidate publish failed at step "
+                  f"{step}: {e}", file=sys.stderr)
+            return
+        self._last_published_step = step
+        self._last_published_version = (str(version)
+                                        if version is not None else None)
+        self._m_published.labels(outcome="published").inc()
 
     # -- guardrail wiring ----------------------------------------------------
     def guard_journal_path(self) -> str:
@@ -253,6 +304,10 @@ class ResilientTrainer:
         if step > 0 and last_saved != step:
             self._save(step, force=True)
             last_saved = step
+        # ... and the final state always publishes as a candidate, so
+        # the release controller sees the run's end product even when
+        # the step count is not a multiple of the publish interval
+        self._maybe_publish(step, force=True)
         self._last_step, self._last_saved_step = step, last_saved
         return step
 
@@ -300,6 +355,7 @@ class ResilientTrainer:
                 raise
             if self._save(step):
                 last_saved = step
+            self._maybe_publish(step)
             if max_steps is not None and step >= max_steps:
                 # deliberate stop mid-chunk: hand the lease back
                 # uncharged (best-effort — if the master is away,

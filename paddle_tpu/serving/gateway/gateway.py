@@ -26,6 +26,7 @@ boundary, mid-prefill included."""
 
 from __future__ import annotations
 
+import contextlib
 import queue as _queue
 import threading
 import time
@@ -34,6 +35,7 @@ from typing import Dict, Iterator, List, Optional
 import numpy as np
 
 from ...observability import metrics as _obs_metrics
+from ...resilience.chaos import injector as _chaos_injector
 from ..scheduler import (ContinuousBatchingScheduler, Request,
                          RequestCancelled)
 from .journal import RequestJournal
@@ -135,6 +137,11 @@ class Gateway:
         self.check_invariants = bool(check_invariants)
         self._wedge_lock = threading.Lock()
         self._wedge_mark = (0, time.monotonic())
+        # >0 while a load/swap is warming a new version: the compile
+        # legitimately freezes the step counter, and wedged() must not
+        # read that as a stall (restarting the process for every swap
+        # would turn each deploy into an outage)
+        self._swapping = 0
         reg = _obs_metrics.registry()
         self._m_requests = reg.counter(
             "paddle_gateway_requests_total",
@@ -148,8 +155,41 @@ class Gateway:
             "paddle_gateway_request_latency_seconds",
             "submit -> finish per tenant SLO class",
             labels=("tenant", "slo"))
+        # per-VERSION latency (ISSUE 12): the release controller's
+        # canary verdict differences this series between marks to price
+        # the candidate's p95 against the stable version's, live
+        self._h_version_latency = reg.histogram(
+            "paddle_gateway_version_latency_seconds",
+            "submit -> finish latency per served model version",
+            labels=("model", "version"))
 
     # -- model lifecycle -----------------------------------------------------
+    def drop_version_series(self, name: str, version: str) -> None:
+        """Retire an unloaded version's per-version metric children —
+        without this, a continual-publish release loop leaks one
+        latency histogram + request-counter set per candidate it ever
+        served, forever (the registry keeps children until told
+        otherwise).  Called on every unload path; the release
+        controller calls it when it drains a version itself."""
+        self._h_version_latency.remove_matching(model=name,
+                                                version=str(version))
+        # request-counter children label model with what was SUBMITTED:
+        # the bare alias for routed traffic, the pinned key for probes
+        for label in (name, f"{name}@{version}"):
+            self._m_requests.remove_matching(model=label,
+                                             version=str(version))
+
+    @contextlib.contextmanager
+    def _swap_guard(self):
+        """Mark a model load/swap in progress for wedged()."""
+        with self._wedge_lock:
+            self._swapping += 1
+        try:
+            yield
+        finally:
+            with self._wedge_lock:
+                self._swapping -= 1
+
     def _warm(self, key: str, n_slots: int) -> None:
         """Compile the new version's program set BEFORE it takes
         traffic: a paged generator runs one tiny admit/lane_step cycle
@@ -187,12 +227,13 @@ class Gateway:
             key = self.registry.load(name, version, dirname=dirname,
                                      **overrides)
         try:
-            if warm:
-                self._warm(key, n_slots or self.default_n_slots)
-            inst = self.registry.instance(key)
-            if callable(getattr(inst, "open_slots", None)):
-                self.sched.add_model(key, inst,
-                                     n_slots or self.default_n_slots)
+            with self._swap_guard():
+                if warm:
+                    self._warm(key, n_slots or self.default_n_slots)
+                inst = self.registry.instance(key)
+                if callable(getattr(inst, "open_slots", None)):
+                    self.sched.add_model(key, inst,
+                                         n_slots or self.default_n_slots)
         except BaseException:
             # a failed warm/add must not leak registry budget
             try:
@@ -217,11 +258,30 @@ class Gateway:
         new_key = self.load_model(name, version, dirname=dirname,
                                   n_slots=n_slots, warm=True,
                                   instance=instance, **overrides)
+        try:
+            # chaos point (ISSUE 12): a seeded mid-swap "crash" — the
+            # new version is loaded and warmed but NOT yet aliased
+            _chaos_injector().maybe_fail("gateway.swap")
+        except BaseException:
+            # unwind the orphan so the in-process survivor matches the
+            # real-crash case: the old version keeps serving, nothing
+            # routes to (or budgets for) the half-swapped one
+            try:
+                self.sched.remove_model(new_key, drain=False)
+            except Exception:
+                pass
+            try:
+                self.registry.unload(new_key)
+            except Exception:
+                pass
+            raise
         self.registry.set_alias(name, version)
         if old_key is not None and old_key != new_key:
-            self.sched.remove_model(old_key, drain=True,
-                                    timeout=drain_timeout)
-            self.registry.unload(old_key)
+            with self._swap_guard():
+                self.sched.remove_model(old_key, drain=True,
+                                        timeout=drain_timeout)
+                self.registry.unload(old_key)
+            self.drop_version_series(name, old_key.split("@", 1)[-1])
         return new_key
 
     def unload_model(self, name_or_key: str,
@@ -233,6 +293,9 @@ class Gateway:
         self.registry.check_unload(key)
         self.sched.remove_model(key, drain=True, timeout=drain_timeout)
         self.registry.unload(key)
+        name, _, version = key.partition("@")
+        if version:
+            self.drop_version_series(name, version)
 
     def models(self) -> List[Dict[str, object]]:
         return self.registry.entries()
@@ -249,7 +312,13 @@ class Gateway:
                 self._m_tokens.labels(tenant=tenant, model=req.model
                                       ).inc()
             else:
-                version = (req.group or "@unresolved").split("@", 1)[-1]
+                # a request that never reached a lane has no group; a
+                # canary-pinned one still names its target in route_to —
+                # without this, a candidate whose admission dispatch
+                # fails would error under version="unresolved" and the
+                # release controller's error-rate gate would never see it
+                target = req.group or req.route_to or "@unresolved"
+                version = target.split("@", 1)[-1]
                 ok = req.error is None
                 event = ("finished" if ok else
                          "cancelled"
@@ -261,6 +330,9 @@ class Gateway:
                 if ok and req.total_latency is not None:
                     self._h_latency.labels(tenant=tenant, slo=slo
                                            ).observe(req.total_latency)
+                    self._h_version_latency.labels(
+                        model=req.model.split("@", 1)[0],
+                        version=version).observe(req.total_latency)
                 if self.journal is not None and jid is not None:
                     self.journal.record_done(
                         jid, ok=ok,
@@ -394,6 +466,12 @@ class Gateway:
         busy = st["in_flight"] > 0 or st["queued"] > 0
         now = time.monotonic()
         with self._wedge_lock:
+            if self._swapping:
+                # a hot swap's _warm compile legitimately freezes the
+                # step counter with work pending — reset the stall
+                # clock so the pause is never mistaken for a wedge
+                self._wedge_mark = (st["steps"], now)
+                return False
             steps, since = self._wedge_mark
             if st["steps"] != steps or not busy:
                 self._wedge_mark = (st["steps"], now)

@@ -27,6 +27,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ...utils.journal import terminate_torn_tail
+
 __all__ = ["RequestJournal"]
 
 
@@ -53,6 +55,7 @@ class RequestJournal:
         # pid-qualified ids: rids restart at 1 in a respawned process,
         # and a replayed entry must never collide with a fresh one
         self._prefix = f"{os.getpid()}"
+        self._tail_checked = False
         # async done-record writer state
         self._cv = threading.Condition()
         self._done_q: deque = deque()
@@ -65,6 +68,13 @@ class RequestJournal:
     def _append(self, entry: Dict) -> None:
         line = json.dumps(entry, separators=(",", ":")) + "\n"
         with self._lock:
+            if not self._tail_checked:
+                # a predecessor that died mid-append leaves a torn
+                # final line; appending onto it would merge the NEXT
+                # record into the garbage and lose both — for a submit
+                # record, a silently lost request on replay (ISSUE 12)
+                self._tail_checked = True
+                terminate_torn_tail(self.path)
             with open(self.path, "a", encoding="utf-8") as f:
                 f.write(line)
                 f.flush()

@@ -159,18 +159,6 @@ class ModelRegistry:
         pool/sidecar are decode-time state, rebuilt empty at load), plus
         a manifest of the constructor config.  The artifact is exactly
         what ``load`` needs to rebuild a byte-equivalent server."""
-        dirname = fluid.io.model_version_dir(root, name, version)
-        os.makedirs(dirname, exist_ok=True)
-        prog = generator._unified[0]
-        for v in prog.list_vars():
-            if not v.persistable or \
-                    any(m in v.name for m in _CACHE_MARKERS):
-                continue
-            val = generator.scope.find_var(v.name)
-            if val is None:
-                continue
-            fluid.io.save_tensor(np.asarray(val),
-                                 os.path.join(dirname, v.name))
         cfg = {
             "src_vocab_size": generator.cfg.src_vocab_size,
             "trg_vocab_size": generator.cfg.trg_vocab_size,
@@ -193,10 +181,27 @@ class ModelRegistry:
             "topk_size": generator.topk_size,
             "kv_dtype": generator.kv_dtype,
         }
-        with open(os.path.join(dirname, MANIFEST_NAME), "w",
-                  encoding="utf-8") as f:
-            json.dump({"kind": "generator", "config": cfg}, f, indent=1)
-        return dirname
+        prog = generator._unified[0]
+
+        def writer(staging: str) -> None:
+            for v in prog.list_vars():
+                if not v.persistable or \
+                        any(m in v.name for m in _CACHE_MARKERS):
+                    continue
+                val = generator.scope.find_var(v.name)
+                if val is None:
+                    continue
+                fluid.io.save_tensor(np.asarray(val),
+                                     os.path.join(staging, v.name))
+            with open(os.path.join(staging, MANIFEST_NAME), "w",
+                      encoding="utf-8") as f:
+                json.dump({"kind": "generator", "config": cfg}, f,
+                          indent=1)
+
+        # staged + fsynced + rename-published (ISSUE 12): a trainer
+        # SIGKILLed mid-publish must never leave a half-written version
+        # for the next registry load to trip over
+        return fluid.io.publish_model_version(root, name, version, writer)
 
     def _manifest(self, dirname: str) -> Dict:
         path = os.path.join(dirname, MANIFEST_NAME)
@@ -284,6 +289,12 @@ class ModelRegistry:
             dirname = fluid.io.model_version_dir(self.root, name, version)
         if not os.path.isdir(dirname):
             raise FileNotFoundError(f"no artifact at {dirname}")
+        # chaos point (ISSUE 12): a seeded load failure — unreadable
+        # artifact store, bad deserialize — injectable so the release
+        # controller's reject-and-keep-serving path is testable
+        from ...resilience.chaos import injector
+
+        injector().maybe_fail("registry.load")
         manifest = self._manifest(dirname)
         kind = manifest.get("kind", "engine")
         config = dict(manifest.get("config", {}))

@@ -45,7 +45,10 @@ ISSUE 10 grows the scheduler into the gateway's shared execution core:
 * **routed admission** — queued requests carry a model *alias*; a
   ``resolve`` hook maps alias → lane-group key AT ADMISSION, so a
   registry can flip an alias mid-traffic and queued requests follow it
-  to the new version (zero lost requests across a hot swap).
+  to the new version (zero lost requests across a hot swap).  An
+  admission policy may additionally pin a request to an explicit
+  lane-group key via ``Request.route_to`` (ISSUE 12 canary slicing);
+  a pin whose group disappears falls back to the alias.
 * **preemptive admission policy** — ``admission_policy(candidates,
   active)`` picks WHICH admissible queued request gets the next free
   slot (the TenantRouter's SLO-class preemption + weighted fair share).
@@ -165,6 +168,13 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.model = str(model)          # alias as submitted; resolved
         self.group: Optional[str] = None  # lane-group key at admission
+        # admission-time routing override (ISSUE 12): a canary admission
+        # policy pins the request to an explicit lane-group key (set at
+        # most once, at pick time); None follows the alias through
+        # ``resolve`` as usual.  Cleared — falling back to the alias —
+        # if the pinned group disappears before admission (a rolled-back
+        # canary must never take its queued requests down with it).
+        self.route_to: Optional[str] = None
         self.tenant = tenant
         # on_token(req, tok) per decoded token and on_token(req, None)
         # once at completion — called under the scheduler lock, so it
@@ -529,7 +539,14 @@ class ContinuousBatchingScheduler:
                     req, RequestCancelled("cancelled before admission"),
                     "cancelled", "cancelled")
                 continue
-            group = self._group_for(req.model)
+            group = self._group_for(req.route_to or req.model)
+            if (group is None or group.draining) \
+                    and req.route_to is not None:
+                # the pinned canary target is gone (rolled back or
+                # unloaded): fall back to the alias — the request must
+                # survive the canary, not die with it
+                req.route_to = None
+                group = self._group_for(req.model)
             if group is None or group.draining:
                 self._queue.remove(req)
                 self._finish_unadmitted_locked(
@@ -563,10 +580,39 @@ class ContinuousBatchingScheduler:
             return None
         active = [r for g in self._groups.values()
                   for r in g.active.values()]
-        chosen = self.admission_policy([r for r, _ in candidates], active)
-        for r, g in candidates:
-            if r is chosen:
-                return r, g
+        pool = candidates
+        while pool:
+            chosen = self.admission_policy([r for r, _ in pool], active)
+            entry = next(((r, g) for r, g in pool if r is chosen), None)
+            if entry is None:
+                return None
+            r, g = entry
+            if r.route_to is not None:
+                # the policy may have pinned the request during this
+                # very pick (canary slicing): honor the new target when
+                # it can admit right now
+                g2 = self._group_for(r.route_to)
+                if g2 is None or g2.draining:
+                    # pinned to a group that vanished between the walk
+                    # and the pick: fall back to the alias group
+                    r.route_to = None
+                    g2 = g
+                if g2 is not g:
+                    blocked = (not g2.free
+                               or (g2.page_aware
+                                   and not g2.model.can_admit(
+                                       r.src, r.max_new_tokens)))
+                    if blocked:
+                        # the pinned target is full: keep the request
+                        # queued (the pin is durable) but let the
+                        # policy pick among the REST of this round's
+                        # candidates — a saturated canary group must
+                        # not block admission into free stable slots
+                        pool = [(rr, gg) for rr, gg in pool
+                                if rr is not r]
+                        continue
+                    g = g2
+            return r, g
         return None
 
     def _admit_pending(self) -> int:

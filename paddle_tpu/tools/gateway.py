@@ -105,14 +105,18 @@ def _cmd_serve(args, raw_argv: List[str]) -> int:
     for spec in args.model or []:
         name, _, version = spec.partition("=")
         if not version:
-            versions = __import__(
-                "paddle_tpu.fluid.io", fromlist=["io"]
-            ).list_model_versions(args.root, name)
-            if not versions:
-                print(f"gateway: no versions for {name} under "
-                      f"{args.root}", file=sys.stderr)
-                return 1
-            version = versions[-1]
+            io_mod = __import__("paddle_tpu.fluid.io", fromlist=["io"])
+            # deploy-on-restart honors the release controller's CURRENT
+            # marker (the last PROMOTED version), not merely the newest
+            # artifact on disk — which may be an unvetted candidate
+            version = io_mod.current_model_version(args.root, name)
+            if not version:
+                versions = io_mod.list_model_versions(args.root, name)
+                if not versions:
+                    print(f"gateway: no versions for {name} under "
+                          f"{args.root}", file=sys.stderr)
+                    return 1
+                version = versions[-1]
         key = gw.load_model(name, version, n_slots=args.slots)
         print(f"loaded {key}")
     recovered = gw.recover()

@@ -1,0 +1,37 @@
+"""Shared append-only jsonl journal plumbing.
+
+Both durable journals in the repo — the gateway's ``RequestJournal``
+and the release controller's ``ReleaseJournal`` — are append-only
+jsonl files whose replay tolerates a torn final line (the crash
+happened mid-append).  Tolerating the torn line on READ is not enough:
+a successor process appending onto it would MERGE its first record
+into the garbage and lose both — for a request journal, a silently
+lost request on the following replay.  This helper terminates the torn
+line once, before the successor's first append.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["terminate_torn_tail"]
+
+
+def terminate_torn_tail(path: str) -> bool:
+    """If ``path`` exists and does not end with a newline, append one
+    so the torn final line is sealed off as its own (skippable) record.
+    Returns True when a torn tail was terminated.  Callers gate this to
+    once per journal instance; the caller holds any write lock."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            if f.tell() == 0:
+                return False
+            f.seek(-1, os.SEEK_END)
+            torn = f.read(1) != b"\n"
+    except OSError:
+        return False
+    if torn:
+        with open(path, "a", encoding="utf-8") as f:
+            f.write("\n")
+    return torn

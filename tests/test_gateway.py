@@ -721,3 +721,134 @@ def test_gateway_metric_series_and_statusz_sources(gen_pair):
     finally:
         obs.stop()
     gw.unload_model("obsM")
+
+
+# -- release-lifecycle satellites (ISSUE 12) ----------------------------------
+
+class SlowWarmModel:
+    """lane_step model whose FIRST dispatch blocks on a gate — the
+    shape of a long XLA compile inside Gateway._warm."""
+
+    start_id, end_id = 0, 1
+    src_len = 8
+
+    def __init__(self):
+        import threading as _th
+
+        self.gate = _th.Event()
+        self.started = _th.Event()
+        self.slot_val = {}
+        self.n = 0
+
+    def open_slots(self, n):
+        self.n = n
+
+    def admit_slot(self, slot, prompt, **_):
+        self.slot_val[slot] = int(np.asarray(prompt).reshape(-1)[0])
+        return len(np.asarray(prompt).reshape(-1))
+
+    def clear_slot(self, slot):
+        self.slot_val.pop(slot, None)
+
+    def lane_step(self):
+        self.started.set()
+        self.gate.wait(30)
+        # every active lane emits end_id: requests finish in one step
+        return {s: self.end_id for s in self.slot_val}
+
+
+def test_wedged_ignores_inflight_hot_swap_warm():
+    """Satellite: stall detection must not fire during a legitimate
+    _warm compile — a hot swap freezes the step counter with work
+    pending, which is exactly the signature wedged() watches for, and
+    restarting the process for it would turn every deploy into an
+    outage.  A genuine stall still fires before and after."""
+    import threading
+    import time as _time
+
+    gw = Gateway(n_slots=1, max_new_tokens=4)
+    gw.load_model("m", "1", instance=EchoModel(), warm=False)
+    gw.wedged(0.02)                        # idle: resets the mark
+    r1 = gw.submit("m", [42], max_new=2)   # queued; nothing steps it
+    # genuine wedge: busy + frozen step counter -> fires after stall_s
+    assert gw.wedged(0.02) is False        # just marked
+    _time.sleep(0.05)
+    assert gw.wedged(0.02) is True
+    # now the same signature DURING a swap's _warm compile
+    v2 = SlowWarmModel()
+    box = {}
+
+    def do_swap():
+        box["key"] = gw.swap_model("m", "2", instance=v2)
+
+    th = threading.Thread(target=do_swap, daemon=True)
+    th.start()
+    assert v2.started.wait(10), "warm never reached the model"
+    assert gw.wedged(0.02) is False        # resets the stall clock
+    _time.sleep(0.06)
+    assert gw.wedged(0.02) is False, \
+        "wedged() fired during a legitimate _warm compile"
+    v2.gate.set()
+    th.join(30)
+    assert not th.is_alive() and box["key"] == "m@2"
+    # the queued request survived the swap and follows the alias
+    gw.run_until_idle()
+    assert r1.done and r1.error is None
+    assert r1.group == "m@2"
+    # after the swap, a genuine stall fires again
+    v2.gate.clear()                        # wedge the model for real
+    v2.started.clear()
+    r2 = gw.submit("m@2", [43], max_new=2)
+    assert gw.wedged(0.02) is False
+    th2 = threading.Thread(target=gw.run_until_idle, daemon=True)
+    th2.start()
+    assert v2.started.wait(10)
+    _time.sleep(0.06)
+    assert gw.wedged(0.02) is True
+    v2.gate.set()
+    th2.join(30)
+    assert r2.done
+
+
+class ConstModel(EchoModel):
+    """Every lane emits a version-identifying constant — which VERSION
+    served a replayed request is visible in its tokens."""
+
+    def __init__(self, const):
+        super().__init__()
+        self.const = const
+
+    def admit_slot(self, slot, prompt):
+        self.slot_val[slot] = self.const
+        return len(np.asarray(prompt).reshape(-1))
+
+
+def test_journal_replay_resolves_alias_at_current_version(tmp_path):
+    """Satellite: replay after a restart resolves the model ALIAS at
+    the restarted process's current version — never the version that
+    served (or would have served) when the entry was journaled."""
+    path = str(tmp_path / "gw.journal")
+    gw1 = Gateway(n_slots=1, max_new_tokens=4, journal_path=path)
+    gw1.load_model("m", "1", instance=ConstModel(111), warm=False)
+    served = gw1.submit("m", [7], max_new=2)
+    gw1.run_until_idle()
+    assert served.tokens == [111, 111]     # v1 served it pre-restart
+    gw1.submit("m", [8], max_new=2)        # journaled, never served
+    gw1.submit("m", [9], max_new=2)
+    assert len(gw1.journal.pending()) == 2
+    del gw1                                 # the process dies
+    # the restarted process comes up on version 2 (a promote landed
+    # between the crash and the restart)
+    gw2 = Gateway(n_slots=1, max_new_tokens=4, journal_path=path)
+    gw2.load_model("m", "1", instance=ConstModel(111), warm=False)
+    gw2.load_model("m", "2", instance=ConstModel(222), warm=False)
+    gw2.registry.set_alias("m", "2")
+    recovered = gw2.recover()
+    assert [int(r.src[0]) for r in recovered] == [8, 9]
+    gw2.run_until_idle()
+    for r in recovered:
+        assert r.error is None
+        assert r.tokens == [222, 222], \
+            "replay must resolve at the CURRENT version"
+        assert r.group == "m@2"
+    assert gw2.journal.pending() == []

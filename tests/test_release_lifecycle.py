@@ -1,0 +1,1061 @@
+"""Release-lifecycle tests (ISSUE 12): the crash-safe versioned
+publish, the new chaos points, the release journal, deterministic
+canary slicing through the scheduler's admission-policy hook, the
+ReleaseController state machine (evaluate → canary → promote /
+auto-rollback from live series), restart-time resume, the trainer's
+candidate publishing, the lifecycle CLI, and the slow chaos e2e that
+drives the whole train→evaluate→deploy loop under injected faults."""
+
+import json
+import os
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.lifecycle import (CandidatePublisher, CanarySlice,
+                                  ReleaseConfig, ReleaseController,
+                                  ReleaseJournal, fold_state)
+from paddle_tpu.resilience import ChaosError, FaultInjector, install
+from paddle_tpu.serving import ContinuousBatchingScheduler
+from paddle_tpu.serving.gateway import Gateway, ModelRegistry
+
+
+@pytest.fixture(autouse=True)
+def _inert_injector():
+    """Every test starts and ends with an inert process-global
+    injector (tests that inject install their own)."""
+    prev = install(FaultInjector())
+    yield
+    install(prev)
+
+
+class Echo:
+    """Deterministic slot model: every lane repeats its prompt's first
+    token (or a constant) — cross-lane contamination is immediately
+    visible, and 'quality' is checkable as tokens[0] == prompt[0]."""
+
+    start_id, end_id = 0, 1
+    src_len = 64
+
+    def __init__(self, const=None, eval_score=1.0):
+        self.n = 0
+        self.slot_val = {}
+        self.const = const
+        self.eval_score = eval_score
+
+    def open_slots(self, n):
+        self.n = n
+
+    def admit_slot(self, slot, prompt, **_):
+        v = int(np.asarray(prompt).reshape(-1)[0])
+        self.slot_val[slot] = v if self.const is None else self.const
+        return len(np.asarray(prompt).reshape(-1))
+
+    def clear_slot(self, slot):
+        self.slot_val.pop(slot, None)
+
+    def step_slots(self, tokens, pos, src_len):
+        return np.array([self.slot_val.get(i, 7777)
+                         for i in range(self.n)], np.int64)
+
+
+class Crashy(Echo):
+    """Every decode dispatch fails — the error-rate rollback seed."""
+
+    def step_slots(self, tokens, pos, src_len):
+        raise RuntimeError("degraded candidate: dispatch fault")
+
+
+class Slow(Echo):
+    """Each decode step stalls — the p95 rollback seed."""
+
+    def __init__(self, delay=0.03, **kw):
+        super().__init__(**kw)
+        self.delay = delay
+
+    def step_slots(self, tokens, pos, src_len):
+        time.sleep(self.delay)
+        return super().step_slots(tokens, pos, src_len)
+
+
+def _echo_quality(prompt, tokens):
+    return 1.0 if tokens and tokens[0] == int(prompt[0]) else 0.0
+
+
+def _mlp_artifact(tmp_path, seed=0):
+    """A tiny trained program + scope for engine-artifact tests;
+    returns (main, scope, exe, feed_name, target)."""
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        y = fluid.layers.fc(input=h, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return main, scope, exe, "x", y
+
+
+def _events(path):
+    return [e["event"] for e in ReleaseJournal(path).replay()]
+
+
+# -- satellite: crash-safe versioned publish ----------------------------------
+
+def test_versioned_publish_is_atomic_under_crash_injection(tmp_path):
+    """io.publish chaos: a 'crash' after staging but before the rename
+    leaves NO published version — only a staging dir that
+    list_model_versions skips and the next publish sweeps — and the
+    registry never sees a torn artifact."""
+    main, scope, exe, feed, y = _mlp_artifact(tmp_path)
+    root = str(tmp_path / "store")
+    install(FaultInjector(spec="io.publish=1.0", seed=5))
+    with pytest.raises(ChaosError):
+        fluid.io.save_versioned_inference_model(
+            root, "m", "1", [feed], [y], exe, main_program=main,
+            scope=scope)
+    assert fluid.io.list_model_versions(root, "m") == []
+    staged = [d for d in os.listdir(os.path.join(root, "m"))
+              if d.endswith(".tmp")]
+    assert not staged, "the failed publish must clean its staging dir"
+    with pytest.raises(FileNotFoundError):
+        ModelRegistry(root=root).load("m", "1")
+    # a lingering staging dir from a REAL crash (no cleanup ran) is
+    # also invisible and swept by the next publish
+    orphan = os.path.join(root, "m", "1.999.staging.tmp")
+    os.makedirs(orphan)
+    open(os.path.join(orphan, "__model__"), "wb").write(b"torn")
+    assert fluid.io.list_model_versions(root, "m") == []
+    install(FaultInjector())
+    d = fluid.io.save_versioned_inference_model(
+        root, "m", "1", [feed], [y], exe, main_program=main,
+        scope=scope)
+    assert fluid.io.list_model_versions(root, "m") == ["1"]
+    assert not os.path.exists(orphan), "publish must sweep stale staging"
+    reg = ModelRegistry(root=root)
+    reg.load("m", "1")
+    feed_val = {feed: np.ones((2, 6), np.float32)}
+    with fluid.scope_guard(scope):
+        want, = exe.run(main, feed=feed_val, fetch_list=[y],
+                        mode="infer")
+    got, = reg.instance("m").infer(feed_val)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5)
+    assert os.path.basename(d) == "1"
+
+
+def test_int8_manifest_rides_the_atomic_publish(tmp_path):
+    """The optional PTQ manifest is inside the same atomic publish and
+    makes the registry quantize at load."""
+    main, scope, exe, feed, y = _mlp_artifact(tmp_path)
+    root = str(tmp_path / "store")
+    pub = CandidatePublisher(root, "m", [feed], [y], exe,
+                             main_program=main, scope=scope, int8=True)
+    version = pub.publish(7)
+    assert version == "7"
+    d = fluid.io.model_version_dir(root, "m", "7")
+    manifest = json.load(open(os.path.join(d, "gateway.json")))
+    assert manifest["config"]["quantize"] == "int8"
+    reg = ModelRegistry(root=root)
+    reg.load("m", "7")
+    eng = reg.instance("m")
+    assert eng.quantize == "int8"
+    out, = eng.infer({feed: np.ones((2, 6), np.float32)})
+    assert out.shape == (2, 4)
+
+
+def test_current_marker_roundtrip_and_staleness(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "m", "1"))
+    os.makedirs(os.path.join(root, "m", "2"))
+    os.makedirs(os.path.join(root, "m", "2.777.staging.tmp"))
+    assert fluid.io.list_model_versions(root, "m") == ["1", "2"]
+    assert fluid.io.current_model_version(root, "m") is None
+    fluid.io.set_current_version(root, "m", "2")
+    assert fluid.io.current_model_version(root, "m") == "2"
+    # the marker is a file, never a version
+    assert fluid.io.list_model_versions(root, "m") == ["1", "2"]
+    # a marker pointing at a pruned version is stale -> None
+    os.rmdir(os.path.join(root, "m", "2"))
+    assert fluid.io.current_model_version(root, "m") is None
+
+
+# -- satellite: new chaos points ----------------------------------------------
+
+def test_registry_load_chaos_point(tmp_path):
+    main, scope, exe, feed, y = _mlp_artifact(tmp_path)
+    root = str(tmp_path / "store")
+    fluid.io.save_versioned_inference_model(
+        root, "m", "1", [feed], [y], exe, main_program=main,
+        scope=scope)
+    install(FaultInjector(spec="registry.load=1.0", seed=1))
+    reg = ModelRegistry(root=root)
+    with pytest.raises(ChaosError):
+        reg.load("m", "1")
+    assert reg.entries() == []
+    install(FaultInjector())
+    reg.load("m", "1")
+    assert [e["key"] for e in reg.entries()] == ["m@1"]
+
+
+def test_gateway_swap_chaos_point_keeps_old_serving():
+    """An injected mid-swap crash (after load+warm, before the alias
+    flip) leaves the OLD version serving and no orphan group/budget."""
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    gw.load_model("m", "1", instance=Echo(), warm=False)
+    install(FaultInjector(spec="gateway.swap=1.0", seed=2))
+    with pytest.raises(ChaosError):
+        gw.swap_model("m", "2", instance=Echo(const=222))
+    assert [e["key"] for e in gw.registry.entries()] == ["m@1"]
+    assert gw.sched.models() == ["m@1"]
+    r = gw.submit("m", [42], max_new=2)
+    gw.run_until_idle()
+    assert r.error is None and r.tokens == [42, 42]
+    install(FaultInjector())
+    gw.swap_model("m", "2", instance=Echo(const=222))
+    r2 = gw.submit("m", [42], max_new=2)
+    gw.run_until_idle()
+    assert r2.tokens == [222, 222] and r2.group == "m@2"
+
+
+# -- release journal ----------------------------------------------------------
+
+def test_release_journal_torn_tail_and_fold(tmp_path):
+    path = str(tmp_path / "rc.journal")
+    j = ReleaseJournal(path)
+    j.append("candidate", version="1")
+    j.append("promoted", version="1", score=0.9)
+    j.append("candidate", version="2")
+    j.append("canary-start", version="2", fraction=0.25, seed=7,
+             score=0.91)
+    j.append("directive", action="rollback", version=None)
+    with open(path, "a") as f:
+        f.write('{"event":"promoted","version":"2"')   # torn tail
+    st = fold_state(ReleaseJournal(path).replay())
+    assert st.last_good == "1" and st.last_good_score == 0.9
+    assert st.canary == {"version": "2", "fraction": 0.25, "seed": 7,
+                         "score": 0.91}
+    assert len(st.directives) == 1
+    # acknowledging the directive removes it; rollback converges state
+    seq = st.directives[0]["_seq"]
+    j2 = ReleaseJournal(path)
+    j2.append("directive-done", seq=seq, ok=True)
+    j2.append("rollback", version="2", to="1", reason="operator")
+    st2 = j2.state()
+    assert st2.directives == [] and st2.canary is None
+    assert st2.last_good == "1" and "2" in st2.bad
+
+
+# -- canary slicing through the admission-policy hook -------------------------
+
+def _two_group_sched(fraction=0.5, seed=9):
+    sched = ContinuousBatchingScheduler(max_new_tokens=4)
+    stable, canary = Echo(), Echo(const=555)
+    sched.add_model("m@1", stable, 2)
+    sched.add_model("m@2", canary, 2)
+    sched.resolve = lambda alias: "m@1" if alias == "m" else alias
+    slc = CanarySlice("m", "m@1", "m@2", fraction, seed=seed)
+    sched.admission_policy = slc.admission_policy
+    return sched, slc
+
+
+def test_canary_slice_deterministic_and_exact():
+    """The slice is a pure function of (seed, draw index): the routing
+    sequence replays exactly, pinned targets decide the serving group,
+    and the outputs prove which lane group served each request."""
+    sched, slc = _two_group_sched(fraction=0.5, seed=9)
+    reqs = [sched.submit([40 + i], max_new_tokens=2, model="m")
+            for i in range(12)]
+    sched.run_until_idle()
+    expected = ["m@2" if FaultInjector.decision(9, "canary.m", i) < 0.5
+                else "m@1" for i in range(12)]
+    assert [r.group for r in reqs] == expected
+    assert {"m@1", "m@2"} == set(expected), "seed must split both ways"
+    for r, grp in zip(reqs, expected):
+        assert r.error is None
+        want = 555 if grp == "m@2" else int(r.src[0])
+        assert r.tokens == [want, want]
+    st = slc.stats()
+    assert st["draws"] == 12
+    assert st["assigned"]["canary"] == expected.count("m@2")
+    assert st["assigned"]["stable"] == expected.count("m@1")
+
+
+def test_canary_pinned_submissions_bypass_the_slice():
+    sched, slc = _two_group_sched(fraction=1.0, seed=3)
+    pinned = sched.submit([50], max_new_tokens=2, model="m@1")
+    aliased = sched.submit([51], max_new_tokens=2, model="m")
+    sched.run_until_idle()
+    assert pinned.group == "m@1" and pinned.tokens == [50, 50]
+    assert aliased.group == "m@2" and aliased.tokens == [555, 555]
+    assert slc.stats()["draws"] == 1      # only the alias consumed one
+
+
+def test_canary_pin_falls_back_when_group_removed():
+    """A queued request pinned to a rolled-back canary group must fall
+    back to the alias and complete — zero lost across a rollback."""
+    sched, slc = _two_group_sched(fraction=1.0, seed=4)
+    sched.admission_policy = None          # uninstall (rollback order)
+    r = sched.submit([61], max_new_tokens=2, model="m")
+    r.route_to = "m@2"                     # pinned before the rollback
+    sched.remove_model("m@2", drain=True)
+    sched.run_until_idle()
+    assert r.error is None
+    assert r.group == "m@1" and r.tokens == [61, 61]
+    assert r.route_to is None              # the pin was cleared
+
+
+# -- the release controller ---------------------------------------------------
+
+def _controller(tmp_path, gw, **over):
+    cfg_kw = dict(n_slots=2, canary_fraction=0.5, canary_requests=4,
+                  probe_prompts=[[5], [6], [7]], p95_floor_s=0.5,
+                  seed=3)
+    cfg_kw.update(over.pop("cfg", {}))
+    cfg = ReleaseConfig("m", **cfg_kw)
+    kw = dict(journal_path=str(tmp_path / "rc.journal"),
+              eval_fn=lambda inst: getattr(inst, "eval_score", 1.0),
+              quality_fn=_echo_quality)
+    kw.update(over)
+    return ReleaseController(gw, cfg, **kw)
+
+
+def _drive_until_verdict(rc, gw, rounds=20, per_round=4, base=20):
+    for i in range(rounds):
+        rs = [gw.submit("m", [base + i * per_round + k], max_new=4)
+              for k in range(per_round)]
+        gw.run_until_idle()
+        out = rc.step()
+        if out != "canary":
+            return out, rs
+    raise AssertionError("no verdict reached")
+
+
+def test_controller_first_version_promotes_after_gate(tmp_path):
+    root = str(tmp_path / "store")
+    os.makedirs(os.path.join(root, "m", "1"))
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    rc = _controller(tmp_path, gw, root=root)
+    rc.offer("1", Echo())
+    assert rc.step() == "promoted"
+    assert gw.registry.resolve("m") == "m@1"
+    assert fluid.io.current_model_version(root, "m") == "1"
+    assert rc.state.last_good == "1"
+    assert _events(str(tmp_path / "rc.journal")) == ["candidate",
+                                                     "promoted"]
+    assert rc.step() == "idle"
+
+
+def test_controller_rejects_candidate_on_eval_gate(tmp_path):
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    rc = _controller(tmp_path, gw, root=None)
+    rc.offer("1", Echo(eval_score=0.9))
+    assert rc.step() == "promoted"
+    rc.offer("2", Echo(eval_score=0.5))    # regression vs last good
+    assert rc.step() == "rejected"
+    # never touched traffic, fully unloaded, never reconsidered
+    assert [e["key"] for e in gw.registry.entries()] == ["m@1"]
+    assert gw.sched.models() == ["m@1"]
+    assert "2" in rc.state.bad
+    rc.offer("2", Echo(eval_score=0.95))
+    assert rc.step() == "idle", "a rejected version is never retried"
+    rej = [e for e in ReleaseJournal(rc.journal.path).replay()
+           if e["event"] == "rejected"]
+    assert rej[0]["reason"] == "eval_regression"
+    assert rej[0]["score"] == 0.5
+
+
+def test_controller_canary_promotes_good_candidate(tmp_path):
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    inner = gw.sched.admission_policy      # the router policy
+    rc = _controller(tmp_path, gw)
+    rc.offer("1", Echo())
+    rc.step()
+    rc.offer("2", Echo())
+    assert rc.step() == "canary-started"
+    assert rc._canary is not None and gw.sched.models() == ["m@1",
+                                                            "m@2"]
+    verdict, reqs = _drive_until_verdict(rc, gw)
+    assert verdict == "promoted"
+    assert gw.registry.resolve("m") == "m@2"
+    assert [e["key"] for e in gw.registry.entries()] == ["m@2"]
+    assert gw.sched.models() == ["m@2"]
+    # the canary policy is uninstalled after the verdict
+    assert gw.sched.admission_policy is inner
+    assert rc._canary is None
+    # zero lost: every request during the canary was answered ok
+    assert all(r.error is None and len(r.tokens) == 4 for r in reqs)
+    assert _events(rc.journal.path) == [
+        "candidate", "promoted", "candidate", "canary-start",
+        "promoted"]
+
+
+def test_controller_rolls_back_on_quality_probes(tmp_path):
+    """A degraded candidate whose requests COMPLETE (wrong tokens, no
+    errors) is caught by the live per-version quality probes — and
+    rolls back with zero lost requests."""
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    rc = _controller(tmp_path, gw)
+    rc.offer("1", Echo())
+    rc.step()
+    rc.offer("2", Echo(const=9999))        # junk outputs, no crashes
+    assert rc.step() == "canary-started"
+    verdict, reqs = _drive_until_verdict(rc, gw)
+    assert verdict == "rollback"
+    assert gw.registry.resolve("m") == "m@1"
+    assert [e["key"] for e in gw.registry.entries()] == ["m@1"]
+    assert all(r.error is None for r in reqs), "zero lost on rollback"
+    rb = [e for e in ReleaseJournal(rc.journal.path).replay()
+          if e["event"] == "rollback"][0]
+    assert rb["reason"] == "quality" and rb["to"] == "1"
+    assert rb["detail"]["cand_quality"] < rb["detail"]["stable_quality"]
+    assert "2" in rc.state.bad and rc.state.last_good == "1"
+
+
+def test_controller_rolls_back_on_error_rate(tmp_path):
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    rc = _controller(tmp_path, gw)
+    rc.offer("1", Echo())
+    rc.step()
+    rc.offer("2", Crashy())
+    assert rc.step() == "canary-started"
+    verdict, reqs = _drive_until_verdict(rc, gw)
+    assert verdict == "rollback"
+    rb = [e for e in ReleaseJournal(rc.journal.path).replay()
+          if e["event"] == "rollback"][0]
+    assert rb["reason"] == "error_rate"
+    assert rb["detail"]["failed"] >= 1
+    # the stable version keeps serving afterwards
+    r = gw.submit("m", [33], max_new=2)
+    gw.run_until_idle()
+    assert r.error is None and r.tokens == [33, 33]
+
+
+def test_controller_rolls_back_on_p95(tmp_path):
+    """A slow candidate trips the windowed p95 gate read from the
+    paddle_gateway_version_latency_seconds series."""
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    rc = _controller(tmp_path, gw,
+                     cfg=dict(canary_fraction=1.0, canary_requests=2,
+                              p95_floor_s=0.05, probe_prompts=[]))
+    rc.offer("1", Echo())
+    rc.step()
+    rc.offer("2", Slow(delay=0.05))
+    assert rc.step() == "canary-started"
+    verdict, _ = _drive_until_verdict(rc, gw, per_round=2)
+    assert verdict == "rollback"
+    rb = [e for e in ReleaseJournal(rc.journal.path).replay()
+          if e["event"] == "rollback"][0]
+    assert rb["reason"] == "p95"
+    assert rb["detail"]["cand_p95_s"] > 0.05
+    assert gw.registry.resolve("m") == "m@1"
+
+
+def test_controller_restart_resumes_mid_canary(tmp_path):
+    """The journal makes the controller restartable: a new process
+    re-arms the canary with the journaled fraction+seed and observes —
+    it never re-promotes blind."""
+    jpath = str(tmp_path / "rc.journal")
+    gw1 = Gateway(n_slots=2, max_new_tokens=4)
+    rc1 = _controller(tmp_path, gw1)
+    rc1.offer("1", Echo())
+    rc1.step()
+    rc1.offer("2", Echo())
+    assert rc1.step() == "canary-started"
+    del rc1, gw1                           # "crash" mid-canary
+
+    loader = {"1": Echo(), "2": Echo()}
+    gw2 = Gateway(n_slots=2, max_new_tokens=4)
+    rc2 = _controller(tmp_path, gw2, loader=lambda v: loader[v])
+    assert rc2.state.canary["version"] == "2"
+    out = rc2.resume()
+    assert out["canary"] is True
+    assert rc2._canary is not None
+    assert rc2._canary.fraction == 0.5 and rc2._canary.seed == 3
+    assert gw2.registry.resolve("m") == "m@1"      # NOT re-promoted
+    assert sorted(gw2.sched.models()) == ["m@1", "m@2"]
+    assert "promoted" not in _events(jpath)[3:], \
+        "resume must observe, not promote blind"
+    verdict, _ = _drive_until_verdict(rc2, gw2)
+    assert verdict == "promoted"
+    events = _events(jpath)
+    assert events.count("promoted") == 2           # v1 + v2, exactly
+    assert events[-2:] == ["resume", "promoted"] or \
+        events[-1] == "promoted"
+
+
+def test_controller_operator_directives_roundtrip(tmp_path):
+    """CLI status/promote/rollback round-trip: directives append to the
+    journal + flip the CURRENT marker; a live controller applies and
+    acknowledges them at its next step."""
+    from paddle_tpu.tools.lifecycle import main as cli
+
+    root = str(tmp_path / "store")
+    for v in ("1", "2"):
+        os.makedirs(os.path.join(root, "m", v))
+    jpath = str(tmp_path / "rc.journal")
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    loader = {"1": Echo(), "2": Echo(const=222)}
+    rc = _controller(tmp_path, gw, root=root,
+                     loader=lambda v: loader[v])
+    rc.offer("1", loader["1"])
+    assert rc.step() == "promoted"
+    # operator promote of version 2 (not in canary): the directive is
+    # journaled, but the durable marker only flips when the controller
+    # APPLIES it — a refused directive must never leave the marker
+    # pointing at a never-promoted version
+    assert cli(["promote", "2", "--journal", jpath, "--model", "m",
+                "--root", root]) == 0
+    assert fluid.io.current_model_version(root, "m") == "1"
+    assert rc.step() == "directive-promote"
+    assert fluid.io.current_model_version(root, "m") == "2"
+    assert gw.registry.resolve("m") == "m@2"
+    r = gw.submit("m", [9], max_new=2)
+    gw.run_until_idle()
+    assert r.tokens == [222, 222]
+    # operator rollback to 1
+    assert cli(["rollback", "1", "--journal", jpath, "--model", "m",
+                "--root", root]) == 0
+    # a fresh loader instance: version 1 was unloaded at the promote
+    loader["1"] = Echo()
+    assert rc.step() == "directive-rollback"
+    assert gw.registry.resolve("m") == "m@1"
+    assert fluid.io.current_model_version(root, "m") == "1"
+    assert rc.state.last_good == "1" and "2" in rc.state.bad
+    # unknown version is refused by the CLI before it touches anything
+    assert cli(["promote", "9", "--journal", jpath, "--model", "m",
+                "--root", root]) == 1
+    # --set-current is the explicit no-controller marker override
+    assert cli(["promote", "2", "--journal", jpath, "--model", "m",
+                "--root", root, "--set-current"]) == 0
+    assert fluid.io.current_model_version(root, "m") == "2"
+    loader["2"] = Echo(const=222)
+    assert rc.step() == "directive-promote"   # and the controller agrees
+    # every directive is acknowledged exactly once
+    entries = ReleaseJournal(jpath).replay()
+    dirs = [e for e in entries if e["event"] == "directive"]
+    acks = [e for e in entries if e["event"] == "directive-done"]
+    assert len(dirs) == 3 and len(acks) == 3
+    assert all(a["ok"] for a in acks)
+    assert rc.journal.state().directives == []
+    # status folds the same picture
+    assert cli(["status", "--journal", jpath, "--model", "m",
+                "--root", root]) == 0
+
+
+def test_controller_promotes_engine_candidates_directly(tmp_path):
+    """Engine artifacts (no decode lanes) skip the canary: the offline
+    eval gate is the whole pipeline, and the alias flip + CURRENT
+    marker still happen."""
+    main, scope, exe, feed, y = _mlp_artifact(tmp_path)
+    root = str(tmp_path / "store")
+    pub = CandidatePublisher(root, "m", [feed], [y], exe,
+                             main_program=main, scope=scope)
+    pub.publish(4)
+    pub.publish(8)
+    gw = Gateway(registry=ModelRegistry(root=root), n_slots=2)
+    seen = []
+    rc = ReleaseController(
+        gw, ReleaseConfig("m"), journal_path=str(tmp_path / "rc.j"),
+        eval_fn=lambda eng: seen.append(type(eng).__name__) or 1.0)
+    assert rc.step() == "promoted"
+    assert rc.step() == "promoted"
+    assert rc.step() == "idle"
+    assert seen == ["InferenceEngine", "InferenceEngine"]
+    assert gw.registry.resolve("m") == "m@8"
+    assert [e["key"] for e in gw.registry.entries()] == ["m@8"]
+    assert fluid.io.current_model_version(root, "m") == "8"
+    out, = gw.registry.instance("m").infer(
+        {feed: np.ones((2, 6), np.float32)})
+    assert out.shape == (2, 4)
+
+
+# -- trainer-side publishing --------------------------------------------------
+
+def test_trainer_publishes_candidates(tmp_path):
+    """ResilientTrainer(publisher=, publish_every_steps=N) emits
+    loadable versioned candidates every N steps plus the final step —
+    each a complete, atomic artifact."""
+    from paddle_tpu.parallel import TaskQueue
+    from paddle_tpu.resilience import ResilientTrainer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, yv))
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    root = str(tmp_path / "store")
+    pub = CandidatePublisher(root, "mlp", ["x"], [pred], exe,
+                             main_program=main, scope=scope)
+
+    queue = TaskQueue(timeout_secs=5.0, failure_max=3)
+    queue.set_dataset([0, 1, 2])
+
+    def read_chunk(seed):
+        r = np.random.RandomState(seed)
+        return [(r.randn(4, 4).astype(np.float32),
+                 r.randn(4, 1).astype(np.float32)) for _ in range(3)]
+
+    trainer = ResilientTrainer(
+        str(tmp_path / "ckpt"), queue, read_chunk, program=main,
+        scope=scope, save_interval_steps=3, publisher=pub,
+        publish_every_steps=4)
+
+    def train_step(rec, step):
+        with fluid.scope_guard(scope):
+            exe.run(main, feed={"x": rec[0], "y": rec[1]},
+                    fetch_list=[loss])
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        final = trainer.run(train_step)
+    assert final == 9
+    # every 4th step + the final step, atomic, no staging leftovers
+    assert fluid.io.list_model_versions(root, "mlp") == ["4", "8", "9"]
+    assert not [d for d in os.listdir(os.path.join(root, "mlp"))
+                if d.endswith(".tmp")]
+    assert trainer.status()["last_published_version"] == "9"
+    reg = ModelRegistry(root=root)
+    for v in ("4", "8", "9"):
+        reg.load("mlp", v)
+        out, = reg.instance(f"mlp@{v}").infer(
+            {"x": np.ones((2, 4), np.float32)})
+        assert out.shape == (2, 1)
+
+
+# -- the chaos e2e ------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+E2E_TRAINER = """
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    addr, ckpt_dir, store = sys.argv[1:4]
+
+    from paddle_tpu import fluid
+    from paddle_tpu.lifecycle import CandidatePublisher
+    from paddle_tpu.parallel import MasterClient
+    from paddle_tpu.resilience import ResilientTrainer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        y = fluid.layers.data("y", [1], "float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    W = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+
+    def read_chunk(seed):
+        r = np.random.RandomState(seed)
+        out = []
+        for _ in range(4):
+            xs = r.randn(8, 4).astype(np.float32)
+            out.append((xs, xs @ W[:, None]))
+        return out
+
+    publisher = CandidatePublisher(store, "mlp", ["x"], [pred], exe,
+                                   main_program=main, scope=scope)
+    client = MasterClient(addr, worker=f"pid-{os.getpid()}")
+    trainer = ResilientTrainer(ckpt_dir, client, read_chunk,
+                               program=main, scope=scope,
+                               save_interval_steps=1,
+                               poll_interval=0.05,
+                               publisher=publisher,
+                               publish_every_steps=4)
+
+    def train_step(rec, step):
+        xs = np.asarray(rec[0], np.float32)
+        ys = np.asarray(rec[1], np.float32)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+
+    with fluid.scope_guard(scope):
+        final = trainer.run(train_step,
+                            init_fn=lambda: exe.run(startup))
+    print("TRAINER-DONE step", final, flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_chaos_e2e_trainer_kill_publish_and_deploy(tmp_path):
+    """Acceptance leg 1: seeded chaos SIGKILLs the trainer mid-epoch
+    (kill-after-N leases, respawned by the supervised launcher) while
+    it publishes candidates every 4 steps; every version the store
+    ends up with is a COMPLETE artifact (the staged publish cannot
+    tear), and the release controller deploys the trained candidates
+    through the engine pipeline."""
+    from paddle_tpu.launch import launch
+    from paddle_tpu.parallel import MasterServer, TaskQueue
+
+    script = str(tmp_path / "trainer.py")
+    open(script, "w").write(textwrap.dedent(E2E_TRAINER))
+    ckpt = str(tmp_path / "ckpt")
+    store = str(tmp_path / "store")
+    journal = str(tmp_path / "chaos.journal")
+    n_chunks = 6
+
+    queue = TaskQueue(timeout_secs=1.0, failure_max=10)
+    queue.set_dataset(list(range(n_chunks)))
+    server = MasterServer(queue)
+    addr = server.start()
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "PADDLE_TPU_CHAOS_SEED": "7",
+           "PADDLE_TPU_CHAOS_KILL_AFTER": "3",
+           "PADDLE_TPU_CHAOS_LOG": journal}
+    try:
+        rc = launch(1, [script, addr, ckpt, store], env_extra=env,
+                    max_restarts=10, kill_grace=5.0,
+                    log_dir=str(tmp_path / "logs"))
+        assert rc == 0
+        counts = server.queue.counts()
+        assert counts["done"] == n_chunks and counts["failed"] == 0
+    finally:
+        server.stop()
+    kills = [ln for ln in open(journal) if ln.startswith("# kill-self")]
+    assert kills, "chaos never killed the trainer"
+
+    versions = fluid.io.list_model_versions(store, "mlp")
+    assert len(versions) >= 2, versions
+    # checkpoint-every-step means the step counter is monotonic across
+    # incarnations and the final publish is at >= one pass over the
+    # data (re-delivered leases can only push it higher)
+    assert int(versions[-1]) >= n_chunks * 4
+    # a SIGKILL mid-publish may orphan a staging dir, but it is never a
+    # version (and the next publish of the model sweeps it)
+    assert not [v for v in versions if v.endswith(".tmp")]
+    # EVERY surviving version is a complete artifact: loads and serves
+    # (the atomic-publish guarantee under real SIGKILL)
+    gw = Gateway(registry=ModelRegistry(root=store), n_slots=2)
+    probe = np.array([[0.5, -1.0, 2.0, 0.25]], np.float32)
+    W = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+
+    want = float((probe @ W[:, None]).item())
+
+    def eval_fn(eng):
+        out, = eng.infer({"x": probe})
+        return -float(out[0, 0] - want) ** 2
+
+    rc2 = ReleaseController(
+        gw, ReleaseConfig("mlp", max_eval_delta=1e9),
+        journal_path=str(tmp_path / "rc.journal"), eval_fn=eval_fn)
+    promoted = 0
+    while True:
+        out = rc2.step()
+        if out == "idle":
+            break
+        assert out == "promoted", out
+        promoted += 1
+    assert promoted == len(versions)
+    assert rc2.state.last_good == versions[-1]
+    assert fluid.io.current_model_version(store, "mlp") == versions[-1]
+    # the deployed (trained) candidate has converged toward x @ W
+    # (fc starts near 0; one noisy pass gets close, not exact)
+    out, = gw.registry.instance("mlp").infer({"x": probe})
+    assert abs(float(out[0, 0]) - want) < 0.45 * abs(want)
+
+
+@pytest.mark.slow
+def test_chaos_e2e_canary_restart_and_degraded_rollback(tmp_path):
+    """Acceptance legs 2+3: a gateway 'restart' mid-canary (request
+    journal replay + controller resume) and a deliberately-degraded
+    candidate both converge to the last good version with zero lost
+    requests and zero steady-state recompiles; the verdict comes from
+    the live paddle_gateway_* quality/p95 series."""
+    from paddle_tpu.serving import PagedTransformerGenerator, copy_weights
+
+    V = 24
+    kw = dict(n_layer=2, n_head=2, d_key=4, d_value=4, d_model=16,
+              d_inner_hid=32, max_length=64, src_len=8, max_out_len=8,
+              page_size=4, chunk_size=4, num_pages=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    gen1 = PagedTransformerGenerator(V, V, param_prefix="rl",
+                                     executor=exe, **kw)
+    gen1.init_params(seed=3)
+    degraded = PagedTransformerGenerator(V, V, param_prefix="rl",
+                                         executor=exe, **kw)
+    degraded.init_params(seed=77)          # junk weights vs v1
+    good = PagedTransformerGenerator(V, V, param_prefix="rl",
+                                     place=fluid.CPUPlace(), **kw)
+    copy_weights(gen1.scope, good.scope, prefix="rl")
+    loader = {"1": gen1, "2": degraded, "3": good}
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, V, rng.randint(3, 9)) for _ in range(16)]
+    probe_prompts = [list(int(t) for t in p) for p in prompts[:3]]
+
+    def until_end(tokens):
+        toks = [int(t) for t in tokens]
+        return toks[:toks.index(1) + 1] if 1 in toks else toks
+
+    golden = {}
+    for p in prompts:
+        golden[tuple(int(t) for t in p)] = until_end(
+            gen1.greedy(np.asarray(p).reshape(1, -1),
+                        np.array([len(p)], np.int32), max_new=4,
+                        stop_at_end=False)[0])
+
+    def quality_fn(prompt, tokens):
+        return 1.0 if tokens == golden[tuple(int(t) for t in prompt)] \
+            else 0.0
+
+    cfg = ReleaseConfig("m", n_slots=2, canary_fraction=0.5,
+                        canary_requests=6, probe_prompts=probe_prompts,
+                        probe_max_new=4, p95_floor_s=30.0, seed=11)
+    jpath = str(tmp_path / "gw.journal")
+    cpath = str(tmp_path / "rc.journal")
+
+    def submit_round(gw, n=4, max_new=4):
+        rs = [gw.submit("m", prompts[i % len(prompts)], max_new=max_new)
+              for i in range(n)]
+        gw.run_until_idle()
+        return rs
+
+    # -- phase 1: v1 serves; good candidate... no, the DEGRADED one ----
+    gw1 = Gateway(n_slots=2, max_new_tokens=8, journal_path=jpath)
+    rc1 = ReleaseController(gw1, cfg, journal_path=cpath,
+                            loader=lambda v: loader[v],
+                            quality_fn=quality_fn)
+    rc1.offer("1", gen1)
+    assert rc1.step() == "promoted"
+    served = submit_round(gw1)
+    for r in served:
+        assert r.error is None
+        assert r.tokens == golden[tuple(int(t) for t in r.src)]
+    rc1.offer("2", degraded)
+    assert rc1.step() == "canary-started"
+    # journaled-but-unserved requests ride the restart
+    pending = [gw1.submit("m", p, max_new=4) for p in prompts[4:8]]
+    assert len(gw1.journal.pending()) == 4
+    del pending
+
+    # -- phase 2: gateway "restart" mid-canary -------------------------
+    del gw1, rc1                           # the process dies
+    gw2 = Gateway(n_slots=2, max_new_tokens=8, journal_path=jpath)
+    rc2 = ReleaseController(gw2, cfg, journal_path=cpath,
+                            loader=lambda v: loader[v],
+                            quality_fn=quality_fn)
+    assert rc2.state.canary == {"version": "2", "fraction": 0.5,
+                                "seed": 11, "score": None}
+    out = rc2.resume()
+    assert out["canary"] is True
+    assert gw2.registry.resolve("m") == "m@1", "resume must not promote"
+    replayed = gw2.recover()
+    assert len(replayed) == 4
+    gw2.run_until_idle()
+    for r in replayed:
+        assert r.error is None and len(r.tokens) >= 1
+    # steady-state recompile mark AFTER the post-restart warm + replay
+    miss0 = exe.cache_stats()["executable"]["misses"]
+
+    # -- phase 3: the degraded candidate auto-rolls-back ---------------
+    all_reqs = list(replayed)
+    for _ in range(20):
+        all_reqs += submit_round(gw2)
+        verdict = rc2.step()
+        if verdict != "canary":
+            break
+    assert verdict == "rollback"
+    rb = [e for e in ReleaseJournal(cpath).replay()
+          if e["event"] == "rollback"][0]
+    assert rb["reason"] in ("quality", "p95") and rb["to"] == "1"
+    assert gw2.registry.resolve("m") == "m@1"
+    assert [e["key"] for e in gw2.registry.entries()] == ["m@1"]
+    # zero lost requests: everything submitted was answered ok
+    assert all(r.error is None for r in all_reqs)
+    gw2.journal.flush()
+    assert gw2.journal.pending() == []
+    # zero steady-state recompiles across restart + canary + rollback
+    post = submit_round(gw2)
+    for r in post:
+        assert r.tokens == golden[tuple(int(t) for t in r.src)]
+    assert exe.cache_stats()["executable"]["misses"] == miss0
+
+    # -- phase 4: a good candidate converges to promotion --------------
+    rc2.offer("3", good)
+    assert rc2.step() == "canary-started"
+    for _ in range(20):
+        all_reqs += submit_round(gw2)
+        verdict = rc2.step()
+        if verdict != "canary":
+            break
+    assert verdict == "promoted"
+    assert gw2.registry.resolve("m") == "m@3"
+    final = submit_round(gw2)
+    for r in final:
+        assert r.error is None
+        assert r.tokens == golden[tuple(int(t) for t in r.src)]
+    # the controller journal replays to the same converged state
+    st = ReleaseJournal(cpath).state()
+    assert st.last_good == "3" and st.bad == {"2"} and st.canary is None
+    events = _events(cpath)
+    assert events.count("resume") == 1 and events.count("rollback") == 1
+
+
+def test_two_controllers_chain_canaries_on_one_gateway(tmp_path):
+    """Two controllers (one per model alias) on ONE gateway: arming the
+    second canary chains onto the first instead of clobbering it, both
+    slices route their own alias, and either verdict splices only its
+    own slice out of the chain."""
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    inner = gw.sched.admission_policy      # the router policy
+    rc_a = _controller(tmp_path, gw, cfg={"probe_prompts": [[5]]},
+                       journal_path=str(tmp_path / "a.journal"))
+    rc_a.cfg.model = "mA"
+    rc_b = _controller(tmp_path, gw, cfg={"probe_prompts": [[5]]},
+                       journal_path=str(tmp_path / "b.journal"))
+    rc_b.cfg.model = "mB"
+    rc_a.offer("1", Echo())
+    rc_a.step()
+    rc_b.offer("1", Echo())
+    rc_b.step()
+    rc_a.offer("2", Echo())
+    assert rc_a.step() == "canary-started"
+    rc_b.offer("2", Echo(const=9999))      # degraded candidate for B
+    assert rc_b.step() == "canary-started"
+    # B chained onto A: A's slice still routes
+    ra = [gw.submit("mA", [10 + i], max_new=2) for i in range(8)]
+    rb = [gw.submit("mB", [20 + i], max_new=2) for i in range(8)]
+    gw.run_until_idle()
+    assert {r.group for r in ra} == {"mA@1", "mA@2"}
+    assert {r.group for r in rb} == {"mB@1", "mB@2"}
+    # B's rollback must leave A's canary armed and routing
+    for _ in range(10):
+        rb += [gw.submit("mB", [30], max_new=2) for _ in range(4)]
+        gw.run_until_idle()
+        if rc_b.step() != "canary":
+            break
+    assert rc_b.state.canary is None and "2" in rc_b.state.bad
+    assert rc_a._canary is not None
+    ra2 = [gw.submit("mA", [40 + i], max_new=2) for i in range(8)]
+    gw.run_until_idle()
+    assert {r.group for r in ra2} == {"mA@1", "mA@2"}
+    # ... and A's verdict restores the original policy
+    for _ in range(10):
+        ra2 += [gw.submit("mA", [50 + i], max_new=2) for i in range(4)]
+        gw.run_until_idle()
+        if rc_a.step() != "canary":
+            break
+    assert rc_a.state.last_good == "2"
+    assert gw.sched.admission_policy is inner
+    assert all(r.error is None for r in ra + ra2)
+
+
+def test_saturated_canary_group_does_not_block_stable_admission():
+    """A full canary group must not stall the whole admission round:
+    the policy re-picks among the remaining candidates, so stable
+    traffic keeps admitting into free stable slots."""
+    sched = ContinuousBatchingScheduler(max_new_tokens=4)
+    stable, canary = Echo(), Echo(const=555)
+    sched.add_model("m@1", stable, 2)
+    sched.add_model("m@2", canary, 1)      # tiny canary group
+    sched.resolve = lambda alias: "m@1" if alias == "m" else alias
+    slc = CanarySlice("m", "m@1", "m@2", fraction=1.0, seed=5)
+    sched.admission_policy = slc.admission_policy
+    # fill the single canary lane, leave more queued behind it
+    reqs = [sched.submit([70 + i], max_new_tokens=4, model="m")
+            for i in range(5)]
+    admitted = sched._admit_pending()
+    # one pick landed on the canary lane; the rest (all pinned to the
+    # FULL canary group) cannot stall a stable-pinned... with
+    # fraction=1.0 every pin targets the canary, so only 1 admits —
+    # but a stable-pinned submission must still get through
+    assert admitted == 1
+    pinned_stable = sched.submit([90], max_new_tokens=4, model="m@1")
+    assert sched._admit_pending() >= 1     # not blocked by the queue
+    sched.run_until_idle()
+    for r in reqs:
+        assert r.error is None and r.tokens == [555] * 4
+    assert pinned_stable.error is None
+    assert pinned_stable.tokens == [90] * 4
+
+
+def test_republish_same_version_never_destroys_published(tmp_path):
+    """Re-publishing an existing version must never delete the
+    published artifact before the replacement is in place: the old dir
+    moves ASIDE (a .tmp name the listing skips) and only then is
+    swept.  A chaos 'crash' BEFORE the swap leaves the original
+    artifact fully intact and served."""
+    main, scope, exe, feed, y = _mlp_artifact(tmp_path)
+    root = str(tmp_path / "store")
+    fluid.io.save_versioned_inference_model(
+        root, "m", "1", [feed], [y], exe, main_program=main,
+        scope=scope)
+    before = sorted(os.listdir(fluid.io.model_version_dir(root, "m",
+                                                          "1")))
+    # crash-injected re-publish: the original must survive untouched
+    install(FaultInjector(spec="io.publish=1.0", seed=3))
+    with pytest.raises(ChaosError):
+        fluid.io.save_versioned_inference_model(
+            root, "m", "1", [feed], [y], exe, main_program=main,
+            scope=scope)
+    install(FaultInjector())
+    assert fluid.io.list_model_versions(root, "m") == ["1"]
+    assert sorted(os.listdir(fluid.io.model_version_dir(
+        root, "m", "1"))) == before
+    reg = ModelRegistry(root=root)
+    reg.load("m", "1")          # still a complete, loadable artifact
+    # a clean re-publish replaces it and leaves no .tmp residue
+    fluid.io.save_versioned_inference_model(
+        root, "m", "1", [feed], [y], exe, main_program=main,
+        scope=scope)
+    assert fluid.io.list_model_versions(root, "m") == ["1"]
+    assert not [d for d in os.listdir(os.path.join(root, "m"))
+                if d.endswith(".tmp")]
+
+
+def test_rollback_retires_per_version_metric_series(tmp_path):
+    """The continual loop must not leak one histogram per candidate it
+    ever canaried: rolling back (or swapping out) a version drops its
+    per-version metric children."""
+    from paddle_tpu.observability.metrics import registry as obs_registry
+
+    gw = Gateway(n_slots=2, max_new_tokens=4)
+    rc = _controller(tmp_path, gw)
+    rc.cfg.model = "gcM"                  # unique: the registry is global
+    rc.offer("1", Echo())
+    rc.step()
+    rc.offer("2", Echo(const=9999))
+    assert rc.step() == "canary-started"
+    for i in range(12):
+        rs = [gw.submit("gcM", [60 + i], max_new=2) for _ in range(4)]
+        gw.run_until_idle()
+        if rc.step() != "canary":
+            break
+    assert rc.state.canary is None and "2" in rc.state.bad
+
+    def versions_with_children(fam_name):
+        fam = obs_registry().get(fam_name)
+        out = set()
+        for vals, _ in (fam.children() if fam else []):
+            labels = dict(zip(fam.label_names, vals))
+            if labels.get("model", "").split("@")[0] == "gcM":
+                out.add(labels.get("version"))
+        return out
+
+    assert "2" not in versions_with_children(
+        "paddle_gateway_version_latency_seconds")
+    assert "2" not in versions_with_children(
+        "paddle_gateway_requests_total")
+    # the serving version's series survive
+    assert "1" in versions_with_children(
+        "paddle_gateway_version_latency_seconds")

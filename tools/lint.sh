@@ -230,6 +230,32 @@ with open(os.path.join(tmpdir, "gateway_engine.json"), "wb") as f:
 with open(os.path.join(tmpdir, "gateway_engine.fetch"), "w") as f:
     f.write("".join(str(v.name if hasattr(v, "name") else v) + "\n"
                     for v in einst.fetch_list))
+
+# lifecycle sweep (ISSUE 12): the candidate artifacts the release
+# controller publishes and gates — fp32 AND the int8-PTQ-manifested
+# variant — must round-trip the staged publish, load through the
+# registry, and dispatch analyzer-clean programs
+lroot = os.path.join(tmpdir, "lifecycle-store")
+with fluid.scope_guard(escope):
+    fluid.io.save_versioned_inference_model(
+        lroot, "cand", "1", ["ex"], [ey], eexe, main_program=emain)
+    fluid.io.save_versioned_inference_model(
+        lroot, "cand", "2", ["ex"], [ey], eexe, main_program=emain,
+        manifest={"kind": "engine", "config": {"quantize": "int8"}})
+lreg = ModelRegistry(root=lroot, place=fluid.CPUPlace())
+for ver, tag in (("1", "fp32"), ("2", "int8")):
+    lreg.load("cand", ver)
+    linst = lreg.instance(f"cand@{ver}")
+    if tag == "int8":
+        assert linst.quantize == "int8" and linst.program is not emain, \
+            "int8 manifest did not trigger the PTQ rewrite at load"
+    with open(os.path.join(tmpdir, f"lifecycle_cand_{tag}.json"),
+              "wb") as f:
+        f.write(linst.program.desc.serialize_to_string())
+    with open(os.path.join(tmpdir, f"lifecycle_cand_{tag}.fetch"),
+              "w") as f:
+        f.write("".join(str(v.name if hasattr(v, "name") else v) + "\n"
+                        for v in linst.fetch_list))
 EOF
   for prog in "$tmpdir"/*.json; do
     name="$(basename "$prog" .json)"
