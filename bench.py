@@ -1019,6 +1019,175 @@ def bench_release(trials: int, n_slots: int = 4, decode_len: int = 8):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_sync(trials: int, n_slots: int = 4, decode_len: int = 8):
+    """ISSUE 13: the concurrency sanitizer's cost story.
+
+    Three tiers, innermost out:
+
+    * **lock microbench** — acquire/release pairs on a raw
+      ``threading.Lock``, an ``OrderedLock`` with checking OFF (the
+      passthrough every production lock now runs through), and with
+      checking ON (order/cycle checks + accounting).
+    * **scheduler step** — a REAL paged-generator scheduler driven
+      inline, per-step wall with checking off vs on.  The passthrough
+      CONTRACT is derived honestly from measurements, not a vibe:
+      per-acquire passthrough overhead (ordered_off − raw) × the
+      measured acquires-per-step must stay **< 1%** of the bare step
+      (gated via the missing-metrics gate); the checking-ON overhead
+      is *reported, not gated* — it is a debug mode.
+    * **gateway submit** — the submit path (rate-limit + journal-less
+      enqueue) latency off vs on, reported.
+    """
+    import threading as _th
+
+    from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                    PagedTransformerGenerator)
+    from paddle_tpu.serving.gateway import Gateway
+    from paddle_tpu.utils import sync
+
+    assert not sync.checking_enabled(), \
+        "bench must start from the passthrough default"
+
+    def _time_lock(lk, iters=20000):
+        best = float("inf")
+        for _ in range(max(2, trials)):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                with lk:
+                    pass
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best * 1e9
+
+    raw_ns = _time_lock(_th.Lock())
+    off_ns = _time_lock(sync.OrderedLock("bench.sync.off", 95))
+    sync.registry().reset()
+    sync.enable_checking()
+    try:
+        on_ns = _time_lock(sync.OrderedLock("bench.sync.on", 95))
+    finally:
+        sync.disable_checking()
+        sync.registry().reset()
+
+    # -- the real scheduler-step legs ---------------------------------------
+    vocab, src_len = 512, 16
+    gen = PagedTransformerGenerator(
+        vocab, vocab, n_layer=2, n_head=4, d_key=16, d_value=16,
+        d_model=64, d_inner_hid=128, max_length=src_len + decode_len + 2,
+        src_len=src_len, max_out_len=decode_len, page_size=8,
+        chunk_size=8, num_pages=4 * n_slots * 8 + 1, param_prefix="syb")
+    gen.init_params(seed=0)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, vocab, int(rng.randint(4, src_len + 1)))
+               for _ in range(6 * n_slots)]
+
+    def _step_leg(checked):
+        if checked:
+            sync.registry().reset()
+            sync.enable_checking()
+        try:
+            best = float("inf")
+            acquires = steps = 0
+            for _ in range(max(2, trials)):
+                sched = ContinuousBatchingScheduler(
+                    gen, n_slots=n_slots, max_new_tokens=decode_len)
+                for p in prompts:
+                    sched.submit(p)
+                t0 = time.perf_counter()
+                steps = sched.run_until_idle()
+                wall = time.perf_counter() - t0
+                assert steps > 0
+                best = min(best, wall / steps)
+                sched.shutdown()
+            if checked:
+                locks = sync.registry().status()["locks"]
+                acquires = sum(v["acquires"] for v in locks.values())
+            return best * 1e3, steps, acquires
+        finally:
+            if checked:
+                sync.disable_checking()
+                sync.registry().reset()
+
+    bare_ms, bare_steps, _ = _step_leg(False)
+    checked_ms, checked_steps, acquires = _step_leg(True)
+    # acquires measured across the whole checked trial set: submits +
+    # steps + retirement; normalize per step for the contract
+    acquires_per_step = acquires / max(1, checked_steps * max(2, trials))
+    passthrough_pct = ((off_ns - raw_ns) * acquires_per_step
+                       / (bare_ms * 1e6) * 100.0)
+
+    # -- gateway submit latency ---------------------------------------------
+    class _Echo:
+        start_id, end_id = 0, 1
+        src_len = 64
+
+        def __init__(self):
+            self.n, self.slot_val = 0, {}
+
+        def open_slots(self, n):
+            self.n = n
+
+        def admit_slot(self, slot, prompt, **_):
+            self.slot_val[slot] = int(prompt[0])
+            return len(prompt)
+
+        def clear_slot(self, slot):
+            self.slot_val.pop(slot, None)
+
+        def step_slots(self, tokens, pos, src_len):
+            return np.array([self.slot_val.get(i, 0)
+                             for i in range(self.n)], np.int64)
+
+    def _submit_leg(checked):
+        if checked:
+            sync.enable_checking()
+        try:
+            best = float("inf")
+            for _ in range(max(2, trials)):
+                gw = Gateway(n_slots=2, max_new_tokens=4)
+                gw.load_model("m", "1", instance=_Echo())
+                n = 300
+                t0 = time.perf_counter()
+                for i in range(n):
+                    gw.submit("m", [2 + (i % 60)], tenant="bench")
+                best = min(best,
+                           (time.perf_counter() - t0) / n * 1e6)
+                gw.run_until_idle()
+                gw.shutdown(drain=True)
+            return best
+        finally:
+            if checked:
+                sync.disable_checking()
+                sync.registry().reset()
+
+    submit_bare_us = _submit_leg(False)
+    submit_checked_us = _submit_leg(True)
+
+    return {
+        "lock_ns": {"raw": round(raw_ns, 1),
+                    "ordered_off": round(off_ns, 1),
+                    "ordered_on": round(on_ns, 1)},
+        "scheduler_step_ms": {
+            "bare": round(bare_ms, 4),
+            "checked": round(checked_ms, 4),
+            "checked_overhead_pct": round(
+                (checked_ms - bare_ms) / bare_ms * 100, 2),
+        },
+        "gateway_submit_us": {
+            "bare": round(submit_bare_us, 2),
+            "checked": round(submit_checked_us, 2),
+            "checked_overhead_pct": round(
+                (submit_checked_us - submit_bare_us)
+                / submit_bare_us * 100, 2),
+        },
+        "acquires_per_step": round(acquires_per_step, 2),
+        # the gated contract: the always-on passthrough must cost the
+        # scheduler step < 1%
+        "passthrough_overhead_pct": round(max(0.0, passthrough_pct), 4),
+        "within_contract": bool(max(0.0, passthrough_pct) < 1.0),
+        "steps_measured": int(bare_steps),
+    }
+
+
 def _calibrated_chip():
     """Measured machine model for the roofline gate: achievable matmul
     FLOP/s and achievable copy bandwidth of THIS device (env overrides:
@@ -1713,6 +1882,16 @@ def main() -> None:
         except Exception as e:
             print(f"release bench failed: {e}", file=sys.stderr)
 
+    sync_cmp = None
+    if os.environ.get("BENCH_SKIP_SYNC", "") != "1":
+        try:
+            sync_cmp = retry_transient(
+                bench_sync, trials,
+                int(os.environ.get("BENCH_SYNC_SLOTS", "4")),
+                int(os.environ.get("BENCH_SYNC_DECODE", "8")))
+        except Exception as e:
+            print(f"sync bench failed: {e}", file=sys.stderr)
+
     cost_model = None
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         try:
@@ -1796,6 +1975,11 @@ def main() -> None:
         # degraded-candidate auto-rollback cycle walls, with zero lost
         # requests and zero steady-state recompiles across both
         "release": release_cmp,
+        # concurrency sanitizer (ISSUE 13): ordered-lock passthrough
+        # cost on the real scheduler step + gateway submit (contract:
+        # passthrough < 1% of a step; checking-ON overhead reported,
+        # not gated — it is a debug mode)
+        "sync": sync_cmp,
         # static cost analyzer gate (ISSUE 11): planner peak HBM vs XLA
         # memory_analysis and roofline step time vs chained device time
         # on mnist / the NMT transformer / the paged int8 decode step,
@@ -1844,6 +2028,13 @@ def main() -> None:
             # the loop's safety contract IS the metric: a lost request
             # or a wrong verdict is a failed run, like a band violation
             missing.append("release_contract")
+    if os.environ.get("BENCH_SKIP_SYNC", "") != "1":
+        if sync_cmp is None:
+            missing.append("sync")
+        elif not sync_cmp["within_contract"]:
+            # the always-on passthrough priced itself above 1% of a
+            # scheduler step — a failed run, like any perf regression
+            missing.append("sync_overhead_contract")
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         if cost_model is None:
             missing.append("cost_model")

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ..utils.reader import PrefetchIterator
+from ..utils.sync import RANK_LOADER, OrderedLock
 
 __all__ = ["DataLoader", "device_put_feed"]
 
@@ -116,6 +117,10 @@ class DataLoader:
         # eliminated.  Track it and raise instead.
         self._one_shot = (not callable(reader)
                           and iter(reader) is reader)
+        # guards the one-shot check-and-set: two threads iterating one
+        # loader concurrently used to BOTH pass the _exhausted check and
+        # silently split the epoch between them (ISSUE 13 migration)
+        self._state_lock = OrderedLock("pipeline.loader", RANK_LOADER)
         self._exhausted = False
         self._feed_fn: Optional[Callable] = None
         if feeder is not None:
@@ -141,12 +146,13 @@ class DataLoader:
 
     def __iter__(self):
         if self._one_shot:
-            if self._exhausted:
-                raise RuntimeError(
-                    "DataLoader reader was a one-shot iterator and is "
-                    "already exhausted; pass a zero-arg callable (or a "
-                    "re-iterable) for multi-epoch use")
-            self._exhausted = True
+            with self._state_lock:
+                if self._exhausted:
+                    raise RuntimeError(
+                        "DataLoader reader was a one-shot iterator and "
+                        "is already exhausted; pass a zero-arg callable "
+                        "(or a re-iterable) for multi-epoch use")
+                self._exhausted = True
         src = self._reader() if callable(self._reader) else iter(self._reader)
         it = PrefetchIterator(src, self.capacity, transform=self._prepare)
         try:

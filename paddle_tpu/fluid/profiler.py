@@ -11,10 +11,11 @@ equivalent of nvprof)."""
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
+
+from ..utils.sync import RANK_PROFILER, OrderedLock
 
 __all__ = ["profiler", "cuda_profiler", "tpu_trace", "reset_profiler", "op_cost_table",
            "record_event", "get_profile_table"]
@@ -26,7 +27,7 @@ __all__ = ["profiler", "cuda_profiler", "tpu_trace", "reset_profiler", "op_cost_
 # concurrent append and could resize mid-iteration in
 # get_profile_table)
 _events: Dict[str, List[float]] = defaultdict(list)
-_events_lock = threading.Lock()
+_events_lock = OrderedLock("fluid.profiler", RANK_PROFILER)
 _enabled = False
 
 from ..observability.tracing import tracer as _obs_tracer  # noqa: E402

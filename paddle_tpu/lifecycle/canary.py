@@ -28,11 +28,11 @@ Design points:
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, List, Optional
 
 from ..resilience.chaos import FaultInjector
 from ..serving.scheduler import Request
+from ..utils.sync import RANK_CANARY, OrderedLock
 
 __all__ = ["CanarySlice"]
 
@@ -52,7 +52,8 @@ class CanarySlice:
         self.fraction = float(fraction)
         self.seed = int(seed)
         self.inner = inner
-        self._lock = threading.Lock()
+        # acquired under the scheduler lock (admission_policy hook)
+        self._lock = OrderedLock("lifecycle.canary", RANK_CANARY)
         self._draw = 0
         self.assigned = {"stable": 0, "canary": 0}
 

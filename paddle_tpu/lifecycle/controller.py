@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..fluid import io as fio
 from ..observability import metrics as _obs_metrics
 from ..observability.metrics import bucket_percentile
+from ..utils.sync import RANK_LIFECYCLE, OrderedLock
 from .canary import CanarySlice
 from .journal import ReleaseJournal, ReleaseState
 
@@ -149,6 +150,14 @@ class ReleaseController:
         # (tests, in-process candidates); None loads from self.root
         self.loader = loader
         self._clock = clock
+        # guards the mutable pipeline state (state sets/canary dict,
+        # the offer queue) against concurrent readers: status() runs on
+        # ObservabilityServer HTTP threads and offer() on the trainer's
+        # publish thread, while step() mutates — sorted() over a set
+        # being mutated mid-step raised (ISSUE 13 migration).  Ranked
+        # at the very top of the order: step() acquires scheduler /
+        # registry / journal locks while holding it.
+        self._lock = OrderedLock("lifecycle.controller", RANK_LIFECYCLE)
         self.journal = ReleaseJournal(journal_path)
         self.state: ReleaseState = self.journal.state()
         self._canary: Optional[CanarySlice] = None
@@ -179,12 +188,17 @@ class ReleaseController:
     def offer(self, version: str, instance=None) -> None:
         """Queue an in-process candidate (takes precedence over disk
         discovery; duplicates of seen/bad versions are dropped at
-        consideration time)."""
-        self._offers.append((str(version), instance))
+        consideration time).  Thread-safe: the trainer's publish hook
+        calls this from its own thread."""
+        with self._lock:
+            self._offers.append((str(version), instance))
 
     def _next_candidate(self) -> Optional[Tuple[str, object]]:
-        while self._offers:
-            version, instance = self._offers.pop(0)
+        while True:
+            with self._lock:
+                if not self._offers:
+                    break
+                version, instance = self._offers.pop(0)
             if not self._considered(version):
                 return version, instance
         if self.root is not None:
@@ -267,18 +281,25 @@ class ReleaseController:
         return True, score, ""
 
     def _consider(self, version: str, instance=None) -> str:
+        # journal FIRST (its own rank-52 file lock; fsync must never
+        # run under the controller lock — the exact stall class this
+        # PR's lint exists to catch), then commit the in-memory state
+        # under the lock.  A crash in the gap loses nothing: the state
+        # is a fold of the journal and rebuilds on resume.
         name = self.cfg.model
         self.journal.append("candidate", version=version)
-        self.state.seen.add(version)
+        with self._lock:
+            self.state.seen.add(version)
         self._m_transitions.labels(event="candidate").inc()
         first = self.gw.registry.current_key(name) is None
         try:
             key = self._load(version, instance)
         except Exception as e:
-            self.journal.append("rejected", version=version,
-                                reason="load_failed",
-                                error=f"{type(e).__name__}: {e}"[:200])
-            self.state.bad.add(version)
+            self.journal.append(
+                "rejected", version=version, reason="load_failed",
+                error=f"{type(e).__name__}: {e}"[:200])
+            with self._lock:
+                self.state.bad.add(version)
             self._m_transitions.labels(event="rejected").inc()
             return "rejected"
         ok, score, reason = self._eval_gate(key)
@@ -289,7 +310,8 @@ class ReleaseController:
                 pass
             self.journal.append("rejected", version=version,
                                 reason=reason, score=score)
-            self.state.bad.add(version)
+            with self._lock:
+                self.state.bad.add(version)
             self._m_transitions.labels(event="rejected").inc()
             return "rejected"
         inst = self.gw.registry.instance(key)
@@ -318,17 +340,26 @@ class ReleaseController:
                           fraction, seed=seed,
                           inner=self.gw.sched.admission_policy)
         self.gw.sched.admission_policy = slc.admission_policy
-        self._canary = slc
-        self._marks = self._take_marks(version, stable_version)
-        self._deadline = self._clock() + self.cfg.canary_timeout_s
-        self._last_window = {}
-        self.state.canary = {"version": version, "fraction": fraction,
-                             "seed": seed, "score": score}
-        self._g_in_canary.set(1.0)
+        # the in-memory handle is set BEFORE the (fallible, fsynced)
+        # journal append: if the append raises, _uninstall_canary can
+        # still splice the installed slice back out — an orphaned
+        # policy routing live traffic with no handle would be
+        # unremovable.  The append itself stays outside the controller
+        # lock (see _consider).
+        with self._lock:
+            self._canary = slc
+            self._marks = self._take_marks(version, stable_version)
+            self._deadline = self._clock() + self.cfg.canary_timeout_s
+            self._last_window = {}
+            self.state.canary = {"version": version,
+                                 "fraction": fraction,
+                                 "seed": seed, "score": score}
         if journal:
             self.journal.append("canary-start", version=version,
                                 fraction=fraction, seed=seed,
                                 score=score, stable=stable_version)
+        self._g_in_canary.set(1.0)
+        if journal:
             self._m_transitions.labels(event="canary_start").inc()
 
     def _uninstall_canary(self) -> None:
@@ -350,9 +381,10 @@ class ReleaseController:
                         outer.inner = slc.inner
                         break
                     p = outer.inner
-        self._canary = None
-        self._marks = None
-        self._deadline = None
+        with self._lock:
+            self._canary = None
+            self._marks = None
+            self._deadline = None
         self._g_in_canary.set(0.0)
 
     def _rearm_from_state(self) -> None:
@@ -473,11 +505,12 @@ class ReleaseController:
         if operator:
             entry["operator"] = True
         self.journal.append("promoted", **entry)
-        self.state.last_good = version
-        if score is not None:
-            self.state.last_good_score = score
-        self.state.seen.add(version)
-        self.state.canary = None
+        with self._lock:
+            self.state.last_good = version
+            if score is not None:
+                self.state.last_good_score = score
+            self.state.seen.add(version)
+            self.state.canary = None
         self._m_transitions.labels(event="promoted").inc()
 
     def _rollback(self, reason: str, detail: Optional[Dict] = None,
@@ -508,8 +541,9 @@ class ReleaseController:
         if operator:
             entry["operator"] = True
         self.journal.append("rollback", **entry)
-        self.state.bad.add(cand)
-        self.state.canary = None
+        with self._lock:
+            self.state.bad.add(cand)
+            self.state.canary = None
         self._m_transitions.labels(event="rollback").inc()
         return "rollback"
 
@@ -622,17 +656,20 @@ class ReleaseController:
         """Directives are appended by the lifecycle CLI — usually from
         another process — so each step re-reads the journal for new,
         unacknowledged ones (the journal is tiny; the fold is cheap)."""
-        known = {d.get("_seq") for d in self.state.directives}
-        for d in self.journal.state().directives:
-            if d.get("_seq") not in known:
-                self.state.directives.append(d)
+        fresh = self.journal.state().directives
+        with self._lock:
+            known = {d.get("_seq") for d in self.state.directives}
+            for d in fresh:
+                if d.get("_seq") not in known:
+                    self.state.directives.append(d)
 
     def _apply_directive(self) -> Optional[str]:
         """Apply (at most) the oldest pending operator directive from
         the journal; returns None when there is none."""
-        if not self.state.directives:
-            return None
-        d = self.state.directives.pop(0)
+        with self._lock:
+            if not self.state.directives:
+                return None
+            d = self.state.directives.pop(0)
         seq = d.get("_seq")
         action = d.get("action")
         version = d.get("version")
@@ -712,10 +749,11 @@ class ReleaseController:
         self.journal.append("rollback", version=old_version,
                             to=version, reason="operator",
                             operator=True)
-        if old_version is not None:
-            self.state.bad.add(old_version)
-        self.state.last_good = version
-        self.state.canary = None
+        with self._lock:
+            if old_version is not None:
+                self.state.bad.add(old_version)
+            self.state.last_good = version
+            self.state.canary = None
         self._m_transitions.labels(event="rollback").inc()
 
     # -- recovery ------------------------------------------------------------
@@ -727,7 +765,11 @@ class ReleaseController:
         window) instead of re-promoting blind.  Call AFTER the gateway
         exists (and after ``Gateway.recover()`` if a request journal is
         in play — replayed requests must find the stable alias)."""
-        self.state = self.journal.state()
+        # fold the journal OUTSIDE the lock (file read + JSON parse —
+        # the _refresh_directives shape); only the swap is locked
+        st = self.journal.state()
+        with self._lock:
+            self.state = st
         name = self.cfg.model
         actions = []
         if self.state.last_good is not None:
@@ -757,18 +799,23 @@ class ReleaseController:
     # -- accounting ----------------------------------------------------------
     def status(self) -> Dict:
         """JSON-able rollup — a duck-typed ObservabilityServer /statusz
-        source."""
-        out = {"model": self.cfg.model,
-               "last_good": self.state.last_good,
-               "last_good_score": self.state.last_good_score,
-               "bad_versions": sorted(self.state.bad),
-               "pending_directives": len(self.state.directives),
-               "config": self.cfg.to_dict()}
-        if self._canary is not None:
-            out["canary"] = self._canary.stats()
-            out["canary"]["window"] = dict(self._last_window)
-        elif self.state.canary is not None:
-            out["canary"] = dict(self.state.canary)
+        source.  Snapshots the mutable state under the controller lock
+        (an HTTP thread sorting a set that step() is mutating raised);
+        the file-system reads below run outside it."""
+        with self._lock:
+            out = {"model": self.cfg.model,
+                   "last_good": self.state.last_good,
+                   "last_good_score": self.state.last_good_score,
+                   "bad_versions": sorted(self.state.bad),
+                   "pending_directives": len(self.state.directives),
+                   "config": self.cfg.to_dict()}
+            canary, state_canary = self._canary, self.state.canary
+            last_window = dict(self._last_window)
+        if canary is not None:
+            out["canary"] = canary.stats()
+            out["canary"]["window"] = last_window
+        elif state_canary is not None:
+            out["canary"] = dict(state_canary)
         depth = self._queue_depth()
         if depth is not None:
             out["queue_depth"] = depth

@@ -19,58 +19,43 @@ index as ``_seq`` so directives can be matched to their
 from __future__ import annotations
 
 import json
-import os
-import threading
-import time
 from typing import Dict, List, Optional
 
-from ..utils.journal import terminate_torn_tail
+from ..utils.journal import JournalFile
 
 __all__ = ["ReleaseJournal", "ReleaseState", "fold_state"]
 
 
 class ReleaseJournal:
-    """Append-only jsonl of release transitions with fold-based replay."""
+    """Append-only jsonl of release transitions with fold-based replay.
+    The file side (torn-tail sealing, ordered fsynced appends, replay
+    reads) is the shared ``utils.journal.JournalFile`` — ISSUE 13
+    dedup: this logic used to be copy-pasted here and in the gateway's
+    RequestJournal."""
 
     def __init__(self, path: str, fsync: bool = True):
-        self.path = str(path)
-        self.fsync = bool(fsync)
-        self._lock = threading.Lock()
-        self._tail_checked = False
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
+        self._file = JournalFile(path, fsync=fsync,
+                                 name="lifecycle.journal")
+
+    @property
+    def path(self) -> str:
+        return self._file.path
+
+    @property
+    def fsync(self) -> bool:
+        return self._file.fsync
 
     def append(self, event: str, **fields) -> Dict:
         """Durably record one transition; returns the written entry."""
         entry: Dict = {"event": str(event)}
         entry.update(fields)
-        entry["t"] = time.time()
-        line = json.dumps(entry, separators=(",", ":")) + "\n"
-        with self._lock:
-            if not self._tail_checked:
-                # a predecessor that died mid-append leaves a torn
-                # final line; appending onto it would merge this record
-                # into the garbage and lose both
-                self._tail_checked = True
-                terminate_torn_tail(self.path)
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
-                f.flush()
-                if self.fsync:
-                    os.fsync(f.fileno())
-        return entry
+        return self._file.append(entry, stamp="t")
 
     def replay(self) -> List[Dict]:
         """Decoded entries in append order, each with ``_seq`` = its
         line index; torn/poison lines are skipped."""
-        if not os.path.exists(self.path):
-            return []
         out: List[Dict] = []
-        with self._lock:
-            with open(self.path, "r", encoding="utf-8") as f:
-                lines = f.readlines()
-        for i, line in enumerate(lines):
+        for i, line in enumerate(self._file.read_lines()):
             line = line.strip()
             if not line:
                 continue

@@ -14,8 +14,9 @@ import ctypes
 import json
 import os
 import subprocess
-import threading
 from typing import List, Optional
+
+from ..utils.sync import RANK_NATIVE, RANK_NATIVE_BUILD, OrderedLock
 
 __all__ = ["available", "validate", "analyze", "prune", "reserialize"]
 
@@ -23,7 +24,13 @@ _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "..", "..", "csrc")
 _SO = os.path.join(_CSRC, "libptpu_ir.so")
 
-_lock = threading.Lock()
+_lock = OrderedLock("native.lib", RANK_NATIVE)
+# serializes the g++ build + dlopen: two concurrent `make` runs would
+# write libptpu_ir.so in place simultaneously and could publish a
+# corrupt artifact with a fresh mtime (permanently wedging the native
+# path).  Ranked just below the publish lock, which is only ever held
+# for the flag/pointer swap — never across the multi-second build.
+_build_lock = OrderedLock("native.build", RANK_NATIVE_BUILD)
 _lib = None
 _tried = False
 
@@ -52,28 +59,39 @@ def _load():
     with _lock:
         if _tried:
             return _lib
-        _tried = True
-        if os.environ.get("PADDLE_TPU_NO_NATIVE"):
-            return None
-        if not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
-        for name, argtypes in (
-                ("ptpu_reserialize", [ctypes.c_char_p]),
-                ("ptpu_validate", [ctypes.c_char_p]),
-                ("ptpu_analyze", [ctypes.c_char_p, ctypes.c_int]),
-                ("ptpu_prune", [ctypes.c_char_p, ctypes.c_int,
-                                ctypes.c_char_p])):
-            fn = getattr(lib, name)
-            fn.argtypes = argtypes
-            fn.restype = ctypes.c_void_p     # manual free via ptpu_free
-        lib.ptpu_free.argtypes = [ctypes.c_void_p]
-        lib.ptpu_free.restype = None
-        _lib = lib
-        return _lib
+    # The build is serialized under its OWN lock (ISSUE 13): exactly
+    # one thread runs `make` + dlopen; the publish lock above is never
+    # held across the multi-second build, so a thread that only wants
+    # the already-published answer never queues behind a compile.
+    with _build_lock:
+        with _lock:
+            if _tried:              # another builder won while we waited
+                return _lib
+        lib = None
+        if not os.environ.get("PADDLE_TPU_NO_NATIVE") and _build():
+            try:
+                lib = ctypes.CDLL(_SO)
+                for name, argtypes in (
+                        ("ptpu_reserialize", [ctypes.c_char_p]),
+                        ("ptpu_validate", [ctypes.c_char_p]),
+                        ("ptpu_analyze", [ctypes.c_char_p,
+                                          ctypes.c_int]),
+                        ("ptpu_prune", [ctypes.c_char_p, ctypes.c_int,
+                                        ctypes.c_char_p])):
+                    fn = getattr(lib, name)
+                    fn.argtypes = argtypes
+                    fn.restype = ctypes.c_void_p  # freed via ptpu_free
+                lib.ptpu_free.argtypes = [ctypes.c_void_p]
+                lib.ptpu_free.restype = None
+            except (OSError, AttributeError):
+                # dlopen failure OR a stale .so missing a symbol: latch
+                # lib=None below so every later call degrades to the
+                # Python fallback instead of re-raising forever
+                lib = None
+        with _lock:
+            _tried = True
+            _lib = lib
+            return _lib
 
 
 def available() -> bool:

@@ -36,11 +36,14 @@ can't fake a rate.
 from __future__ import annotations
 
 import math
-import threading
 import time
 import weakref
 from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, \
     Sequence, Tuple
+
+from ..utils import sync as _sync
+from ..utils.sync import (RANK_METRICS_CHILD, RANK_METRICS_FAMILY,
+                          RANK_METRICS_REGISTRY, OrderedLock, OrderedRLock)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Sample",
            "registry", "bucket_percentile", "DEFAULT_BUCKETS"]
@@ -102,7 +105,10 @@ class _Child:
     __slots__ = ("_lock", "_value", "updated_at")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # children share ONE registry node ("metrics.child"): they are
+        # leaves of the rank order, and per-child names would explode
+        # the sync accounting with thousands of single-writer entries
+        self._lock = OrderedLock("metrics.child", RANK_METRICS_CHILD)
         self._value = 0.0
         self.updated_at = time.monotonic()
 
@@ -250,7 +256,7 @@ class _Family:
         self.help = help
         self.label_names = tuple(_check_name(ln) for ln in label_names)
         self._buckets = tuple(buckets) if buckets is not None else None
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics.family", RANK_METRICS_FAMILY)
         self._children: Dict[tuple, _Child] = {}
 
     def labels(self, **labels):
@@ -330,7 +336,8 @@ class MetricsRegistry:
     ``registry()``, private instances for tests."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = OrderedRLock("metrics.registry",
+                                  RANK_METRICS_REGISTRY)
         self._families: Dict[str, _Family] = {}
         self._collectors: List[Callable[[], Optional[Callable]]] = []
         self.created_at = time.monotonic()
@@ -518,3 +525,12 @@ _registry = MetricsRegistry()
 def registry() -> MetricsRegistry:
     """The process-global registry every instrumented surface shares."""
     return _registry
+
+
+# The sync layer's paddle_sync_* accounting registers its collector
+# HERE (this module already imports utils.sync; sync cannot import
+# metrics at module load without a cycle).  Registration is guarded
+# inside SyncRegistry, so a later enable_checking() never duplicates
+# it — and PADDLE_TPU_SYNC_CHECK=1 exports its series without anyone
+# calling enable_checking() explicitly.
+_sync.registry()._register_collector()

@@ -34,6 +34,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from ..utils.sync import RANK_OBS_SOURCES, OrderedLock
 from .metrics import MetricsRegistry, registry as _global_registry
 from .tracing import Tracer, tracer as _global_tracer
 
@@ -146,7 +147,8 @@ class ObservabilityServer:
         self.tracer = tracer or _global_tracer()
         self.started_at = time.monotonic()
         self._sources: Dict[str, Callable[[], object]] = {}
-        self._sources_lock = threading.Lock()
+        self._sources_lock = OrderedLock("obs.server.sources",
+                                         RANK_OBS_SOURCES)
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None

@@ -39,6 +39,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..utils.sync import RANK_TRACER, OrderedLock
+
 __all__ = ["Tracer", "tracer", "span", "instant"]
 
 
@@ -48,7 +50,9 @@ class Tracer:
     emit into a cheap no-op (the bench's "bare" leg)."""
 
     def __init__(self, capacity: int = 65536, enabled: bool = True):
-        self._lock = threading.Lock()
+        # innermost-but-one rank: emits happen under the scheduler and
+        # router locks (span instants from _retire_locked/_note_token)
+        self._lock = OrderedLock("obs.tracer", RANK_TRACER)
         self._events: deque = deque(maxlen=int(capacity))
         self._ids = itertools.count(1)
         self.enabled = bool(enabled)

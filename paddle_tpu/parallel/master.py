@@ -19,9 +19,10 @@ contract (python/paddle/v2/reader/creator.py:91).
 from __future__ import annotations
 
 import json
-import threading
 import time
 from typing import Callable, Iterable, List, Optional, Sequence
+
+from ..utils.sync import RANK_MASTER_QUEUE, OrderedLock
 
 __all__ = ["Task", "TaskQueue", "master_reader"]
 
@@ -52,7 +53,7 @@ class TaskQueue:
         self._timeout = float(timeout_secs)
         self._failure_max = int(failure_max)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("master.queue", RANK_MASTER_QUEUE)
         self._todo: List[Task] = []
         self._pending = {}          # task_id -> Task
         self._done: List[Task] = []

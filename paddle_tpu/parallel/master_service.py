@@ -53,6 +53,7 @@ from typing import Optional
 
 from ..resilience.chaos import injector
 from ..resilience.retry import RetryPolicy
+from ..utils.sync import RANK_MASTER_SNAP, OrderedLock
 from .master import Task, TaskQueue
 
 __all__ = ["MasterServer", "MasterClient"]
@@ -196,7 +197,10 @@ class MasterServer:
 
         self.snapshot_path = snapshot_path
         self.snapshot_every = max(1, int(snapshot_every))
-        self._snap_lock = threading.Lock()
+        # ranked BELOW master.queue: _maybe_snapshot holds this while
+        # queue.snapshot takes the queue lock (write-then-reply order)
+        self._snap_lock = OrderedLock("master.snapshot",
+                                      RANK_MASTER_SNAP)
         recovered = bool(snapshot_path and os.path.exists(snapshot_path))
         if recovered:
             if queue is not None:

@@ -34,7 +34,14 @@ through the distributed stack (all no-ops unless configured):
   * ``gateway.swap``  — "crash" a Gateway.swap_model after the new
                         version loaded+warmed but before the alias flip
                         (the old version must keep serving, the orphan
-                        must not linger).
+                        must not linger);
+  * ``sync.preempt``  — seeded yield/sleep perturbation at lock
+                        acquire/release boundaries (armed via
+                        ``utils.sync.enable_preemption``): the
+                        deterministic race harness of ISSUE 13 —
+                        ``maybe_preempt`` widens race windows per seed
+                        so tests/test_concurrency.py replays the same
+                        interleaving pressure every run.
 
 Every probabilistic decision is a pure function of (seed, point, draw
 index) — `FaultInjector.decision` — so the same seed yields the same
@@ -52,12 +59,14 @@ Configuration (environment, all off by default):
 
 from __future__ import annotations
 
+import itertools
 import os
 import signal
-import threading
 import time
 import zlib
 from typing import Dict, Optional
+
+from ..utils.sync import RANK_CHAOS, OrderedLock
 
 __all__ = ["ChaosError", "FaultInjector", "injector", "install"]
 
@@ -91,9 +100,14 @@ class FaultInjector:
         self.kill_after = int(kill_after)
         self.log_path = log_path
         self.hang_seconds = float(hang_seconds)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("chaos.injector", RANK_CHAOS)
         self._draws: Dict[str, int] = {}
         self._leases = 0
+        # sync.preempt draws are LOCK-FREE (itertools.count.next is
+        # atomic under the GIL): maybe_preempt runs inside the sync
+        # layer's own acquire path, and taking self._lock there would
+        # recurse straight back into it
+        self._preempt_draws = itertools.count()
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultInjector":
@@ -134,10 +148,34 @@ class FaultInjector:
         return fired
 
     def _log(self, line: str) -> None:
+        # NOT under self._lock (syncheck io-under-lock fix, ISSUE 13):
+        # the lock's job is draw-index atomicity; holding it across a
+        # file append serialized every injection point behind the disk.
+        # One whole line per O_APPEND write keeps concurrent entries
+        # from interleaving mid-line.
         if not self.log_path:
             return
-        with self._lock, open(self.log_path, "a") as f:
+        with open(self.log_path, "a") as f:
             f.write(line + "\n")
+
+    def maybe_preempt(self, point: str = "sync.preempt",
+                      max_sleep: float = 0.001) -> bool:
+        """The ISSUE 13 race-harness perturbation: consume one seeded
+        draw for `point`; when it fires, either yield the GIL
+        (``sleep(0)``) or sleep a small deterministic-length interval —
+        both derived from the same draw value, so a seed maps to one
+        fixed perturbation schedule.  Lock-free (called from inside
+        lock acquire/release paths); returns True when it perturbed."""
+        prob = self.probs.get(point, 0.0)
+        if prob <= 0.0:
+            return False
+        index = next(self._preempt_draws)
+        value = self.decision(self.seed, point, index)
+        if value >= prob:
+            return False
+        frac = value / prob          # uniform [0,1) given the fire
+        time.sleep(0.0 if frac < 0.5 else frac * max_sleep)
+        return True
 
     # -- injection actions ---------------------------------------------------
     def maybe_fail(self, point: str) -> None:
@@ -184,7 +222,10 @@ class FaultInjector:
 
 
 _global: Optional[FaultInjector] = None
-_global_lock = threading.Lock()
+# own name: sharing "chaos.injector" with the per-instance draw locks
+# would merge two different locks into one paddle_sync_* series and
+# read any future nesting as a same-name cycle
+_global_lock = OrderedLock("chaos.global", RANK_CHAOS)
 
 
 def injector() -> FaultInjector:

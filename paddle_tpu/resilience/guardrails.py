@@ -67,6 +67,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils.sync import RANK_GUARD, OrderedLock
 from .retry import RetryPolicy
 
 __all__ = ["GuardPolicy", "NonFiniteError", "NonFiniteEscalation",
@@ -130,7 +131,7 @@ class _DispatchControl:
     def __init__(self):
         self.cancelled = threading.Event()
         self.consumed = False
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("guardrails.dispatch", RANK_GUARD)
 
     def begin_consume(self) -> bool:
         """Worker side: claim the donated buffers for the device call.

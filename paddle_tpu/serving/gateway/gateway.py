@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import contextlib
 import queue as _queue
-import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -36,6 +35,7 @@ import numpy as np
 
 from ...observability import metrics as _obs_metrics
 from ...resilience.chaos import injector as _chaos_injector
+from ...utils.sync import RANK_GATEWAY_WEDGE, OrderedLock
 from ..scheduler import (ContinuousBatchingScheduler, Request,
                          RequestCancelled)
 from .journal import RequestJournal
@@ -135,7 +135,11 @@ class Gateway:
         # PageAllocator.check_invariants after every retirement — the
         # steady-state leak tripwire the cancellation tests run under
         self.check_invariants = bool(check_invariants)
-        self._wedge_lock = threading.Lock()
+        # ranked BELOW the scheduler: _swap_guard holds it across
+        # add/remove_model (which take the scheduler lock); wedged()
+        # deliberately reads sched.stats() BEFORE taking it
+        self._wedge_lock = OrderedLock("gateway.wedge",
+                                       RANK_GATEWAY_WEDGE)
         self._wedge_mark = (0, time.monotonic())
         # >0 while a load/swap is warming a new version: the compile
         # legitimately freezes the step counter, and wedged() must not
@@ -351,7 +355,17 @@ class Gateway:
         ``Request`` (``wait()`` for blocking use)."""
         cfg = self.router.tenant(tenant)
         key = self.registry.resolve(model)
-        inst = self.registry.instance(key)  # KeyError on unknown model
+        try:
+            inst = self.registry.instance(key)  # KeyError: unknown model
+        except KeyError:
+            # TOCTOU with a concurrent hot swap (found by the ISSUE 13
+            # race harness): the alias flipped and the old version
+            # unloaded between resolve() and instance() — a client
+            # submitting against a model that IS being served got a
+            # spurious unknown-model error mid-swap.  Re-resolve once;
+            # a genuinely unknown model still raises.
+            key = self.registry.resolve(model)
+            inst = self.registry.instance(key)
         if not callable(getattr(inst, "open_slots", None)):
             raise TypeError(
                 f"model {model!r} is an engine artifact (batch "

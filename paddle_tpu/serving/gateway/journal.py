@@ -13,9 +13,11 @@ requests ride across the restart instead of vanishing with the process.
 Entries are self-contained (tenant, model alias, prompt tokens,
 max_new), so replay needs nothing but the journal file and a registry
 with the same model aliases loaded.  Writes are append-only single
-lines; ``fsync=True`` makes each append durable at the cost of one
-fsync per request (the CheckpointManager plain-write rule: publish
-nothing you have not flushed)."""
+lines through the shared ``utils.journal.JournalFile`` (ISSUE 13: one
+audited home for journal I/O-under-its-own-lock); ``fsync=True`` makes
+each append durable at the cost of one fsync per request (the
+CheckpointManager plain-write rule: publish nothing you have not
+flushed)."""
 
 from __future__ import annotations
 
@@ -27,7 +29,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-from ...utils.journal import terminate_torn_tail
+from ...utils.journal import JournalFile
+from ...utils.sync import RANK_JOURNAL_CV, OrderedCondition
 
 __all__ = ["RequestJournal"]
 
@@ -39,55 +42,49 @@ class RequestJournal:
     the request queues.  ``record_done`` is asynchronous (a background
     writer drains a queue): it is called from the scheduler's
     completion callback, which runs under the scheduler lock, and a
-    file write there would stall admission behind the filesystem.  The
+    file write there would stall admission behind the filesystem (the
+    PR 9 review bug the ISSUE 13 lint now catches statically).  The
     at-least-once model absorbs the weaker ordering: a done record lost
-    to a crash merely replays one already-answered request."""
+    to a crash merely replays one already-answered request.  A ``done``
+    can never precede its ``submit`` in the file: the submit is
+    appended synchronously before the request enters the scheduler, so
+    the completion callback — the only producer of the done record —
+    cannot run until the submit line is durable (the race harness
+    asserts this under seeded preemption)."""
 
     _uniq = itertools.count(1)
 
     def __init__(self, path: str, fsync: bool = False):
-        self.path = str(path)
-        self.fsync = bool(fsync)
-        self._lock = threading.Lock()
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
+        self._file = JournalFile(path, fsync=fsync,
+                                 name="gateway.journal")
         # pid-qualified ids: rids restart at 1 in a respawned process,
         # and a replayed entry must never collide with a fresh one
         self._prefix = f"{os.getpid()}"
-        self._tail_checked = False
         # async done-record writer state
-        self._cv = threading.Condition()
+        self._cv = OrderedCondition(name="gateway.journal.cv",
+                                    rank=RANK_JOURNAL_CV)
         self._done_q: deque = deque()
         self._writing = False
         self._writer: Optional[threading.Thread] = None
 
+    @property
+    def path(self) -> str:
+        return self._file.path
+
+    @property
+    def fsync(self) -> bool:
+        return self._file.fsync
+
     def new_jid(self) -> str:
         return f"{self._prefix}-{next(RequestJournal._uniq)}"
-
-    def _append(self, entry: Dict) -> None:
-        line = json.dumps(entry, separators=(",", ":")) + "\n"
-        with self._lock:
-            if not self._tail_checked:
-                # a predecessor that died mid-append leaves a torn
-                # final line; appending onto it would merge the NEXT
-                # record into the garbage and lose both — for a submit
-                # record, a silently lost request on replay (ISSUE 12)
-                self._tail_checked = True
-                terminate_torn_tail(self.path)
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
-                f.flush()
-                if self.fsync:
-                    os.fsync(f.fileno())
 
     # -- lifecycle records ---------------------------------------------------
     def record_submit(self, jid: str, tenant: str, model: str,
                       prompt, max_new: int) -> None:
-        self._append({"op": "submit", "jid": jid, "tenant": tenant,
-                      "model": model,
-                      "prompt": [int(t) for t in prompt],
-                      "max_new": int(max_new), "t": time.time()})
+        self._file.append({"op": "submit", "jid": jid, "tenant": tenant,
+                           "model": model,
+                           "prompt": [int(t) for t in prompt],
+                           "max_new": int(max_new)}, stamp="t")
 
     def record_done(self, jid: str, ok: bool = True,
                     error: Optional[str] = None) -> None:
@@ -114,9 +111,11 @@ class RequestJournal:
                 batch = list(self._done_q)
                 self._done_q.clear()
                 self._writing = True
+            # file I/O OUTSIDE the cv: appends go through the journal's
+            # own file lock; the cv only hands batches over
             for entry in batch:
                 try:
-                    self._append(entry)
+                    self._file.append(entry)
                 except Exception:
                     pass    # a failed done-append = one extra replay
             with self._cv:
@@ -143,26 +142,23 @@ class RequestJournal:
         (crash mid-append) is skipped, not fatal: the journal must be
         readable at exactly the moments the process died badly."""
         self.flush()
-        if not os.path.exists(self.path):
-            return []
         submits: Dict[str, Dict] = {}
         order: List[str] = []
-        with open(self.path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    entry = json.loads(line)
-                except ValueError:
-                    continue
-                jid = entry.get("jid")
-                if entry.get("op") == "submit" and jid is not None:
-                    if jid not in submits:
-                        order.append(jid)
-                    submits[jid] = entry
-                elif entry.get("op") == "done" and jid in submits:
-                    del submits[jid]
+        for line in self._file.read_lines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue
+            jid = entry.get("jid")
+            if entry.get("op") == "submit" and jid is not None:
+                if jid not in submits:
+                    order.append(jid)
+                submits[jid] = entry
+            elif entry.get("op") == "done" and jid in submits:
+                del submits[jid]
         return [submits[j] for j in order if j in submits]
 
     def stats(self) -> Dict[str, object]:

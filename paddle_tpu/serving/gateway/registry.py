@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 import weakref
 from typing import Dict, List, Optional
@@ -44,6 +43,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ... import fluid
+from ...utils.sync import (RANK_COLLECTOR_INIT, RANK_MODEL_REGISTRY,
+                           OrderedLock)
 from ..engine import DEFAULT_BATCH_BUCKETS, InferenceEngine
 from ..paged_decoder import (PagedTransformerGenerator, _CACHE_MARKERS,
                              estimate_generator_hbm)
@@ -63,7 +64,7 @@ _GENERATOR_KEYS = (
     "num_pages", "chunk_size", "prefix_sharing", "topk_size", "kv_dtype")
 
 _LIVE_REGISTRIES: "weakref.WeakSet[ModelRegistry]" = weakref.WeakSet()
-_collector_lock = threading.Lock()
+_collector_lock = OrderedLock("obs.collector_init", RANK_COLLECTOR_INIT)
 _collector_registered = False
 
 
@@ -144,7 +145,9 @@ class ModelRegistry:
         self.hbm_budget_bytes = (None if hbm_budget_bytes is None
                                  else int(hbm_budget_bytes))
         self.place = place
-        self._lock = threading.Lock()
+        # acquired under the scheduler lock (resolve at admission)
+        self._lock = OrderedLock("gateway.registry",
+                                 RANK_MODEL_REGISTRY)
         self._entries: Dict[str, _Entry] = {}
         self._alias: Dict[str, str] = {}        # name -> version
         _LIVE_REGISTRIES.add(self)

@@ -31,10 +31,10 @@ slots and pages."""
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ...utils.sync import RANK_ROUTER, OrderedLock
 from ..scheduler import Request
 
 __all__ = ["RateLimited", "TenantConfig", "TenantRouter"]
@@ -100,7 +100,8 @@ class TenantRouter:
                  now_fn: Callable[[], float] = time.monotonic):
         if default_slo not in SLO_CLASSES:
             raise ValueError(f"default_slo={default_slo!r}")
-        self._lock = threading.Lock()
+        # acquired under the scheduler lock (admission_policy hook)
+        self._lock = OrderedLock("gateway.router", RANK_ROUTER)
         self._tenants: Dict[str, TenantConfig] = {}
         self._buckets: Dict[str, _Bucket] = {}
         self._service: Dict[str, float] = {}

@@ -32,12 +32,13 @@ page a function of the whole prompt and sharing would corrupt outputs.
 from __future__ import annotations
 
 import hashlib
-import threading
 import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..utils.sync import RANK_COLLECTOR_INIT, OrderedLock
 
 __all__ = ["PageAllocator", "PoolCapacityError", "TRASH_PAGE",
            "chunk_hashes"]
@@ -51,7 +52,7 @@ TRASH_PAGE = 0
 # counts per state plus ONE aggregate utilization over all live pools.
 # Allocators register weakly; a GC'd pool drops out of the rollup.
 _LIVE_ALLOCATORS: "weakref.WeakSet[PageAllocator]" = weakref.WeakSet()
-_collector_lock = threading.Lock()
+_collector_lock = OrderedLock("obs.collector_init", RANK_COLLECTOR_INIT)
 _collector_registered = False
 
 

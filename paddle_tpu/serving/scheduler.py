@@ -77,6 +77,8 @@ import numpy as np
 
 from ..observability import metrics as _obs_metrics
 from ..observability import tracing as _obs_tracing
+from ..utils.sync import (RANK_COLLECTOR_INIT, RANK_SCHEDULER,
+                          OrderedCondition, OrderedLock)
 from .paging import PoolCapacityError
 
 __all__ = ["Request", "ContinuousBatchingScheduler", "RequestCancelled",
@@ -110,7 +112,8 @@ class SchedulerShutdown(RuntimeError):
 # (two schedulers at 0.8 -> 1.6) — so the ratio is computed over the
 # aggregated counts.  Schedulers register weakly.
 _LIVE_SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
-_sched_collector_lock = threading.Lock()
+_sched_collector_lock = OrderedLock("obs.collector_init",
+                                    RANK_COLLECTOR_INIT)
 _sched_collector_registered = False
 
 
@@ -293,8 +296,11 @@ class ContinuousBatchingScheduler:
         self.hbm_budget_bytes = (None if hbm_budget_bytes is None
                                  else int(hbm_budget_bytes))
         self._hbm_reserved = 0
-        self._lock = threading.Lock()
-        self._work = threading.Condition(self._lock)
+        # ONE state lock (ISSUE 13 rank table: serving.scheduler); the
+        # work condition SHARES it, so `with self._work:` and
+        # `with self._lock:` are the same registry node
+        self._lock = OrderedLock("serving.scheduler", RANK_SCHEDULER)
+        self._work = OrderedCondition(self._lock)
         self._groups: Dict[str, _LaneGroup] = {}
         self._queue: deque = deque()
         self._peak_in_flight = 0
@@ -653,6 +659,28 @@ class ContinuousBatchingScheduler:
                                      error=type(e).__name__)
                 continue
             with self._lock:
+                if self._groups.get(group.key) is not group \
+                        or group.draining:
+                    # the group was torn down (or began draining)
+                    # while this admission's prefill dispatch ran
+                    # OUTSIDE the lock — a hot swap or unload raced
+                    # us.  Before this check the request was silently
+                    # orphaned: parked in a group the step loop no
+                    # longer iterates, never stepped, never failed
+                    # (found by the ISSUE 13 seeded race harness).
+                    # It has produced no tokens, so give the lane
+                    # state back and RE-QUEUE it at the head: the next
+                    # admission round re-resolves its alias — the new
+                    # version after a swap (zero lost), the normal
+                    # rejected-at-admission path after a plain unload.
+                    if group.page_aware:
+                        try:
+                            group.model.clear_slot(slot)
+                        except Exception:
+                            pass
+                    group.free.append(slot)
+                    self._queue.appendleft(req)
+                    continue
                 req.slot = slot
                 req.group = group.key
                 req.admitted = time.perf_counter()

@@ -1,5 +1,5 @@
 """paddle_tpu.utils — flags, readers, misc runtime utilities (the analog of
 paddle/utils/ + python/paddle/v2/reader/)."""
 
-from . import flags, reader  # noqa: F401
+from . import flags, reader, sync  # noqa: F401
 from .flags import FLAGS, get_flag, set_flag  # noqa: F401

@@ -5,6 +5,7 @@
 #   tools/lint.sh            # run everything available
 #   tools/lint.sh --ruff     # ruff only
 #   tools/lint.sh --plint    # program lint only
+#   tools/lint.sh --sync     # concurrency lint + lock-order graph only
 #
 # ruff is optional in the hermetic CI container (no network installs);
 # when absent we warn and still run the program linter, which needs
@@ -15,14 +16,87 @@ cd "$(dirname "$0")/.."
 
 want_ruff=1
 want_plint=1
+want_sync=1
 case "${1:-}" in
-  --ruff)  want_plint=0 ;;
-  --plint) want_ruff=0 ;;
+  --ruff)  want_plint=0; want_sync=0 ;;
+  --plint) want_ruff=0; want_sync=0 ;;
+  --sync)  want_ruff=0; want_plint=0 ;;
   "") ;;
-  *) echo "usage: tools/lint.sh [--ruff|--plint]" >&2; exit 64 ;;
+  *) echo "usage: tools/lint.sh [--ruff|--plint|--sync]" >&2; exit 64 ;;
 esac
 
 rc=0
+
+if [ "$want_sync" = 1 ]; then
+  # concurrency lint (ISSUE 13): raw threading primitives outside
+  # utils/sync.py, blocking I/O lexically under a lock, predicate-free
+  # condition waits — errors fail the gate
+  echo "== syncheck (concurrency lint) over paddle_tpu/"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m paddle_tpu.tools.syncheck paddle_tpu || rc=1
+
+  # smoke-run the real scheduler/gateway/journal stack with runtime
+  # order checking ON and dump the observed lock-order graph as an
+  # artifact (SYNC_GRAPH_OUT overrides the path) — the graph is the
+  # living version of the README rank table
+  # per-run paths: a fixed /tmp name would let two concurrent lint
+  # runs on one host append to each other's smoke journal (spurious
+  # pending()!=[] failures) or interleave graph writes
+  graph_out="${SYNC_GRAPH_OUT:-/tmp/paddle_tpu_sync_graph.$$.json}"
+  smoke_journal="$(mktemp /tmp/paddle_tpu_sync_smoke.XXXXXX.jsonl)"
+  echo "== sync smoke: lock-order graph -> $graph_out"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python - "$graph_out" "$smoke_journal" <<'EOF' || rc=1
+import sys
+
+import numpy as np
+
+from paddle_tpu.serving.gateway import Gateway
+from paddle_tpu.utils import sync
+
+
+class Echo:
+    start_id, end_id = 0, 1
+    src_len = 64
+
+    def __init__(self):
+        self.n, self.slot_val = 0, {}
+
+    def open_slots(self, n):
+        self.n = n
+
+    def admit_slot(self, slot, prompt, **_):
+        self.slot_val[slot] = int(np.asarray(prompt).reshape(-1)[0])
+        return len(np.asarray(prompt).reshape(-1))
+
+    def clear_slot(self, slot):
+        self.slot_val.pop(slot, None)
+
+    def step_slots(self, tokens, pos, src_len):
+        return np.array([self.slot_val.get(i, 7777)
+                         for i in range(self.n)], np.int64)
+
+
+sync.registry().reset()
+sync.enable_checking()
+gw = Gateway(n_slots=2, max_new_tokens=4, journal_path=sys.argv[2])
+gw.load_model("m", "1", instance=Echo())
+gw.serve()
+reqs = [gw.submit("m", [40 + i]) for i in range(8)]
+for r in reqs:
+    assert r.wait(30), "smoke request stalled"
+gw.swap_model("m", "2", instance=Echo())
+gw.shutdown(drain=True)
+assert gw.journal.pending() == []
+g = sync.registry().export_graph(sys.argv[1])
+assert g["violations"] == 0, f"lock-order violations: {g}"
+assert g["edges"], "smoke run recorded no lock-order edges"
+print(f"sync smoke: {len(g['nodes'])} locks, {len(g['edges'])} edges, "
+      f"0 violations")
+sync.disable_checking()
+EOF
+  rm -f "$smoke_journal"
+fi
 
 if [ "$want_ruff" = 1 ]; then
   # paddle_tpu/ covers the observability package (ISSUE 8) too — the
