@@ -659,7 +659,8 @@ class BlockBytePlan:
 def block_byte_plan(view: ProgramView, block_idx: int = 0,
                     assume_batch: int = 1,
                     sub_extra: Optional[Dict[int, int]] = None,
-                    persistable_base: int = 0) -> BlockBytePlan:
+                    persistable_base: int = 0,
+                    assume_donation: bool = True) -> BlockBytePlan:
     """Build the liveness byte timeline for one block.
 
     Transient live ranges come from :func:`dataflow.block_liveness` (the
@@ -669,6 +670,13 @@ def block_byte_plan(view: ProgramView, block_idx: int = 0,
     extra transient bytes while that control-flow op runs).
     ``persistable_base`` is added to every timeline point (the resident
     params/KV bytes the program-level planner accounts once).
+
+    ``assume_donation=False`` models an executable compiled WITHOUT
+    buffer donation (the persistent AOT cache's entries, ISSUE 14): a
+    written persistable no longer aliases its scope buffer in place, so
+    the new value is a fresh transient of full size live until the
+    dispatch returns — the pool/param write-back copy the donating jit
+    path avoids.  Dying-transient reuse still applies either way.
     """
     b = view.blocks[block_idx]
     plan = BlockBytePlan.__new__(BlockBytePlan)
@@ -740,7 +748,7 @@ def block_byte_plan(view: ProgramView, block_idx: int = 0,
                     continue
                 dies_here = live_range.get(r, (None, None))[1] == op.idx \
                     and r not in feed_last
-                donated = r_vd.persistable
+                donated = r_vd.persistable and assume_donation
                 if dies_here or donated:
                     aliases.union(r, n)
                     if donated:
@@ -770,6 +778,28 @@ def block_byte_plan(view: ProgramView, block_idx: int = 0,
         class_bytes[n] = nb
         class_members[n] = [n]
     plan.feed_bytes = feed_bytes_total
+
+    if not assume_donation:
+        # no-donation dispatch: every persistable the block WRITES
+        # (ParamOut in-place idiom — output name == persistable name —
+        # or a transient output the donating path would have aliased
+        # onto it) gets a FRESH output buffer of full size, live from
+        # its first write until the dispatch returns.  This is the
+        # pool/param write-back copy a persistent-AOT-cached executable
+        # really pays (ISSUE 14).
+        for op in b.ops:
+            for n in op.write_names():
+                vd = local.get(n)
+                if vd is None or not vd.persistable:
+                    continue
+                key = f"@nodonate@{n}"
+                if key in class_range:
+                    class_range[key][0] = min(class_range[key][0],
+                                              op.idx)
+                    continue
+                class_range[key] = [op.idx, max(0, len(b.ops) - 1)]
+                class_bytes[key] = vbytes(n)
+                class_members[key] = [key]
 
     sub_extra = sub_extra or {}
     n_ops = max(1, len(b.ops))
@@ -832,11 +862,17 @@ class ProgramMemoryPlan:
                 f"{self.peak_block} op#{self.peak_op} ({comp})")
 
 
-def plan_program(view_or_program, assume_batch: int = 1) -> ProgramMemoryPlan:
+def plan_program(view_or_program, assume_batch: int = 1,
+                 assume_donation: bool = True) -> ProgramMemoryPlan:
     """Peak-HBM plan over the whole program.  Persistables are counted
     once by name across every block (params vs KV state split via
     ``KV_POOL_MARKERS``); sub-block transient peaks are charged at
-    their control-flow op's position in the parent timeline."""
+    their control-flow op's position in the parent timeline.
+    ``assume_donation=False`` prices the no-donation dispatch the
+    persistent AOT executable cache serves (see block_byte_plan) — the
+    gateway registry budgets with it whenever a version mounts a
+    ``compiled/`` cache, so admission never under-counts the write-back
+    copies real hardware will pay."""
     view = view_or_program if isinstance(view_or_program, ProgramView) \
         else ProgramView(getattr(view_or_program, "desc", view_or_program))
     plan = ProgramMemoryPlan.__new__(ProgramMemoryPlan)
@@ -868,7 +904,8 @@ def plan_program(view_or_program, assume_batch: int = 1) -> ProgramMemoryPlan:
         extra = {op.idx: sum(sub_peak.get(si, 0) for si in op.sub_blocks)
                  for op in b.ops if op.sub_blocks}
         bp = block_byte_plan(view, b.idx, assume_batch, sub_extra=extra,
-                             persistable_base=0)
+                             persistable_base=0,
+                             assume_donation=assume_donation)
         plan.approximate = plan.approximate or bp.approximate
         sub_peak[b.idx] = bp.peak_bytes
         block_plans[b.idx] = bp

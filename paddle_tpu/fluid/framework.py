@@ -46,14 +46,20 @@ _DUMMY_TIME = 11
 _NO_INFER_OPS = {"feed", "fetch", "while", "conditional_block", "print",
                  "save", "load", "save_combine", "load_combine"}
 
-# Ops that consume RNG.  Each instance gets a unique __rng_salt__ attr at
-# build time; the *_grad op copies the attr, so the vjp-recomputed forward
-# (lowering.py) derives the IDENTICAL key — the property the reference gets
-# by saving dropout masks (dropout_op.cc), we get by key determinism.
+# Ops that consume RNG.  Each instance gets a __rng_salt__ attr at build
+# time, unique WITHIN ITS PROGRAM; the *_grad op copies the attr, so the
+# vjp-recomputed forward (lowering.py) derives the IDENTICAL key — the
+# property the reference gets by saving dropout masks (dropout_op.cc), we
+# get by key determinism.  The salt counter lives on the Program, NOT in
+# a module global: a process-global counter made identically-seeded
+# builds depend on every program built before them (different salts ->
+# different random init -> different tokens), which is both a
+# reproducibility hole and the cross-module test-order flake the PR 12
+# note records — and it would poison a content-addressed executable
+# cache, since two identical builds would never share a fingerprint.
 _RANDOM_OPS = {"dropout", "uniform_random", "gaussian_random",
                "truncated_gaussian_random", "nce", "sampling_id",
                "fused_attention"}
-_rng_salt_counter = [0]
 
 
 class Variable:
@@ -267,8 +273,7 @@ class Block:
         if type == "fused_attention" and not attrs.get("dropout_rate"):
             consumes_rng = False  # deterministic unless dropout is on
         if consumes_rng and "__rng_salt__" not in attrs:
-            _rng_salt_counter[0] += 1
-            attrs["__rng_salt__"] = _rng_salt_counter[0]
+            attrs["__rng_salt__"] = self.program._next_rng_salt()
         desc = OpDesc(type=type,
                       inputs=_names_dict(inputs),
                       outputs=_names_dict(outputs),
@@ -431,10 +436,17 @@ class Program:
         self._current_block_idx = 0
         self._version = 0
         self._seed: Optional[int] = None  # program-level RNG seed override
+        self._rng_salt = 0                # per-program __rng_salt__ counter
 
     # -- versioning (compile-cache key support) ------------------------------
     def _bump_version(self):
         self._version += 1
+
+    def _next_rng_salt(self) -> int:
+        """Next per-program RNG salt — deterministic for a given build
+        sequence, so two identical builds serialize byte-identically."""
+        self._rng_salt += 1
+        return self._rng_salt
 
     @property
     def version(self) -> int:
@@ -488,6 +500,13 @@ class Program:
                 b.ops.append(Operator(b, od))
             self.blocks.append(b)
         self._current_block_idx = 0
+        # resume the per-program salt counter past every deserialized
+        # salt: an op appended AFTER the load must never collide with
+        # (= derive the same RNG stream as) an existing random op
+        self._rng_salt = max(
+            (int(od.attrs["__rng_salt__"])
+             for bd in desc.blocks for od in bd.ops
+             if "__rng_salt__" in od.attrs), default=0)
         self._bump_version()
 
     def clone(self, for_test: bool = False) -> "Program":

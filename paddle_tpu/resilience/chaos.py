@@ -35,6 +35,11 @@ through the distributed stack (all no-ops unless configured):
                         version loaded+warmed but before the alias flip
                         (the old version must keep serving, the orphan
                         must not linger);
+  * ``aot.corrupt``   — truncate a persistent AOT cache entry's bytes
+                        as they are read (fluid/compile_cache.py): the
+                        checksum must fail and the entry degrade to a
+                        compile-and-overwrite MISS — never a crash,
+                        never garbage loaded into the device;
   * ``sync.preempt``  — seeded yield/sleep perturbation at lock
                         acquire/release boundaries (armed via
                         ``utils.sync.enable_preemption``): the

@@ -50,9 +50,16 @@ from ..paged_decoder import (PagedTransformerGenerator, _CACHE_MARKERS,
                              estimate_generator_hbm)
 from ..scheduler import HBMBudgetError
 
-__all__ = ["HBMBudgetError", "ModelRegistry", "MANIFEST_NAME"]
+__all__ = ["HBMBudgetError", "ModelRegistry", "MANIFEST_NAME",
+           "COMPILED_SUBDIR"]
 
 MANIFEST_NAME = "gateway.json"
+# per-version persistent AOT executable cache (ISSUE 14): a published
+# version ships its compiled bucket set here (tools/aot_compile
+# pre-warms it offline; serving processes also store back what they do
+# compile, so even an un-prewarmed version pays its compile storm once
+# per artifact, not once per process/restart/swap)
+COMPILED_SUBDIR = "compiled"
 
 # the paged generator's constructor surface a manifest may carry — kept
 # explicit so a stale manifest key fails loudly at load, not deep in the
@@ -108,6 +115,19 @@ def _register_registry_collector() -> None:
 
         _m().register_collector(_collect_registry_metrics)
         _collector_registered = True
+
+
+def _artifact_cache(dirname: str):
+    """The artifact's ``compiled/`` executable cache, or None when the
+    tier is disabled (``PADDLE_TPU_AOT_DISABLE=1``).  Always mounted
+    read-write: loads consume the shipped bucket set, and anything the
+    serving process does compile is published back for the next
+    restart."""
+    if os.environ.get("PADDLE_TPU_AOT_DISABLE", "") == "1":
+        return None
+    from ...fluid.compile_cache import CompileCache
+
+    return CompileCache(os.path.join(dirname, COMPILED_SUBDIR))
 
 
 def _artifact_bytes(dirname: str) -> int:
@@ -245,9 +265,16 @@ class ModelRegistry:
         the manifest config (the KV pool and its int8 scale sidecar are
         persistable vars with recorded shapes — no separate
         kv_page_bytes term), an engine's saved ``__model__`` program is
-        planned at its largest declared batch bucket."""
+        planned at its largest declared batch bucket.  Artifact loads
+        that will mount a ``compiled/`` AOT cache (ISSUE 14) are priced
+        WITHOUT donation aliasing — their executables really dispatch
+        with write-back copies, and a budget computed from the donating
+        ideal would admit models that OOM the chip mid-traffic."""
+        donation = not dirname or \
+            os.environ.get("PADDLE_TPU_AOT_DISABLE", "") == "1"
         if kind == "generator":
-            plan = estimate_generator_hbm(config)
+            plan = estimate_generator_hbm(config,
+                                          assume_donation=donation)
             return int(plan.peak_bytes), dict(plan.components)
         if kind == "engine" and dirname:
             model_path = os.path.join(dirname, "__model__")
@@ -260,7 +287,8 @@ class ModelRegistry:
                 buckets = config.get("batch_buckets") \
                     or DEFAULT_BATCH_BUCKETS
                 plan = plan_program(prog,
-                                    assume_batch=int(max(buckets)))
+                                    assume_batch=int(max(buckets)),
+                                    assume_donation=donation)
                 return int(plan.peak_bytes), dict(plan.components)
         # no program to plan (adopted instance, bare artifact dir):
         # artifact bytes are the only static signal left
@@ -308,8 +336,10 @@ class ModelRegistry:
         if kind == "generator":
             instance = self._build_generator(dirname, config)
         elif kind == "engine":
+            exe = fluid.Executor(self.place,
+                                 compile_cache=_artifact_cache(dirname))
             instance = InferenceEngine(
-                dirname=dirname, place=self.place,
+                dirname=dirname, place=self.place, executor=exe,
                 quantize=config.pop("quantize", "off"), **config)
         else:
             raise ValueError(f"{dirname}: unknown artifact kind "
@@ -326,7 +356,10 @@ class ModelRegistry:
         if bad:
             raise ValueError(f"{dirname}: unknown generator config keys "
                              f"{sorted(bad)}")
-        gen = PagedTransformerGenerator(place=self.place, **config)
+        exe = fluid.Executor(self.place,
+                             compile_cache=_artifact_cache(dirname))
+        gen = PagedTransformerGenerator(place=self.place, executor=exe,
+                                        **config)
         for n in os.listdir(dirname):
             path = os.path.join(dirname, n)
             if n == MANIFEST_NAME or not os.path.isfile(path):

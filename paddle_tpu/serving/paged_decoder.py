@@ -183,12 +183,15 @@ def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
 HBM_ESTIMATE_LANES = 8
 
 
-def estimate_generator_hbm(config: Dict, assume_lanes: int = None):
+def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
+                           assume_donation: bool = True):
     """Static peak-HBM plan for a paged generator described by a
     gateway manifest config — built and planned as a DESC, before any
     device allocation.  Params, the KV pool, and the int8 scale sidecar
     are persistable vars with recorded shapes; activations price at
-    ``assume_lanes`` in-flight lanes.  Returns the
+    ``assume_lanes`` in-flight lanes.  ``assume_donation=False`` prices
+    the no-donation dispatch of a persistent-AOT-cached executable
+    (pool/param write-backs get fresh buffers — ISSUE 14).  Returns the
     ``analysis.cost.ProgramMemoryPlan``."""
     from ..fluid.analysis.cost import plan_program
 
@@ -215,7 +218,8 @@ def estimate_generator_hbm(config: Dict, assume_lanes: int = None):
         kv_dtype=str(config.get("kv_dtype", "float32")))
     lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
         else int(assume_lanes)
-    return plan_program(prog, assume_batch=lanes)
+    return plan_program(prog, assume_batch=lanes,
+                        assume_donation=assume_donation)
 
 
 class _Lane:
@@ -835,6 +839,35 @@ class PagedTransformerGenerator:
                 mode="infer")
         return out_ids, np.asarray(out_scores)
 
+    # -- AOT pre-resolution (ISSUE 14) ---------------------------------------
+    def bucket_set(self, n_slots: int):
+        """The unified program's closed compile-signature set at the
+        given lane count — the batch axis is the ONLY dynamic feed
+        axis, so this enumerates to exactly one signature per serving
+        width (the static form of the zero-recompile guarantee, PR 10's
+        ``enumerate_buckets``)."""
+        from ..fluid.analysis.dataflow import ProgramView
+        from ..fluid.analysis.recompile import enumerate_buckets
+
+        return enumerate_buckets(ProgramView(self._unified[0].desc),
+                                 batch_buckets=(int(n_slots),))
+
+    def aot_warm(self, n_slots: int) -> None:
+        """Resolve the unified executable AT THE SERVING LANE COUNT
+        without admitting any request: one all-idle ``lane_step`` —
+        every lane rides along with trash-page writes and length-1
+        masks, so no KV state or lane bookkeeping changes.  With a
+        persistent AOT cache attached to the executor this is a disk
+        load; without one it is the offline pre-compile that populates
+        the cache (``tools/aot_compile``).  Lanes are left open at
+        ``n_slots`` (the scheduler re-opens them at attach anyway)."""
+        if any(lane.phase != "idle" for lane in self._lanes):
+            raise RuntimeError(
+                "aot_warm: lanes are busy — pre-resolution is for "
+                "load/publish time, not mid-traffic")
+        self.open_slots(int(n_slots))
+        self.lane_step()
+
     # -- accounting ----------------------------------------------------------
     def kv_bytes_per_slot_dense(self) -> int:
         """What ONE dense lane costs in the PR 5 decoder — the baseline
@@ -855,16 +888,22 @@ class PagedTransformerGenerator:
         + KV pool + int8 sidecar + per-dispatch activations at
         ``assume_lanes``) — the number the gateway registry budgets
         with and the scheduler surfaces per lane group (ISSUE 11:
-        admission runs on the planner, not a byte-count heuristic)."""
+        admission runs on the planner, not a byte-count heuristic).
+        A generator whose executor mounts a persistent AOT cache is
+        priced WITHOUT donation aliasing (its dispatches really run
+        that way — ISSUE 14): the admission budget must cover the
+        pool/param write-back copies, not the donating ideal."""
         from ..fluid.analysis.cost import plan_program
 
         lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
             else int(assume_lanes)
-        key = ("_hbm_plan", lanes)
+        donation = self.exe._aot_cache() is None
+        key = ("_hbm_plan", lanes, donation)
         cached = getattr(self, "_static_hbm_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
-        plan = plan_program(self._unified[0], assume_batch=lanes)
+        plan = plan_program(self._unified[0], assume_batch=lanes,
+                            assume_donation=donation)
         self._static_hbm_cache = (key, plan)
         return plan
 

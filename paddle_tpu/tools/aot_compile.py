@@ -1,0 +1,178 @@
+"""aot_compile — pre-warm a model version's compiled bucket set offline.
+
+::
+
+    # pre-compile a published version in place (<dir>/compiled/)
+    python -m paddle_tpu.tools.aot_compile --root models/ --model nmt \\
+        --version 3 --n-slots 8
+
+    # an explicit artifact dir (generator or save_inference_model)
+    python -m paddle_tpu.tools.aot_compile --dirname models/nmt/3 \\
+        --n-slots 8 --json
+
+    # an engine artifact with a reduced bucket set + ragged time cap
+    python -m paddle_tpu.tools.aot_compile --dirname models/cls/1 \\
+        --batch-bucket 1 --batch-bucket 8 --max-time 64
+
+The compiled-programs-as-artifacts half of ISSUE 14: a publish pipeline
+(the PR 11 lifecycle publishers call this with ``aot_warm=``) runs it
+once, offline, and every serving process that later loads the version —
+gateway hot swap, supervised restart, a fresh replica — deserializes
+the shipped executables instead of paying the XLA compile storm.  The
+second run over an already-warm version reports zero compiles and
+byte-stable cache keys (tools/lint.sh asserts exactly that).
+
+Exit status: 0 = bucket set resolved, 1 = pre-compilation failed,
+2 = bad arguments / missing artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def precompile(dirname: str, n_slots: int = 4,
+               max_time: Optional[int] = None,
+               cache_dir: Optional[str] = None,
+               place=None, **overrides) -> Dict:
+    """Resolve every compile signature of the artifact at ``dirname``
+    into its persistent cache (default ``<dirname>/compiled/``).
+
+    Loads the artifact through a throwaway ``ModelRegistry`` (so the
+    engine-vs-generator manifest handling, weight placement, and cache
+    mounting are EXACTLY what serving does), then:
+
+    * generator artifacts: ``aot_warm(n_slots)`` — the unified
+      prefill+decode executable at the serving lane count;
+    * engine artifacts: ``preresolve(max_time)`` — every enumerated
+      batch/time bucket signature.
+
+    Returns ``{"kind", "signatures", "compiles", "loads", "keys",
+    "cache_dir", "bytes"}``; ``compiles`` on a second run over the same
+    artifact must be zero (the lint sweep's assertion).
+    """
+    from .. import fluid
+    from ..serving.gateway.registry import (COMPILED_SUBDIR,
+                                            ModelRegistry)
+
+    dirname = os.path.abspath(dirname)
+    if not os.path.isdir(dirname):
+        raise FileNotFoundError(f"no artifact at {dirname}")
+    reg = ModelRegistry(place=place or fluid.CPUPlace())
+    key = reg.load("aot", "prewarm", dirname=dirname, **overrides)
+    inst = reg.instance(key)
+    if cache_dir is not None:
+        # redirect the instance's executor at an external cache dir
+        # (the default is the artifact's own compiled/ subdir)
+        from ..fluid.compile_cache import CompileCache
+
+        inst.exe.set_compile_cache(CompileCache(cache_dir))
+    else:
+        cache_dir = os.path.join(dirname, COMPILED_SUBDIR)
+    if callable(getattr(inst, "aot_warm", None)):
+        kind = "generator"
+        inst.aot_warm(int(n_slots))
+        signatures = 1
+    else:
+        kind = "engine"
+        signatures = inst.preresolve(max_time=max_time)
+    st = inst.exe.cache_stats()["persistent"]
+    cache = inst.exe._aot_cache()
+    return {
+        "kind": kind,
+        "signatures": signatures,
+        "compiles": st["misses"],
+        "loads": st["hits"],
+        "stores": st["stores"],
+        "cache_dir": cache_dir,
+        "keys": cache.keys() if cache is not None else [],
+        "bytes": int(sum(
+            os.path.getsize(os.path.join(cache_dir, n))
+            for n in os.listdir(cache_dir)) if os.path.isdir(cache_dir)
+            else 0),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.aot_compile",
+        description="Pre-compile a model version's closed bucket set "
+                    "into its persistent AOT executable cache.")
+    ap.add_argument("--dirname", help="artifact directory (generator or "
+                    "save_inference_model layout)")
+    ap.add_argument("--root", help="model store root (versioned layout)")
+    ap.add_argument("--model", help="model name under --root")
+    ap.add_argument("--version", help="version under --root/--model "
+                    "(default: the CURRENT marker, else newest)")
+    ap.add_argument("--n-slots", type=int, default=4,
+                    help="serving lane count to compile a generator at "
+                         "(must match the gateway's n_slots; default 4)")
+    ap.add_argument("--max-time", type=int, default=None,
+                    help="time cap closing ragged engine feeds")
+    ap.add_argument("--batch-bucket", type=int, action="append",
+                    default=None, metavar="N",
+                    help="override the engine's batch buckets "
+                         "(repeatable; default: the artifact's own)")
+    ap.add_argument("--time-bucket", type=int, default=None,
+                    help="override the engine's time bucket")
+    ap.add_argument("--cache", default=None, metavar="DIR",
+                    help="external cache directory (default: the "
+                         "artifact's compiled/ subdir)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    if args.dirname:
+        dirname = args.dirname
+    elif args.root and args.model:
+        from ..fluid import io as fio
+
+        version = args.version or fio.current_model_version(
+            args.root, args.model)
+        if version is None:
+            versions = fio.list_model_versions(args.root, args.model)
+            if not versions:
+                print(f"aot_compile: no versions of {args.model} under "
+                      f"{args.root}", file=sys.stderr)
+                return 2
+            version = versions[-1]
+        dirname = fio.model_version_dir(args.root, args.model, version)
+    else:
+        ap.print_usage(file=sys.stderr)
+        print("aot_compile: pass --dirname or --root + --model",
+              file=sys.stderr)
+        return 2
+
+    overrides = {}
+    if args.batch_bucket:
+        overrides["batch_buckets"] = tuple(args.batch_bucket)
+    if args.time_bucket is not None:
+        overrides["time_bucket"] = args.time_bucket
+    try:
+        report = precompile(dirname, n_slots=args.n_slots,
+                            max_time=args.max_time,
+                            cache_dir=args.cache, **overrides)
+    except FileNotFoundError as e:
+        print(f"aot_compile: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:
+        print(f"aot_compile: pre-compilation failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(f"aot_compile: {report['kind']} artifact, "
+              f"{report['signatures']} signature(s): "
+              f"{report['compiles']} compiled, {report['loads']} loaded "
+              f"from cache, {len(report['keys'])} entr(ies) "
+              f"({report['bytes']} bytes) at {report['cache_dir']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,8 +65,4 @@ def init(use_gpu: bool = False, trainer_count: int = 1,
     if seed is not None:
         _fluid.default_main_program().random_seed = seed
         _fluid.default_startup_program().random_seed = seed
-        # reset the global rng-salt counter too: without this, random-op
-        # streams (param init, dropout) depend on how many programs were
-        # built earlier in the process — seeded init must be deterministic
-        _fluid.framework._rng_salt_counter[0] = 0
     _initialized = True

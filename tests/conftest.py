@@ -40,11 +40,6 @@ def fresh_programs():
     tests do the same via new Program() + program_guard)."""
     from paddle_tpu import fluid
 
-    from paddle_tpu.fluid import framework
-
-    # reset the global rng-salt counter so a test's random-op streams do not
-    # depend on which tests ran before it (determinism across orderings)
-    framework._rng_salt_counter[0] = 0
     main = fluid.Program()
     startup = fluid.Program()
     scope = fluid.Scope()

@@ -15,9 +15,7 @@ CFG = dict(vocab=64, seq=16, layers=1, heads=2, d_model=16)
 
 
 def build(fused, seq_parallel=False, seed=7):
-    from paddle_tpu.fluid import framework
 
-    framework._rng_salt_counter[0] = 0  # identical init streams per build
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = seed
     scope = fluid.Scope()
@@ -159,8 +157,6 @@ def test_fused_dropout_off_in_test_mode():
 def test_fused_dropout_trains():
     """Training with fused attention dropout converges (statistically the
     same regularisation as the unfused softmax->dropout->matmul chain)."""
-    from paddle_tpu.fluid import framework
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 7
     scope = fluid.Scope()
@@ -189,7 +185,6 @@ def test_causal_in_kernel_matches_dense_bias():
     """materialize_attn_bias=False (in-kernel causal, no [b,h,s,s] bias
     feeds — the bench's packed-full-length mode) must match the dense
     causal-bias program on full-length batches."""
-    from paddle_tpu.fluid import framework
 
     batch, s = 4, CFG["seq"]
     rng = np.random.RandomState(0)
@@ -212,7 +207,6 @@ def test_causal_in_kernel_matches_dense_bias():
                                                          CFG["heads"]))
 
     def run(materialize, feed):
-        framework._rng_salt_counter[0] = 0
         main, startup = fluid.Program(), fluid.Program()
         main.random_seed = startup.random_seed = 7
         scope = fluid.Scope()
@@ -255,7 +249,6 @@ def test_no_bias_requires_fused():
 def test_fused_vocab_loss_matches_dense():
     """fused_vocab_loss=True (streaming vocab xent, bench path) must match
     the fc+softmax_with_cross_entropy composition."""
-    from paddle_tpu.fluid import framework
 
     batch, s = 4, CFG["seq"]
     rng = np.random.RandomState(0)
@@ -269,7 +262,6 @@ def test_fused_vocab_loss_matches_dense():
     }
 
     def run(fused_vocab):
-        framework._rng_salt_counter[0] = 0
         main, startup = fluid.Program(), fluid.Program()
         main.random_seed = startup.random_seed = 7
         scope = fluid.Scope()
@@ -302,7 +294,6 @@ def test_fused_vocab_loss_matches_dense():
 def test_amp_bfloat16_activations_train():
     """amp_dtype='bfloat16': activations flow bf16 end-to-end over f32
     master weights; training stays close to the f32 run and converges."""
-    from paddle_tpu.fluid import framework
 
     batch, s = 4, CFG["seq"]
     rng = np.random.RandomState(0)
@@ -316,7 +307,6 @@ def test_amp_bfloat16_activations_train():
     }
 
     def run(amp):
-        framework._rng_salt_counter[0] = 0
         main, startup = fluid.Program(), fluid.Program()
         main.random_seed = startup.random_seed = 7
         scope = fluid.Scope()

@@ -14,7 +14,6 @@ import numpy as np
 import pytest
 
 from paddle_tpu import fluid
-from paddle_tpu.fluid import framework
 from paddle_tpu.parallel import TaskQueue
 from paddle_tpu.resilience import (FaultInjector, GuardPolicy,
                                    NonFiniteError, NonFiniteEscalation,
@@ -26,9 +25,8 @@ PARAM_PREFIX = "fc_0"
 
 def build_net(seed=7):
     """A deterministic fc regression step: -> (main, startup, scope,
-    cost).  The rng-salt counter is reset so two builds are identical
+    cost).  Per-program rng salts make two builds identical
     program-for-program (the bitwise comparisons depend on it)."""
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = seed
     scope = fluid.Scope()
@@ -246,7 +244,6 @@ class TestRecovery:
         pre-step twin for the gate — a bad step must drop it rather
         than publish its non-finite value into the scope (where the
         next checkpoint would durably record it)."""
-        framework._rng_salt_counter[0] = 0
         main, startup = fluid.Program(), fluid.Program()
         main.random_seed = startup.random_seed = 7
         scope = fluid.Scope()
@@ -799,7 +796,6 @@ class TestErrorClip:
         """var.error_clip = ErrorClipByValue(max): the gradient flowing
         upstream from that var is clamped to [min, max] during
         append_backward (reference clip.py semantics)."""
-        framework._rng_salt_counter[0] = 0
         main, startup = fluid.Program(), fluid.Program()
         main.random_seed = startup.random_seed = 5
         scope = fluid.Scope()
@@ -835,7 +831,6 @@ class TestErrorClip:
             fluid.clip.ErrorClipByValue(max=-1.0, min=1.0)
 
     def test_error_clip_rejects_wrong_type(self):
-        framework._rng_salt_counter[0] = 0
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup), fluid.unique_name.guard():
             x = fluid.layers.data("x", [4], "float32")
@@ -846,7 +841,6 @@ class TestErrorClip:
                 fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
 
     def test_no_error_clip_means_no_clip_ops(self):
-        framework._rng_salt_counter[0] = 0
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup), fluid.unique_name.guard():
             x = fluid.layers.data("x", [4], "float32")

@@ -11,14 +11,12 @@ import numpy as np
 import pytest
 
 from paddle_tpu import fluid
-from paddle_tpu.fluid import framework
 
 
 def _build_tiny(seed=5):
-    """Tiny fixed-seed regression net: fc -> square_error -> SGD.  The
-    rng-salt counter resets so two builds of this model produce the
+    """Tiny fixed-seed regression net: fc -> square_error -> SGD.
+    Per-program rng salts mean two builds of this model produce the
     SAME init stream (what makes bitwise comparison meaningful)."""
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = seed
     startup.random_seed = seed
@@ -333,7 +331,6 @@ def test_pipelined_feed_no_slower_than_sync():
     microsecond-scale model with zero data prep is deliberately NOT
     tested — there per-batch thread handoff dominates and pipelining
     has nothing to hide.)"""
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 5
     startup.random_seed = 5

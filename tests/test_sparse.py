@@ -16,7 +16,6 @@ import numpy as np
 import pytest
 
 from paddle_tpu import fluid
-from paddle_tpu.fluid import framework
 from paddle_tpu.fluid.core.selected_rows import SelectedRows, merge_rows
 
 
@@ -43,7 +42,6 @@ def test_selected_rows_scatter_matches_dense():
 
 
 def _build_embedding_net(is_sparse, make_opt, vocab=50, dim=8):
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -114,7 +112,6 @@ def test_sparse_grad_is_selected_rows():
 
 
 def test_padding_idx_rows_get_no_grad():
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -139,7 +136,6 @@ def test_ctr_wide_and_deep_trains():
     on a synthetic click signal."""
     from paddle_tpu.models import ctr
 
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     n_slots, vocab, batch = 6, 1000, 32
@@ -204,7 +200,6 @@ def test_sparse_grad_regularizer_and_clip():
     import warnings
     from paddle_tpu.fluid.regularizer import L2Decay
 
-    framework._rng_salt_counter[0] = 0
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
