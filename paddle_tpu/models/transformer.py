@@ -21,7 +21,7 @@ from ..fluid import ParamAttr, layers
 
 __all__ = ["transformer", "encoder", "wrap_encoder", "make_attn_bias",
            "position_encoding_init", "decode_prefill", "decode_step",
-           "paged_prefill_chunk", "paged_decode_step"]
+           "paged_prefill_chunk", "paged_decode_step", "verify_step"]
 
 
 def _nm(prefix, key):
@@ -622,14 +622,56 @@ def paged_decode_step(trg_word, trg_pos, self_table, self_pages,
     ``self_table``/``cross_table`` [b, P] int32 under ``self_lengths``/
     ``src_lengths`` masks.  ``kv_scales`` (int8 pools) rides into every
     write and attention walk — the decode read stream moves int8 bytes.
-    Returns logits [b, 1, vocab]."""
+    Returns logits [b, 1, vocab].  The 1-token case of ``verify_step``
+    (same op sequence — the programs stay byte-identical)."""
+    return verify_step(trg_word, trg_pos, self_table, self_pages,
+                       self_offsets, self_lengths, self_base, cross_table,
+                       src_lengths, pool, trg_vocab_size, max_length,
+                       n_layer, n_head, d_key, d_value, d_model,
+                       d_inner_hid, param_prefix, kv_scales=kv_scales,
+                       n_tokens=1)
+
+
+def verify_step(trg_word, trg_pos, self_table, self_pages, self_offsets,
+                self_lengths, self_base, cross_table, src_lengths, pool,
+                trg_vocab_size, max_length, n_layer, n_head, d_key,
+                d_value, d_model, d_inner_hid, param_prefix,
+                kv_scales=None, n_tokens=1, logit_mask=None):
+    """Score ``n_tokens`` candidate positions per lane in ONE dispatch —
+    the target half of speculative decoding (ISSUE 15).
+
+    Feeds generalize ``paged_decode_step`` along a per-lane token axis:
+    ``trg_word``/``trg_pos`` [b, K] int64 (the lane's current token
+    followed by its draft tokens, at GLOBAL positions base..base+K-1),
+    ``self_pages``/``self_offsets`` [b, K] int32 per-token write targets
+    (trash page 0 for positions past the lane's draft count — a lane
+    verifying n < K tokens, or a plain lane verifying exactly its
+    current token, rides the same executable), ``self_lengths`` [b]
+    int32 (= base + live token count), ``self_base`` [b] int32.
+
+    Each token's K/V scatters into the lane's self pages
+    (``paged_cache_write`` already takes a [b, C] token axis — the
+    chunked-prefill path writes C tokens the same way) and the K
+    queries attend CAUSALLY over the lane's page list: query j at
+    global position base+j reads keys ≤ base+j (the ragged kernel's
+    per-query causal bound — the exact mask chunked prefill uses), so
+    position j's logits condition on precisely the tokens before it.
+    Rejected positions need no device undo: acceptance truncates the
+    lane's position on the host, and the garbage K/V beyond it is
+    re-written by the next round's tokens before any masked read.
+
+    ``logit_mask`` (constrained generation) is an additive [b, K, vocab]
+    float32 feed — 0 for allowed tokens, a large negative for banned —
+    applied in-graph before the caller's argmax.  Masks ride as DATA, so
+    per-request grammar changes never recompile.  Returns logits
+    [b, K, vocab]."""
     if not param_prefix:
-        raise ValueError("paged_decode_step requires param_prefix")
+        raise ValueError("verify_step requires param_prefix")
     emb = prepare_embedding(trg_word, trg_pos, trg_vocab_size, max_length,
                             d_model, 0.0,
                             emb_name=_nm(param_prefix, "trg_emb.w"),
                             pos_name=_nm(param_prefix, "trg_pos_emb.w"))
-    emb = layers.reshape(emb, [-1, 1, d_model])
+    emb = layers.reshape(emb, [-1, int(n_tokens), d_model])
     paged_caches = [{"pool": pool, "table": self_table,
                      "pages": self_pages, "offsets": self_offsets,
                      "lengths": self_lengths, "base": self_base,
@@ -643,10 +685,13 @@ def paged_decode_step(trg_word, trg_pos, self_table, self_pages,
                          d_value, d_model, d_inner_hid, 0.0,
                          prefix=param_prefix, paged_caches=paged_caches,
                          paged_crosses=paged_crosses)
-    return layers.fc(input=dec_output, size=trg_vocab_size,
-                     num_flatten_dims=2, bias_attr=False,
-                     param_attr=_plain_attr(
-                         _nm(param_prefix, "vocab_proj.w")))
+    logits = layers.fc(input=dec_output, size=trg_vocab_size,
+                       num_flatten_dims=2, bias_attr=False,
+                       param_attr=_plain_attr(
+                           _nm(param_prefix, "vocab_proj.w")))
+    if logit_mask is not None:
+        logits = layers.elementwise_add(logits, logit_mask)
+    return logits
 
 
 def make_attn_bias(lengths, seq_len, n_head, causal=False):

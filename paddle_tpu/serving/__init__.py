@@ -34,6 +34,13 @@ This package is the TPU-native replacement:
   causal prefill interleaved with decode in one compiled dispatch, and
   copy-on-write prefix sharing with refcounts.  The dense decoder stays
   as the differential parity baseline.
+* ``SpeculativeGenerator`` (speculative.py) + ``constraints.py`` — the
+  ISSUE-15 tentpole: draft k tokens with a cheap draft model, verify
+  all k in ONE target dispatch (``verify_step``'s per-lane token axis
+  over the paged pool), accept/reject with host-side page-table
+  truncation + pre-write copy-on-write, and per-request grammar/JSON
+  constrained generation via in-graph token masks fed as data.
+  Token-for-token parity with plain greedy at any accept rate.
 * ``gateway/`` (ISSUE 10) — the production front door: ``ModelRegistry``
   (versioned artifacts, HBM budget, zero-downtime hot swap),
   ``TenantRouter`` (token buckets, SLO-class admission, fair share),
@@ -50,9 +57,13 @@ from .paged_decoder import (PagedTransformerGenerator,  # noqa: F401
 from .paging import PageAllocator, PoolCapacityError  # noqa: F401
 from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
                         RequestCancelled, SchedulerShutdown)
+from .constraints import (Constraint, DFAConstraint,  # noqa: F401
+                          TokenSetConstraint, compile_constraint)
+from .speculative import SpeculativeGenerator  # noqa: F401
 
 __all__ = ["InferenceEngine", "TransformerGenerator", "FullRerunDecoder",
            "PagedTransformerGenerator", "PageAllocator", "copy_weights",
            "kv_page_bytes", "PoolCapacityError",
            "ContinuousBatchingScheduler", "Request", "RequestCancelled",
-           "SchedulerShutdown"]
+           "SchedulerShutdown", "SpeculativeGenerator", "Constraint",
+           "TokenSetConstraint", "DFAConstraint", "compile_constraint"]

@@ -204,6 +204,13 @@ class Gateway:
         steady state must add zero executable-cache misses — the
         ``recompiles_after_warmup == 0`` contract across a swap."""
         inst = self.registry.instance(key)
+        if getattr(inst, "speculative_aware", False):
+            # speculative pair (ISSUE 15): resolve the draft, verify,
+            # and COW executables at the serving lane count with
+            # all-idle dispatches — the generic admit/lane_step warm
+            # below would only exercise the verify program
+            inst.aot_warm(n_slots)
+            return
         if hasattr(inst, "lane_step"):
             inst.open_slots(n_slots)
             prompt = np.full(min(2, getattr(inst, "src_len", 2)),
@@ -238,12 +245,43 @@ class Gateway:
     def load_model(self, name: str, version: str,
                    dirname: Optional[str] = None,
                    n_slots: Optional[int] = None, warm: bool = True,
-                   instance=None, **overrides) -> str:
+                   instance=None, draft_model: Optional[str] = None,
+                   draft_version: Optional[str] = None,
+                   speculate_k: int = 4, **overrides) -> str:
         """Load a version and register its lane group; the first version
         of a model becomes the alias target and starts taking traffic
-        immediately."""
+        immediately.  ``draft_model``/``draft_version`` (ISSUE 15)
+        attach a draft generator artifact: the group serves as a
+        ``SpeculativeGenerator`` (k = ``speculate_k``), budgeted
+        jointly and warmed across its draft/verify/cow executables."""
         if instance is not None:
+            if draft_model is not None or draft_version is not None:
+                # refuse, don't silently drop: an adopted instance is
+                # used as-is (wrap it in a SpeculativeGenerator before
+                # registering if you want a draft attached)
+                raise ValueError(
+                    "load_model: draft_model/draft_version do not "
+                    "apply to instance= loads — pass a "
+                    "SpeculativeGenerator instance instead")
             key = self.registry.register(name, version, instance)
+        elif draft_model is not None:
+            if draft_version is None:
+                raise ValueError("load_model: draft_model needs "
+                                 "draft_version")
+            draft_dirname = overrides.pop("draft_dirname", None)
+            if overrides:
+                # the plain path applies manifest overrides; the
+                # speculative loader does not — refusing beats
+                # silently loading (and budgeting) a config the
+                # operator never asked for
+                raise ValueError(
+                    f"load_model: overrides {sorted(overrides)} are "
+                    f"not supported with draft_model — bake them into "
+                    f"the artifact manifests")
+            key = self.registry.load_speculative(
+                name, version, draft_model, draft_version,
+                k=speculate_k, dirname=dirname,
+                draft_dirname=draft_dirname)
         else:
             key = self.registry.load(name, version, dirname=dirname,
                                      **overrides)
@@ -359,17 +397,85 @@ class Gateway:
                         jid, ok=ok,
                         error=None if ok else type(req.error).__name__)
                 if self.check_invariants:
-                    alloc = getattr(inst, "alloc", None)
-                    if alloc is not None:
-                        alloc.check_invariants()
+                    check = getattr(inst, "check_invariants", None)
+                    if callable(check):
+                        # a speculative pair checks BOTH pools (its
+                        # .alloc is only the target's)
+                        check()
+                    else:
+                        alloc = getattr(inst, "alloc", None)
+                        if alloc is not None:
+                            alloc.check_invariants()
             if user_cb is not None:
                 user_cb(req, tok)
         return on_token
 
+    def _decode_options(self, model: str, inst,
+                        draft_model: Optional[str],
+                        constraint, speculate: Optional[bool]):
+        """Validate per-request decode options against the serving
+        instance and fold them into the scheduler's ``decode`` dict —
+        loudly, at submit time (HTTP 400), never inside the serve loop.
+        Returns None for a plain (non-speculative) group; a speculative
+        group always gets an explicit dict — speculation defaults ON
+        there (``speculate=False`` opts a request out)."""
+        spec_aware = getattr(inst, "speculative_aware", False)
+        if not spec_aware:
+            if draft_model is None and constraint is None \
+                    and speculate is not True:
+                # nothing asked that a plain group cannot serve — an
+                # explicit speculate=False OPT-OUT lands here too:
+                # plain decode is exactly what the client requested
+                return None
+            raise ValueError(
+                f"model {model!r} has no draft attached — "
+                f"draft_model/constraint/speculate=True need a "
+                f"speculative group (load_model(..., draft_model=))")
+        if draft_model is None and constraint is None \
+                and speculate is None:
+            # nothing asked: leave decode None so the journal records
+            # nothing and a replay (or a queued request surviving a
+            # swap to a DRAFTLESS version) decodes plain instead of
+            # being rejected for options the client never requested —
+            # speculation still defaults ON group-side (admit_slot)
+            return None
+        attached = getattr(inst, "draft_name", None)
+        if draft_model is not None and str(draft_model) != str(attached):
+            # attached None (an adopted instance built without
+            # draft_name) also lands here: the client named a draft we
+            # cannot confirm is the one attached — refuse rather than
+            # silently speculate with an unknown draft
+            raise ValueError(
+                f"model {model!r} serves with draft {attached!r}, not "
+                f"{draft_model!r} — one draft per lane group")
+        decode = {"draft": True if speculate is None
+                  else bool(speculate)}
+        if constraint is not None:
+            if not isinstance(constraint, dict):
+                # the journal replays decode options as JSON; a
+                # prebuilt Constraint object could neither serialize
+                # nor reconstruct — in-process callers with custom
+                # automata use the scheduler/generator directly
+                raise ValueError(
+                    "gateway constraint must be a JSON spec dict "
+                    "(serving/constraints.py wire format), not "
+                    f"{type(constraint).__name__}")
+            # compile now so a malformed grammar 400s the submit; the
+            # generator memoizes, so admission pays a dict lookup
+            inst.compile_constraint(constraint)
+            decode["constraint"] = constraint
+        return decode
+
     def submit(self, model: str, prompt, tenant: str = "default",
-               max_new: Optional[int] = None, on_token=None) -> Request:
+               max_new: Optional[int] = None, on_token=None,
+               draft_model: Optional[str] = None, constraint=None,
+               speculate: Optional[bool] = None) -> Request:
         """Rate-limit gate -> journal -> queue.  Returns the scheduler
-        ``Request`` (``wait()`` for blocking use)."""
+        ``Request`` (``wait()`` for blocking use).  ``draft_model``
+        (must match the group's attached draft), ``constraint`` (a
+        grammar spec — serving/constraints.py wire format) and
+        ``speculate`` (False = plain decode on a speculative group)
+        ride the request as ``Request.decode`` (ISSUE 15)."""
         cfg = self.router.tenant(tenant)
         key = self.registry.resolve(model)
         try:
@@ -390,17 +496,22 @@ class Gateway:
                 f"call registry.instance({model!r}).infer(feed) instead")
         cap = getattr(inst, "max_out_len", self.sched.default_max_new)
         eff_new = min(max_new or self.sched.default_max_new, cap)
+        # rate-limit BEFORE decoding options: compile_constraint can
+        # cost real CPU/memory on a large grammar, and an over-budget
+        # tenant must not get to burn it
         self.router.check_submit(
             tenant, self.router.request_cost(len(prompt), eff_new))
+        decode = self._decode_options(model, inst, draft_model,
+                                      constraint, speculate)
         jid = None
         if self.journal is not None:
             jid = self.journal.new_jid()
             self.journal.record_submit(jid, tenant, model, prompt,
-                                       eff_new)
+                                       eff_new, decode=decode)
         try:
             req = self.sched.submit(
                 prompt, max_new_tokens=eff_new, model=model,
-                tenant=tenant,
+                tenant=tenant, decode=decode,
                 on_token=self._wrap_on_token(jid, cfg.slo, inst,
                                              on_token))
         except BaseException as e:
@@ -419,9 +530,13 @@ class Gateway:
 
     def generate(self, model: str, prompt, tenant: str = "default",
                  max_new: Optional[int] = None,
-                 timeout: Optional[float] = 120.0) -> Dict[str, object]:
+                 timeout: Optional[float] = 120.0,
+                 draft_model: Optional[str] = None, constraint=None,
+                 speculate: Optional[bool] = None) -> Dict[str, object]:
         """Blocking path: submit, wait, return the full token list."""
-        req = self.submit(model, prompt, tenant=tenant, max_new=max_new)
+        req = self.submit(model, prompt, tenant=tenant, max_new=max_new,
+                          draft_model=draft_model, constraint=constraint,
+                          speculate=speculate)
         if not req.wait(timeout):
             req.cancel()
             raise TimeoutError(f"generate: rid {req.rid} still running "
@@ -435,14 +550,19 @@ class Gateway:
 
     def submit_stream(self, model: str, prompt, tenant: str = "default",
                       max_new: Optional[int] = None,
-                      timeout: float = 60.0) -> TokenStream:
+                      timeout: float = 60.0,
+                      draft_model: Optional[str] = None, constraint=None,
+                      speculate: Optional[bool] = None) -> TokenStream:
         """Streaming path: returns a ``TokenStream`` yielding tokens as
         decode steps retire.  Token-for-token identical to the blocking
         path (same scheduler, same lanes) — the acceptance test asserts
-        it."""
+        it.  A speculative lane delivers its accepted tokens through
+        the same per-token callback, so a stream consumer sees a burst
+        of up to k+1 tokens per round, in order."""
         stream = TokenStream(timeout=timeout)
         req = self.submit(model, prompt, tenant=tenant, max_new=max_new,
-                          on_token=stream._push)
+                          on_token=stream._push, draft_model=draft_model,
+                          constraint=constraint, speculate=speculate)
         stream.request = req
         return stream
 
@@ -463,6 +583,7 @@ class Gateway:
                     np.asarray(entry["prompt"], np.int64),
                     max_new_tokens=entry["max_new"],
                     model=entry["model"], tenant=entry["tenant"],
+                    decode=entry.get("decode"),
                     on_token=self._wrap_on_token(entry["jid"], cfg.slo,
                                                  inst))
             except Exception as e:

@@ -80,11 +80,17 @@ class RequestJournal:
 
     # -- lifecycle records ---------------------------------------------------
     def record_submit(self, jid: str, tenant: str, model: str,
-                      prompt, max_new: int) -> None:
-        self._file.append({"op": "submit", "jid": jid, "tenant": tenant,
-                           "model": model,
-                           "prompt": [int(t) for t in prompt],
-                           "max_new": int(max_new)}, stamp="t")
+                      prompt, max_new: int,
+                      decode: Optional[Dict] = None) -> None:
+        entry = {"op": "submit", "jid": jid, "tenant": tenant,
+                 "model": model, "prompt": [int(t) for t in prompt],
+                 "max_new": int(max_new)}
+        if decode is not None:
+            # per-request decode options (ISSUE 15: draft on/off +
+            # constraint spec) are plain JSON, so a replayed request
+            # decodes under the SAME grammar it was admitted with
+            entry["decode"] = decode
+        self._file.append(entry, stamp="t")
 
     def record_done(self, jid: str, ok: bool = True,
                     error: Optional[str] = None) -> None:

@@ -49,6 +49,7 @@ from ..engine import DEFAULT_BATCH_BUCKETS, InferenceEngine
 from ..paged_decoder import (PagedTransformerGenerator, _CACHE_MARKERS,
                              estimate_generator_hbm)
 from ..scheduler import HBMBudgetError
+from ..speculative import SpeculativeGenerator, estimate_speculative_hbm
 
 __all__ = ["HBMBudgetError", "ModelRegistry", "MANIFEST_NAME",
            "COMPILED_SUBDIR"]
@@ -170,6 +171,7 @@ class ModelRegistry:
                                  RANK_MODEL_REGISTRY)
         self._entries: Dict[str, _Entry] = {}
         self._alias: Dict[str, str] = {}        # name -> version
+        self._loading: set = set()   # keys reserved by in-flight loads
         _LIVE_REGISTRIES.add(self)
         _register_registry_collector()
 
@@ -311,43 +313,125 @@ class ModelRegistry:
         model becomes its alias target."""
         name, version = str(name), str(version)
         key = f"{name}@{version}"
-        with self._lock:
-            if key in self._entries:
-                raise ValueError(f"{key} already loaded")
-        if dirname is None:
-            if self.root is None:
-                raise ValueError("registry has no root; pass dirname=")
-            dirname = fluid.io.model_version_dir(self.root, name, version)
-        if not os.path.isdir(dirname):
-            raise FileNotFoundError(f"no artifact at {dirname}")
-        # chaos point (ISSUE 12): a seeded load failure — unreadable
-        # artifact store, bad deserialize — injectable so the release
-        # controller's reject-and-keep-serving path is testable
-        from ...resilience.chaos import injector
+        self._reserve_load(key)
+        try:
+            if dirname is None:
+                if self.root is None:
+                    raise ValueError(
+                        "registry has no root; pass dirname=")
+                dirname = fluid.io.model_version_dir(self.root, name,
+                                                     version)
+            if not os.path.isdir(dirname):
+                raise FileNotFoundError(f"no artifact at {dirname}")
+            # chaos point (ISSUE 12): a seeded load failure —
+            # unreadable artifact store, bad deserialize — injectable
+            # so the release controller's reject-and-keep-serving path
+            # is testable
+            from ...resilience.chaos import injector
 
-        injector().maybe_fail("registry.load")
-        manifest = self._manifest(dirname)
-        kind = manifest.get("kind", "engine")
-        config = dict(manifest.get("config", {}))
-        config.update(overrides)
-        cost, components = self._estimate_cost_detail(kind, dirname,
-                                                      config)
-        self._charge(cost, key, components)
-        if kind == "generator":
-            instance = self._build_generator(dirname, config)
-        elif kind == "engine":
-            exe = fluid.Executor(self.place,
-                                 compile_cache=_artifact_cache(dirname))
-            instance = InferenceEngine(
-                dirname=dirname, place=self.place, executor=exe,
-                quantize=config.pop("quantize", "off"), **config)
-        else:
-            raise ValueError(f"{dirname}: unknown artifact kind "
-                             f"{kind!r} (engine or generator)")
+            injector().maybe_fail("registry.load")
+            manifest = self._manifest(dirname)
+            kind = manifest.get("kind", "engine")
+            config = dict(manifest.get("config", {}))
+            config.update(overrides)
+            cost, components = self._estimate_cost_detail(kind, dirname,
+                                                          config)
+            self._charge(cost, key, components)
+            if kind == "generator":
+                instance = self._build_generator(dirname, config)
+            elif kind == "engine":
+                exe = fluid.Executor(
+                    self.place, compile_cache=_artifact_cache(dirname))
+                instance = InferenceEngine(
+                    dirname=dirname, place=self.place, executor=exe,
+                    quantize=config.pop("quantize", "off"), **config)
+            else:
+                raise ValueError(f"{dirname}: unknown artifact kind "
+                                 f"{kind!r} (engine or generator)")
+            with self._lock:
+                self._entries[key] = _Entry(key, name, version, kind,
+                                            instance, cost, dirname)
+                self._alias.setdefault(name, version)
+        finally:
+            with self._lock:
+                self._loading.discard(key)
+        return key
+
+    def _reserve_load(self, key: str) -> None:
+        """Reserve ``key`` for an in-flight load: a concurrent load of
+        the same name@version fails FAST here instead of both passing
+        the duplicate check, both building full instances on device
+        (transient double HBM residency), and the second silently
+        replacing the first's entry.  The caller clears the
+        reservation in a ``finally``."""
         with self._lock:
-            self._entries[key] = _Entry(key, name, version, kind,
-                                        instance, cost, dirname)
-            self._alias.setdefault(name, version)
+            if key in self._entries or key in self._loading:
+                raise ValueError(f"{key} already loaded")
+            self._loading.add(key)
+
+    def load_speculative(self, name: str, version: str, draft_name: str,
+                         draft_version: str, k: int = 4,
+                         dirname: Optional[str] = None,
+                         draft_dirname: Optional[str] = None) -> str:
+        """Load a TARGET generator artifact with a DRAFT generator
+        artifact attached as one speculative serving instance (ISSUE
+        15): the lane-group key stays ``name@version`` — speculation is
+        a serving configuration of the target, not a separate alias —
+        and the HBM budget charges the PAIR jointly (target priced at
+        its k+1-token verify shape, draft at its masked decode shape,
+        both pools and parameter sets resident at once) BEFORE either
+        model is built.  Each artifact mounts its own ``compiled/`` AOT
+        cache, so a pre-compiled pair serves its draft/verify/cow
+        executables from disk (zero process compiles)."""
+        name, version = str(name), str(version)
+        key = f"{name}@{version}"
+        self._reserve_load(key)
+        try:
+            def _dir(n, v, explicit):
+                if explicit is not None:
+                    return explicit
+                if self.root is None:
+                    raise ValueError("registry has no root; pass "
+                                     "dirname= and draft_dirname=")
+                return fluid.io.model_version_dir(self.root, n, v)
+
+            t_dir = _dir(name, version, dirname)
+            d_dir = _dir(draft_name, draft_version, draft_dirname)
+            for d in (t_dir, d_dir):
+                if not os.path.isdir(d):
+                    raise FileNotFoundError(f"no artifact at {d}")
+            from ...resilience.chaos import injector
+
+            injector().maybe_fail("registry.load")
+            t_manifest, d_manifest = self._manifest(t_dir), \
+                self._manifest(d_dir)
+            if t_manifest.get("kind") != "generator" or \
+                    d_manifest.get("kind") != "generator":
+                raise ValueError(
+                    "load_speculative: both artifacts must be "
+                    "generator artifacts (target kind "
+                    f"{t_manifest.get('kind')!r}, "
+                    f"draft kind {d_manifest.get('kind')!r})")
+            t_cfg = dict(t_manifest.get("config", {}))
+            d_cfg = dict(d_manifest.get("config", {}))
+            donation = os.environ.get(
+                "PADDLE_TPU_AOT_DISABLE", "") == "1"
+            plan = estimate_speculative_hbm(t_cfg, d_cfg, k=int(k),
+                                            assume_donation=donation)
+            cost = int(plan.peak_bytes)
+            self._charge(cost, key, dict(plan.components))
+            target = self._build_generator(t_dir, t_cfg)
+            draft = self._build_generator(d_dir, d_cfg)
+            instance = SpeculativeGenerator(target, draft, k=int(k),
+                                            draft_name=str(draft_name))
+            with self._lock:
+                self._entries[key] = _Entry(key, name, version,
+                                            "speculative", instance,
+                                            cost, t_dir)
+                self._alias.setdefault(name, version)
+        finally:
+            with self._lock:
+                self._loading.discard(key)
         return key
 
     def _build_generator(self, dirname: str,
@@ -394,6 +478,8 @@ class ModelRegistry:
         self._charge(int(hbm_bytes), key, components)
         kind = ("generator"
                 if isinstance(instance, PagedTransformerGenerator)
+                else "speculative"
+                if isinstance(instance, SpeculativeGenerator)
                 else "engine" if isinstance(instance, InferenceEngine)
                 else type(instance).__name__)
         with self._lock:

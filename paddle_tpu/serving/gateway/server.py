@@ -5,7 +5,10 @@ Same serving shape as ``observability/server.py`` and the PR 1
 JSON bodies, port 0 = pick-a-port.  Routes:
 
 * ``POST /v1/generate`` — body ``{"model", "prompt": [ids], "tenant",
-  "max_new", "stream"}``.  Blocking by default (one JSON response with
+  "max_new", "stream", "draft_model", "constraint", "speculate"}``
+  (the last three are the ISSUE 15 speculative/constrained decode
+  options; they 400 unless the model group has a draft attached).
+  Blocking by default (one JSON response with
   the full token list); ``"stream": true`` switches to chunked
   transfer, one JSON line per token as the decode step retires it, with
   a final ``{"done": ...}`` line.  A client that disconnects mid-stream
@@ -120,17 +123,30 @@ class _Handler(BaseHTTPRequestHandler):
         model = str(body.get("model", "default"))
         tenant = str(body.get("tenant", "default"))
         max_new = body.get("max_new")
+        # speculative/constrained decode options (ISSUE 15): validated
+        # at submit — a wrong draft name or malformed grammar is a 400
+        # here, never a serve-loop failure
+        draft_model = body.get("draft_model")
+        constraint = body.get("constraint")
+        speculate = body.get("speculate")
+        if speculate is not None:
+            speculate = bool(speculate)
         if not body.get("stream", False):
             out = gw.generate(model, prompt, tenant=tenant,
                               max_new=max_new,
-                              timeout=self.server_ref.request_timeout)
+                              timeout=self.server_ref.request_timeout,
+                              draft_model=draft_model,
+                              constraint=constraint, speculate=speculate)
             return self._send_json(out)
         # chunked streaming: one JSON line per token, then a done line.
         # BrokenPipe (client went away) cancels the request so the lane
         # and its pages stop burning on an audience of zero.
         stream = gw.submit_stream(model, prompt, tenant=tenant,
                                   max_new=max_new,
-                                  timeout=self.server_ref.request_timeout)
+                                  timeout=self.server_ref.request_timeout,
+                                  draft_model=draft_model,
+                                  constraint=constraint,
+                                  speculate=speculate)
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonl")
         self.send_header("Transfer-Encoding", "chunked")
@@ -166,15 +182,33 @@ class _Handler(BaseHTTPRequestHandler):
         action = body.get("action")
         model = body.get("model")
         version = body.get("version")
+        if action in ("load", "swap"):
+            kw = {}
+            if body.get("draft_model") is not None:
+                kw = {"draft_model": body.get("draft_model"),
+                      "draft_version": body.get("draft_version"),
+                      "speculate_k": int(body.get("speculate_k", 4)),
+                      "draft_dirname": body.get("draft_dirname")}
+            else:
+                stray = [f for f in ("draft_version", "draft_dirname",
+                                     "speculate_k")
+                         if body.get(f) is not None]
+                if stray:
+                    # refuse, don't silently produce a plain group:
+                    # the misconfiguration would otherwise surface as
+                    # baffling 400s on every speculative request
+                    raise ValueError(
+                        f"models {action}: {'/'.join(stray)} need "
+                        f"draft_model")
         if action == "load":
             key = gw.load_model(model, version,
                                 dirname=body.get("dirname"),
-                                n_slots=body.get("n_slots"))
+                                n_slots=body.get("n_slots"), **kw)
             return self._send_json({"loaded": key})
         if action == "swap":
             key = gw.swap_model(model, version,
                                 dirname=body.get("dirname"),
-                                n_slots=body.get("n_slots"))
+                                n_slots=body.get("n_slots"), **kw)
             return self._send_json({"swapped": key})
         if action == "unload":
             gw.unload_model(f"{model}@{version}" if version else model)

@@ -83,7 +83,8 @@ _CACHE_MARKERS = ("@kv_pool", "@kv_scales", "@kcache", "@vcache",
                   "@crossk", "@crossv")
 
 
-def copy_weights(src_scope, dst_scope, prefix: Optional[str] = None) -> int:
+def copy_weights(src_scope, dst_scope, prefix: Optional[str] = None,
+                 dst_prefix: Optional[str] = None) -> int:
     """Host-copy vars from ``src_scope`` into ``dst_scope`` EXCEPT
     cache-state vars (``_CACHE_MARKERS``): two generators sharing one
     ``param_prefix`` (a float-pool and an int8-pool parity pair) share
@@ -91,9 +92,15 @@ def copy_weights(src_scope, dst_scope, prefix: Optional[str] = None) -> int:
     would carry stale decode state across.  ``prefix`` restricts the
     copy to one model's ``param_prefix`` — required when ``src_scope``
     is shared with other models (their caches and params would
-    otherwise be dragged along and re-uploaded for nothing).  Unset
+    otherwise be dragged along and re-uploaded for nothing).
+    ``dst_prefix`` (requires ``prefix``) REWRITES the leading prefix on
+    the way over — how a draft model under its own ``param_prefix`` is
+    seeded from a target's weights (the ISSUE 15 draft==target parity
+    pair, and the bench's shared-trunk draft construction).  Unset
     placeholders (``Scope.var()`` with no value) are skipped.  Returns
     the number of vars copied."""
+    if dst_prefix is not None and prefix is None:
+        raise ValueError("copy_weights: dst_prefix requires prefix")
     n = 0
     for name in list(src_scope.vars):
         if any(m in name for m in _CACHE_MARKERS):
@@ -103,7 +110,9 @@ def copy_weights(src_scope, dst_scope, prefix: Optional[str] = None) -> int:
         val = src_scope.find_var(name)
         if val is None:
             continue
-        dst_scope.set_var(name, np.array(np.asarray(val)))
+        out_name = name if dst_prefix is None \
+            else dst_prefix + name[len(prefix):]
+        dst_scope.set_var(out_name, np.array(np.asarray(val)))
         n += 1
     return n
 
@@ -119,16 +128,32 @@ def default_num_pages(src_len: int, max_out_len: int,
 
 def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
                           page_size: int, num_pages: int, chunk_size: int,
-                          param_prefix: str, kv_dtype: str = "float32"):
+                          param_prefix: str, kv_dtype: str = "float32",
+                          verify_tokens: int = 1,
+                          logit_masks: bool = False):
     """Build the unified prefill+decode program DESC — pure Python, no
     device allocation, no scope.  The generator's ``_build_unified``
     calls this with its own config; the gateway registry calls it with
     a manifest config to run the static peak-HBM planner BEFORE any
     construction (the pool/sidecar are persistable vars with recorded
     shapes, so the planner prices the full serving footprint from the
-    desc alone).  Returns ``(prog, startup, next_ids, logits)``."""
+    desc alone).  Returns ``(prog, startup, next_ids, logits)``.
+
+    ``verify_tokens=K`` (ISSUE 15) widens the decode half to a per-lane
+    K-token axis: the chunked-prefill tower is unchanged, but the step
+    feeds become ``trg_word``/``trg_pos``/``self_pages``/``self_offsets``
+    [b, K] and the program scores all K positions causally in the one
+    dispatch (``models.transformer.verify_step``) — the target side of
+    speculative decoding, where K = draft length + 1.  A lane verifying
+    fewer than K tokens (a plain non-speculative lane verifies exactly
+    its current token) rides trash-page writes for the dead positions.
+    ``logit_masks=True`` adds a ``logit_mask`` [b, K, vocab] additive
+    float32 feed applied to the logits before the argmax — constrained
+    generation with masks as DATA (a grammar change never recompiles).
+    The defaults build the exact PR 6 program, byte for byte."""
     c = cfg
     C = int(chunk_size)
+    K = int(verify_tokens)
     p_src = _ceil_div(int(src_len), int(page_size))
     p_out = _ceil_div(int(max_out_len), int(page_size))
     pool_shape = [c.n_head, int(num_pages) * c.n_layer * 2,
@@ -159,21 +184,24 @@ def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
             c.max_length, c.n_layer, c.n_head, c.d_key, c.d_value,
             c.d_model, c.d_inner_hid, param_prefix,
             kv_scales=kv_scales)
-        trg_word = layers.data("trg_word", [1], "int64")
-        trg_pos = layers.data("trg_pos", [1], "int64")
+        trg_word = layers.data("trg_word", [K], "int64")
+        trg_pos = layers.data("trg_pos", [K], "int64")
         self_table = layers.data("self_table", [p_out], "int32")
-        self_pages = layers.data("self_pages", [1], "int32")
-        self_offsets = layers.data("self_offsets", [1], "int32")
+        self_pages = layers.data("self_pages", [K], "int32")
+        self_offsets = layers.data("self_offsets", [K], "int32")
         self_lengths = layers.data("self_lengths", [], "int32")
         self_base = layers.data("self_base", [], "int32")
         cross_table = layers.data("cross_table", [p_src], "int32")
         src_lengths = layers.data("src_lengths", [], "int32")
-        logits = T.paged_decode_step(
+        logit_mask = layers.data(
+            "logit_mask", [K, c.trg_vocab_size], "float32") \
+            if logit_masks else None
+        logits = T.verify_step(
             trg_word, trg_pos, self_table, self_pages, self_offsets,
             self_lengths, self_base, cross_table, src_lengths, pool,
             c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
             c.d_key, c.d_value, c.d_model, c.d_inner_hid, param_prefix,
-            kv_scales=kv_scales)
+            kv_scales=kv_scales, n_tokens=K, logit_mask=logit_mask)
         next_ids = layers.argmax(logits, axis=-1)
     return prog, startup, next_ids, logits
 
@@ -184,14 +212,20 @@ HBM_ESTIMATE_LANES = 8
 
 
 def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
-                           assume_donation: bool = True):
+                           assume_donation: bool = True,
+                           verify_tokens: int = 1,
+                           logit_masks: bool = False):
     """Static peak-HBM plan for a paged generator described by a
     gateway manifest config — built and planned as a DESC, before any
     device allocation.  Params, the KV pool, and the int8 scale sidecar
     are persistable vars with recorded shapes; activations price at
     ``assume_lanes`` in-flight lanes.  ``assume_donation=False`` prices
     the no-donation dispatch of a persistent-AOT-cached executable
-    (pool/param write-backs get fresh buffers — ISSUE 14).  Returns the
+    (pool/param write-backs get fresh buffers — ISSUE 14).
+    ``verify_tokens``/``logit_masks`` (ISSUE 15) price the speculative
+    VERIFY shape of the program — K-token activations and the
+    [lanes, K, vocab] mask feed are real peak-HBM contributors the
+    admission budget must cover.  Returns the
     ``analysis.cost.ProgramMemoryPlan``."""
     from ..fluid.analysis.cost import plan_program
 
@@ -215,7 +249,8 @@ def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
         page_size=page_size, num_pages=int(num_pages),
         chunk_size=int(config.get("chunk_size", 8)),
         param_prefix=str(config.get("param_prefix", "tf")),
-        kv_dtype=str(config.get("kv_dtype", "float32")))
+        kv_dtype=str(config.get("kv_dtype", "float32")),
+        verify_tokens=int(verify_tokens), logit_masks=bool(logit_masks))
     lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
         else int(assume_lanes)
     return plan_program(prog, assume_batch=lanes,
@@ -580,6 +615,109 @@ class PagedTransformerGenerator:
         lane.enc_owned = []
         lane.enc_table = []
 
+    def _prefill_arrays(self) -> Dict[str, np.ndarray]:
+        """The chunked-prefill half of a unified-program feed: one
+        source chunk per lane in phase ``prefill`` (recording each
+        lane's ``pending_chunk``); every other lane rides trash-page
+        writes.  Pair with ``_absorb_prefill()`` AFTER the dispatch ran
+        — the split lets the speculative generator (ISSUE 15) drive the
+        same prefill machinery through its own verify/draft programs."""
+        B, C, ps = self._slots, self.chunk, self.page_size
+        feed = {"pf_word": np.zeros((B, C), np.int64),
+                "pf_pos": np.zeros((B, C), np.int64),
+                "pf_base": np.zeros(B, np.int32),
+                "pf_len": np.ones(B, np.int32),
+                "enc_table": np.zeros((B, self.p_src), np.int32),
+                "enc_pages": np.full((B, C), TRASH_PAGE, np.int32),
+                "cross_pages": np.full((B, C), TRASH_PAGE, np.int32),
+                "w_offsets": np.zeros((B, C), np.int32)}
+        for slot, lane in enumerate(self._lanes):
+            if lane.phase != "prefill":
+                continue
+            done = lane.enc_done
+            m = min(C, lane.s_true - done)
+            lane.pending_chunk = m
+            feed["pf_word"][slot, :m] = lane.src[done:done + m]
+            feed["pf_pos"][slot, :m] = np.arange(done, done + m)
+            feed["pf_base"][slot] = done
+            feed["pf_len"][slot] = done + m
+            feed["enc_table"][slot, :len(lane.enc_table)] = lane.enc_table
+            pos = done + np.arange(m)
+            feed["enc_pages"][slot, :m] = [lane.enc_table[p // ps]
+                                           for p in pos]
+            feed["cross_pages"][slot, :m] = [lane.cross_table[p // ps]
+                                             for p in pos]
+            feed["w_offsets"][slot, :m] = pos % ps
+        return feed
+
+    def _decode_arrays(self, n_tokens: int = 1) -> Dict[str, np.ndarray]:
+        """Idle-default decode-half feed arrays at a per-lane token
+        axis of ``n_tokens`` (1 = the plain decode step; the ISSUE 15
+        verify program feeds k+1) — idle lanes ride trash-page writes,
+        length-1 masks, position 0.  The single home for the decode
+        feed scaffold: ``lane_step`` and the speculative generator's
+        draft/verify dispatches all fill lanes into THESE arrays, so a
+        feed-shape change cannot silently diverge between them."""
+        B = self._slots
+        return {"trg_word": np.zeros((B, n_tokens), np.int64),
+                "trg_pos": np.zeros((B, n_tokens), np.int64),
+                "self_table": np.zeros((B, self.p_out), np.int32),
+                "self_pages": np.full((B, n_tokens), TRASH_PAGE,
+                                      np.int32),
+                "self_offsets": np.zeros((B, n_tokens), np.int32),
+                "self_lengths": np.ones(B, np.int32),
+                "self_base": np.zeros(B, np.int32),
+                "cross_table": np.zeros((B, self.p_src), np.int32),
+                "src_lengths": np.ones(B, np.int32)}
+
+    def _fill_decode_lane(self, dec: Dict[str, np.ndarray], slot: int,
+                          lane, tokens, base_pos: int) -> None:
+        """Fill one lane's rows of a ``_decode_arrays`` feed:
+        ``tokens`` embed at positions ``base_pos..base_pos+n-1`` and
+        their K/V scatter into the lane's self pages at those slots.
+        The single home for the lane->feed convention — ``lane_step``
+        (1 token at ``lane.pos``), the speculative draft dispatch (1
+        token at the draft's own depth) and the k+1-token verify
+        dispatch all go through here, so the page/offset/length
+        arithmetic cannot silently diverge between them."""
+        ps = self.page_size
+        n = len(tokens)
+        t = int(base_pos)
+        if t + n > len(lane.self_table) * ps:
+            raise RuntimeError(
+                f"slot {slot}: writing {n} token(s) at position {t} "
+                f"runs past the reserved {len(lane.self_table)} "
+                f"self pages")
+        for j, tok in enumerate(tokens):
+            dec["trg_word"][slot, j] = tok
+            dec["trg_pos"][slot, j] = t + j
+            dec["self_pages"][slot, j] = lane.self_table[(t + j) // ps]
+            dec["self_offsets"][slot, j] = (t + j) % ps
+        dec["self_table"][slot, :len(lane.self_table)] = lane.self_table
+        dec["self_lengths"][slot] = t + n
+        dec["self_base"][slot] = t
+        dec["cross_table"][slot, :len(lane.cross_table)] = \
+            lane.cross_table
+        dec["src_lengths"][slot] = lane.s_true
+
+    def _absorb_prefill(self) -> None:
+        """Post-dispatch bookkeeping for ``_prefill_arrays``: advance
+        each prefilling lane past its pending chunk (emitting the trace
+        instant AFTER the dispatch returned — a chunk that never ran
+        must not appear in the request timeline)."""
+        for slot, lane in enumerate(self._lanes):
+            if lane.phase != "prefill":
+                continue
+            self._tracer.instant(
+                "lane/prefill_chunk", cat="serving", slot=slot,
+                tokens=lane.pending_chunk,
+                done=lane.enc_done + lane.pending_chunk,
+                total=lane.s_true)
+            lane.enc_done += lane.pending_chunk
+            lane.pending_chunk = 0
+            if lane.enc_done >= lane.s_true:
+                self._finish_prefill(lane)
+
     def lane_step(self) -> Dict[int, int]:
         """ONE dispatch over every lane: prefill lanes advance one
         source chunk, decode lanes emit one token.  Returns
@@ -587,86 +725,25 @@ class PagedTransformerGenerator:
         B = self._slots
         if B == 0:
             raise RuntimeError("open_slots() before lane_step()")
-        C = self.chunk
-        ps = self.page_size
-        pf_word = np.zeros((B, C), np.int64)
-        pf_pos = np.zeros((B, C), np.int64)
-        pf_base = np.zeros(B, np.int32)
-        pf_len = np.ones(B, np.int32)
-        enc_table = np.zeros((B, self.p_src), np.int32)
-        enc_pages = np.full((B, C), TRASH_PAGE, np.int32)
-        cross_pages = np.full((B, C), TRASH_PAGE, np.int32)
-        w_offsets = np.zeros((B, C), np.int32)
-        trg_word = np.zeros((B, 1), np.int64)
-        trg_pos = np.zeros((B, 1), np.int64)
-        self_table = np.zeros((B, self.p_out), np.int32)
-        self_pages = np.full((B, 1), TRASH_PAGE, np.int32)
-        self_offsets = np.zeros((B, 1), np.int32)
-        self_lengths = np.ones(B, np.int32)
-        self_base = np.zeros(B, np.int32)
-        cross_table = np.zeros((B, self.p_src), np.int32)
-        src_lengths = np.ones(B, np.int32)
+        feed = self._prefill_arrays()
+        dec = self._decode_arrays()
         decoding: List[int] = []
         for slot, lane in enumerate(self._lanes):
-            if lane.phase == "prefill":
-                done = lane.enc_done
-                m = min(C, lane.s_true - done)
-                lane.pending_chunk = m
-                pf_word[slot, :m] = lane.src[done:done + m]
-                pf_pos[slot, :m] = np.arange(done, done + m)
-                pf_base[slot] = done
-                pf_len[slot] = done + m
-                enc_table[slot, :len(lane.enc_table)] = lane.enc_table
-                pos = done + np.arange(m)
-                enc_pages[slot, :m] = [lane.enc_table[p // ps] for p in pos]
-                cross_pages[slot, :m] = [lane.cross_table[p // ps]
-                                         for p in pos]
-                w_offsets[slot, :m] = pos % ps
-            elif lane.phase == "decode" and lane.self_table:
-                t = lane.pos
-                if t >= len(lane.self_table) * ps:
-                    raise RuntimeError(
-                        f"lane {slot} decoded past its reserved "
-                        f"{len(lane.self_table)} self pages")
-                trg_word[slot, 0] = lane.cur
-                trg_pos[slot, 0] = t
-                self_table[slot, :len(lane.self_table)] = lane.self_table
-                self_pages[slot, 0] = lane.self_table[t // ps]
-                self_offsets[slot, 0] = t % ps
-                self_lengths[slot] = t + 1
-                self_base[slot] = t
-                cross_table[slot, :len(lane.cross_table)] = lane.cross_table
-                src_lengths[slot] = lane.s_true
+            if lane.phase == "decode" and lane.self_table:
+                self._fill_decode_lane(dec, slot, lane, [lane.cur],
+                                       lane.pos)
                 decoding.append(slot)
         prog, _, next_ids, _logits = self._unified
-        feed = {"pf_word": pf_word, "pf_pos": pf_pos, "pf_base": pf_base,
-                "pf_len": pf_len, "enc_table": enc_table,
-                "enc_pages": enc_pages, "cross_pages": cross_pages,
-                "w_offsets": w_offsets, "trg_word": trg_word,
-                "trg_pos": trg_pos, "self_table": self_table,
-                "self_pages": self_pages, "self_offsets": self_offsets,
-                "self_lengths": self_lengths, "self_base": self_base,
-                "cross_table": cross_table, "src_lengths": src_lengths}
+        feed.update(dec)
         with fluid.scope_guard(self.scope):
             nxt, = self.exe.run(prog, feed=feed, fetch_list=[next_ids],
                                 return_numpy=False, mode="infer")
         ids = np.asarray(nxt).reshape(B)
         self._steps += 1
+        self._absorb_prefill()
         emitted: Dict[int, int] = {}
         for slot, lane in enumerate(self._lanes):
-            if lane.phase == "prefill":
-                # emitted AFTER the dispatch returned: a chunk that
-                # never ran must not appear in the request timeline
-                self._tracer.instant(
-                    "lane/prefill_chunk", cat="serving", slot=slot,
-                    tokens=lane.pending_chunk,
-                    done=lane.enc_done + lane.pending_chunk,
-                    total=lane.s_true)
-                lane.enc_done += lane.pending_chunk
-                lane.pending_chunk = 0
-                if lane.enc_done >= lane.s_true:
-                    self._finish_prefill(lane)
-            elif slot in decoding:
+            if slot in decoding:
                 tok = int(ids[slot])
                 lane.cur = tok
                 lane.pos += 1

@@ -165,12 +165,18 @@ class Request:
 
     def __init__(self, src_tokens, max_new_tokens: int,
                  model: str = DEFAULT_MODEL, tenant: Optional[str] = None,
-                 on_token: Optional[Callable] = None):
+                 on_token: Optional[Callable] = None,
+                 decode: Optional[Dict] = None):
         self.rid = next(Request._next_id)
         self.src = np.asarray(src_tokens)
         self.max_new_tokens = int(max_new_tokens)
         self.model = str(model)          # alias as submitted; resolved
         self.group: Optional[str] = None  # lane-group key at admission
+        # per-request decode options (ISSUE 15): a speculative-aware
+        # lane group receives this at admit_slot — {"draft": bool,
+        # "constraint": grammar spec}; None = the model's defaults.
+        # Plain JSON so the request journal replays it verbatim.
+        self.decode = decode
         # admission-time routing override (ISSUE 12): a canary admission
         # policy pins the request to an explicit lane-group key (set at
         # most once, at pick time); None follows the alias through
@@ -475,7 +481,8 @@ class ContinuousBatchingScheduler:
     # -- submission ----------------------------------------------------------
     def submit(self, src_tokens, max_new_tokens: Optional[int] = None,
                model: str = DEFAULT_MODEL, tenant: Optional[str] = None,
-               on_token: Optional[Callable] = None) -> Request:
+               on_token: Optional[Callable] = None,
+               decode: Optional[Dict] = None) -> Request:
         with self._lock:
             group = self._group_for(model)
         if group is None:
@@ -488,10 +495,28 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"submit: prompt length {len(np.asarray(src_tokens))} "
                 f"exceeds the model's src_len {src_cap}")
+        if decode is not None and \
+                not getattr(group.model, "speculative_aware", False):
+            if decode.get("constraint") is None \
+                    and not decode.get("draft", True):
+                # the same carve-out as the admit-time gate: an
+                # explicit speculation OPT-OUT asks for nothing a
+                # plain group cannot do — journal replay of an
+                # opted-out request onto a draftless version must
+                # decode plain, not fail
+                decode = None
+            else:
+                # a decode-options request admitted into a group that
+                # cannot honor them would fail inside the serve loop
+                raise ValueError(
+                    f"submit: model {model!r} does not support "
+                    f"per-request decode options (draft/constraint "
+                    f"need a speculative lane group)")
         cap = getattr(group.model, "max_out_len", self.default_max_new)
         req = Request(src_tokens,
                       min(max_new_tokens or self.default_max_new, cap),
-                      model=model, tenant=tenant, on_token=on_token)
+                      model=model, tenant=tenant, on_token=on_token,
+                      decode=decode)
         if group.page_aware and group.model.prompt_infeasible(
                 req.src, req.max_new_tokens):
             # structurally unserveable: the prompt + decode reservation
@@ -560,6 +585,31 @@ class ContinuousBatchingScheduler:
                                   f"{req.model!r}"),
                     "rejected", "unknown_model")
                 continue
+            if req.decode is not None and not getattr(
+                    group.model, "speculative_aware", False):
+                if req.decode.get("constraint") is None \
+                        and not req.decode.get("draft", True):
+                    # an explicit speculation OPT-OUT ({"draft": False},
+                    # no grammar) that a swap re-routed to a plain
+                    # group: plain decode is exactly what was asked —
+                    # admit it plain instead of rejecting
+                    req.decode = None
+                else:
+                    # the request carries decode options (grammar/
+                    # draft) its resolved group cannot honor — a canary
+                    # pin or a hot swap re-pointed the alias at a plain
+                    # generator AFTER the submit-time check.  Silently
+                    # admitting would serve a grammar-constrained
+                    # request unconstrained; reject it loudly instead.
+                    self._queue.remove(req)
+                    self._finish_unadmitted_locked(
+                        req, ValueError(
+                            f"model {req.model!r} no longer serves "
+                            f"with decode options (draft/constraint) — "
+                            f"the serving group changed under the "
+                            f"request"),
+                        "rejected", "decode_unsupported")
+                    continue
             if group.page_aware and group.model.prompt_infeasible(
                     req.src, req.max_new_tokens):
                 # reject-with-error, never hang: this prompt can NEVER
@@ -638,7 +688,11 @@ class ContinuousBatchingScheduler:
                 self._queue.remove(req)
                 slot = group.free.pop()
             try:
-                if group.page_aware:
+                if getattr(group.model, "speculative_aware", False):
+                    s_true = group.model.admit_slot(
+                        slot, req.src, max_new=req.max_new_tokens,
+                        decode=req.decode)
+                elif group.page_aware:
                     s_true = group.model.admit_slot(
                         slot, req.src, max_new=req.max_new_tokens)
                 else:
@@ -773,7 +827,14 @@ class ContinuousBatchingScheduler:
         if group.managed:
             # self-managed model: one dispatch interleaves chunked
             # prefill and decode over every lane; only lanes that
-            # actually emitted a token come back
+            # actually emitted come back.  A speculative model (ISSUE
+            # 15) returns a LIST of tokens per lane — the accepted
+            # draft prefix plus the target's own next token — delivered
+            # one by one so streaming, telemetry, end-of-sequence and
+            # the max_new cap see the exact per-token sequence a plain
+            # model would have produced (tokens past the end/cap in the
+            # same round are dropped, as a plain model would never have
+            # decoded them).
             try:
                 with self._tracer.span("scheduler/step", cat="serving",
                                        managed=True, model=group.key):
@@ -784,15 +845,20 @@ class ContinuousBatchingScheduler:
             with self._lock:
                 self._steps += 1
                 self._m_steps.inc()
-                for slot, tok in emitted.items():
+                for slot, toks in emitted.items():
                     req = group.active.get(slot)
                     if req is None:
                         continue
-                    req.tokens.append(int(tok))
-                    self._note_token(req, int(tok))
-                    if int(tok) == group.model.end_id or \
-                            len(req.tokens) >= req.max_new_tokens:
-                        self._retire_locked(group, slot, req)
+                    seq = toks if isinstance(toks, (list, tuple,
+                                                    np.ndarray)) \
+                        else [toks]
+                    for tok in seq:
+                        req.tokens.append(int(tok))
+                        self._note_token(req, int(tok))
+                        if int(tok) == group.model.end_id or \
+                                len(req.tokens) >= req.max_new_tokens:
+                            self._retire_locked(group, slot, req)
+                            break
             return
         tokens, pos, src_len = snap
         try:

@@ -35,10 +35,18 @@ import sys
 from typing import Dict, List, Optional
 
 
+def _dir_bytes(cache_dir: str) -> int:
+    return int(sum(
+        os.path.getsize(os.path.join(cache_dir, n))
+        for n in os.listdir(cache_dir)) if os.path.isdir(cache_dir)
+        else 0)
+
+
 def precompile(dirname: str, n_slots: int = 4,
                max_time: Optional[int] = None,
                cache_dir: Optional[str] = None,
-               place=None, **overrides) -> Dict:
+               place=None, draft_dirname: Optional[str] = None,
+               speculate_k: int = 4, **overrides) -> Dict:
     """Resolve every compile signature of the artifact at ``dirname``
     into its persistent cache (default ``<dirname>/compiled/``).
 
@@ -49,7 +57,13 @@ def precompile(dirname: str, n_slots: int = 4,
     * generator artifacts: ``aot_warm(n_slots)`` — the unified
       prefill+decode executable at the serving lane count;
     * engine artifacts: ``preresolve(max_time)`` — every enumerated
-      batch/time bucket signature.
+      batch/time bucket signature;
+    * ``draft_dirname`` (ISSUE 15): warm the pair as a
+      ``SpeculativeGenerator`` — the target's k+1-token VERIFY
+      executable and COW page-copy land in the target artifact's
+      ``compiled/``, the draft's masked decode executable in the
+      draft's, so a gateway loading the pair performs zero process
+      compiles.
 
     Returns ``{"kind", "signatures", "compiles", "loads", "keys",
     "cache_dir", "bytes"}``; ``compiles`` on a second run over the same
@@ -63,6 +77,49 @@ def precompile(dirname: str, n_slots: int = 4,
     if not os.path.isdir(dirname):
         raise FileNotFoundError(f"no artifact at {dirname}")
     reg = ModelRegistry(place=place or fluid.CPUPlace())
+    if draft_dirname is not None:
+        if cache_dir is not None:
+            raise ValueError(
+                "precompile: --cache is incompatible with a draft — "
+                "each artifact of the pair owns its compiled/ subdir")
+        from ..serving.speculative import SpeculativeGenerator
+
+        draft_dirname = os.path.abspath(draft_dirname)
+        if not os.path.isdir(draft_dirname):
+            raise FileNotFoundError(f"no draft artifact at "
+                                    f"{draft_dirname}")
+        for what, d in (("target", dirname), ("draft", draft_dirname)):
+            kind = reg._manifest(d).get("kind", "engine")
+            if kind != "generator":
+                # fail with the artifact named, not an AttributeError
+                # from deep inside SpeculativeGenerator
+                raise ValueError(
+                    f"speculative pre-warm needs generator artifacts; "
+                    f"the {what} at {d} is kind {kind!r}")
+        tkey = reg.load("aot", "prewarm", dirname=dirname, **overrides)
+        dkey = reg.load("aotdraft", "prewarm", dirname=draft_dirname)
+        target, draft = reg.instance(tkey), reg.instance(dkey)
+        spec = SpeculativeGenerator(target, draft, k=int(speculate_k))
+        spec.aot_warm(int(n_slots))
+        t_cache = os.path.join(dirname, COMPILED_SUBDIR)
+        d_cache = os.path.join(draft_dirname, COMPILED_SUBDIR)
+        st_t = target.exe.cache_stats()["persistent"]
+        st_d = draft.exe.cache_stats()["persistent"]
+        keys = []
+        for c in (target.exe._aot_cache(), draft.exe._aot_cache()):
+            if c is not None:
+                keys.extend(c.keys())
+        return {
+            "kind": "speculative",
+            "signatures": len(spec.bucket_set(int(n_slots))),
+            "compiles": st_t["misses"] + st_d["misses"],
+            "loads": st_t["hits"] + st_d["hits"],
+            "stores": st_t["stores"] + st_d["stores"],
+            "cache_dir": t_cache,
+            "draft_cache_dir": d_cache,
+            "keys": keys,
+            "bytes": _dir_bytes(t_cache) + _dir_bytes(d_cache),
+        }
     key = reg.load("aot", "prewarm", dirname=dirname, **overrides)
     inst = reg.instance(key)
     if cache_dir is not None:
@@ -90,11 +147,26 @@ def precompile(dirname: str, n_slots: int = 4,
         "stores": st["stores"],
         "cache_dir": cache_dir,
         "keys": cache.keys() if cache is not None else [],
-        "bytes": int(sum(
-            os.path.getsize(os.path.join(cache_dir, n))
-            for n in os.listdir(cache_dir)) if os.path.isdir(cache_dir)
-            else 0),
+        "bytes": _dir_bytes(cache_dir),
     }
+
+
+def _resolve_version_dir(root: str, model: str,
+                         version: Optional[str]) -> Optional[str]:
+    """``--root/--model[/--version]`` -> artifact dir: the explicit
+    version, else the CURRENT marker, else the newest published
+    version.  ``None`` (caller exits 2) when none exist."""
+    from ..fluid import io as fio
+
+    version = version or fio.current_model_version(root, model)
+    if version is None:
+        versions = fio.list_model_versions(root, model)
+        if not versions:
+            print(f"aot_compile: no versions of {model} under "
+                  f"{root}", file=sys.stderr)
+            return None
+        version = versions[-1]
+    return fio.model_version_dir(root, model, version)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -122,6 +194,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--cache", default=None, metavar="DIR",
                     help="external cache directory (default: the "
                          "artifact's compiled/ subdir)")
+    ap.add_argument("--draft-dirname", default=None,
+                    help="draft generator artifact to pair with the "
+                         "target (speculative decoding): warms the "
+                         "draft/verify/cow executable set")
+    ap.add_argument("--draft-model", default=None,
+                    help="draft model name under --root")
+    ap.add_argument("--draft-version", default=None,
+                    help="draft version under --root/--draft-model "
+                         "(default: CURRENT marker, else newest)")
+    ap.add_argument("--speculate-k", type=int, default=4,
+                    help="draft tokens per verify round (default 4; "
+                         "must match the gateway's speculate_k)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -129,23 +213,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.dirname:
         dirname = args.dirname
     elif args.root and args.model:
-        from ..fluid import io as fio
-
-        version = args.version or fio.current_model_version(
-            args.root, args.model)
-        if version is None:
-            versions = fio.list_model_versions(args.root, args.model)
-            if not versions:
-                print(f"aot_compile: no versions of {args.model} under "
-                      f"{args.root}", file=sys.stderr)
-                return 2
-            version = versions[-1]
-        dirname = fio.model_version_dir(args.root, args.model, version)
+        dirname = _resolve_version_dir(args.root, args.model,
+                                       args.version)
+        if dirname is None:
+            return 2
     else:
         ap.print_usage(file=sys.stderr)
         print("aot_compile: pass --dirname or --root + --model",
               file=sys.stderr)
         return 2
+
+    draft_dirname = args.draft_dirname
+    if draft_dirname is None and args.draft_model:
+        if not args.root:
+            print("aot_compile: --draft-model needs --root (or pass "
+                  "--draft-dirname)", file=sys.stderr)
+            return 2
+        draft_dirname = _resolve_version_dir(args.root,
+                                             args.draft_model,
+                                             args.draft_version)
+        if draft_dirname is None:
+            return 2
 
     overrides = {}
     if args.batch_bucket:
@@ -155,7 +243,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         report = precompile(dirname, n_slots=args.n_slots,
                             max_time=args.max_time,
-                            cache_dir=args.cache, **overrides)
+                            cache_dir=args.cache,
+                            draft_dirname=draft_dirname,
+                            speculate_k=args.speculate_k, **overrides)
     except FileNotFoundError as e:
         print(f"aot_compile: {e}", file=sys.stderr)
         return 2

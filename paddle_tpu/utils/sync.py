@@ -80,6 +80,7 @@ RANK_SCHEDULER = 30        # serving.scheduler     serving/scheduler.py
 RANK_ROUTER = 40           # gateway.router        serving/gateway/router.py
 RANK_CANARY = 42           # lifecycle.canary      lifecycle/canary.py
 RANK_MODEL_REGISTRY = 44   # gateway.registry      serving/gateway/registry.py
+RANK_CONSTRAINTS = 46      # serving.constraints   serving/speculative.py
 RANK_JOURNAL_CV = 50       # gateway.journal.cv    serving/gateway/journal.py
 RANK_JOURNAL_FILE = 52     # *.journal.file        utils/journal.py
 RANK_GUARD = 60            # guardrails.dispatch   resilience/guardrails.py
@@ -104,6 +105,7 @@ RANK_TABLE: Dict[str, int] = {
     "gateway.router": RANK_ROUTER,
     "lifecycle.canary": RANK_CANARY,
     "gateway.registry": RANK_MODEL_REGISTRY,
+    "serving.constraints": RANK_CONSTRAINTS,
     "gateway.journal.cv": RANK_JOURNAL_CV,
     # JournalFile locks are named "<journal>.file" per instance
     "gateway.journal.file": RANK_JOURNAL_FILE,

@@ -272,6 +272,30 @@ with open(os.path.join(tmpdir, "serving_int8_ragged_step.json"), "wb") as f:
 with open(os.path.join(tmpdir, "serving_int8_ragged_step.fetch"), "w") as f:
     f.write(qids.name + "\n")
 
+# speculative sweep (ISSUE 15): the target's k-token VERIFY program
+# (per-lane token axis + logit-mask data feed) and the draft's
+# constrained decode-step program must both stay analyzer-clean —
+# they are what a speculative lane group actually dispatches
+from paddle_tpu.serving.speculative import SpeculativeGenerator
+
+sdraft = PagedTransformerGenerator(30, 30, n_layer=1, n_head=2, d_key=4,
+                                   d_value=4, d_model=16, d_inner_hid=32,
+                                   max_length=64, src_len=8, max_out_len=8,
+                                   page_size=4, chunk_size=4, num_pages=32,
+                                   param_prefix="tfdr",
+                                   place=fluid.CPUPlace())
+sgen = SpeculativeGenerator(pgen, sdraft, k=3)
+vprog, _, v_ids, _ = sgen._verify
+with open(os.path.join(tmpdir, "speculative_verify_step.json"), "wb") as f:
+    f.write(vprog.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "speculative_verify_step.fetch"), "w") as f:
+    f.write(v_ids.name + "\n")
+dprog, _, d_ids, _ = sgen._draft_prog
+with open(os.path.join(tmpdir, "speculative_draft_step.json"), "wb") as f:
+    f.write(dprog.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "speculative_draft_step.fetch"), "w") as f:
+    f.write(d_ids.name + "\n")
+
 # gateway sweep (ISSUE 10): every program the registry builds for a
 # loaded model version must stay analyzer-clean — round-trip a
 # generator artifact AND an engine artifact through ModelRegistry.load
@@ -347,11 +371,13 @@ EOF
   done
 
   # cost sweep (ISSUE 11): the static cost family over the book
-  # programs AND the paged int8 decode-step program — recompile-hazard
-  # errors fail via the normal error exit, and an op one of these
-  # programs uses with no registered cost rule fails via --fail-on
-  # (the analyzer guessing about the flagship programs is a defect)
-  for name in digits_conv word2vec resnet_cifar serving_int8_ragged_step; do
+  # programs AND the paged int8 decode-step program AND the ISSUE 15
+  # verify/constrained-draft programs — recompile-hazard errors fail
+  # via the normal error exit, and an op one of these programs uses
+  # with no registered cost rule fails via --fail-on (the analyzer
+  # guessing about the flagship programs is a defect)
+  for name in digits_conv word2vec resnet_cifar serving_int8_ragged_step \
+              speculative_verify_step speculative_draft_step; do
     prog="$tmpdir/$name.json"
     [ -f "$prog" ] || { echo "-- plint --cost $name: MISSING"; rc=1; continue; }
     fetch_args=""
