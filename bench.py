@@ -1293,6 +1293,215 @@ def bench_aot(trials: int, n_slots: int = 4, decode_len: int = 8):
     }
 
 
+def bench_fleet(trials: int, n_replicas: int = 2, decode_len: int = 8):
+    """ISSUE 16: the multi-replica serving fleet's scaling and
+    recovery story, measured at the FLEET layer (routing, health
+    probes, journal migration), not the compute — the model is
+    deliberately small and replica subprocesses are pinned to CPU so
+    they never contend with this process's accelerator.
+
+    * aggregate decoded tok/s through the router as the replica count
+      scales 1 -> ``n_replicas`` at the same offered load;
+    * prefix-chunk cache hit rate under affinity routing vs seeded
+      random routing on shared-prompt traffic (in-process replicas, so
+      the page allocators can be read directly);
+    * replica-kill recovery: SIGKILL one replica mid-traffic and time
+      kill -> router marks it down -> respawn back in rotation, with
+      the safety contract measured rather than asserted: zero lost
+      requests and an empty victim journal after migration."""
+    import shutil
+    import tempfile
+    import threading
+
+    from paddle_tpu.serving import PagedTransformerGenerator
+    from paddle_tpu.serving.fleet import (FleetRouter, FleetSupervisor,
+                                          ReplicaSpec)
+    from paddle_tpu.serving.gateway import (Gateway, GatewayServer,
+                                            ModelRegistry,
+                                            RequestJournal)
+
+    vocab, src_len, page = 64, 16, 8
+    kw = dict(n_layer=2, n_head=2, d_key=8, d_value=8, d_model=32,
+              d_inner_hid=64, max_length=src_len + decode_len + 2,
+              src_len=src_len, max_out_len=decode_len, page_size=page,
+              chunk_size=8, num_pages=256)
+    tmp = tempfile.mkdtemp(prefix="bench-fleet-")
+    root = os.path.join(tmp, "store")
+    gen = PagedTransformerGenerator(vocab, vocab, param_prefix="bft",
+                                    **kw)
+    gen.init_params(seed=0)
+    ModelRegistry.save_generator_artifact(gen, root, "nmt", "1")
+
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(2, vocab, src_len)]
+               for _ in range(32)]
+    lost = served = 0
+
+    def drive(router, n_req):
+        nonlocal lost, served
+        done, errs = [], []
+
+        def client(i):
+            try:
+                out = router.generate("nmt", prompts[i % len(prompts)],
+                                      max_new=decode_len)
+                done.append(len(out["tokens"]))
+            except Exception as e:       # a lost request is the metric
+                errs.append(repr(e))
+
+        t0 = time.time()
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(n_req)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(240)
+        wall = time.time() - t0
+        lost += len(errs)
+        served += len(done)
+        return sum(done), wall
+
+    cpu_env = {"JAX_PLATFORMS": "cpu"}   # replicas never touch the chip
+    try:
+        # -- aggregate tok/s vs replica count --------------------------------
+        agg = {}
+        for n in sorted({1, int(n_replicas)}):
+            sup = FleetSupervisor(
+                root=root, models=["nmt=1"], n=n,
+                journal_dir=os.path.join(tmp, f"journals{n}"),
+                slots=4, max_new=decode_len,
+                log_dir=os.path.join(tmp, f"logs{n}"),
+                env_extra=cpu_env)
+            sup.start(wait_ready=240.0)
+            router = FleetRouter(sup.replica_specs(), page_size=page,
+                                 probe_interval=0.25,
+                                 request_timeout=240.0, seed=0)
+            router.start()
+            try:
+                drive(router, 2 * n)                    # warm every lane
+                toks, wall = drive(router, 32)
+                agg[str(n)] = round(toks / max(wall, 1e-9), 1)
+            finally:
+                router.stop()
+                sup.stop()
+
+        # -- affinity vs random prefix-chunk hit rate ------------------------
+        # in-process replicas: the hit rate lives in the page allocator,
+        # which only an in-process generator exposes
+        def hit_rate(arm, routing):
+            gens, reps = [], []
+            for i in range(2):
+                g = PagedTransformerGenerator(
+                    vocab, vocab, param_prefix=f"bf{arm}{i}", **kw)
+                g.init_params(seed=0)
+                jp = os.path.join(tmp, f"{arm}{i}.journal")
+                gw = Gateway(n_slots=2, max_new_tokens=2,
+                             journal_path=jp)
+                gw.load_model("m", "1", instance=g)
+                srv = GatewayServer(gw, port=0)
+                srv.start()
+                gens.append(g)
+                reps.append((srv, ReplicaSpec(f"{arm}{i}", srv.address,
+                                              jp)))
+            router = FleetRouter([r[1] for r in reps], page_size=page,
+                                 affinity_depth=2, routing=routing,
+                                 probe_interval=0.05, seed=0)
+            try:
+                router.health_check_once()
+                r2 = np.random.RandomState(11)
+                shared = [[int(t) for t in r2.randint(2, vocab, page)]
+                          for _ in range(4)]
+                for _ in range(6):
+                    for p in shared:
+                        tail = [int(t) for t in r2.randint(2, vocab, 3)]
+                        router.generate("m", p + tail, max_new=2)
+                hits = sum(g.alloc.stats()["prefix_hits"] for g in gens)
+                lks = sum(g.alloc.stats()["prefix_lookups"]
+                          for g in gens)
+                return hits / max(1, lks)
+            finally:
+                router.stop()
+                for srv, _ in reps:
+                    srv.stop(drain=False)
+
+        aff_rate = hit_rate("a", "affinity")
+        rnd_rate = hit_rate("r", "random")
+
+        # -- replica-kill recovery wall clock --------------------------------
+        sup = FleetSupervisor(
+            root=root, models=["nmt=1"], n=2,
+            journal_dir=os.path.join(tmp, "journals-kill"),
+            slots=4, max_new=decode_len, max_restarts=3,
+            log_dir=os.path.join(tmp, "logs-kill"), env_extra=cpu_env)
+        sup.start(wait_ready=240.0)
+        router = FleetRouter(sup.replica_specs(), page_size=page,
+                             probe_interval=0.1, settle_timeout=20.0,
+                             request_timeout=240.0, seed=0)
+        router.start()
+        try:
+            drive(router, 4)                            # warm both
+            errs, ths = [], []
+
+            def client(i):
+                try:
+                    router.generate("nmt", prompts[i % len(prompts)],
+                                    max_new=decode_len)
+                except Exception as e:
+                    errs.append(repr(e))
+
+            for i in range(24):
+                t = threading.Thread(target=client, args=(i,))
+                t.start()
+                ths.append(t)
+            time.sleep(0.1)                             # mid-decode
+            victim = "replica-0"
+            t_kill = time.time()
+            sup.kill(victim)
+            while router._by_name(victim).state == "ready" \
+                    and time.time() - t_kill < 60:
+                time.sleep(0.02)
+            t_down = time.time()
+            for t in ths:
+                t.join(240)
+            lost += len(errs)
+            served += 24 - len(errs)
+            while router._by_name(victim).state != "ready" \
+                    and time.time() - t_kill < 240:
+                router.health_check_once()
+                time.sleep(0.2)
+            t_ready = time.time()
+            jr = RequestJournal(
+                [s for s in sup.replica_specs()
+                 if s.name == victim][0].journal_path)
+            deadline = time.time() + 30
+            while jr.pending() and time.time() < deadline:
+                time.sleep(0.2)
+            pending_after = len(jr.pending())
+            migrated = router.stats()["migrated_entries"]
+        finally:
+            router.stop()
+            sup.stop()
+
+        return {
+            "replicas": int(n_replicas),
+            "aggregate_tokens_per_sec": agg,
+            "scaling_x": round(
+                agg[str(n_replicas)] / max(agg["1"], 1e-9), 2),
+            "prefix_hit_rate": {"affinity": round(aff_rate, 4),
+                                "random": round(rnd_rate, 4)},
+            "affinity_beats_random": bool(aff_rate > rnd_rate),
+            "kill_recovery_s": {
+                "detect": round(t_down - t_kill, 3),
+                "rejoin": round(t_ready - t_kill, 3)},
+            "migrated_entries": int(migrated),
+            "victim_pending_after_migration": int(pending_after),
+            "lost_requests": int(lost),
+            "requests_served": int(served),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_sync(trials: int, n_slots: int = 4, decode_len: int = 8):
     """ISSUE 13: the concurrency sanitizer's cost story.
 
@@ -2177,6 +2386,16 @@ def main() -> None:
         except Exception as e:
             print(f"aot bench failed: {e}", file=sys.stderr)
 
+    fleet_cmp = None
+    if os.environ.get("BENCH_SKIP_FLEET", "") != "1":
+        try:
+            fleet_cmp = retry_transient(
+                bench_fleet, trials,
+                int(os.environ.get("BENCH_FLEET_REPLICAS", "2")),
+                int(os.environ.get("BENCH_FLEET_DECODE", "8")))
+        except Exception as e:
+            print(f"fleet bench failed: {e}", file=sys.stderr)
+
     sync_cmp = None
     if os.environ.get("BENCH_SKIP_SYNC", "") != "1":
         try:
@@ -2282,6 +2501,12 @@ def main() -> None:
         # before first token, and recompiles_after_warmup == 0 holds
         # across a hot swap that loads a pre-compiled candidate)
         "aot": aot_cmp,
+        # multi-replica serving fleet (ISSUE 16): aggregate tok/s as
+        # the replica count scales, affinity-vs-random prefix-chunk hit
+        # rate, SIGKILL detect/rejoin wall clocks, and the exactly-once
+        # contract measured: zero lost requests, empty victim journal
+        # after migration
+        "fleet": fleet_cmp,
         # concurrency sanitizer (ISSUE 13): ordered-lock passthrough
         # cost on the real scheduler step + gateway submit (contract:
         # passthrough < 1% of a step; checking-ON overhead reported,
@@ -2356,6 +2581,19 @@ def main() -> None:
             # cache's entire contract failed; a failed run, like any
             # perf regression
             missing.append("aot_zero_compile_contract")
+    if os.environ.get("BENCH_SKIP_FLEET", "") != "1":
+        if fleet_cmp is None:
+            missing.append("fleet")
+        elif fleet_cmp["lost_requests"] != 0 \
+                or fleet_cmp["victim_pending_after_migration"] != 0:
+            # the fleet's whole contract: a SIGKILL loses nothing and
+            # migration leaves no open journal entry behind — a lost
+            # request is a failed run, like any perf regression
+            missing.append("fleet_lost_requests")
+        elif not fleet_cmp["affinity_beats_random"]:
+            # affinity routing must beat random on shared-prompt
+            # traffic or the routing key is broken
+            missing.append("fleet_affinity_contract")
     if os.environ.get("BENCH_SKIP_SYNC", "") != "1":
         if sync_cmp is None:
             missing.append("sync")

@@ -33,12 +33,12 @@ from .retry import RetryPolicy
 from .chaos import ChaosError, FaultInjector, injector, install
 from .guardrails import (GuardPolicy, NonFiniteError, NonFiniteEscalation,
                          StepFault, StepTimeout)
-from .service import run_supervised
+from .service import SupervisedService, run_supervised
 
 __all__ = ["RetryPolicy", "ChaosError", "FaultInjector", "injector",
            "install", "ResilientTrainer", "GuardPolicy", "NonFiniteError",
            "NonFiniteEscalation", "StepFault", "StepTimeout",
-           "run_supervised"]
+           "run_supervised", "SupervisedService"]
 
 
 def __getattr__(name):

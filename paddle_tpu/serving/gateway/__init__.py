@@ -23,12 +23,12 @@ package is the missing layer:
   CLI client.
 """
 
-from .gateway import Gateway, TokenStream  # noqa: F401
+from .gateway import Gateway, GatewayDraining, TokenStream  # noqa: F401
 from .journal import RequestJournal  # noqa: F401
 from .registry import HBMBudgetError, ModelRegistry  # noqa: F401
 from .router import RateLimited, TenantConfig, TenantRouter  # noqa: F401
 from .server import GatewayServer  # noqa: F401
 
-__all__ = ["Gateway", "TokenStream", "RequestJournal", "ModelRegistry",
-           "HBMBudgetError", "TenantRouter", "TenantConfig",
-           "RateLimited", "GatewayServer"]
+__all__ = ["Gateway", "GatewayDraining", "TokenStream", "RequestJournal",
+           "ModelRegistry", "HBMBudgetError", "TenantRouter",
+           "TenantConfig", "RateLimited", "GatewayServer"]

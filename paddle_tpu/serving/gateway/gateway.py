@@ -27,6 +27,7 @@ boundary, mid-prefill included."""
 from __future__ import annotations
 
 import contextlib
+import os as _os
 import queue as _queue
 import time
 from typing import Dict, Iterator, List, Optional
@@ -37,12 +38,21 @@ from ...observability import metrics as _obs_metrics
 from ...resilience.chaos import injector as _chaos_injector
 from ...utils.sync import RANK_GATEWAY_WEDGE, OrderedLock
 from ..scheduler import (ContinuousBatchingScheduler, Request,
-                         RequestCancelled)
+                         RequestCancelled, SchedulerShutdown)
 from .journal import RequestJournal
 from .registry import ModelRegistry
 from .router import TenantRouter
 
-__all__ = ["Gateway", "TokenStream"]
+__all__ = ["Gateway", "GatewayDraining", "TokenStream"]
+
+
+class GatewayDraining(RuntimeError):
+    """Submit refused: the gateway is draining toward shutdown (ISSUE
+    16).  HTTP layer maps this to 503 + ``Retry-After`` — the client
+    (or the fleet router) retries on another replica instead of
+    queueing work here that drain would only hand back as failed."""
+
+    retry_after = 2.0
 
 
 class TokenStream:
@@ -146,6 +156,11 @@ class Gateway:
         # read that as a stall (restarting the process for every swap
         # would turn each deploy into an outage)
         self._swapping = 0
+        # externally visible drain state (ISSUE 16): set the moment
+        # shutdown(drain=True) begins, cleared by serve().  submit()
+        # refuses with GatewayDraining while it is up, and /readyz
+        # reports not-ready — the fleet router's rotation signal.
+        self._draining = False
         reg = _obs_metrics.registry()
         self._m_requests = reg.counter(
             "paddle_gateway_requests_total",
@@ -392,7 +407,14 @@ class Gateway:
                     self._h_version_latency.labels(
                         model=req.model.split("@", 1)[0],
                         version=version).observe(req.total_latency)
-                if self.journal is not None and jid is not None:
+                if self.journal is not None and jid is not None \
+                        and not isinstance(req.error, SchedulerShutdown):
+                    # SchedulerShutdown = drain stopped before this
+                    # request was served; leave its journal entry OPEN
+                    # so the work survives the process — a restart's
+                    # recover() or the fleet router's migration replays
+                    # it (closing it here is how a drain used to lose
+                    # every queued request)
                     self.journal.record_done(
                         jid, ok=ok,
                         error=None if ok else type(req.error).__name__)
@@ -469,13 +491,22 @@ class Gateway:
     def submit(self, model: str, prompt, tenant: str = "default",
                max_new: Optional[int] = None, on_token=None,
                draft_model: Optional[str] = None, constraint=None,
-               speculate: Optional[bool] = None) -> Request:
+               speculate: Optional[bool] = None,
+               tag: Optional[str] = None) -> Request:
         """Rate-limit gate -> journal -> queue.  Returns the scheduler
         ``Request`` (``wait()`` for blocking use).  ``draft_model``
         (must match the group's attached draft), ``constraint`` (a
         grammar spec — serving/constraints.py wire format) and
         ``speculate`` (False = plain decode on a speculative group)
-        ride the request as ``Request.decode`` (ISSUE 15)."""
+        ride the request as ``Request.decode`` (ISSUE 15).  ``tag`` is
+        an opaque caller id journaled with the entry (ISSUE 16: the
+        fleet router's migration correlator)."""
+        if self._draining:
+            # refuse BEFORE rate-limit debit and BEFORE journaling:
+            # work accepted now would only be handed back as failed
+            # when the drain reaches the queue
+            raise GatewayDraining(
+                "gateway is draining; resubmit to another replica")
         cfg = self.router.tenant(tenant)
         key = self.registry.resolve(model)
         try:
@@ -507,7 +538,7 @@ class Gateway:
         if self.journal is not None:
             jid = self.journal.new_jid()
             self.journal.record_submit(jid, tenant, model, prompt,
-                                       eff_new, decode=decode)
+                                       eff_new, decode=decode, tag=tag)
         try:
             req = self.sched.submit(
                 prompt, max_new_tokens=eff_new, model=model,
@@ -532,18 +563,23 @@ class Gateway:
                  max_new: Optional[int] = None,
                  timeout: Optional[float] = 120.0,
                  draft_model: Optional[str] = None, constraint=None,
-                 speculate: Optional[bool] = None) -> Dict[str, object]:
+                 speculate: Optional[bool] = None,
+                 tag: Optional[str] = None) -> Dict[str, object]:
         """Blocking path: submit, wait, return the full token list."""
         req = self.submit(model, prompt, tenant=tenant, max_new=max_new,
                           draft_model=draft_model, constraint=constraint,
-                          speculate=speculate)
+                          speculate=speculate, tag=tag)
         if not req.wait(timeout):
             req.cancel()
             raise TimeoutError(f"generate: rid {req.rid} still running "
                                f"after {timeout}s (cancelled)")
         if req.error is not None:
             raise req.error
-        return {"rid": req.rid, "model": req.model,
+        # jid rides the response so the fleet router can tell a
+        # DELIVERED completion from one whose async done record was
+        # still queued when the replica died (the dedup input for
+        # zero-duplicate journal migration)
+        return {"rid": req.rid, "jid": req.jid, "model": req.model,
                 "version": (req.group or "@?").split("@", 1)[-1],
                 "tenant": tenant, "tokens": list(req.tokens),
                 "latency_s": round(req.total_latency or 0.0, 4)}
@@ -574,6 +610,11 @@ class Gateway:
         the tenant.  Returns the resubmitted requests."""
         if self.journal is None:
             return []
+        # compact first (ISSUE 16): the restart boundary is the natural
+        # moment to drop the predecessor's done-record history and its
+        # torn tail — replay input is identical, the file stops growing
+        # across restart cycles
+        self.journal.compact()
         out = []
         for entry in self.journal.pending():
             cfg = self.router.tenant(entry["tenant"])
@@ -600,12 +641,55 @@ class Gateway:
 
     # -- serving loop --------------------------------------------------------
     def serve(self) -> "Gateway":
+        self._draining = False
         self.sched.serve()
         return self
 
     def shutdown(self, drain: bool = True,
                  timeout: float = 30.0) -> List[Request]:
-        return self.sched.shutdown(timeout=timeout, drain=drain)
+        if drain:
+            # flip the refusal gate FIRST: from here on submits 503
+            # (GatewayDraining) instead of queueing work the drain
+            # below would only hand back as failed
+            self._draining = True
+        leftovers = self.sched.shutdown(timeout=timeout, drain=drain)
+        if self.journal is not None:
+            # settle the file: a migrator reading the journal after the
+            # drain must see every done record that will ever be
+            # written — what is still pending afterwards is exactly the
+            # handoff set (the leftovers above plus anything in-flight
+            # a non-drain shutdown abandoned)
+            self.journal.flush()
+        return leftovers
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True once a drain finished: nothing queued, nothing in
+        flight, serve loop stopped — the fleet router's cue that this
+        replica's journal tail is stable and safe to migrate."""
+        if not self._draining:
+            return False
+        st = self.sched.stats()
+        return (st["queued"] == 0 and st["in_flight"] == 0
+                and self.sched._thread is None)
+
+    def ready(self) -> Dict[str, object]:
+        """Readiness (distinct from liveness): False while a load/swap
+        is warming a compile or while draining.  /readyz serves this —
+        the router's rotation signal (ISSUE 16)."""
+        if self._draining:
+            return {"ready": False, "reason": "draining",
+                    "draining": True, "drained": self.drained}
+        with self._wedge_lock:
+            warming = self._swapping > 0
+        if warming:
+            return {"ready": False, "reason": "warming",
+                    "draining": False}
+        return {"ready": True, "draining": False}
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> int:
         return self.sched.run_until_idle(max_steps)
@@ -655,6 +739,12 @@ class Gateway:
             "router": self.router.stats(),
             "scheduler": self.sched.stats(),
             "tenants": self.tenant_latencies(),
+            # pid lets a same-host operator (the fleet CLI's kill) find
+            # the process behind an address; draining/drained are the
+            # router's migration cues
+            "pid": _os.getpid(),
+            "draining": self._draining,
+            "drained": self.drained,
         }
         if self.journal is not None:
             out["journal"] = self.journal.stats()

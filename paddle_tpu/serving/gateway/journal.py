@@ -54,9 +54,15 @@ class RequestJournal:
 
     _uniq = itertools.count(1)
 
-    def __init__(self, path: str, fsync: bool = False):
+    def __init__(self, path: str, fsync: bool = False,
+                 compact_bytes: Optional[int] = 1 << 20):
         self._file = JournalFile(path, fsync=fsync,
                                  name="gateway.journal")
+        # size threshold for opportunistic compaction: the jsonl
+        # otherwise grows without bound across restarts (done records
+        # are never pruned).  None disables; recover() compacts anyway.
+        self._compact_bytes = (None if compact_bytes is None
+                               else int(compact_bytes))
         # pid-qualified ids: rids restart at 1 in a respawned process,
         # and a replayed entry must never collide with a fresh one
         self._prefix = f"{os.getpid()}"
@@ -81,7 +87,8 @@ class RequestJournal:
     # -- lifecycle records ---------------------------------------------------
     def record_submit(self, jid: str, tenant: str, model: str,
                       prompt, max_new: int,
-                      decode: Optional[Dict] = None) -> None:
+                      decode: Optional[Dict] = None,
+                      tag: Optional[str] = None) -> None:
         entry = {"op": "submit", "jid": jid, "tenant": tenant,
                  "model": model, "prompt": [int(t) for t in prompt],
                  "max_new": int(max_new)}
@@ -90,6 +97,11 @@ class RequestJournal:
             # constraint spec) are plain JSON, so a replayed request
             # decodes under the SAME grammar it was admitted with
             entry["decode"] = decode
+        if tag is not None:
+            # opaque caller correlation id (ISSUE 16: the fleet router
+            # stamps its own tag so a migration can tell which journal
+            # entries belong to proxy calls it is already retrying)
+            entry["tag"] = str(tag)
         self._file.append(entry, stamp="t")
 
     def record_done(self, jid: str, ok: bool = True,
@@ -127,6 +139,16 @@ class RequestJournal:
             with self._cv:
                 self._writing = False
                 self._cv.notify_all()
+            # opportunistic compaction at the size threshold — here in
+            # the writer (never under the cv, never on the submit path)
+            # so a long-lived gateway prunes its own done-record churn
+            # instead of growing the file one line per request forever
+            if self._compact_bytes is not None:
+                try:
+                    if os.path.getsize(self.path) >= self._compact_bytes:
+                        self._compact_file()
+                except OSError:
+                    pass
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Block until queued done records hit the file (False on
@@ -140,6 +162,48 @@ class RequestJournal:
                     return False
                 self._cv.wait(remaining)
         return True
+
+    # -- compaction ----------------------------------------------------------
+    @staticmethod
+    def _keep_incomplete(lines: List[str]) -> List[str]:
+        """The compaction filter: keep only submit lines with no done
+        record, in submission order.  Garbage lines (the torn tail a
+        crash left) and settled submit/done pairs drop together."""
+        done = set()
+        parsed = []
+        for line in lines:
+            s = line.strip()
+            if not s:
+                continue
+            try:
+                entry = json.loads(s)
+            except ValueError:
+                continue
+            parsed.append((s, entry))
+            if entry.get("op") == "done":
+                done.add(entry.get("jid"))
+        kept, seen = [], set()
+        for s, entry in parsed:
+            jid = entry.get("jid")
+            if (entry.get("op") == "submit" and jid is not None
+                    and jid not in done and jid not in seen):
+                seen.add(jid)
+                kept.append(s + "\n")
+        return kept
+
+    def _compact_file(self) -> Dict[str, int]:
+        before = len(self._file.read_lines())
+        kept = self._file.compact(RequestJournal._keep_incomplete)
+        return {"kept": len(kept), "dropped": max(0, before - len(kept))}
+
+    def compact(self) -> Dict[str, int]:
+        """Atomically rewrite the journal keeping only incomplete
+        entries (ISSUE 16): replay input is unchanged, the unbounded
+        done-record history is gone.  Called by ``Gateway.recover()``
+        and from the background writer past ``compact_bytes``.  Returns
+        ``{"kept", "dropped"}`` line counts."""
+        self.flush()
+        return self._compact_file()
 
     # -- recovery ------------------------------------------------------------
     def pending(self) -> List[Dict]:

@@ -19,8 +19,13 @@ JSON bodies, port 0 = pick-a-port.  Routes:
   "unload", "model", "version", ...}`` drives the lifecycle — the
   ``tools.gateway`` CLI is a thin client of this route.
 * ``GET /healthz`` — liveness only, never touches the scheduler (the
-  master_service /ping rule); ``GET /statusz`` — the gateway's full
-  stats rollup (registry, router, scheduler, per-tenant latencies).
+  master_service /ping rule); ``GET /readyz`` — readiness (ISSUE 16):
+  503 while a swap warms a compile or a drain is in progress, the
+  fleet router's rotation signal; ``GET /statusz`` — the gateway's
+  full stats rollup (registry, router, scheduler, tenant latencies).
+* ``POST /v1/admin`` — ``{"action": "drain"}`` starts a background
+  drain (submits 503 immediately, /readyz reports ``drained`` when the
+  journal tail is stable); ``{"action": "compact_journal"}`` compacts.
 
 Error mapping: ``RateLimited`` → 429, unknown model → 404,
 ``PoolCapacityError`` → 413, bad request → 400 — each with a JSON body
@@ -34,7 +39,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..paging import PoolCapacityError
-from .gateway import Gateway
+from ..scheduler import SchedulerShutdown
+from .gateway import Gateway, GatewayDraining
 from .router import RateLimited
 
 __all__ = ["GatewayServer"]
@@ -71,7 +77,17 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
             if path == "/healthz":
+                # liveness ONLY (the master_service /ping rule): a
+                # draining or warming gateway is still alive
                 return self._send_json({"ok": True})
+            if path == "/readyz":
+                # readiness is the rotation signal (ISSUE 16): 503
+                # while a swap warms a compile or a drain is running —
+                # the fleet router pulls the replica, nothing routes
+                # new work at a gateway that would refuse or stall it
+                state = gw.ready()
+                return self._send_json(state,
+                                       200 if state["ready"] else 503)
             if path == "/statusz":
                 return self._send_json(gw.stats())
             if path == "/v1/models":
@@ -80,8 +96,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "aliases": gw.registry.stats()["aliases"]})
             return self._send_json(
                 {"error": f"unknown route {path}",
-                 "routes": ["/v1/generate", "/v1/models", "/healthz",
-                            "/statusz"]}, 404)
+                 "routes": ["/v1/generate", "/v1/models", "/v1/admin",
+                            "/healthz", "/readyz", "/statusz"]}, 404)
         except Exception as e:
             return self._send_json(
                 {"error": f"{type(e).__name__}: {e}"}, 500)
@@ -97,8 +113,27 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._generate(body)
             if path == "/v1/models":
                 return self._models(body)
+            if path == "/v1/admin":
+                return self._admin(body)
             return self._send_json({"error": f"unknown route {path}"},
                                    404)
+        except (GatewayDraining, SchedulerShutdown) as e:
+            # 503 + Retry-After (ISSUE 16): "come back elsewhere/later",
+            # not an error in the request itself.  SchedulerShutdown
+            # lands here when a drain failed this request while QUEUED:
+            # its journal entry stays open (the gateway skips the done
+            # record), so the fleet router either retries it itself
+            # (claiming the tag) or migrates it at the next sweep.
+            payload = json.dumps({"error": str(e),
+                                  "reason": "draining"}).encode()
+            self.send_response(503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Retry-After",
+                             str(int(getattr(e, "retry_after", 2.0))))
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return None
         except RateLimited as e:
             return self._send_json({"error": str(e),
                                     "reason": "rate_limit"}, 429)
@@ -131,12 +166,16 @@ class _Handler(BaseHTTPRequestHandler):
         speculate = body.get("speculate")
         if speculate is not None:
             speculate = bool(speculate)
+        tag = body.get("tag")
+        if tag is not None:
+            tag = str(tag)
         if not body.get("stream", False):
             out = gw.generate(model, prompt, tenant=tenant,
                               max_new=max_new,
                               timeout=self.server_ref.request_timeout,
                               draft_model=draft_model,
-                              constraint=constraint, speculate=speculate)
+                              constraint=constraint, speculate=speculate,
+                              tag=tag)
             return self._send_json(out)
         # chunked streaming: one JSON line per token, then a done line.
         # BrokenPipe (client went away) cancels the request so the lane
@@ -161,6 +200,7 @@ class _Handler(BaseHTTPRequestHandler):
             req = stream.request
             self._chunk(json.dumps(
                 {"done": True, "tokens": n, "rid": req.rid,
+                 "jid": req.jid,
                  "version": (req.group or "@?").split("@", 1)[-1]}
                 ).encode() + b"\n")
             self._chunk(b"")
@@ -215,6 +255,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json({"unloaded": model})
         raise ValueError(f"models: unknown action {action!r} "
                          "(load/swap/unload)")
+
+    def _admin(self, body: dict):
+        """Operational actions (ISSUE 16).  ``drain`` flips the refusal
+        gate immediately and runs the actual drain on a background
+        thread — the caller (fleet router / CLI) polls /readyz for
+        ``drained`` instead of holding a connection open across the
+        whole drain."""
+        gw = self.server_ref.gateway
+        action = body.get("action")
+        if action == "drain":
+            timeout = float(body.get("timeout", 30.0))
+            gw._draining = True    # visible before this response lands
+            t = threading.Thread(
+                target=lambda: gw.shutdown(drain=True, timeout=timeout),
+                daemon=True, name="gateway-drain")
+            t.start()
+            return self._send_json({"draining": True})
+        if action == "compact_journal":
+            if gw.journal is None:
+                raise ValueError("admin compact_journal: gateway has "
+                                 "no journal")
+            return self._send_json(gw.journal.compact())
+        raise ValueError(f"admin: unknown action {action!r} "
+                         "(drain/compact_journal)")
 
 
 class GatewayServer:

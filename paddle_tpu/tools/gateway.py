@@ -102,6 +102,12 @@ def _cmd_serve(args, raw_argv: List[str]) -> int:
         reserve_latency_slots=args.reserve_latency_slots)
     gw = Gateway(registry=registry, router=router, n_slots=args.slots,
                  max_new_tokens=args.max_new, journal_path=args.journal)
+    draft_name = draft_version = None
+    if args.draft:
+        draft_name, _, draft_version = args.draft.partition("=")
+        if not draft_version:
+            print("gateway: --draft needs NAME=VER", file=sys.stderr)
+            return 1
     for spec in args.model or []:
         name, _, version = spec.partition("=")
         if not version:
@@ -117,8 +123,13 @@ def _cmd_serve(args, raw_argv: List[str]) -> int:
                           f"{args.root}", file=sys.stderr)
                     return 1
                 version = versions[-1]
-        key = gw.load_model(name, version, n_slots=args.slots)
-        print(f"loaded {key}")
+        key = gw.load_model(name, version, n_slots=args.slots,
+                            draft_model=draft_name,
+                            draft_version=draft_version,
+                            speculate_k=args.speculate_k)
+        print(f"loaded {key}"
+              + (f" (draft {draft_name}={draft_version})"
+                 if draft_name else ""))
     recovered = gw.recover()
     if recovered:
         print(f"recovered {len(recovered)} journaled request(s)")
@@ -169,6 +180,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "gateway sources attached")
     sv.add_argument("--slots", type=int, default=4)
     sv.add_argument("--max-new", type=int, default=32)
+    sv.add_argument("--draft", metavar="NAME=VER", default=None,
+                    help="attach this draft artifact to every --model "
+                         "(the group serves speculatively, ISSUE 15)")
+    sv.add_argument("--speculate-k", type=int, default=4,
+                    help="draft tokens per verify dispatch")
     sv.add_argument("--hbm-budget", type=int, default=None,
                     help="reject loads beyond this many HBM bytes")
     sv.add_argument("--tenant", action="append",

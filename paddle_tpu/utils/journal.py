@@ -94,6 +94,36 @@ class JournalFile:
                     os.fsync(f.fileno())
         return entry
 
+    def compact(self, transform) -> List[str]:
+        """Atomically rewrite the journal as ``transform(lines)`` (a
+        pure function over raw lines, each newline-terminated): sibling
+        temp file, flush+fsync, rename over.  The compaction primitive
+        (ISSUE 16) — a crash at ANY point leaves either the old
+        complete journal or the new one, never a half-written mix, and
+        the rename publishes only what was fsynced (the
+        CheckpointManager plain-write rule).  Read, filter, and swap
+        all run under ONE acquisition of the journal lock, so a
+        concurrent append can never land in the window between the
+        snapshot read and the swap-in and be silently rewritten away.
+        Returns the kept lines."""
+        tmp = self.path + ".compact"
+        with self._lock:  # syncheck: ok — dedicated journal I/O lock
+            if os.path.exists(self.path):
+                with open(self.path, "r", encoding="utf-8") as f:
+                    lines = f.readlines()
+            else:
+                lines = []
+            kept = list(transform(lines))
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.writelines(kept)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            # the rewrite wrote whole lines only — a predecessor's torn
+            # tail (if any) was dropped with the rest of the old file
+            self._tail_checked = True
+        return kept
+
     def read_lines(self) -> List[str]:
         """Raw journal lines for replay (missing file = empty).  Held
         under the lock so a reader never observes a torn in-flight

@@ -70,11 +70,13 @@ __all__ = [
 # are policed by the cycle detector instead.  Keep this table in sync
 # with README "Concurrency discipline".
 RANK_LOADER = 8            # pipeline.loader       fluid/pipeline_io.py
+RANK_SERVICE = 10          # resilience.service    resilience/service.py
 RANK_LIFECYCLE = 12        # lifecycle.controller  lifecycle/controller.py
 RANK_NATIVE_BUILD = 14     # native.build          native/__init__.py
 RANK_NATIVE = 15           # native.lib            native/__init__.py
 RANK_MASTER_SNAP = 20      # master.snapshot       parallel/master_service.py
 RANK_MASTER_QUEUE = 22     # master.queue          parallel/master.py
+RANK_FLEET_ROUTER = 24     # fleet.router          serving/fleet/router.py
 RANK_GATEWAY_WEDGE = 26    # gateway.wedge         serving/gateway/gateway.py
 RANK_SCHEDULER = 30        # serving.scheduler     serving/scheduler.py
 RANK_ROUTER = 40           # gateway.router        serving/gateway/router.py
@@ -95,11 +97,13 @@ RANK_CHAOS = 90            # chaos.injector        resilience/chaos.py
 
 RANK_TABLE: Dict[str, int] = {
     "pipeline.loader": RANK_LOADER,
+    "resilience.service": RANK_SERVICE,
     "lifecycle.controller": RANK_LIFECYCLE,
     "native.build": RANK_NATIVE_BUILD,
     "native.lib": RANK_NATIVE,
     "master.snapshot": RANK_MASTER_SNAP,
     "master.queue": RANK_MASTER_QUEUE,
+    "fleet.router": RANK_FLEET_ROUTER,
     "gateway.wedge": RANK_GATEWAY_WEDGE,
     "serving.scheduler": RANK_SCHEDULER,
     "gateway.router": RANK_ROUTER,

@@ -1,0 +1,880 @@
+"""Serving-fleet tests (ISSUE 16): prefix-affinity routing over the
+paging chain hash, /readyz health checking with seeded backoff,
+drain-aware 503s, journal compaction, and the exactly-once migration
+of a dead or drained replica's journal tail.
+
+The multi-process chaos scenarios (supervised subprocess replicas,
+real SIGKILL) live at the bottom behind the ``slow`` marker; everything
+above runs in-process and deterministic for tier-1."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.serving import PagedTransformerGenerator, copy_weights
+from paddle_tpu.serving.fleet import (FleetRouter, FleetRouterServer,
+                                      FleetSupervisor, NoReadyReplica,
+                                      ReplicaSpec)
+from paddle_tpu.serving.gateway import (Gateway, GatewayDraining,
+                                        GatewayServer, ModelRegistry,
+                                        RequestJournal)
+from paddle_tpu.serving.paging import affinity_key, chunk_hashes
+from paddle_tpu.utils.journal import JournalFile
+
+V, NL, NH, DK, DM, DI = 24, 2, 2, 4, 16, 32
+SRC, OUT, PS, CHUNK = 8, 8, 4, 4
+
+GEN_KW = dict(n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+              d_inner_hid=DI, max_length=64, src_len=SRC,
+              max_out_len=OUT, page_size=PS, chunk_size=CHUNK,
+              num_pages=64)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class EchoModel:
+    """Deterministic slot model: every lane repeats its prompt's first
+    token — a migrated response contaminated by another request's lane
+    is immediately visible."""
+
+    start_id, end_id = 0, 1
+    src_len = 64
+
+    def __init__(self, delay=0.0):
+        self.n = 0
+        self.delay = delay
+        self.slot_val = {}
+
+    def open_slots(self, n):
+        self.n = n
+
+    def admit_slot(self, slot, prompt):
+        self.slot_val[slot] = int(np.asarray(prompt).reshape(-1)[0])
+        return len(np.asarray(prompt).reshape(-1))
+
+    def clear_slot(self, slot):
+        self.slot_val.pop(slot, None)
+
+    def step_slots(self, tokens, pos, src_len):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.array([self.slot_val.get(i, 7777)
+                         for i in range(self.n)], np.int64)
+
+
+def _mk_replica(tmp, name, delay=0.0, slots=2, max_new=4, instance=None):
+    """One in-process gateway replica whose accepted connections are
+    tracked, so ``_hard_kill`` can reset them the way a real SIGKILL
+    does at the TCP level."""
+    jp = os.path.join(str(tmp), f"{name}.journal")
+    gw = Gateway(n_slots=slots, max_new_tokens=max_new, journal_path=jp)
+    gw.load_model("m", "1", instance=instance or EchoModel(delay))
+    srv = GatewayServer(gw, port=0)
+    conns = []
+    base = srv._httpd.RequestHandlerClass
+
+    class Tracked(base):
+        def setup(self):
+            conns.append(self.request)
+            base.setup(self)
+
+    srv._httpd.RequestHandlerClass = Tracked
+    srv.start()
+    return gw, srv, ReplicaSpec(name, srv.address, journal_path=jp), conns
+
+
+def _hard_kill(gw, srv, conns):
+    """In-process SIGKILL: the scheduler dies mid-flight (no further
+    done records), the listener closes, established sockets reset."""
+    srv._httpd.shutdown()
+    srv._httpd.server_close()
+    gw.sched.shutdown(drain=False)
+    for c in list(conns):
+        try:
+            c.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
+def _journal_lines(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _get(addr, route, timeout=10):
+    with urllib.request.urlopen(f"http://{addr}{route}",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(addr, route, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://{addr}{route}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+# -- affinity key (paging satellite) ------------------------------------------
+
+def test_affinity_key_matches_chain_hash_and_depth():
+    prompt = list(range(2, 2 + 3 * PS))
+    # depth=2 keys on the first two full chunks — exactly the paging
+    # chain hash of that prefix, so router placement and replica page
+    # reuse agree by construction
+    assert affinity_key(prompt, PS, depth=2) == \
+        chunk_hashes(np.array(prompt[:2 * PS]), PS)[-1]
+    # same leading chunks, different tail: same key
+    assert affinity_key(prompt, PS, 2) == \
+        affinity_key(prompt[:2 * PS] + [17, 19], PS, 2)
+    # different first chunk: different key
+    other = [9] * PS + prompt[PS:]
+    assert affinity_key(other, PS, 2) != affinity_key(prompt, PS, 2)
+    # no full chunk -> nothing cacheable -> None (least-loaded fallback)
+    assert affinity_key(prompt[:PS - 1], PS, 2) is None
+    # deeper than the prompt: clamps to the chunks that exist
+    assert affinity_key(prompt, PS, depth=99) == \
+        chunk_hashes(np.array(prompt), PS)[-1]
+
+
+# -- journal compaction (satellite 1) -----------------------------------------
+
+def test_journal_file_compact_atomic_rewrite(tmp_path):
+    jf = JournalFile(str(tmp_path / "j.jsonl"), name="t")
+    for i in range(6):
+        jf.append({"i": i})
+    kept = jf.compact(lambda lines: [ln for ln in lines
+                                     if json.loads(ln)["i"] % 2 == 0])
+    assert [json.loads(ln)["i"] for ln in kept] == [0, 2, 4]
+    assert [json.loads(ln)["i"] for ln in jf.read_lines()] == [0, 2, 4]
+    assert not os.path.exists(jf.path + ".compact")   # tmp renamed away
+
+
+def test_request_journal_compact_keeps_incomplete_drops_torn_tail(
+        tmp_path):
+    path = str(tmp_path / "req.journal")
+    j = RequestJournal(path, compact_bytes=None)
+    j.record_submit("a-1", "t", "m", [3, 4], 4)
+    j.record_submit("a-2", "t", "m", [5, 6], 4,
+                    decode={"draft": True}, tag="fleet-1-1")
+    j.record_submit("a-3", "t", "m", [7, 8], 4)
+    j.record_done("a-1", ok=True)
+    j.record_done("a-3", ok=False, error="boom")
+    j.flush()
+    with open(path, "a") as f:
+        f.write('{"op": "submit", "jid": "torn')   # crash mid-append
+    out = j.compact()
+    assert out == {"kept": 1, "dropped": 5}
+    lines = _journal_lines(path)
+    assert len(lines) == 1 and lines[0]["jid"] == "a-2"
+    # replay input unchanged: decode options and tag survive compaction
+    (pend,) = j.pending()
+    assert pend["decode"] == {"draft": True}
+    assert pend["tag"] == "fleet-1-1"
+
+
+def test_request_journal_threshold_compaction(tmp_path):
+    path = str(tmp_path / "req.journal")
+    j = RequestJournal(path, compact_bytes=512)
+    for i in range(40):
+        j.record_submit(f"b-{i}", "t", "m", [3], 4)
+        j.record_done(f"b-{i}")
+    j.flush()
+    # the drain thread compacts after its batch; give it a beat
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if os.path.getsize(path) < 512:
+            break
+        time.sleep(0.02)
+    assert os.path.getsize(path) < 512
+    assert j.pending() == []
+
+
+def test_recover_compacts_then_replays(tmp_path):
+    path = str(tmp_path / "req.journal")
+    seed = RequestJournal(path, compact_bytes=None)
+    for i in range(5):
+        seed.record_submit(f"c-{i}", "default", "m", [40 + i], 2)
+        if i != 3:
+            seed.record_done(f"c-{i}")
+    seed.flush()
+    gw = Gateway(n_slots=2, max_new_tokens=2, journal_path=path)
+    gw.load_model("m", "1", instance=EchoModel())
+    gw.serve()
+    try:
+        recovered = gw.recover()
+        assert [r.jid for r in recovered] == ["c-3"]
+        for r in recovered:
+            assert r.wait(30)
+        assert gw.journal.flush()
+        # recover() compacted: the settled c-0..c-4 history is gone
+        jids = {ln["jid"] for ln in _journal_lines(path)}
+        assert jids == {"c-3"}
+    finally:
+        gw.shutdown(drain=True)
+    assert gw.journal.pending() == []
+
+
+# -- liveness vs readiness, draining (satellites 2+3) -------------------------
+
+def test_readyz_split_from_healthz_warming_and_draining(tmp_path):
+    gw, srv, spec, _ = _mk_replica(tmp_path, "r", slots=2)
+    try:
+        assert _get(spec.address, "/healthz")["ok"] is True
+        assert _get(spec.address, "/readyz")["ready"] is True
+        # warming: a hot swap in progress flips readiness, not liveness
+        with gw._wedge_lock:
+            gw._swapping += 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(spec.address, "/readyz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert body == {"ready": False, "reason": "warming",
+                        "draining": False}
+        assert _get(spec.address, "/healthz")["ok"] is True
+        with gw._wedge_lock:
+            gw._swapping -= 1
+        assert _get(spec.address, "/readyz")["ready"] is True
+        # draining: /readyz 503s with the reason, /healthz stays 200
+        out = _post(spec.address, "/v1/admin",
+                    {"action": "drain", "timeout": 10.0})
+        assert out["draining"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(spec.address, "/readyz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert body["reason"] == "draining" and body["draining"] is True
+        assert _get(spec.address, "/healthz")["ok"] is True
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not gw.drained:
+            time.sleep(0.02)
+        assert gw.drained
+        assert gw.stats()["draining"] is True
+    finally:
+        srv.stop(drain=False)
+
+
+def test_draining_gateway_refuses_submit_503_retry_after(tmp_path):
+    gw, srv, spec, _ = _mk_replica(tmp_path, "r", slots=2)
+    try:
+        _post(spec.address, "/v1/admin", {"action": "drain",
+                                          "timeout": 10.0})
+        with pytest.raises(GatewayDraining):
+            gw.submit("m", [3, 4])
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(spec.address, "/v1/generate",
+                  {"model": "m", "prompt": [3, 4], "max_new": 2})
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read().decode())["reason"] == \
+            "draining"
+        # refused BEFORE journaling: nothing new pending
+        gw.journal.flush()
+        assert gw.journal.pending() == []
+    finally:
+        srv.stop(drain=False)
+
+
+def test_admin_compact_journal_verb(tmp_path):
+    gw, srv, spec, _ = _mk_replica(tmp_path, "r", slots=2)
+    try:
+        for i in range(4):
+            _post(spec.address, "/v1/generate",
+                  {"model": "m", "prompt": [40 + i], "max_new": 2})
+        gw.journal.flush()
+        out = _post(spec.address, "/v1/admin",
+                    {"action": "compact_journal"})
+        assert out["kept"] == 0 and out["dropped"] == 8
+        assert _journal_lines(spec.journal_path) == []
+    finally:
+        srv.stop(drain=False)
+
+
+# -- routing --------------------------------------------------------------
+
+def _fleet(tmp, n=2, delay=0.0, slots=2, **kw):
+    reps = [_mk_replica(tmp, f"r{i}", delay=delay, slots=slots)
+            for i in range(n)]
+    kw.setdefault("page_size", PS)
+    kw.setdefault("probe_interval", 0.05)
+    kw.setdefault("settle_timeout", 5.0)
+    kw.setdefault("seed", 0)
+    router = FleetRouter([spec for _, _, spec, _ in reps], **kw)
+    return reps, router
+
+
+def _teardown(reps, router):
+    router.stop()
+    for gw, srv, _, _ in reps:
+        try:
+            srv.stop(drain=False)
+        except Exception:
+            pass
+
+
+def test_affinity_routing_sticky_and_fallback(tmp_path):
+    reps, router = _fleet(tmp_path, n=3)
+    try:
+        router.start()
+        assert router.stats()["ready"] == 3
+        # one full chunk -> HRW key -> every repeat lands identically
+        for base in (5, 9, 13):
+            prompt = [base] * PS + [2]
+            names = {router.generate("m", prompt, max_new=2)["replica"]
+                     for _ in range(4)}
+            assert len(names) == 1
+        # sub-chunk prompt -> least-loaded fallback (the idle minimum
+        # by (in_flight, name) is r0)
+        out = router.generate("m", [3, 4], max_new=2)
+        assert out["replica"] == "r0"
+        # HRW stability: pulling a non-owner replica must not move the
+        # key (only keys owned by the pulled replica may move)
+        owner = router.generate("m", [5] * PS, max_new=2)["replica"]
+        bystander = next(n for n in ("r0", "r1", "r2") if n != owner)
+        out = router.proxy({"model": "m", "prompt": [5] * PS,
+                            "max_new": 2}, exclude=(bystander,))
+        assert out["replica"] == owner
+    finally:
+        _teardown(reps, router)
+
+
+def test_least_loaded_and_seeded_random_routing(tmp_path):
+    reps, router = _fleet(tmp_path, n=2, routing="least_loaded")
+    try:
+        router.start()
+        rep = router._route([3] * PS, ())
+        assert rep.spec.name == "r0"          # idle tie -> name order
+        rep2 = router._route([3] * PS, ())    # r0 now busier
+        assert rep2.spec.name == "r1"
+        with router._lock:
+            rep.in_flight -= 1
+            rep2.in_flight -= 1
+    finally:
+        _teardown(reps, router)
+    # seeded random: same seed -> same placement sequence
+    reps, ra = _fleet(tmp_path, n=2, routing="random", seed=7)
+    try:
+        ra.start()
+        seq_a = [ra._route([3], ()).spec.name for _ in range(8)]
+        for r in ra._replicas:
+            with ra._lock:
+                r.in_flight = 0
+        rb = FleetRouter([r[2] for r in reps], routing="random",
+                         page_size=PS, probe_interval=0.05, seed=7)
+        rb.health_check_once()
+        seq_b = [rb._route([3], ()).spec.name for _ in range(8)]
+        assert seq_a == seq_b
+        assert len(set(seq_a)) == 2           # actually spreads
+    finally:
+        _teardown(reps, ra)
+
+
+def test_health_transitions_and_seeded_backoff(tmp_path):
+    reps, router = _fleet(tmp_path, n=2)
+    (gw0, srv0, spec0, conns0), (gw1, srv1, spec1, conns1) = reps
+    try:
+        router.health_check_once()
+        assert router.stats()["ready"] == 2
+        _hard_kill(gw1, srv1, conns1)
+        r1 = router._by_name("r1")
+        router.health_check_once()
+        # probes against the corpse refuse -> down, with a seeded
+        # backoff schedule deterministic per (router seed, replica name)
+        assert r1.state == "down" and r1.fails >= 1
+        salt = int(__import__("hashlib").sha1(b"r1").hexdigest()[:8],
+                   16) % 997
+        from paddle_tpu.resilience.retry import RetryPolicy
+        want = next(RetryPolicy(max_attempts=None, deadline=60.0,
+                                base_delay=router.probe_interval,
+                                max_delay=2.0, seed=salt).delays())
+        got = r1.next_probe - time.monotonic()
+        assert 0 < got <= want + 0.5
+        # routing skips the pulled replica entirely
+        for _ in range(6):
+            assert router.generate("m", [3] * PS,
+                                   max_new=2)["replica"] == "r0"
+    finally:
+        _teardown(reps, router)
+
+
+# -- failover + migration (the tentpole) --------------------------------------
+
+def test_kill_failover_migrates_exactly_once(tmp_path):
+    reps, router = _fleet(tmp_path, n=2, delay=0.01,
+                          settle_timeout=5.0)
+    (gw0, srv0, spec0, conns0), (gw1, srv1, spec1, conns1) = reps
+    try:
+        router.start()
+        results, errs = [], []
+
+        def client(i):
+            try:
+                results.append(router.generate(
+                    "m", [50 + i, 3, 3, 3], max_new=4))
+            except Exception as e:          # pragma: no cover - fails
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.02)
+        _hard_kill(gw1, srv1, conns1)
+        for t in threads:
+            t.join(60)
+        assert not errs
+        # zero lost: every client answered, with ITS OWN echo
+        assert sorted(r["tokens"][0] for r in results) == \
+            sorted(range(50, 58))
+        # migration settles the victim's journal tail
+        deadline = time.monotonic() + 10
+        jr = RequestJournal(spec1.journal_path)
+        while time.monotonic() < deadline and jr.pending():
+            time.sleep(0.05)
+        assert jr.pending() == []
+        # zero duplicated: every submitted jid has EXACTLY one done
+        # record, and nothing both completed normally and was replayed
+        lines = _journal_lines(spec1.journal_path)
+        dones = {}
+        for ln in lines:
+            if ln["op"] == "done":
+                dones.setdefault(ln["jid"], []).append(
+                    ln.get("error", ""))
+        assert all(len(v) == 1 for v in dones.values()), dones
+        st = router.stats()
+        assert st["migrated_entries"] >= 1
+        assert router._by_name("r1").migrations == 1
+    finally:
+        _teardown(reps, router)
+
+
+def test_drain_migrates_queued_tail_without_duplicates(tmp_path):
+    # slots=1 + slow steps => a queued backlog exists at drain time
+    reps, router = _fleet(tmp_path, n=2, delay=0.02, slots=1,
+                          routing="least_loaded")
+    (gw0, srv0, spec0, conns0), (gw1, srv1, spec1, conns1) = reps
+    try:
+        router.start()
+        results, errs = [], []
+
+        def client(i):
+            try:
+                results.append(router.generate(
+                    "m", [70 + i, 3, 3, 3], max_new=4))
+            except Exception as e:          # pragma: no cover - fails
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.03)
+        router.drain("r0")
+        for t in threads:
+            t.join(60)
+        assert not errs
+        assert sorted(r["tokens"][0] for r in results) == \
+            sorted(range(70, 76))
+        # r0's queued tail was failed by the drain with NO done record,
+        # answered 503-draining, and the router retried it on r1 while
+        # claiming the tag — so the migration pass closes those entries
+        # as claimed instead of replaying them a second time
+        deadline = time.monotonic() + 10
+        jr = RequestJournal(spec0.journal_path)
+        while time.monotonic() < deadline and jr.pending():
+            time.sleep(0.05)
+        assert jr.pending() == []
+        dones = {}
+        for ln in _journal_lines(spec0.journal_path):
+            if ln["op"] == "done":
+                dones.setdefault(ln["jid"], []).append(
+                    ln.get("error", ""))
+        assert all(len(v) == 1 for v in dones.values()), dones
+        # direct submits to the drained replica refuse with 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(spec0.address, "/v1/generate",
+                  {"model": "m", "prompt": [3], "max_new": 2})
+        assert ei.value.code == 503
+        # traffic continues on the survivor
+        assert router.generate("m", [80, 3], max_new=2)["replica"] \
+            == "r1"
+    finally:
+        _teardown(reps, router)
+
+
+def test_migration_replays_decode_options(tmp_path):
+    """A dead replica's pending entry with decode options must replay
+    with them intact (speculate -> decode.draft on the target)."""
+    reps, router = _fleet(tmp_path, n=2)
+    (gw0, srv0, spec0, conns0), (gw1, srv1, spec1, conns1) = reps
+    try:
+        # seed r1's journal as if it died holding a speculative request
+        # and a constrained one (written by a previous incarnation)
+        seed = RequestJournal(spec1.journal_path, compact_bytes=None)
+        seed.record_submit("z-1", "default", "m", [5, 6], 2,
+                           decode={"draft": True}, tag="fleet-0-999")
+        seed.record_submit("z-2", "default", "m", [7, 8], 2)
+        seed.flush()
+        _hard_kill(gw1, srv1, conns1)
+        router.health_check_once()      # marks down + migrates
+        jr = RequestJournal(spec1.journal_path)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and jr.pending():
+            router.health_check_once()
+            time.sleep(0.05)
+        assert jr.pending() == []
+        # EchoModel is not speculative-aware, so a replay that CARRIED
+        # speculate=True must have been refused by r0 (400) and closed
+        # as migrate_failed — proving options were forwarded, not
+        # silently dropped; the plain entry replays fine
+        dones = {ln["jid"]: ln for ln in
+                 _journal_lines(spec1.journal_path)
+                 if ln["op"] == "done"}
+        assert dones["z-1"]["ok"] is False
+        assert dones["z-1"]["error"] == "migrate_failed"
+        assert dones["z-2"]["ok"] is True
+        assert dones["z-2"]["error"] == "migrated"
+        # and the replayed plain request really ran on r0
+        gw0.journal.flush()
+        r0_prompts = [ln["prompt"] for ln in
+                      _journal_lines(spec0.journal_path)
+                      if ln["op"] == "submit"]
+        assert [7, 8] in r0_prompts and [5, 6] not in r0_prompts
+    finally:
+        _teardown(reps, router)
+
+
+def test_affinity_beats_random_prefix_hit_rate(tmp_path):
+    """The acceptance signal: shared-prompt traffic through affinity
+    routing reuses prefix pages strictly better than seeded random
+    routing on real paged generators."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    gens = {}
+    for arm in ("aff", "rnd"):
+        for i in range(2):
+            g = PagedTransformerGenerator(
+                V, V, param_prefix=f"fl{arm}{i}", executor=exe, **GEN_KW)
+            g.init_params(seed=3)
+            gens[(arm, i)] = g
+
+    def run(arm, routing, seed):
+        reps = []
+        for i in range(2):
+            jp = os.path.join(str(tmp_path), f"{arm}{i}.journal")
+            gw = Gateway(n_slots=2, max_new_tokens=2, journal_path=jp)
+            gw.load_model("m", "1", instance=gens[(arm, i)])
+            srv = GatewayServer(gw, port=0)
+            srv.start()
+            reps.append((gw, srv,
+                         ReplicaSpec(f"{arm}{i}", srv.address, jp), []))
+        router = FleetRouter([r[2] for r in reps], page_size=PS,
+                             affinity_depth=2, routing=routing,
+                             probe_interval=0.05, seed=seed)
+        try:
+            router.health_check_once()
+            rng = np.random.RandomState(11)
+            # one shared full chunk per prompt family (src_len caps the
+            # prompt at SRC=8 tokens: chunk + tail fits, chunk cached)
+            shared = [[int(t) for t in rng.randint(2, V, PS)]
+                      for _ in range(4)]
+            for rep_i in range(6):          # shared prefixes, repeated
+                for p in shared:
+                    tail = [int(t) for t in rng.randint(2, V, 3)]
+                    router.generate("m", p + tail, max_new=2)
+            hits = lookups = 0
+            for i in range(2):
+                st = gens[(arm, i)].alloc.stats()
+                hits += st["prefix_hits"]
+                lookups += st["prefix_lookups"]
+            return hits / max(1, lookups)
+        finally:
+            router.stop()
+            for gw, srv, _, _ in reps:
+                srv.stop(drain=False)
+
+    aff = run("aff", "affinity", seed=0)
+    rnd = run("rnd", "random", seed=0)
+    assert aff > rnd, (aff, rnd)
+
+
+# -- front-door HTTP server ---------------------------------------------------
+
+def test_router_server_routes_and_errors(tmp_path):
+    reps, router = _fleet(tmp_path, n=2)
+    fs = FleetRouterServer(router, port=0)
+    addr = fs.start()
+    try:
+        assert _get(addr, "/healthz")["ok"] is True
+        assert _get(addr, "/readyz")["ready"] is True
+        st = _get(addr, "/statusz")
+        assert st["ready"] == 2 and st["routing"] == "affinity"
+        assert _get(addr, "/v1/models")["aliases"] == {"m": "1"}
+        out = _post(addr, "/v1/generate",
+                    {"model": "m", "prompt": [9, 9, 9, 9, 5],
+                     "max_new": 4})
+        assert out["tokens"] == [9] * 4 and out["replica"] in ("r0",
+                                                               "r1")
+        # streaming is replica-direct by design
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(addr, "/v1/generate", {"model": "m", "prompt": [3],
+                                         "stream": True})
+        assert ei.value.code == 400
+        # replica-origin verdicts pass through untouched (unknown
+        # model -> the replica's 404)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(addr, "/v1/generate", {"model": "nope",
+                                         "prompt": [3]})
+        assert ei.value.code == 404
+        # operator verbs
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(addr, "/v1/fleet", {"action": "drain",
+                                      "replica": "nope"})
+        assert ei.value.code == 404
+        out = _post(addr, "/v1/fleet", {"action": "drain",
+                                        "replica": "r1"})
+        assert out["draining"] is True
+        out = _post(addr, "/v1/fleet", {"action": "restore",
+                                        "replica": "r1"})
+        assert out == {"restoring": "r1"}
+        # drain the last replica too -> router answers 503+Retry-After
+        _post(addr, "/v1/fleet", {"action": "drain", "replica": "r0"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and _get(addr, "/statusz")["ready"]:
+            time.sleep(0.05)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(addr, "/v1/generate", {"model": "m", "prompt": [3]})
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"]
+        assert json.loads(ei.value.read().decode())["reason"] == \
+            "no_ready_replica"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(addr, "/readyz")
+        assert ei.value.code == 503
+    finally:
+        fs.stop()
+        for gw, srv, _, _ in reps:
+            srv.stop(drain=False)
+
+
+def test_fleet_cli_status_and_verbs(tmp_path, capsys):
+    from paddle_tpu.tools.fleet import main as cli
+    reps, router = _fleet(tmp_path, n=2)
+    fs = FleetRouterServer(router, port=0)
+    addr = fs.start()
+    try:
+        assert cli(["status", addr]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ready"] == 2
+        assert cli(["generate", addr, "m", "--prompt", "9 9 9 9",
+                    "--max-new", "3"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["tokens"] == [9] * 3
+        assert cli(["drain", addr, "r1"]) == 0
+        assert json.loads(capsys.readouterr().out)["draining"] is True
+        assert cli(["restore", addr, "r1"]) == 0
+        capsys.readouterr()
+        assert cli(["drain", addr, "nope"]) == 1      # router error
+        capsys.readouterr()
+    finally:
+        fs.stop()
+        for gw, srv, _, _ in reps:
+            srv.stop(drain=False)
+    assert cli(["status", "127.0.0.1:1"]) == 2        # unreachable
+
+
+# -- cross-process journal replay (satellite 4) -------------------------------
+
+WRITER = r"""
+import os, sys, time
+from paddle_tpu.serving.gateway import RequestJournal
+
+path = sys.argv[1]
+j = RequestJournal(path, fsync=True, compact_bytes=None)
+j.record_submit("w-1", "default", "m", [41], 2)
+j.record_submit("w-2", "default", "m", [42], 2)
+j.record_done("w-1")
+j.flush()
+# a torn tail: the process dies mid-append
+with open(path, "a") as f:
+    f.write('{"op": "submit", "jid": "w-3", "model": "m"')
+    f.flush()
+    os.fsync(f.fileno())
+print("READY", flush=True)
+time.sleep(60)          # parent SIGKILLs us here
+"""
+
+
+def test_cross_process_journal_replay_after_sigkill(tmp_path):
+    """A journal written by one process, torn by SIGKILL, replays on a
+    fresh gateway in THIS process: completed entries skipped, the torn
+    tail tolerated, pid-qualified jids colliding with nothing."""
+    path = str(tmp_path / "xproc.journal")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    p = subprocess.Popen([sys.executable, "-c", WRITER, path],
+                         env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "READY"
+    finally:
+        p.kill()
+        p.wait()
+    gw = Gateway(n_slots=2, max_new_tokens=2, journal_path=path)
+    gw.load_model("m", "1", instance=EchoModel())
+    gw.serve()
+    try:
+        recovered = gw.recover()
+        assert [r.jid for r in recovered] == ["w-2"]
+        assert all(r.wait(30) for r in recovered)
+        assert recovered[0].tokens[0] == 42
+        # fresh submits in this process cannot collide with the dead
+        # process's jids
+        req = gw.submit("m", [43])
+        assert req.wait(30) and req.jid != "w-2"
+        assert req.jid.startswith(f"{os.getpid()}-")
+    finally:
+        gw.shutdown(drain=True)
+    assert gw.journal.pending() == []
+
+
+# -- multi-process chaos (slow: the ISSUE 16 acceptance scenario) -------------
+
+def _save_fleet_artifacts(root):
+    exe = fluid.Executor(fluid.CPUPlace())
+    target = PagedTransformerGenerator(V, V, param_prefix="flt",
+                                       executor=exe, **GEN_KW)
+    target.init_params(seed=3)
+    draft = PagedTransformerGenerator(V, V, param_prefix="fld",
+                                      executor=exe, **GEN_KW)
+    copy_weights(target.scope, draft.scope, prefix="flt",
+                 dst_prefix="fld")
+    ModelRegistry.save_generator_artifact(target, root, "nmt", "1")
+    ModelRegistry.save_generator_artifact(draft, root, "draft", "1")
+    return target
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_and_drain_exactly_once(tmp_path):
+    """The acceptance gate: 2 supervised subprocess replicas serving a
+    speculative group, mixed plain/speculative traffic, one replica
+    SIGKILLed mid-decode and another drained — every request completes
+    exactly once with its decode options honored, and the killed
+    replica's respawn replays nothing twice."""
+    root = str(tmp_path / "store")
+    target = _save_fleet_artifacts(root)
+    sup = FleetSupervisor(
+        root=root, models=["nmt=1"], n=2,
+        journal_dir=str(tmp_path / "journals"),
+        slots=4, max_new=OUT, max_restarts=3,
+        log_dir=str(tmp_path / "logs"),
+        draft="draft=1", speculate_k=3)
+    sup.start(wait_ready=240.0)
+    router = FleetRouter(sup.replica_specs(), page_size=PS,
+                         probe_interval=0.1, settle_timeout=20.0,
+                         request_timeout=240.0, seed=0)
+    router.start()
+    try:
+        assert router.stats()["ready"] == 2
+        rng = np.random.RandomState(4)
+        n_req = 24
+        prompts = [list(rng.randint(2, V, PS + 2))
+                   for _ in range(n_req)]
+        expected = {}
+        for i, p in enumerate(prompts):
+            arr = np.array(p).reshape(1, -1)
+            lens = np.array([len(p)], np.int32)
+            expected[i] = [int(t) for t in target.greedy(
+                arr, lens, max_new=OUT, stop_at_end=False)[0]]
+        results, errs = {}, []
+
+        def client(i):
+            try:
+                # odd requests opt into speculation explicitly; even
+                # ones decode plain — both must survive migration
+                results[i] = router.generate(
+                    "nmt", prompts[i], max_new=OUT,
+                    speculate=True if i % 2 == 1 else None)
+            except Exception as e:          # pragma: no cover - fails
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)                     # traffic mid-decode
+        victim = "replica-0"
+        survivor = "replica-1"
+        sup.kill(victim)                    # real SIGKILL; respawns
+        for t in threads:
+            t.join(300)
+        assert not errs, errs
+        assert len(results) == n_req
+        # exactly once, correct bytes: every response equals the
+        # deterministic greedy decode truncated at end_id, speculative
+        # or plain, migrated or not
+        for i, out in results.items():
+            toks = expected[i]
+            toks = toks[:toks.index(1) + 1] if 1 in toks else toks
+            assert out["tokens"] == toks, (i, out)
+        # the victim's journal settles: each jid exactly one done
+        vic_journal = [s for s in sup.replica_specs()
+                       if s.name == victim][0].journal_path
+        deadline = time.monotonic() + 60
+        jr = RequestJournal(vic_journal)
+        while time.monotonic() < deadline and jr.pending():
+            time.sleep(0.2)
+        assert jr.pending() == []
+        dones = {}
+        for ln in _journal_lines(vic_journal):
+            if ln["op"] == "done":
+                dones.setdefault(ln["jid"], []).append(ln)
+        assert all(len(v) == 1 for v in dones.values()), dones
+        # wait for the router to OBSERVE the death first — probing is
+        # periodic, so "ready" right after the kill is the stale
+        # pre-kill state, not the respawn
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline \
+                and router._by_name(victim).state == "ready":
+            time.sleep(0.05)
+        assert router._by_name(victim).state != "ready"
+        # drain the survivor once the victim's respawn is back in
+        # rotation; traffic keeps flowing throughout
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            router.health_check_once()
+            if router._by_name(victim).state == "ready":
+                break
+            time.sleep(0.5)
+        assert router._by_name(victim).state == "ready"
+        assert sup.status()[victim]["restarts"] >= 1
+        router.drain(survivor)
+        out = router.generate("nmt", prompts[0], max_new=OUT)
+        assert out["replica"] == victim
+        toks = expected[0]
+        toks = toks[:toks.index(1) + 1] if 1 in toks else toks
+        assert out["tokens"] == toks
+        surv_journal = [s for s in sup.replica_specs()
+                        if s.name == survivor][0].journal_path
+        jr2 = RequestJournal(surv_journal)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and jr2.pending():
+            time.sleep(0.2)
+        assert jr2.pending() == []
+    finally:
+        router.stop()
+        sup.stop()

@@ -38,6 +38,14 @@ if [ "$want_sync" = 1 ]; then
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m paddle_tpu.tools.syncheck paddle_tpu || rc=1
 
+  # the fleet package (ISSUE 16) proxies HTTP while tracking rotation
+  # state — the explicit second sweep makes an I/O-under-lock
+  # regression there unmissable
+  echo "== syncheck over paddle_tpu/serving/fleet/"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m paddle_tpu.tools.syncheck paddle_tpu/serving/fleet \
+      paddle_tpu/tools/fleet.py || rc=1
+
   # smoke-run the real scheduler/gateway/journal stack with runtime
   # order checking ON and dump the observed lock-order graph as an
   # artifact (SYNC_GRAPH_OUT overrides the path) — the graph is the
