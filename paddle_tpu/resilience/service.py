@@ -127,8 +127,16 @@ class SupervisedService:
                 if self._stopping:
                     break
                 self._proc = respawned
-        # raced with stop(): tear the straggler down ourselves
+        # raced with stop(): tear the straggler down ourselves, with
+        # the same SIGTERM -> grace -> SIGKILL escalation stop() uses,
+        # and REAP it — a bare terminate() leaves a zombie and lets
+        # stop() (which joins this thread) return mid-teardown
         respawned.terminate()
+        try:
+            respawned.wait(self.kill_grace)
+        except subprocess.TimeoutExpired:
+            respawned.kill()
+            respawned.wait()
 
     def stop(self) -> Optional[int]:
         """End supervision and the child: SIGTERM, grace, SIGKILL.

@@ -39,6 +39,7 @@ jids guarantee its fresh requests never collide with the old tail.
 from __future__ import annotations
 
 import hashlib
+import http.client
 import itertools
 import json
 import os
@@ -112,10 +113,18 @@ class _Replica:
 
 
 def _read_http_error(e: urllib.error.HTTPError) -> Dict:
-    try:
-        return json.loads(e.read().decode() or "{}")
-    except Exception:
-        return {}
+    """Parse an HTTPError's JSON body ONCE and cache it on the
+    exception: the underlying response is consumable a single time,
+    and the same error object is inspected at several layers (proxy's
+    draining check, then _migrate's when proxy re-raises it)."""
+    cached = getattr(e, "_fleet_body", None)
+    if cached is None:
+        try:
+            cached = json.loads(e.read().decode() or "{}")
+        except Exception:
+            cached = {}
+        e._fleet_body = cached
+    return dict(cached)     # callers mutate (e.g. _probe); copy out
 
 
 class FleetRouter:
@@ -415,44 +424,46 @@ class FleetRouter:
             except urllib.error.HTTPError as e:
                 draining = (e.code == 503 and _read_http_error(e)
                             .get("reason") == "draining")
-                with self._lock:
-                    rep.in_flight -= 1
-                    if draining:
-                        # claim ATOMICALLY with the decrement: the
-                        # migration pass gates on in_flight == 0, and a
-                        # claim landing after that gate opens would let
-                        # it replay an entry this thread is already
-                        # retrying.  The claim is a no-op when the 503
-                        # fired before journaling (submit refused).
-                        self._claim_locked(tag)
-                        self._set_state_locked(rep, "draining")
-                if draining:
+                if not draining:
+                    # any other HTTP error is the replica's verdict on
+                    # THIS request (429/400/404/500): propagate, don't
+                    # failover
                     self._m_requests.labels(replica=name,
-                                            outcome="failover").inc()
-                    self._failovers += 1
-                    self._kick.set()
-                    excluded.add(name)
-                    last_err = e
-                    continue
-                # any other HTTP error is the replica's verdict on THIS
-                # request (429/400/404/500): propagate, don't failover
+                                            outcome="error").inc()
+                    raise
+                with self._lock:
+                    # claim BEFORE the finally below decrements: the
+                    # migration pass gates on in_flight == 0, and a
+                    # claim landing after that gate opens would let it
+                    # replay an entry this thread is already retrying.
+                    # The claim is a no-op when the 503 fired before
+                    # journaling (submit refused).
+                    self._claim_locked(tag)
+                    self._set_state_locked(rep, "draining")
                 self._m_requests.labels(replica=name,
-                                        outcome="error").inc()
-                raise
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                                        outcome="failover").inc()
+                self._failovers += 1
+                self._kick.set()
+                excluded.add(name)
+                last_err = e
+                continue
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    http.client.HTTPException, ValueError) as e:
                 reason = getattr(e, "reason", e)
                 if isinstance(reason, socket.timeout) \
                         or isinstance(e, socket.timeout):
                     # a TIMEOUT is not a death signal: the replica may
                     # still complete and journal it — failing over here
                     # could double-serve.  Surface it.
-                    with self._lock:
-                        rep.in_flight -= 1
                     self._m_requests.labels(replica=name,
                                             outcome="error").inc()
                     raise
+                # HTTPException covers IncompleteRead — a replica
+                # SIGKILLed mid-response truncates the body — and
+                # ValueError the JSON parse of that truncation; both
+                # mean the response never reached a client, so claiming
+                # + retrying still delivers exactly once
                 with self._lock:
-                    rep.in_flight -= 1
                     self._claim_locked(tag)
                     self._mark_down_locked(rep, time.monotonic())
                 self._m_requests.labels(replica=name,
@@ -464,7 +475,6 @@ class FleetRouter:
                 continue
             else:
                 with self._lock:
-                    rep.in_flight -= 1
                     jid = out.get("jid")
                     if jid:
                         rep.remember_delivered(str(jid))
@@ -473,6 +483,16 @@ class FleetRouter:
                                         outcome="proxied").inc()
                 out["replica"] = name
                 return out
+            finally:
+                # the increment from _route is undone HERE and only
+                # here, whatever the exit path — an exception outside
+                # the handled set must not leak the count, or the
+                # migration gate (in_flight == 0) never opens for this
+                # replica and least-loaded routing skews forever.
+                # Claims and delivered-marks above happen BEFORE this
+                # decrement, so the gate cannot open without them.
+                with self._lock:
+                    rep.in_flight -= 1
         if last_err is not None:
             raise last_err
         raise NoReadyReplica("fleet: failover budget exhausted")
@@ -548,7 +568,16 @@ class FleetRouter:
                 # retry the whole migration at a later sweep
                 jr.flush()
                 return stats
-            except urllib.error.HTTPError:
+            except urllib.error.HTTPError as e:
+                if (e.code == 503 and _read_http_error(e)
+                        .get("reason") == "draining"):
+                    # proxy() exhausted its failover budget with every
+                    # remaining target draining and re-raised the last
+                    # 503 — the entry is perfectly recoverable, not a
+                    # poison pill.  Same disposition as NoReadyReplica:
+                    # leave the tail pending for a later sweep.
+                    jr.flush()
+                    return stats
                 # the target REFUSED it (model gone, over limit): close
                 # the entry as failed — replaying a poison pill forever
                 # is how recovery loops die
@@ -556,6 +585,14 @@ class FleetRouter:
                 stats["failed"] += 1
                 self._m_migrated.labels(replica=name, mode="failed").inc()
                 continue
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    http.client.HTTPException, ValueError):
+                # the replay TARGET died mid-call and the failover
+                # budget ran out — fleet-wide instability, not this
+                # entry's fault.  Leave the tail pending; the next
+                # sweep retries once the rotation stabilizes.
+                jr.flush()
+                return stats
             jr.record_done(jid, ok=True, error="migrated")
             stats["replayed"] += 1
             self._m_migrated.labels(replica=name, mode="replayed").inc()

@@ -662,6 +662,19 @@ class Gateway:
             self.journal.flush()
         return leftovers
 
+    def begin_drain(self) -> bool:
+        """Atomically flip the draining gate (under the wedge lock):
+        True when THIS call turned it on — the caller owns running the
+        actual drain; False when a drain is already in progress, so
+        repeated drain verbs (router retries, CLI + router both
+        draining) are idempotent instead of stacking concurrent
+        ``shutdown(drain=True)`` threads."""
+        with self._wedge_lock:
+            if self._draining:
+                return False
+            self._draining = True
+            return True
+
     @property
     def draining(self) -> bool:
         return self._draining

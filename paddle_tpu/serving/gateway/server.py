@@ -266,7 +266,13 @@ class _Handler(BaseHTTPRequestHandler):
         action = body.get("action")
         if action == "drain":
             timeout = float(body.get("timeout", 30.0))
-            gw._draining = True    # visible before this response lands
+            # begin_drain flips the refusal gate atomically (visible
+            # before this response lands) and tells repeats apart:
+            # retried drain verbs (router + CLI both draining) answer
+            # idempotently instead of stacking concurrent
+            # sched.shutdown() threads
+            if not gw.begin_drain():
+                return self._send_json({"draining": True})
             t = threading.Thread(
                 target=lambda: gw.shutdown(drain=True, timeout=timeout),
                 daemon=True, name="gateway-drain")

@@ -15,12 +15,26 @@ with optional fsync, and replay reads — all serialized by ONE
 dedicated ``OrderedLock`` (rank ``RANK_JOURNAL_FILE``, innermost of the
 journal layer).  The blocking file I/O inside that lock is **the
 lock's entire purpose** — appends must hit the file in submission
-order or replay reorders history — so the two ``# syncheck: ok``
+order or replay reorders history — so the ``# syncheck: ok``
 suppressions below are the sanctioned, audited exception to the
 io-under-lock lint.  What the lint actually polices is this I/O
 migrating under somebody ELSE's lock (the PR 9 bug: journal fsync
 under the scheduler lock); callers of ``JournalFile`` hold no other
 lock below rank 52 while appending.
+
+The OrderedLock is per-PROCESS only, and since ISSUE 16 one journal
+file has writers in TWO processes: the fleet router appends done
+records to a dead replica's journal (migration) while the supervisor's
+respawn of that replica runs ``recover()`` -> ``compact()`` on the same
+path.  Without cross-process exclusion, ``compact()``'s read-snapshot +
+``os.replace`` can silently drop a done record appended in the window —
+and the respawn then replays an entry the router already settled:
+duplicate execution.  Every append/compact/read therefore ALSO holds an
+exclusive ``flock`` on a sidecar ``<path>.lock`` file (the sidecar, not
+the journal itself, because ``os.replace`` swaps the journal's inode
+out from under any lock held on it).  The flock is acquired inside the
+OrderedLock, so in-process ordering stays rank-decided and the flock
+only arbitrates between processes.
 """
 
 from __future__ import annotations
@@ -28,9 +42,15 @@ from __future__ import annotations
 import json
 import os
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from .sync import RANK_JOURNAL_FILE, OrderedLock
+
+try:
+    import fcntl
+except ImportError:             # pragma: no cover - non-POSIX fallback
+    fcntl = None
 
 __all__ = ["JournalFile", "terminate_torn_tail"]
 
@@ -65,10 +85,28 @@ class JournalFile:
         self.path = str(path)
         self.fsync = bool(fsync)
         self._lock = OrderedLock(f"{name}.file", RANK_JOURNAL_FILE)
+        self._lock_path = self.path + ".lock"
         self._tail_checked = False
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
+
+    @contextmanager
+    def _oslock(self):
+        """The cross-process half of the journal lock: an exclusive
+        flock on the sidecar lock file, held for the duration of one
+        append/compact/read.  See the module docstring for why the
+        in-process OrderedLock alone is not enough (ISSUE 16: router
+        migration appends race a respawned replica's compact())."""
+        if fcntl is None:               # pragma: no cover - non-POSIX
+            yield
+            return
+        fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            os.close(fd)        # closing the fd releases the flock
 
     def append(self, entry: Dict, stamp: Optional[str] = None) -> Dict:
         """Append one JSON record as a single line (``stamp`` adds a
@@ -81,17 +119,18 @@ class JournalFile:
             entry[stamp] = time.time()
         line = json.dumps(entry, separators=(",", ":")) + "\n"
         with self._lock:  # syncheck: ok — dedicated journal I/O lock
-            if not self._tail_checked:
-                # a predecessor that died mid-append leaves a torn
-                # final line; appending onto it would merge this record
-                # into the garbage and lose both
-                self._tail_checked = True
-                terminate_torn_tail(self.path)
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
-                f.flush()
-                if self.fsync:
-                    os.fsync(f.fileno())
+            with self._oslock():
+                if not self._tail_checked:
+                    # a predecessor that died mid-append leaves a torn
+                    # final line; appending onto it would merge this
+                    # record into the garbage and lose both
+                    self._tail_checked = True
+                    terminate_torn_tail(self.path)
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line)
+                    f.flush()
+                    if self.fsync:
+                        os.fsync(f.fileno())
         return entry
 
     def compact(self, transform) -> List[str]:
@@ -102,34 +141,38 @@ class JournalFile:
         complete journal or the new one, never a half-written mix, and
         the rename publishes only what was fsynced (the
         CheckpointManager plain-write rule).  Read, filter, and swap
-        all run under ONE acquisition of the journal lock, so a
-        concurrent append can never land in the window between the
+        all run under ONE acquisition of the journal lock AND one
+        continuous flock, so a concurrent append — from another thread
+        or another PROCESS (a router migrating this journal while its
+        owner respawns) — can never land in the window between the
         snapshot read and the swap-in and be silently rewritten away.
         Returns the kept lines."""
         tmp = self.path + ".compact"
         with self._lock:  # syncheck: ok — dedicated journal I/O lock
-            if os.path.exists(self.path):
-                with open(self.path, "r", encoding="utf-8") as f:
-                    lines = f.readlines()
-            else:
-                lines = []
-            kept = list(transform(lines))
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.writelines(kept)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
-            # the rewrite wrote whole lines only — a predecessor's torn
-            # tail (if any) was dropped with the rest of the old file
-            self._tail_checked = True
+            with self._oslock():
+                if os.path.exists(self.path):
+                    with open(self.path, "r", encoding="utf-8") as f:
+                        lines = f.readlines()
+                else:
+                    lines = []
+                kept = list(transform(lines))
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.writelines(kept)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+                # the rewrite wrote whole lines only — a predecessor's
+                # torn tail (if any) dropped with the rest of the file
+                self._tail_checked = True
         return kept
 
     def read_lines(self) -> List[str]:
         """Raw journal lines for replay (missing file = empty).  Held
-        under the lock so a reader never observes a torn in-flight
-        append from a concurrent writer thread."""
+        under the lock (and the cross-process flock) so a reader never
+        observes a torn in-flight append from a concurrent writer."""
         with self._lock:  # syncheck: ok — dedicated journal I/O lock
-            if not os.path.exists(self.path):
-                return []
-            with open(self.path, "r", encoding="utf-8") as f:
-                return f.readlines()
+            with self._oslock():
+                if not os.path.exists(self.path):
+                    return []
+                with open(self.path, "r", encoding="utf-8") as f:
+                    return f.readlines()

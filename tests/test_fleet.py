@@ -16,6 +16,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 import pytest
@@ -204,6 +205,50 @@ def test_request_journal_threshold_compaction(tmp_path):
     assert j.pending() == []
 
 
+APPENDER = r"""
+import sys
+from paddle_tpu.utils.journal import JournalFile
+
+path, n = sys.argv[1], int(sys.argv[2])
+jf = JournalFile(path, name="t")
+print("GO", flush=True)
+for i in range(n):
+    jf.append({"op": "submit", "jid": "x-%d" % i})
+"""
+
+
+def test_journal_cross_process_append_vs_compact_no_lost_records(
+        tmp_path):
+    """The ISSUE 16 review race: a router process appends done records
+    to a dead replica's journal while the respawned replica compacts
+    the same file.  The in-process OrderedLock cannot arbitrate that —
+    the sidecar flock must: an append landing between compact()'s
+    snapshot read and its os.replace would otherwise be silently
+    rewritten away (and the respawn would replay settled work)."""
+    path = str(tmp_path / "race.journal")
+    n = 200
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    jf = JournalFile(path, name="t")
+    p = subprocess.Popen([sys.executable, "-c", APPENDER, path, str(n)],
+                         env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "GO"
+        # hammer identity compactions for the writer's whole lifetime:
+        # every compact reads a snapshot and atomically rewrites it, so
+        # any append in the window would be dropped without the flock
+        while p.poll() is None:
+            jf.compact(lambda lines: lines)
+        assert p.wait() == 0
+    finally:
+        if p.poll() is None:        # pragma: no cover - hang cleanup
+            p.kill()
+            p.wait()
+    jids = [json.loads(ln)["jid"] for ln in jf.read_lines()]
+    assert jids == [f"x-{i}" for i in range(n)]
+
+
 def test_recover_compacts_then_replays(tmp_path):
     path = str(tmp_path / "req.journal")
     seed = RequestJournal(path, compact_bytes=None)
@@ -286,6 +331,36 @@ def test_draining_gateway_refuses_submit_503_retry_after(tmp_path):
         gw.journal.flush()
         assert gw.journal.pending() == []
     finally:
+        srv.stop(drain=False)
+
+
+def test_admin_drain_idempotent_single_shutdown(tmp_path):
+    """Repeated drain verbs (router retries, CLI + router racing) must
+    not stack concurrent shutdown(drain=True) threads: only the call
+    that flips the gate runs the drain, repeats answer immediately."""
+    gw, srv, spec, _ = _mk_replica(tmp_path, "r", slots=2)
+    try:
+        calls = []
+        orig = gw.shutdown
+
+        def counting_shutdown(**kw):
+            calls.append(dict(kw))
+            return orig(**kw)
+
+        gw.shutdown = counting_shutdown
+        for _ in range(3):
+            out = _post(spec.address, "/v1/admin",
+                        {"action": "drain", "timeout": 10.0})
+            assert out["draining"] is True
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not gw.drained:
+            time.sleep(0.02)
+        assert gw.drained
+        assert len([c for c in calls if c.get("drain")]) == 1
+        # begin_drain itself reports the repeat
+        assert gw.begin_drain() is False
+    finally:
+        gw.shutdown = orig
         srv.stop(drain=False)
 
 
@@ -412,6 +487,143 @@ def test_health_transitions_and_seeded_backoff(tmp_path):
 
 
 # -- failover + migration (the tentpole) --------------------------------------
+
+class _FlakyReplica:
+    """A fake replica whose /readyz is healthy but whose /v1/generate
+    response is damaged in flight — the wire-level signature of a
+    SIGKILL between send_response and the full body.  ``truncate``
+    under-delivers a declared Content-Length (the client's resp.read()
+    raises http.client.IncompleteRead); ``garbage`` delivers a complete
+    non-JSON body (json.loads raises ValueError)."""
+
+    def __init__(self):
+        self.mode = "truncate"
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"ready": True,
+                                   "draining": False}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                if outer.mode == "truncate":
+                    payload = b'{"jid": "f-1", "tokens": [1, 2'
+                    self.send_response(200)
+                    self.send_header("Content-Length",
+                                     str(len(payload) + 16))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    self.close_connection = True
+                else:
+                    payload = b"% not json at all %"
+                    self.send_response(200)
+                    self.send_header("Content-Length",
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+        h, p = self.httpd.server_address[:2]
+        self.address = f"{h}:{p}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_proxy_failover_on_torn_response_no_inflight_leak(tmp_path):
+    """A replica SIGKILLed mid-response surfaces as IncompleteRead (an
+    HTTPException, not OSError) or a truncated-JSON ValueError.  Both
+    must fail over like a refused connection AND undo the in_flight
+    increment — a leak would permanently close the migration gate
+    (in_flight == 0) for that replica and skew least-loaded routing."""
+    gw, srv, good_spec, _ = _mk_replica(tmp_path, "r0", slots=2)
+    flaky = _FlakyReplica()
+    router = FleetRouter(
+        [ReplicaSpec("a-bad", flaky.address), good_spec],
+        page_size=PS, routing="least_loaded", probe_interval=0.05,
+        seed=0)
+    try:
+        router.health_check_once()
+        assert router.stats()["ready"] == 2
+        bad = router._by_name("a-bad")
+        for mode in ("truncate", "garbage"):
+            flaky.mode = mode
+            # least-loaded idle tie breaks by name: "a-bad" < "r0", so
+            # the damaged replica is always tried first
+            with router._lock:
+                router._set_state_locked(bad, "ready")
+            out = router.generate("m", [90, 3], max_new=2)
+            assert out["replica"] == "r0"       # failed over, answered
+            assert out["tokens"][0] == 90
+            with router._lock:
+                assert bad.in_flight == 0, mode
+                assert router._by_name("r0").in_flight == 0, mode
+                assert bad.state == "down"      # treated as a death
+    finally:
+        router.stop()
+        flaky.stop()
+        srv.stop(drain=False)
+
+
+def test_migrate_leaves_tail_pending_when_targets_drain(tmp_path):
+    """A replay that dies on proxy()'s re-raised 503-draining (failover
+    budget exhausted, every remaining target draining) is recoverable —
+    it must stay PENDING for a later sweep, never be closed as
+    migrate_failed (that would lose the work and break the
+    lost_requests==0 gate)."""
+    reps, router = _fleet(tmp_path, n=2, max_failovers=0)
+    (gw0, srv0, spec0, conns0), (gw1, srv1, spec1, conns1) = reps
+    try:
+        router.health_check_once()
+        assert router.stats()["ready"] == 2
+        # r1 dies holding one queued entry nobody claimed
+        seed = RequestJournal(spec1.journal_path, compact_bytes=None)
+        seed.record_submit("y-1", "default", "m", [9, 9], 2)
+        seed.flush()
+        _hard_kill(gw1, srv1, conns1)
+        r0, r1 = router._by_name("r0"), router._by_name("r1")
+        with router._lock:
+            router._mark_down_locked(r1, time.monotonic())
+        # r0 drains WITHOUT the router noticing (its rotation state is
+        # stale-ready): the replay gets a real 503-draining and, with
+        # max_failovers=0, proxy re-raises it as the last error
+        gw0._draining = True
+        stats = router._migrate(r1)
+        assert stats == {"replayed": 0, "claimed": 0, "delivered": 0,
+                         "failed": 0}
+        jr = RequestJournal(spec1.journal_path)
+        assert [e["jid"] for e in jr.pending()] == ["y-1"]
+        assert not r1.migrated          # a later sweep retries
+        # the drain ends; the next sweep replays the entry for real
+        gw0._draining = False
+        with router._lock:
+            router._set_state_locked(r0, "ready")
+        stats = router._migrate(r1)
+        assert stats["replayed"] == 1
+        assert jr.pending() == []
+        dones = {ln["jid"]: ln for ln in _journal_lines(spec1.journal_path)
+                 if ln["op"] == "done"}
+        assert dones["y-1"]["ok"] is True
+        assert dones["y-1"]["error"] == "migrated"
+    finally:
+        _teardown(reps, router)
+
 
 def test_kill_failover_migrates_exactly_once(tmp_path):
     reps, router = _fleet(tmp_path, n=2, delay=0.01,
