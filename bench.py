@@ -1671,6 +1671,128 @@ def bench_sync(trials: int, n_slots: int = 4, decode_len: int = 8):
     }
 
 
+def bench_sharded_child() -> None:
+    """Child half of ``bench_sharded`` — runs in a subprocess whose
+    XLA_FLAGS force 4 virtual CPU devices (the flag must precede the
+    jax import, so the parent cannot measure this in-process).  Prints
+    one JSON object on stdout."""
+    import time as _t
+
+    import numpy as _np
+
+    from paddle_tpu import fluid
+    from paddle_tpu.serving.paged_decoder import (
+        PagedTransformerGenerator, copy_weights, estimate_generator_hbm)
+
+    decode_len = int(os.environ.get("BENCH_SHARDED_DECODE", "24"))
+    trials = max(1, int(os.environ.get("BENCH_TRIALS", "2")))
+    base = dict(src_vocab_size=211, trg_vocab_size=211, n_layer=2,
+                n_head=8, d_key=16, d_value=16, d_model=128,
+                d_inner_hid=256, max_length=128, src_len=32,
+                max_out_len=decode_len, page_size=8, chunk_size=8,
+                num_pages=128)
+    rng = _np.random.RandomState(0)
+    batch = 4
+    src = rng.randint(2, 211, (batch, 32)).astype(_np.int64)
+    lens = _np.full(batch, 32, _np.int32)
+
+    ref = PagedTransformerGenerator(**base, place=fluid.TPUPlace(0))
+    ref.init_params(seed=7)
+    ref_tokens = None
+
+    # max-servable-model-size vs device count: the single-chip budget is
+    # 1.05x the BASE model's peak — then the widest (d_model/d_inner
+    # scaled) variant whose PER-SHARD static plan still fits tells how
+    # far each mesh stretches the same chip
+    budget = int(estimate_generator_hbm(
+        dict(base, param_prefix="b"), assume_lanes=batch).peak_bytes
+        * 1.05)
+
+    def max_servable(n_model):
+        axes = None if n_model == 1 else {"batch": 1, "model": n_model}
+        best = 0
+        for mult in (1, 2, 3, 4, 6, 8, 12, 16):
+            cfg = dict(base, param_prefix="b", d_model=128 * mult,
+                       d_inner_hid=256 * mult)
+            if axes is not None:
+                cfg["mesh_axes"] = axes
+            plan = estimate_generator_hbm(cfg, assume_lanes=batch)
+            if plan.peak_bytes <= budget:
+                best = mult
+        return best
+
+    rows = {}
+    for n_model in (1, 2, 4):
+        axes = None if n_model == 1 else {"batch": 1, "model": n_model}
+        gen = ref if n_model == 1 else PagedTransformerGenerator(
+            **base, mesh_axes=axes, place=fluid.TPUPlace(0))
+        if gen is not ref:
+            copy_weights(ref.scope, gen.scope)
+        gen.greedy(src, lens, max_new=2, stop_at_end=False)   # warm
+        c0 = gen.cache_stats()["executable"]
+        best = float("inf")
+        for _ in range(trials):
+            t0 = _t.time()
+            out = gen.greedy(src, lens, max_new=decode_len,
+                             stop_at_end=False)
+            best = min(best, _t.time() - t0)
+        c1 = gen.cache_stats()["executable"]
+        if ref_tokens is None:
+            ref_tokens = out
+        parity = bool(_np.array_equal(out, ref_tokens))
+        row = {
+            "decoded_tok_per_s": round(batch * decode_len / best, 2),
+            "recompiles_after_warmup": c1["misses"] - c0["misses"],
+            "token_parity_vs_single_chip": parity,
+            "pool_bytes_per_shard":
+                gen.shard_plan()["pool_bytes_per_shard"],
+            "per_shard_peak_hbm_bytes": int(gen.static_hbm_estimate(
+                assume_lanes=batch).peak_bytes),
+            "max_servable_width_multiplier": max_servable(n_model),
+        }
+        if n_model > 1:
+            gen.open_slots(batch)
+            rep = gen.collective_report()
+            pred = rep["predicted"]["allreduce_payload_bytes"]
+            meas = (rep["measured"] or {}).get("total_payload_bytes")
+            row["allreduce_bytes"] = {
+                "predicted": pred,
+                "measured": meas,
+                "rel_err": (round(abs(pred - meas) / meas, 4)
+                            if meas else None),
+            }
+        rows[str(n_model)] = row
+    print(json.dumps({
+        "platform": "cpu_virtual_devices",
+        "batch": batch, "decode_len": decode_len,
+        "single_chip_budget_bytes": budget,
+        "devices": rows,
+    }))
+
+
+def bench_sharded(trials: int) -> dict:
+    """Tensor-parallel sharded serving (ISSUE 17): decoded tok/s +
+    max-servable-model-size at 1/2/4 virtual devices, the zero-
+    recompile and token-parity contracts, and predicted-vs-measured
+    allreduce bytes (analysis/comms vs the partitioner's HLO).  Runs in
+    a subprocess: the virtual-device flag only takes effect before jax
+    initializes."""
+    import subprocess
+
+    env = dict(
+        os.environ, BENCH_SHARDED_CHILD="1", JAX_PLATFORMS="cpu",
+        BENCH_TRIALS=str(trials),
+        XLA_FLAGS="--xla_force_host_platform_device_count=4 "
+                  + os.environ.get("XLA_FLAGS", ""))
+    p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, capture_output=True, text=True,
+                       timeout=1800)
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"sharded bench child failed: {p.stderr[-2000:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 def _calibrated_chip():
     """Measured machine model for the roofline gate: achievable matmul
     FLOP/s and achievable copy bandwidth of THIS device (env overrides:
@@ -2220,6 +2342,11 @@ def bench_nmt_quality(dict_size: int = 2000, max_epochs: int = 45,
 
 
 def main() -> None:
+    if os.environ.get("BENCH_SHARDED_CHILD", "") == "1":
+        # re-exec'd by bench_sharded with virtual-device XLA_FLAGS in
+        # place; print the sharded measurement JSON and stop
+        bench_sharded_child()
+        return
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     trials = max(1, int(os.environ.get("BENCH_TRIALS", "2")))
     batches = [int(b) for b in os.environ.get(
@@ -2406,6 +2533,13 @@ def main() -> None:
         except Exception as e:
             print(f"sync bench failed: {e}", file=sys.stderr)
 
+    sharded_cmp = None
+    if os.environ.get("BENCH_SKIP_SHARDED", "") != "1":
+        try:
+            sharded_cmp = retry_transient(bench_sharded, trials)
+        except Exception as e:
+            print(f"sharded bench failed: {e}", file=sys.stderr)
+
     cost_model = None
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         try:
@@ -2507,6 +2641,11 @@ def main() -> None:
         # contract measured: zero lost requests, empty victim journal
         # after migration
         "fleet": fleet_cmp,
+        # tensor-parallel sharded serving (ISSUE 17): tok/s +
+        # max-servable-model-size at 1/2/4 virtual devices, the
+        # zero-recompile and token-parity contracts, and predicted-vs-
+        # measured allreduce bytes from the comms estimator
+        "sharded": sharded_cmp,
         # concurrency sanitizer (ISSUE 13): ordered-lock passthrough
         # cost on the real scheduler step + gateway submit (contract:
         # passthrough < 1% of a step; checking-ON overhead reported,
@@ -2601,6 +2740,19 @@ def main() -> None:
             # the always-on passthrough priced itself above 1% of a
             # scheduler step — a failed run, like any perf regression
             missing.append("sync_overhead_contract")
+    if os.environ.get("BENCH_SKIP_SHARDED", "") != "1":
+        if sharded_cmp is None:
+            missing.append("sharded")
+        else:
+            rows = sharded_cmp["devices"].values()
+            if any(r["recompiles_after_warmup"] != 0 for r in rows):
+                # a sharded lane step compiled after warmup — replicated
+                # block tables failed their never-recompile contract
+                missing.append("sharded_recompile_contract")
+            if not all(r["token_parity_vs_single_chip"] for r in rows):
+                # the sharded engine diverged from the single-chip
+                # tokens — a correctness failure, not a perf number
+                missing.append("sharded_parity_contract")
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         if cost_model is None:
             missing.append("cost_model")

@@ -44,8 +44,8 @@ from .dataflow import ProgramView, block_liveness
 from .diagnostics import ERROR, INFO, WARNING, Diagnostics, Finding
 
 __all__ = ["ChipSpec", "CHIP_SPECS", "get_chip", "OpCost", "cost_rule",
-           "op_cost", "var_bytes", "block_byte_plan", "plan_program",
-           "roofline", "cost_pass", "KV_POOL_MARKERS"]
+           "op_cost", "var_bytes", "shard_divisor", "block_byte_plan",
+           "plan_program", "roofline", "cost_pass", "KV_POOL_MARKERS"]
 
 
 # ---------------------------------------------------------------------------
@@ -151,11 +151,37 @@ def dtype_bytes(dtype) -> int:
     return np_dtype(canonical_dtype(dtype)).itemsize
 
 
-def var_bytes(vd, assume_batch: int = 1) -> Tuple[int, bool]:
+def shard_divisor(vd, mesh_axes: Optional[Dict[str, int]] = None) -> int:
+    """Per-DEVICE byte divisor for one VarDesc under a declared mesh:
+    the product of the axis extents its sharding annotation maps onto
+    dims that divide evenly.  Unannotated vars (activations, feeds,
+    block tables) divide by 1 — the conservative per-shard plan charges
+    them replicated, exactly the contract the serving mesh keeps for
+    paging state."""
+    if not mesh_axes or vd is None or vd.sharding is None \
+            or vd.shape is None:
+        return 1
+    div = 1
+    for d, ax in zip(vd.shape, vd.sharding):
+        if not isinstance(ax, str):
+            continue
+        if ax.endswith("?"):          # deferred (ZeRO) placement
+            ax = ax[:-1]
+        n = mesh_axes.get(ax)
+        if n and d is not None and d > 0 and d % int(n) == 0:
+            div *= int(n)
+    return div
+
+
+def var_bytes(vd, assume_batch: int = 1,
+              mesh_axes: Optional[Dict[str, int]] = None) -> Tuple[int, bool]:
     """(bytes, approximate) for one VarDesc.  Dynamic dims substitute
     ``assume_batch`` at dim 0 and 1 elsewhere; opaque/unsized vars cost
     0 — both substitutions flip the ``approximate`` flag so the report
-    can say how much of the estimate is assumed rather than recorded."""
+    can say how much of the estimate is assumed rather than recorded.
+    With ``mesh_axes`` the bytes are the per-device footprint: annotated
+    dims that divide their axis extent scale down (see
+    :func:`shard_divisor`)."""
     if vd is None or vd.type not in _SIZED_TYPES or vd.shape is None:
         return 0, True
     n, approx = 1, False
@@ -164,7 +190,8 @@ def var_bytes(vd, assume_batch: int = 1) -> Tuple[int, bool]:
             d = assume_batch if i == 0 else 1
             approx = True
         n *= int(d)
-    return n * dtype_bytes(vd.dtype), approx
+    return (n * dtype_bytes(vd.dtype)) // shard_divisor(vd, mesh_axes), \
+        approx
 
 
 def _is_kv_state(name: str) -> bool:
@@ -660,7 +687,9 @@ def block_byte_plan(view: ProgramView, block_idx: int = 0,
                     assume_batch: int = 1,
                     sub_extra: Optional[Dict[int, int]] = None,
                     persistable_base: int = 0,
-                    assume_donation: bool = True) -> BlockBytePlan:
+                    assume_donation: bool = True,
+                    mesh_axes: Optional[Dict[str, int]] = None
+                    ) -> BlockBytePlan:
     """Build the liveness byte timeline for one block.
 
     Transient live ranges come from :func:`dataflow.block_liveness` (the
@@ -692,7 +721,7 @@ def block_byte_plan(view: ProgramView, block_idx: int = 0,
 
     def vbytes(name: str) -> int:
         got, approx = var_bytes(view.visible_var(block_idx, name),
-                                assume_batch)
+                                assume_batch, mesh_axes)
         plan.approximate = plan.approximate or approx
         return got
 
@@ -863,7 +892,9 @@ class ProgramMemoryPlan:
 
 
 def plan_program(view_or_program, assume_batch: int = 1,
-                 assume_donation: bool = True) -> ProgramMemoryPlan:
+                 assume_donation: bool = True,
+                 mesh_axes: Optional[Dict[str, int]] = None
+                 ) -> ProgramMemoryPlan:
     """Peak-HBM plan over the whole program.  Persistables are counted
     once by name across every block (params vs KV state split via
     ``KV_POOL_MARKERS``); sub-block transient peaks are charged at
@@ -872,7 +903,11 @@ def plan_program(view_or_program, assume_batch: int = 1,
     persistent AOT executable cache serves (see block_byte_plan) — the
     gateway registry budgets with it whenever a version mounts a
     ``compiled/`` cache, so admission never under-counts the write-back
-    copies real hardware will pay."""
+    copies real hardware will pay.  ``mesh_axes`` turns the plan into a
+    PER-SHARD footprint: vars with sharding annotations (params, the KV
+    pool) scale by their shard divisor while unannotated state (block
+    tables, feeds, activations) stays charged replicated — the
+    conservative side of GSPMD's actual partitioning."""
     view = view_or_program if isinstance(view_or_program, ProgramView) \
         else ProgramView(getattr(view_or_program, "desc", view_or_program))
     plan = ProgramMemoryPlan.__new__(ProgramMemoryPlan)
@@ -887,7 +922,7 @@ def plan_program(view_or_program, assume_batch: int = 1,
             if not vd.persistable or name in seen:
                 continue
             seen.add(name)
-            nb, approx = var_bytes(vd, assume_batch)
+            nb, approx = var_bytes(vd, assume_batch, mesh_axes)
             plan.approximate = plan.approximate or approx
             kind = "kv_pool" if _is_kv_state(name) else "params"
             persist_items.append((name, nb, kind))
@@ -905,7 +940,8 @@ def plan_program(view_or_program, assume_batch: int = 1,
                  for op in b.ops if op.sub_blocks}
         bp = block_byte_plan(view, b.idx, assume_batch, sub_extra=extra,
                              persistable_base=0,
-                             assume_donation=assume_donation)
+                             assume_donation=assume_donation,
+                             mesh_axes=mesh_axes)
         plan.approximate = plan.approximate or bp.approximate
         sub_peak[b.idx] = bp.peak_bytes
         block_plans[b.idx] = bp
@@ -1056,7 +1092,8 @@ def cost_pass(ctx, diag: Diagnostics) -> None:
     assume_batch = int(opts.get("assume_batch", 1))
     chip = get_chip(opts.get("chip"))
 
-    plan = plan_program(ctx.view, assume_batch)
+    plan = plan_program(ctx.view, assume_batch,
+                        mesh_axes=opts.get("mesh_axes"))
     roof = roofline(ctx.view, chip, assume_batch)
     diag.reports["cost"] = {"memory": plan.to_dict(),
                             "roofline": roof.to_dict()}

@@ -854,6 +854,96 @@ class Executor:
                              + out["temp_bytes"] - out["alias_bytes"])
         return out
 
+    # HLO element-type byte widths for collective payload accounting
+    _HLO_BYTES = {"pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1,
+                  "f8e5m2": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+                  "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                  "f64": 8, "c64": 8, "c128": 16}
+
+    def collective_analysis(self, program: Optional[Program] = None,
+                            feed: Optional[Dict[str, Any]] = None,
+                            fetch_list: Optional[Sequence] = None,
+                            scope: Optional[Scope] = None,
+                            mode: str = "infer") -> Dict[str, Any]:
+        """MEASURED collective traffic of one SPMD step: the program is
+        lowered under the active mesh with run()'s exact input shardings
+        (feeds batch-sharded, persistables per their desc annotations),
+        and the partitioner's optimized HLO is scanned for collective
+        instructions — the ground truth the static estimator
+        (analysis/comms.estimate_comms) predicts from descs alone.
+        Returns {kind: {count, payload_bytes}} per collective kind plus
+        ``total_payload_bytes`` (sum of per-shard operand bytes) and the
+        mesh shape; {} without an active mesh (no partitioner, no
+        collectives).  Lowering only — nothing executes."""
+        import re
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel import mesh as _pmesh
+
+        mesh = _pmesh.current_mesh()
+        if mesh is None:
+            return {}
+        program = program or default_main_program()
+        block = program.desc.global_block()
+        feed, state_vals, step = self._prepare_step(program, feed,
+                                                    fetch_list, scope, mode)
+        feed_sh = {n: _pmesh.feed_sharding(mesh, v)
+                   for n, v in feed.items()}
+        state_sh = {
+            n: _pmesh.state_sharding(
+                mesh, v,
+                block.vars[n].sharding if n in block.vars else None)
+            for n, v in state_vals.items()}
+        in_sh = (feed_sh, state_sh, NamedSharding(mesh, PartitionSpec()))
+        # run()'s re-layout rule: state whose current placement disagrees
+        # with its annotation (e.g. loaded replicated) moves first, or
+        # lowering rejects the arg/sharding mismatch
+        for n, target in state_sh.items():
+            v = state_vals[n]
+            cur = getattr(v, "sharding", None)
+            if cur is not None and not isinstance(v, SeqArray) \
+                    and cur != target:
+                state_vals[n] = jax.device_put(v, target)
+        lowered = jax.jit(step, donate_argnums=(1,),
+                          in_shardings=in_sh).lower(
+            feed, state_vals, np.zeros(2, np.int32))
+        hlo = lowered.compile().as_text()
+        kinds = ("all-reduce", "all-gather", "reduce-scatter",
+                 "all-to-all", "collective-permute")
+        head = re.compile(
+            r"=\s+(\(?[a-z0-9\[\],{}\s/]*\)?)\s+(" + "|".join(kinds)
+            + r")(?:-start)?\(")
+        shape = re.compile(r"([a-z]\d*[a-z0-9]*)\[([0-9,]*)\]")
+        per_kind: Dict[str, Dict[str, float]] = {}
+        total = 0.0
+        for line in hlo.splitlines():
+            m = head.search(line)
+            if not m:
+                continue
+            result, kind = m.group(1), m.group(2)
+            payload = 0.0
+            for dt, dims in shape.findall(result):
+                width = self._HLO_BYTES.get(dt)
+                if width is None:
+                    continue
+                n = 1
+                for d in dims.split(","):
+                    if d:
+                        n *= int(d)
+                payload += n * width
+            d = per_kind.setdefault(kind,
+                                    {"count": 0, "payload_bytes": 0.0})
+            d["count"] += 1
+            d["payload_bytes"] += payload
+            total += payload
+        return {
+            "per_kind": per_kind,
+            "total_payload_bytes": total,
+            "mesh_axes": {str(a): int(s) for a, s in mesh.shape.items()},
+        }
+
     def device_time_per_step(self, program: Optional[Program] = None,
                              feed: Optional[Dict[str, Any]] = None,
                              fetch_list: Optional[Sequence] = None,

@@ -35,18 +35,27 @@ def _nm(prefix, key):
     return None if prefix is None else f"{prefix}.{key}"
 
 
+def _shard_axis(mp_shard):
+    """Mesh axis name for tensor-parallel params: ``True`` keeps the
+    training default 'mp'; a string names the axis directly (the serving
+    batch × model mesh passes 'model')."""
+    return mp_shard if isinstance(mp_shard, str) else "mp"
+
+
 def _col_attr(mp_shard, name=None):
     if name is None and not mp_shard:
         return None
     return ParamAttr(name=name,
-                     sharding=(None, "mp") if mp_shard else None)
+                     sharding=(None, _shard_axis(mp_shard))
+                     if mp_shard else None)
 
 
 def _row_attr(mp_shard, name=None):
     if name is None and not mp_shard:
         return None
     return ParamAttr(name=name,
-                     sharding=("mp", None) if mp_shard else None)
+                     sharding=(_shard_axis(mp_shard), None)
+                     if mp_shard else None)
 
 
 def _plain_attr(name):
@@ -436,7 +445,8 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=6,
 
     proj_attr = ParamAttr(name=(_nm(param_prefix, "vocab_proj.w")
                                 or unique_name.generate("vocab_proj_w")),
-                          sharding=(None, "mp") if mp_shard else None)
+                          sharding=(None, _shard_axis(mp_shard))
+                          if mp_shard else None)
     predict = layers.fc(input=dec_output, size=trg_vocab_size,
                         num_flatten_dims=2, bias_attr=False,
                         param_attr=proj_attr)
@@ -553,7 +563,7 @@ def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
                         enc_pages, cross_pages, w_offsets, pool,
                         src_vocab_size, max_length, n_layer, n_head, d_key,
                         d_value, d_model, d_inner_hid, param_prefix,
-                        kv_scales=None):
+                        kv_scales=None, mp_shard=False):
     """One chunked-prefill tower step: encode up to C source tokens per
     lane CAUSALLY against the lane's paged encoder-KV prefix, and
     project + page-write the chunk's cross-attention K/V.
@@ -584,8 +594,8 @@ def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
               "layer": i, "n_layer": n_layer, "scales": kv_scales}
              for i in range(n_layer)]
     enc_chunk = encoder(emb, None, n_layer, n_head, d_key, d_value,
-                        d_model, d_inner_hid, 0.0, prefix=param_prefix,
-                        paged_caches=paged)
+                        d_model, d_inner_hid, 0.0, mp_shard=mp_shard,
+                        prefix=param_prefix, paged_caches=paged)
     b, c = enc_chunk.shape[0], enc_chunk.shape[1]
 
     def heads(x, d_head):
@@ -593,12 +603,14 @@ def paged_prefill_chunk(pf_word, pf_pos, pf_base, pf_len, enc_table,
 
     for i in range(n_layer):
         pre = _nm(param_prefix, f"dec{i}.cross")
+        # column-sharded like every other K/V projection: the written
+        # pool rows stay aligned with the pool's head-axis partition
         k = layers.fc(input=enc_chunk, size=d_key * n_head,
                       bias_attr=False, num_flatten_dims=2,
-                      param_attr=_plain_attr(_nm(pre, "k.w")))
+                      param_attr=_col_attr(mp_shard, _nm(pre, "k.w")))
         v = layers.fc(input=enc_chunk, size=d_value * n_head,
                       bias_attr=False, num_flatten_dims=2,
-                      param_attr=_plain_attr(_nm(pre, "v.w")))
+                      param_attr=_col_attr(mp_shard, _nm(pre, "v.w")))
         if kv_scales is not None:
             pool, kv_scales = layers.quantized_paged_cache_write(
                 pool, kv_scales, heads(k, d_key), heads(v, d_value),
@@ -615,7 +627,7 @@ def paged_decode_step(trg_word, trg_pos, self_table, self_pages,
                       self_offsets, self_lengths, self_base, cross_table,
                       src_lengths, pool, trg_vocab_size, max_length,
                       n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
-                      param_prefix, kv_scales=None):
+                      param_prefix, kv_scales=None, mp_shard=False):
     """One paged incremental decode step — the page-indirected analog of
     ``decode_step``: each lane's token K/V lands in its self pages
     (``self_pages``/``self_offsets`` [b, 1] int32) and attention walks
@@ -629,14 +641,15 @@ def paged_decode_step(trg_word, trg_pos, self_table, self_pages,
                        src_lengths, pool, trg_vocab_size, max_length,
                        n_layer, n_head, d_key, d_value, d_model,
                        d_inner_hid, param_prefix, kv_scales=kv_scales,
-                       n_tokens=1)
+                       n_tokens=1, mp_shard=mp_shard)
 
 
 def verify_step(trg_word, trg_pos, self_table, self_pages, self_offsets,
                 self_lengths, self_base, cross_table, src_lengths, pool,
                 trg_vocab_size, max_length, n_layer, n_head, d_key,
                 d_value, d_model, d_inner_hid, param_prefix,
-                kv_scales=None, n_tokens=1, logit_mask=None):
+                kv_scales=None, n_tokens=1, logit_mask=None,
+                mp_shard=False):
     """Score ``n_tokens`` candidate positions per lane in ONE dispatch —
     the target half of speculative decoding (ISSUE 15).
 
@@ -683,8 +696,13 @@ def verify_step(trg_word, trg_pos, self_table, self_pages, self_offsets,
                      for i in range(n_layer)]
     dec_output = decoder(emb, None, None, None, n_layer, n_head, d_key,
                          d_value, d_model, d_inner_hid, 0.0,
-                         prefix=param_prefix, paged_caches=paged_caches,
+                         mp_shard=mp_shard, prefix=param_prefix,
+                         paged_caches=paged_caches,
                          paged_crosses=paged_crosses)
+    # vocab_proj stays REPLICATED even when mp_shard is set: dec_output
+    # is replicated after the row-sharded out/fc2 allreduce, and a
+    # replicated logits matmul keeps the serving argmax bitwise equal to
+    # the single-chip engine (the token-for-token parity guarantee)
     logits = layers.fc(input=dec_output, size=trg_vocab_size,
                        num_flatten_dims=2, bias_attr=False,
                        param_attr=_plain_attr(

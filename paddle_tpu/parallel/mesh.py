@@ -61,8 +61,9 @@ def mesh_guard(mesh: Mesh):
 
 
 def _dp_axes(mesh: Mesh):
-    """Axes used for batch sharding: 'dp' if present, else none."""
-    return [a for a in ("dp",) if a in mesh.axis_names]
+    """Axes used for batch sharding: 'dp' (training) or 'batch' (the
+    serving batch × model mesh), whichever is present, else none."""
+    return [a for a in ("dp", "batch") if a in mesh.axis_names]
 
 
 def feed_sharding(mesh: Mesh, value):

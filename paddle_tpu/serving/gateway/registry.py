@@ -48,7 +48,7 @@ from ...utils.sync import (RANK_COLLECTOR_INIT, RANK_MODEL_REGISTRY,
 from ..engine import DEFAULT_BATCH_BUCKETS, InferenceEngine
 from ..paged_decoder import (PagedTransformerGenerator, _CACHE_MARKERS,
                              estimate_generator_hbm)
-from ..scheduler import HBMBudgetError
+from ..scheduler import HBMBudgetError, suggest_model_axis
 from ..speculative import SpeculativeGenerator, estimate_speculative_hbm
 
 __all__ = ["HBMBudgetError", "ModelRegistry", "MANIFEST_NAME",
@@ -69,7 +69,8 @@ _GENERATOR_KEYS = (
     "src_vocab_size", "trg_vocab_size", "n_layer", "n_head", "d_key",
     "d_value", "d_model", "d_inner_hid", "max_length", "src_len",
     "max_out_len", "param_prefix", "start_id", "end_id", "page_size",
-    "num_pages", "chunk_size", "prefix_sharing", "topk_size", "kv_dtype")
+    "num_pages", "chunk_size", "prefix_sharing", "topk_size", "kv_dtype",
+    "mesh_axes")
 
 _LIVE_REGISTRIES: "weakref.WeakSet[ModelRegistry]" = weakref.WeakSet()
 _collector_lock = OrderedLock("obs.collector_init", RANK_COLLECTOR_INIT)
@@ -206,6 +207,8 @@ class ModelRegistry:
             "topk_size": generator.topk_size,
             "kv_dtype": generator.kv_dtype,
         }
+        if generator.mesh_axes:
+            cfg["mesh_axes"] = dict(generator.mesh_axes)
         prog = generator._unified[0]
 
         def writer(staging: str) -> None:
@@ -252,11 +255,17 @@ class ModelRegistry:
             if components:
                 detail = " (" + ", ".join(
                     f"{k}={v}" for k, v in components.items() if v) + ")"
+            avail = self.hbm_budget_bytes - used
+            ax = suggest_model_axis(components, avail)
+            hint = ("" if ax is None else
+                    f", or shard it: a mesh model-axis of {ax} fits "
+                    f"per-shard — load with mesh_axes={{'model': {ax}}}")
             raise HBMBudgetError(
                 f"loading {what} needs {cost} static peak-HBM bytes"
-                f"{detail} but only {self.hbm_budget_bytes - used} of "
+                f"{detail} but only {avail} of "
                 f"{self.hbm_budget_bytes} remain "
-                f"({used} in use) — unload a version first")
+                f"({used} in use) — unload a version first{hint}",
+                suggested_model_axis=ax)
 
     @staticmethod
     def _estimate_cost_detail(kind: str, dirname: Optional[str],

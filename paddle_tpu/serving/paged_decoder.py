@@ -33,6 +33,7 @@ test_paged_serving.py) when both run the causal-encoder feeds.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -48,7 +49,7 @@ from .paging import (PageAllocator, PoolCapacityError, TRASH_PAGE,
 
 __all__ = ["PagedTransformerGenerator", "copy_weights", "kv_page_bytes",
            "build_unified_program", "estimate_generator_hbm",
-           "default_num_pages"]
+           "default_num_pages", "model_axis_of", "check_shardable"]
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -126,11 +127,48 @@ def default_num_pages(src_len: int, max_out_len: int,
     return 8 * (2 * p_src + p_out) + 1
 
 
+# mesh axes reserved for batch (data) sharding on the serving mesh —
+# everything else is a tensor-parallel (model) axis
+_BATCH_AXES = ("dp", "batch")
+
+
+def model_axis_of(mesh_axes: Optional[Dict[str, int]]) -> Optional[str]:
+    """The tensor-parallel axis of a ``{'batch': nb, 'model': nm}``
+    serving mesh spec: the first non-batch axis with extent > 1, or
+    None (pure data parallelism / single chip — the unsharded
+    program)."""
+    if not mesh_axes:
+        return None
+    for ax, n in mesh_axes.items():
+        if ax not in _BATCH_AXES and int(n) > 1:
+            return ax
+    return None
+
+
+def check_shardable(cfg: _Cfg, mesh_axes: Dict[str, int]) -> None:
+    """Refuse mesh specs the head-sharded serving program cannot
+    partition evenly: the pool's head axis, the fc column extents, and
+    the MLP inner width must all divide the model-axis size (GSPMD
+    would silently replicate a non-divisible dim, breaking the
+    per-shard HBM plan the admission path budgets with)."""
+    ax = model_axis_of(mesh_axes)
+    if ax is None:
+        return
+    n = int(mesh_axes[ax])
+    for what, extent in (("n_head", cfg.n_head),
+                         ("d_inner_hid", cfg.d_inner_hid)):
+        if extent % n:
+            raise ValueError(
+                f"mesh axis {ax}={n} cannot shard the model: {what}="
+                f"{extent} is not divisible by {n}")
+
+
 def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
                           page_size: int, num_pages: int, chunk_size: int,
                           param_prefix: str, kv_dtype: str = "float32",
                           verify_tokens: int = 1,
-                          logit_masks: bool = False):
+                          logit_masks: bool = False,
+                          shard_axis: Optional[str] = None):
     """Build the unified prefill+decode program DESC — pure Python, no
     device allocation, no scope.  The generator's ``_build_unified``
     calls this with its own config; the gateway registry calls it with
@@ -150,7 +188,15 @@ def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
     ``logit_masks=True`` adds a ``logit_mask`` [b, K, vocab] additive
     float32 feed applied to the logits before the argmax — constrained
     generation with masks as DATA (a grammar change never recompiles).
-    The defaults build the exact PR 6 program, byte for byte."""
+    ``shard_axis`` (ISSUE 17) annotates the program for a tensor-
+    parallel mesh axis of that name: the pool partitions on its head
+    axis, QKV/O and the MLP carry Megatron column/row shardings (the
+    attention-output allreduce lands in-graph via GSPMD), the int8
+    scale sidecar and all paging feeds stay replicated DATA, and the
+    vocab head stays replicated for bitwise argmax parity.  The
+    annotations are desc-level — the program still runs unsharded when
+    no mesh is active.  The defaults build the exact PR 6 program,
+    byte for byte."""
     c = cfg
     C = int(chunk_size)
     K = int(verify_tokens)
@@ -165,8 +211,18 @@ def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
         pool = block.create_var(name=f"{param_prefix}@kv_pool",
                                 shape=pool_shape, dtype=kv_dtype,
                                 persistable=True)
+        if shard_axis:
+            # [h, R, page_size, d] partitions on the head axis; the
+            # per-token page scatters and the ragged attention walk are
+            # head-parallel, so every shard pages its own slice of the
+            # pool against the SAME replicated block tables
+            pool.set_sharding((shard_axis, None, None, None))
         kv_scales = None
         if kv_dtype == "int8":
+            # the sidecar stays replicated: one scale per (row, slot)
+            # is the max over ALL heads, which GSPMD reduces with an
+            # exact allreduce-max — int8 bytes stay bitwise identical
+            # to the single-chip pool
             kv_scales = block.create_var(
                 name=f"{param_prefix}@kv_scales", shape=scales_shape,
                 dtype="float32", persistable=True)
@@ -183,7 +239,7 @@ def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
             cross_pages, w_offsets, pool, c.src_vocab_size,
             c.max_length, c.n_layer, c.n_head, c.d_key, c.d_value,
             c.d_model, c.d_inner_hid, param_prefix,
-            kv_scales=kv_scales)
+            kv_scales=kv_scales, mp_shard=shard_axis or False)
         trg_word = layers.data("trg_word", [K], "int64")
         trg_pos = layers.data("trg_pos", [K], "int64")
         self_table = layers.data("self_table", [p_out], "int32")
@@ -201,7 +257,8 @@ def build_unified_program(cfg: _Cfg, *, src_len: int, max_out_len: int,
             self_lengths, self_base, cross_table, src_lengths, pool,
             c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
             c.d_key, c.d_value, c.d_model, c.d_inner_hid, param_prefix,
-            kv_scales=kv_scales, n_tokens=K, logit_mask=logit_mask)
+            kv_scales=kv_scales, n_tokens=K, logit_mask=logit_mask,
+            mp_shard=shard_axis or False)
         next_ids = layers.argmax(logits, axis=-1)
     return prog, startup, next_ids, logits
 
@@ -214,7 +271,8 @@ HBM_ESTIMATE_LANES = 8
 def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
                            assume_donation: bool = True,
                            verify_tokens: int = 1,
-                           logit_masks: bool = False):
+                           logit_masks: bool = False,
+                           mesh_axes: Optional[Dict[str, int]] = None):
     """Static peak-HBM plan for a paged generator described by a
     gateway manifest config — built and planned as a DESC, before any
     device allocation.  Params, the KV pool, and the int8 scale sidecar
@@ -225,7 +283,11 @@ def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
     ``verify_tokens``/``logit_masks`` (ISSUE 15) price the speculative
     VERIFY shape of the program — K-token activations and the
     [lanes, K, vocab] mask feed are real peak-HBM contributors the
-    admission budget must cover.  Returns the
+    admission budget must cover.  ``mesh_axes`` (ISSUE 17, also read
+    from ``config["mesh_axes"]``) prices the PER-SHARD footprint of
+    the sharded program: the pool and the column/row-sharded params
+    scale by the model-axis extent while paging state and activations
+    stay charged replicated.  Returns the
     ``analysis.cost.ProgramMemoryPlan``."""
     from ..fluid.analysis.cost import plan_program
 
@@ -244,17 +306,24 @@ def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
     num_pages = config.get("num_pages")
     if num_pages is None:
         num_pages = default_num_pages(src_len, max_out_len, page_size)
+    if mesh_axes is None:
+        mesh_axes = config.get("mesh_axes")
+    shard_axis = model_axis_of(mesh_axes)
+    if shard_axis is not None:
+        check_shardable(cfg, mesh_axes)
     prog, _, _, _ = build_unified_program(
         cfg, src_len=src_len, max_out_len=max_out_len,
         page_size=page_size, num_pages=int(num_pages),
         chunk_size=int(config.get("chunk_size", 8)),
         param_prefix=str(config.get("param_prefix", "tf")),
         kv_dtype=str(config.get("kv_dtype", "float32")),
-        verify_tokens=int(verify_tokens), logit_masks=bool(logit_masks))
+        verify_tokens=int(verify_tokens), logit_masks=bool(logit_masks),
+        shard_axis=shard_axis)
     lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
         else int(assume_lanes)
     return plan_program(prog, assume_batch=lanes,
-                        assume_donation=assume_donation)
+                        assume_donation=assume_donation,
+                        mesh_axes=mesh_axes)
 
 
 class _Lane:
@@ -306,7 +375,7 @@ class PagedTransformerGenerator:
                  param_prefix="tf", start_id=0, end_id=1,
                  page_size=8, num_pages=None, chunk_size=8,
                  prefix_sharing=True, topk_size=None,
-                 kv_dtype="float32"):
+                 kv_dtype="float32", mesh=None, mesh_axes=None):
         if d_key != d_value:
             raise ValueError("paged KV pool requires d_key == d_value "
                              "(one pool row shape serves both)")
@@ -315,6 +384,26 @@ class PagedTransformerGenerator:
                              f"{sorted(_KV_ITEMSIZE)}")
         self.cfg = _Cfg(src_vocab_size, trg_vocab_size, n_layer, n_head,
                         d_key, d_value, d_model, d_inner_hid, max_length)
+        # tensor-parallel serving (ISSUE 17): a batch × model mesh —
+        # pass either a built jax Mesh or an axes spec like
+        # {'batch': 1, 'model': 2} (the manifest form; make_mesh builds
+        # it over the attached devices).  With neither, the engine is
+        # the exact single-chip PR 6 program.
+        if mesh is not None and mesh_axes is None:
+            mesh_axes = dict(mesh.shape)
+        self.mesh_axes = ({ax: int(n) for ax, n in mesh_axes.items()}
+                          if mesh_axes else None)
+        self.shard_axis = model_axis_of(self.mesh_axes)
+        if self.mesh_axes and any(int(n) > 1
+                                  for n in self.mesh_axes.values()):
+            check_shardable(self.cfg, self.mesh_axes)
+            if mesh is None:
+                from ..parallel.mesh import make_mesh
+
+                mesh = make_mesh(self.mesh_axes)
+        else:
+            mesh = None
+        self.mesh = mesh
         self.src_len = int(src_len)
         self.max_out_len = int(max_out_len)
         self.prefix = param_prefix
@@ -353,20 +442,45 @@ class PagedTransformerGenerator:
         self._build_unified()
         self._reset_pool()
 
+    # -- mesh dispatch -------------------------------------------------------
+    def _mesh_ctx(self):
+        """Every device dispatch of a sharded generator runs under its
+        mesh: the executor keys executables on the mesh content and
+        applies the program's sharding annotations as jit in_shardings
+        (the pjit path — one compile per mesh shape, cached and
+        AOT-persistable like any other executable)."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from ..parallel.mesh import mesh_guard
+
+        return mesh_guard(self.mesh)
+
     # -- device pool ---------------------------------------------------------
     def _reset_pool(self):
         import jax.numpy as jnp
 
-        self.scope.set_var(self._pool_name,
-                           jnp.zeros(self._pool_shape, self.kv_dtype))
+        pool = jnp.zeros(self._pool_shape, self.kv_dtype)
+        if self.mesh is not None:
+            # lay the pool out sharded from birth: a pool sized for the
+            # MESH (num_pages beyond one chip's HBM) must never
+            # materialise single-device
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            pool = jax.device_put(pool, NamedSharding(
+                self.mesh, PartitionSpec(self.shard_axis)))
+        self.scope.set_var(self._pool_name, pool)
         if self.kv_dtype == "int8":
             self.scope.set_var(self._scales_name,
                                jnp.zeros(self._scales_shape, jnp.float32))
 
     def _pool_var(self, block):
-        return block.create_var(name=self._pool_name,
-                                shape=list(self._pool_shape),
-                                dtype=self.kv_dtype, persistable=True)
+        v = block.create_var(name=self._pool_name,
+                             shape=list(self._pool_shape),
+                             dtype=self.kv_dtype, persistable=True)
+        if self.shard_axis:
+            v.set_sharding((self.shard_axis, None, None, None))
+        return v
 
     def _scales_var(self, block):
         """The int8 pool's fp32 block-scale sidecar (None for float
@@ -390,7 +504,7 @@ class PagedTransformerGenerator:
             self.cfg, src_len=self.src_len, max_out_len=self.max_out_len,
             page_size=self.page_size, num_pages=self.num_pages,
             chunk_size=self.chunk, param_prefix=self.prefix,
-            kv_dtype=self.kv_dtype)
+            kv_dtype=self.kv_dtype, shard_axis=self.shard_axis)
 
     def _build_beam_step(self, W: int):
         """Paged beam step: in-dispatch copy-on-write page copies, the
@@ -429,7 +543,8 @@ class PagedTransformerGenerator:
                 self_lengths, self_base, cross_table, src_lengths, pool,
                 c.trg_vocab_size, c.max_length, c.n_layer, c.n_head,
                 c.d_key, c.d_value, c.d_model, c.d_inner_hid, self.prefix,
-                kv_scales=kv_scales)
+                kv_scales=kv_scales,
+                mp_shard=self.shard_axis or False)
             probs = layers.softmax(
                 layers.reshape(logits, [-1, W, c.trg_vocab_size]))
             topk_scores, topk_idx = layers.topk(probs, k=K)
@@ -457,7 +572,7 @@ class PagedTransformerGenerator:
         vocab head)."""
         if seed is not None:
             self._unified[1].random_seed = seed
-        with fluid.scope_guard(self.scope):
+        with fluid.scope_guard(self.scope), self._mesh_ctx():
             self.exe.run(self._unified[1])
 
     # -- admission accounting ------------------------------------------------
@@ -735,7 +850,7 @@ class PagedTransformerGenerator:
                 decoding.append(slot)
         prog, _, next_ids, _logits = self._unified
         feed.update(dec)
-        with fluid.scope_guard(self.scope):
+        with fluid.scope_guard(self.scope), self._mesh_ctx():
             nxt, = self.exe.run(prog, feed=feed, fetch_list=[next_ids],
                                 return_numpy=False, mode="infer")
         ids = np.asarray(nxt).reshape(B)
@@ -828,7 +943,7 @@ class PagedTransformerGenerator:
         score_steps = [pre_scores]
         parent_steps = [np.zeros((b, W), np.int32)]
         try:
-            with fluid.scope_guard(self.scope):
+            with fluid.scope_guard(self.scope), self._mesh_ctx():
                 for t in range(max_new):
                     off = t % ps
                     cow_src = np.full(bw, TRASH_PAGE, np.int32)
@@ -975,12 +1090,18 @@ class PagedTransformerGenerator:
         lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
             else int(assume_lanes)
         donation = self.exe._aot_cache() is None
-        key = ("_hbm_plan", lanes, donation)
+        mesh_key = None if self.mesh_axes is None \
+            else tuple(sorted(self.mesh_axes.items()))
+        key = ("_hbm_plan", lanes, donation, mesh_key)
         cached = getattr(self, "_static_hbm_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
+        # per-shard plan: a sharded generator budgets what ONE device
+        # holds (the admission criterion ISSUE 17 flips from "fits one
+        # chip" to "fits one shard")
         plan = plan_program(self._unified[0], assume_batch=lanes,
-                            assume_donation=donation)
+                            assume_donation=donation,
+                            mesh_axes=self.mesh_axes)
         self._static_hbm_cache = (key, plan)
         return plan
 
@@ -1008,4 +1129,67 @@ class PagedTransformerGenerator:
                 if active else 0,
                 "dense_bytes_per_slot": self.kv_bytes_per_slot_dense(),
             },
+            "shard": self.shard_plan(),
         }
+
+    def shard_plan(self) -> Dict[str, object]:
+        """The mesh/sharding summary observability and admission share:
+        mesh axes, model-shard count, and the pool bytes ONE shard
+        holds (the head-axis partition divides the pool exactly; the
+        int8 sidecar replicates, so it is charged in full per shard)."""
+        n_shards = (self.mesh_axes or {}).get(self.shard_axis, 1) \
+            if self.shard_axis else 1
+        pool_bytes = self.page_bytes * self.num_pages
+        if self.kv_dtype == "int8":
+            # split pool data (head-sharded) from the replicated sidecar
+            rows = 2 * self.cfg.n_layer * self.num_pages
+            sidecar = rows * self.page_size * 4
+            per_shard = (pool_bytes - sidecar) // n_shards + sidecar
+        else:
+            per_shard = pool_bytes // n_shards
+        return {
+            "mesh_axes": dict(self.mesh_axes) if self.mesh_axes else None,
+            "shard_axis": self.shard_axis,
+            "n_model_shards": int(n_shards),
+            "pool_bytes_per_shard": int(per_shard),
+        }
+
+    def collective_report(self) -> Dict[str, object]:
+        """Predicted vs MEASURED collective traffic of the unified
+        serving step on this generator's mesh: the static estimator
+        (analysis/comms.estimate_comms) prices the TP partial-sum
+        all-reduces from desc shardings alone, and the executor lowers
+        the SAME program under the mesh and tallies the partitioner's
+        actual collective instructions from the optimized HLO
+        (Executor.collective_analysis).  The pair is the bench's
+        honesty gate for the comms estimator.  Unsharded generators
+        report an empty measured block (no partitioner, no
+        collectives).  Lowering only — no KV state changes."""
+        from ..fluid.analysis.comms import estimate_comms
+
+        prog, _, next_ids, _ = self._unified
+        lanes = self._slots or 1
+        pred = estimate_comms(
+            prog, options={"mesh_axes": dict(self.mesh_axes or {}),
+                           "assume_batch": lanes})
+        out: Dict[str, object] = {
+            "predicted": {
+                "allreduce_count": len(pred.collectives),
+                "allreduce_payload_bytes": float(sum(
+                    c["payload_bytes"] for c in pred.collectives
+                    if c["kind"].startswith("allreduce"))),
+                "per_axis": {a: dict(d)
+                             for a, d in pred.per_axis.items()},
+            },
+            "measured": {},
+        }
+        if self.mesh is None:
+            return out
+        if not self._slots:
+            raise RuntimeError("open_slots() before collective_report()")
+        feed = self._prefill_arrays()
+        feed.update(self._decode_arrays())
+        with fluid.scope_guard(self.scope), self._mesh_ctx():
+            out["measured"] = self.exe.collective_analysis(
+                prog, feed=feed, fetch_list=[next_ids], mode="infer")
+        return out

@@ -82,7 +82,8 @@ from ..utils.sync import (RANK_COLLECTOR_INIT, RANK_SCHEDULER,
 from .paging import PoolCapacityError
 
 __all__ = ["Request", "ContinuousBatchingScheduler", "RequestCancelled",
-           "SchedulerShutdown", "HBMBudgetError", "DEFAULT_MODEL"]
+           "SchedulerShutdown", "HBMBudgetError", "suggest_model_axis",
+           "DEFAULT_MODEL"]
 
 DEFAULT_MODEL = "default"
 
@@ -92,7 +93,48 @@ class HBMBudgetError(RuntimeError):
     unload something (or raise the budget) first.  Raised by both the
     scheduler's ``add_model`` (when constructed with
     ``hbm_budget_bytes``) and the gateway registry's costed load; the
-    message carries the static planner's per-component breakdown."""
+    message carries the static planner's per-component breakdown.
+    When tensor-parallel sharding would make the model fit,
+    ``suggested_model_axis`` carries the smallest mesh ``model``-axis
+    size whose per-shard footprint fits the remaining budget (None
+    when nothing shards or no considered axis size helps)."""
+
+    def __init__(self, message, suggested_model_axis=None):
+        super().__init__(message)
+        self.suggested_model_axis = suggested_model_axis
+
+
+# plan components that divide across the mesh 'model' axis: parameters
+# (column/row-sharded matmul weights) and the head-sharded KV pool.
+# Activations and feeds are priced replicated — the static planner's
+# own conservative rule — so a suggestion never overpromises.
+_SHARDABLE_COMPONENTS = ("params", "kv_pool")
+
+
+def suggest_model_axis(components, available, max_axis=64):
+    """Smallest power-of-two mesh ``model``-axis size whose PER-SHARD
+    static footprint fits ``available`` bytes, computed from a refused
+    plan's per-component breakdown (speculative plans prefix components
+    with ``target.``/``draft.`` — the suffix is what shards).  Returns
+    None when nothing shards or even ``max_axis`` shards stay over
+    budget."""
+    if not components:
+        return None
+    available = int(available)
+    shardable = fixed = 0
+    for k, v in components.items():
+        if k.split(".")[-1] in _SHARDABLE_COMPONENTS:
+            shardable += int(v)
+        else:
+            fixed += int(v)
+    if shardable <= 0 or fixed > available:
+        return None
+    n = 2
+    while n <= max_axis:
+        if fixed + -(-shardable // n) <= available:
+            return n
+        n *= 2
+    return None
 
 # tokens-per-request is a count histogram, not a latency one
 _TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
@@ -121,6 +163,7 @@ def _collect_scheduler_metrics():
     from ..observability.metrics import Sample
 
     queued = active = free = total = 0
+    shard_rows = []
     for s in list(_LIVE_SCHEDULERS):
         try:
             with s._lock:
@@ -129,6 +172,9 @@ def _collect_scheduler_metrics():
                     active += len(g.active)
                     free += len(g.free)
                     total += g.n_slots
+                    fn = getattr(g.model, "shard_plan", None)
+                    if callable(fn):
+                        shard_rows.append((g.key, fn()))
         except Exception:
             continue
     yield Sample("paddle_serving_queue_depth", "gauge", (),
@@ -144,6 +190,17 @@ def _collect_scheduler_metrics():
     yield Sample("paddle_serving_slot_utilization", "gauge", (),
                  active / max(1, total),
                  "Occupied fraction of all live schedulers' lanes")
+    # per-shard KV pool residency: one sample per mesh model-axis shard
+    # (shard "0" with the full pool for unsharded groups), so a scrape
+    # shows what each chip actually holds, not the global pool size
+    for key, plan in shard_rows:
+        n = max(1, int(plan.get("n_model_shards", 1)))
+        per_shard = float(plan.get("pool_bytes_per_shard", 0))
+        for i in range(n):
+            yield Sample("paddle_serving_shard_pool_bytes", "gauge",
+                         (("model", key), ("shard", str(i))), per_shard,
+                         "KV pool bytes resident on each mesh "
+                         "model-axis shard")
 
 
 def _register_scheduler_collector() -> None:
@@ -392,23 +449,32 @@ class ContinuousBatchingScheduler:
         reserved = 0
         if self.hbm_budget_bytes is not None:
             est = hbm_bytes
+            comp = None
             if est is None:
                 fn = getattr(model, "static_hbm_estimate", None)
                 if callable(fn):
                     try:
-                        est = fn(assume_lanes=int(n_slots)).peak_bytes
+                        plan = fn(assume_lanes=int(n_slots))
                     except TypeError:
-                        est = fn().peak_bytes
+                        plan = fn()
+                    est = plan.peak_bytes
+                    comp = dict(getattr(plan, "components", None) or {})
             est = int(est or 0)
             with self._lock:
                 committed = self._hbm_committed_locked()
                 if committed + est > self.hbm_budget_bytes:
+                    avail = self.hbm_budget_bytes - committed
+                    ax = suggest_model_axis(comp, avail)
+                    hint = ("" if ax is None else
+                            f" — sharding over a mesh model-axis of "
+                            f"{ax} would fit per-shard; rebuild with "
+                            f"mesh_axes={{'model': {ax}}}")
                     raise HBMBudgetError(
                         f"model {key!r} needs ~{est} static peak-HBM "
-                        f"bytes but only "
-                        f"{self.hbm_budget_bytes - committed} of "
+                        f"bytes but only {avail} of "
                         f"{self.hbm_budget_bytes} remain "
-                        f"({committed} committed)")
+                        f"({committed} committed){hint}",
+                        suggested_model_axis=ax)
                 self._hbm_reserved += est
             reserved = est
             hbm_bytes = est
@@ -1065,6 +1131,9 @@ class ContinuousBatchingScheduler:
                     if hasattr(model, "kv_bytes_per_token")
                     else None),
             }
+            if hasattr(model, "shard_plan"):
+                # mesh shape + per-shard pool residency for /statusz
+                out["kv"]["shard"] = model.shard_plan()
         # latency percentiles cover successfully served requests only (a
         # request failed at admission has no admitted timestamp)
         ok = [r for r in done if r.error is None]

@@ -228,7 +228,7 @@ class SpeculativeGenerator:
             page_size=target.page_size, num_pages=target.num_pages,
             chunk_size=target.chunk, param_prefix=target.prefix,
             kv_dtype=target.kv_dtype, verify_tokens=self.verify_tokens,
-            logit_masks=True)
+            logit_masks=True, shard_axis=target.shard_axis)
         # the DRAFT's program: its own prefill tower + a masked 1-token
         # decode (constraints must shape the draft's guesses, or a
         # grammar would reject every speculative token)
@@ -236,7 +236,8 @@ class SpeculativeGenerator:
             dc, src_len=draft.src_len, max_out_len=draft.max_out_len,
             page_size=draft.page_size, num_pages=draft.num_pages,
             chunk_size=draft.chunk, param_prefix=draft.prefix,
-            kv_dtype=draft.kv_dtype, verify_tokens=1, logit_masks=True)
+            kv_dtype=draft.kv_dtype, verify_tokens=1, logit_masks=True,
+            shard_axis=draft.shard_axis)
         self._cow = None
 
     # -- parameter init ------------------------------------------------------
@@ -419,7 +420,8 @@ class SpeculativeGenerator:
             dst = np.full(B, TRASH_PAGE, np.int32)
             for j, (s, d) in enumerate(chunk):
                 src[j], dst[j] = s, d
-            with fluid.scope_guard(self.target.scope):
+            with fluid.scope_guard(self.target.scope), \
+                    self.target._mesh_ctx():
                 self.target.exe.run(prog, feed={"cow_src": src,
                                                 "cow_dst": dst},
                                     mode="infer")
@@ -528,7 +530,7 @@ class SpeculativeGenerator:
         feed.update(dec)
         feed["logit_mask"] = mask
         prog, _, next_ids, _ = self._draft_prog
-        with fluid.scope_guard(d.scope):
+        with fluid.scope_guard(d.scope), d._mesh_ctx():
             out, = d.exe.run(prog, feed=feed, fetch_list=[next_ids],
                              return_numpy=False, mode="infer")
         d._absorb_prefill()
@@ -567,7 +569,7 @@ class SpeculativeGenerator:
         feed.update(dec)
         feed["logit_mask"] = mask
         prog, _, next_ids, _ = self._verify
-        with fluid.scope_guard(tgt.scope):
+        with fluid.scope_guard(tgt.scope), tgt._mesh_ctx():
             out, = tgt.exe.run(prog, feed=feed, fetch_list=[next_ids],
                                return_numpy=False, mode="infer")
         tgt._absorb_prefill()
@@ -813,18 +815,22 @@ class SpeculativeGenerator:
 
         lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
             else int(assume_lanes)
+        tmesh = None if self.target.mesh_axes is None \
+            else tuple(sorted(self.target.mesh_axes.items()))
+        dmesh = None if self.draft.mesh_axes is None \
+            else tuple(sorted(self.draft.mesh_axes.items()))
         key = ("_spec_hbm", lanes,
                self.target.exe._aot_cache() is None,
-               self.draft.exe._aot_cache() is None)
+               self.draft.exe._aot_cache() is None, tmesh, dmesh)
         cached = getattr(self, "_static_hbm_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
         t = plan_program(self._verify[0], assume_batch=lanes,
                          assume_donation=self.target.exe._aot_cache()
-                         is None)
+                         is None, mesh_axes=self.target.mesh_axes)
         d = plan_program(self._draft_prog[0], assume_batch=lanes,
                          assume_donation=self.draft.exe._aot_cache()
-                         is None)
+                         is None, mesh_axes=self.draft.mesh_axes)
         plan = _CombinedPlan(t, d)
         self._static_hbm_cache = (key, plan)
         return plan
@@ -856,6 +862,8 @@ class SpeculativeGenerator:
             "hbm": dict(tstats["hbm"],
                         draft_pool_bytes=(self.draft.page_bytes
                                           * self.draft.num_pages)),
+            "shard": tstats["shard"],
+            "draft_shard": self.draft.shard_plan(),
             "steps": sp["verify_steps"],
             "speculative": sp,
         }

@@ -97,7 +97,13 @@ def precompile(dirname: str, n_slots: int = 4,
                     f"speculative pre-warm needs generator artifacts; "
                     f"the {what} at {d} is kind {kind!r}")
         tkey = reg.load("aot", "prewarm", dirname=dirname, **overrides)
-        dkey = reg.load("aotdraft", "prewarm", dirname=draft_dirname)
+        # the mesh override shapes BOTH halves: a sharded target with a
+        # replicated draft would warm executables the sharded gateway
+        # pair never dispatches
+        d_over = {k: v for k, v in overrides.items()
+                  if k == "mesh_axes"}
+        dkey = reg.load("aotdraft", "prewarm", dirname=draft_dirname,
+                        **d_over)
         target, draft = reg.instance(tkey), reg.instance(dkey)
         spec = SpeculativeGenerator(target, draft, k=int(speculate_k))
         spec.aot_warm(int(n_slots))
@@ -206,6 +212,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--speculate-k", type=int, default=4,
                     help="draft tokens per verify round (default 4; "
                          "must match the gateway's speculate_k)")
+    ap.add_argument("--mesh", action="append", default=None,
+                    metavar="AXIS=N",
+                    help="mesh axis for a SHARDED generator pre-warm, "
+                         "e.g. --mesh model=2 (repeatable; the "
+                         "executable cache salts keys with the mesh, "
+                         "so sharded and single-chip entries coexist)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -240,6 +252,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         overrides["batch_buckets"] = tuple(args.batch_bucket)
     if args.time_bucket is not None:
         overrides["time_bucket"] = args.time_bucket
+    if args.mesh:
+        mesh_axes = {}
+        for spec in args.mesh:
+            ax, _, n = spec.partition("=")
+            if not ax or not n.isdigit() or int(n) < 1:
+                print(f"aot_compile: bad --mesh {spec!r} (want AXIS=N)",
+                      file=sys.stderr)
+                return 2
+            mesh_axes[ax] = int(n)
+        overrides["mesh_axes"] = mesh_axes
     try:
         report = precompile(dirname, n_slots=args.n_slots,
                             max_time=args.max_time,

@@ -40,6 +40,13 @@ class FakeModel:
     def step_slots(self, tokens, pos, src_len):
         return np.full(self.n, 5, np.int64)
 
+    def shard_plan(self):
+        # mesh shape the scrape exposes per-shard (ISSUE 17): the
+        # collector emits one shard_pool_bytes sample per model shard
+        return {"mesh_axes": {"batch": 1, "model": 2},
+                "shard_axis": "model", "n_model_shards": 2,
+                "pool_bytes_per_shard": 4096.0}
+
 
 # -- registry ----------------------------------------------------------------
 
@@ -386,6 +393,13 @@ def test_endpoints_roundtrip_live_scrape_during_run():
         assert typed["paddle_guardrail_events_total"] == "counter"
         assert 'paddle_kv_pages{state="in_use"}' in text
         assert 'paddle_serving_requests_total{event="submitted"}' in text
+        # per-shard pool residency (ISSUE 17): one labeled sample per
+        # mesh model-axis shard of every live model
+        assert typed["paddle_serving_shard_pool_bytes"] == "gauge"
+        for shard in ("0", "1"):
+            assert re.search(
+                r'^paddle_serving_shard_pool_bytes\{model="default",'
+                rf'shard="{shard}"\}} 4096', text, re.M), text
 
         health = json.loads(_get(addr, "/healthz"))
         assert health["ok"] is True and health["uptime_s"] >= 0

@@ -280,6 +280,23 @@ with open(os.path.join(tmpdir, "serving_int8_ragged_step.json"), "wb") as f:
 with open(os.path.join(tmpdir, "serving_int8_ragged_step.fetch"), "w") as f:
     f.write(qids.name + "\n")
 
+# sharded sweep (ISSUE 17): the tensor-parallel unified decode-step
+# program — head-sharded QKV/O + column/row MLP partitions annotated on
+# the descs, the pool partitioned on its head axis — must stay
+# analyzer-clean, and the cost pass below prices it PER SHARD at
+# --mesh-axis model=2 (no devices needed: desc-level build only)
+from paddle_tpu.serving.paged_decoder import build_unified_program
+
+sh_prog, _, sh_ids, _ = build_unified_program(
+    pgen.cfg, src_len=8, max_out_len=8, page_size=4, num_pages=32,
+    chunk_size=4, param_prefix="tfsh", shard_axis="model")
+with open(os.path.join(tmpdir, "serving_sharded_ragged_step.json"),
+          "wb") as f:
+    f.write(sh_prog.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "serving_sharded_ragged_step.fetch"),
+          "w") as f:
+    f.write(sh_ids.name + "\n")
+
 # speculative sweep (ISSUE 15): the target's k-token VERIFY program
 # (per-lane token axis + logit-mask data feed) and the draft's
 # constrained decode-step program must both stay analyzer-clean —
@@ -400,6 +417,29 @@ EOF
         --fail-on unregistered-cost-rule --fail-on value-shape-op \
         $fetch_args || rc=1
   done
+
+  # sharded cost sweep (ISSUE 17): the tensor-parallel unified
+  # decode-step program priced PER SHARD at a model-axis of 2 — the
+  # admission criterion the sharded gateway budgets with.  Recompile
+  # hazards fail via the normal error exit; an op with no cost rule or
+  # a collective the comms pass cannot price fails via --fail-on.
+  name=serving_sharded_ragged_step
+  prog="$tmpdir/$name.json"
+  if [ -f "$prog" ]; then
+    fetch_args=""
+    while read -r v; do
+      [ -n "$v" ] && fetch_args="$fetch_args --fetch $v"
+    done < "$tmpdir/$name.fetch"
+    echo "-- plint --cost $name (--mesh-axis model=2)"
+    # shellcheck disable=SC2086
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m paddle_tpu.tools.plint "$prog" --cost --quiet \
+        --assume-batch 64 --batch-bucket 8 --mesh-axis model=2 \
+        --fail-on unregistered-cost-rule --fail-on value-shape-op \
+        $fetch_args || rc=1
+  else
+    echo "-- plint --cost $name: MISSING"; rc=1
+  fi
 fi
 
 if [ "$want_aot" = 1 ]; then
@@ -448,6 +488,56 @@ assert second["loads"] == second["signatures"], second
 assert second["keys"] == first["keys"], \
     f"cache keys not byte-stable: {first['keys']} vs {second['keys']}"
 print(f"aot sweep: {first['compiles']} compiled once, "
+      f"{second['loads']} loaded on rerun, keys byte-stable")
+EOF
+  rm -rf "$aot_tmp"
+
+  # sharded AOT round-trip (ISSUE 17): publish a paged generator
+  # artifact, aot_compile it with --mesh model=2 on a 2-virtual-device
+  # CPU mesh TWICE — the second run must perform zero compiles (the
+  # cache salts entry keys with the mesh, so sharded executables ship
+  # exactly like single-chip ones)
+  echo "== aot sweep: sharded generator through aot_compile --mesh twice"
+  aot_tmp="$(mktemp -d)"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$aot_tmp" <<'EOF' || rc=1
+import json, os, subprocess, sys
+
+tmpdir = sys.argv[1]
+from paddle_tpu import fluid
+from paddle_tpu.serving import PagedTransformerGenerator
+from paddle_tpu.serving.gateway import ModelRegistry
+
+gen = PagedTransformerGenerator(30, 30, n_layer=2, n_head=2, d_key=4,
+                                d_value=4, d_model=16, d_inner_hid=32,
+                                max_length=64, src_len=8, max_out_len=8,
+                                page_size=4, chunk_size=4, num_pages=32,
+                                param_prefix="tfsh",
+                                place=fluid.CPUPlace())
+gen.init_params(seed=0)
+ModelRegistry.save_generator_artifact(gen, tmpdir, "shgen", "1")
+dirname = fluid.io.model_version_dir(tmpdir, "shgen", "1")
+
+env = dict(os.environ,
+           JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+           XLA_FLAGS="--xla_force_host_platform_device_count=2 "
+                     + os.environ.get("XLA_FLAGS", ""))
+reports = []
+for run in (1, 2):
+    p = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.aot_compile",
+         "--dirname", dirname, "--n-slots", "2", "--mesh", "model=2",
+         "--json"],
+        env=env, capture_output=True, text=True)
+    assert p.returncode == 0, \
+        f"aot_compile --mesh run {run}: {p.stderr[-2000:]}"
+    reports.append(json.loads(p.stdout))
+first, second = reports
+assert first["compiles"] >= 1, first
+assert second["compiles"] == 0, \
+    f"second aot_compile --mesh run recompiled: {second}"
+assert second["keys"] == first["keys"], \
+    f"sharded cache keys not byte-stable: {first['keys']} vs {second['keys']}"
+print(f"sharded aot sweep: {first['compiles']} compiled once, "
       f"{second['loads']} loaded on rerun, keys byte-stable")
 EOF
   rm -rf "$aot_tmp"
