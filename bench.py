@@ -1770,6 +1770,67 @@ def bench_sharded_child() -> None:
     }))
 
 
+def bench_shardprop_child() -> None:
+    """Child half of the ``cost_model.shardprop`` sub-block (ISSUE 18)
+    — runs under 4 virtual CPU devices.  Times whole-program sharding
+    inference on the largest sharded program the bench builds (the
+    tensor-parallel unified decode step) against a 250 ms budget, and
+    diffs the inferred collective graph per kind against the payloads
+    ``Executor.collective_analysis`` counts in the compiled HLO.
+    Prints one JSON object on stdout."""
+    import time as _t
+
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid.analysis.shardprop import (compare_collectives,
+                                                     infer_sharding)
+    from paddle_tpu.parallel import mesh as pmesh
+    from paddle_tpu.serving.paged_decoder import PagedTransformerGenerator
+
+    trials = max(1, int(os.environ.get("BENCH_TRIALS", "2")))
+    budget_ms = float(os.environ.get("BENCH_SHARDPROP_BUDGET_MS", "250"))
+    lanes = 4
+    axes = {"batch": 1, "model": 2}
+    gen = PagedTransformerGenerator(
+        211, 211, n_layer=2, n_head=8, d_key=16, d_value=16,
+        d_model=128, d_inner_hid=256, max_length=128, src_len=32,
+        max_out_len=24, page_size=8, chunk_size=8, num_pages=128,
+        param_prefix="sp_bench", mesh_axes=axes,
+        place=fluid.TPUPlace(0))
+    gen.init_params(seed=0)
+    gen.open_slots(lanes)
+    prog, _, next_ids, _ = gen._unified
+    opts = {"mesh_axes": axes, "assume_batch": lanes}
+    fetch = [next_ids.name]
+
+    pred = infer_sharding(prog, options=opts, fetch=fetch)   # warm
+    best = float("inf")
+    for _ in range(trials):
+        t0 = _t.perf_counter()
+        pred = infer_sharding(prog, options=opts, fetch=fetch)
+        best = min(best, _t.perf_counter() - t0)
+
+    feed = gen._prefill_arrays()
+    feed.update(gen._decode_arrays(1))
+    with fluid.scope_guard(gen.scope), pmesh.mesh_guard(gen.mesh):
+        meas = gen.exe.collective_analysis(prog, feed=feed,
+                                           fetch_list=[next_ids],
+                                           mode="infer")
+    cmp = compare_collectives(pred.per_kind(), meas["per_kind"])
+    ms = round(best * 1000.0, 2)
+    print(json.dumps({
+        "program_ops": sum(len(b.ops) for b in prog.desc.blocks),
+        "mesh_axes": axes,
+        "analysis_ms": ms,
+        "budget_ms": budget_ms,
+        "within_budget": ms < budget_ms,
+        "errors": sum(1 for f in pred.findings
+                      if f.severity == "error"),
+        "per_kind": cmp["per_kind"],
+        "rel_err": cmp["rel_err"],
+        "match": cmp["match"],
+    }))
+
+
 def bench_sharded(trials: int) -> dict:
     """Tensor-parallel sharded serving (ISSUE 17): decoded tok/s +
     max-servable-model-size at 1/2/4 virtual devices, the zero-
@@ -2009,6 +2070,25 @@ def bench_cost_model(steps: int, trials: int):
     programs["paged_decode_step"]["registry_static_bytes"] = \
         gen.static_hbm_estimate(assume_lanes=lanes).peak_bytes
 
+    # -- shardprop differential + wall-time gate (ISSUE 18): the
+    # inference must be cheap enough for every-load preflights AND
+    # byte-exact against the partitioner.  Subprocess: the 4-virtual-
+    # device flag only takes effect before jax initializes.
+    import subprocess
+
+    sp_env = dict(
+        os.environ, BENCH_SHARDPROP_CHILD="1", JAX_PLATFORMS="cpu",
+        BENCH_TRIALS=str(trials),
+        XLA_FLAGS="--xla_force_host_platform_device_count=4 "
+                  + os.environ.get("XLA_FLAGS", ""))
+    p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=sp_env, capture_output=True, text=True,
+                       timeout=1800)
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"shardprop bench child failed: {p.stderr[-2000:]}")
+    shardprop = json.loads(p.stdout.strip().splitlines()[-1])
+
     hbm_ok = time_ok = True
     for name, row in programs.items():
         r = row.get("hbm_ratio")
@@ -2027,6 +2107,7 @@ def bench_cost_model(steps: int, trials: int):
                  "calibrated_gbps": round(chip.hbm_bw / 1e9, 2)},
         "band": {"hbm": hbm_band, "time": time_band},
         "programs": programs,
+        "shardprop": shardprop,
         "hbm_within_band": hbm_ok,
         "time_within_band": time_ok,
         "within_band": hbm_ok and time_ok,
@@ -2346,6 +2427,10 @@ def main() -> None:
         # re-exec'd by bench_sharded with virtual-device XLA_FLAGS in
         # place; print the sharded measurement JSON and stop
         bench_sharded_child()
+        return
+    if os.environ.get("BENCH_SHARDPROP_CHILD", "") == "1":
+        # re-exec'd by bench_cost_model for the shardprop differential
+        bench_shardprop_child()
         return
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     trials = max(1, int(os.environ.get("BENCH_TRIALS", "2")))
@@ -2760,6 +2845,13 @@ def main() -> None:
             # predicted-vs-measured drifted out of the declared band —
             # a failed run, same as a missing headline metric
             missing.append("cost_model_band")
+        elif cost_model.get("shardprop") is None:
+            missing.append("cost_model_shardprop")
+        elif not (cost_model["shardprop"]["within_budget"]
+                  and cost_model["shardprop"]["match"]):
+            # inference blew the wall-time budget or the inferred
+            # collective graph disagreed with the lowered HLO
+            missing.append("cost_model_shardprop_gate")
     if os.environ.get("BENCH_SKIP_QUALITY", "") != "1":
         if quality is None:
             missing.append("mnist_quality")

@@ -25,6 +25,12 @@ the liveness-based peak-HBM planner + roofline op cost model
 enumeration (recompile.py), and the sharded-collective estimator
 (comms.py).  Cost-family passes attach structured data to
 ``Diagnostics.reports`` alongside their findings.
+``"shard"`` runs the structural passes plus whole-program SPMD
+sharding propagation (shardprop.py): per-op rules infer a
+PartitionSpec for every var from the param/feed annotations alone,
+emit resharding-hazard / replicated-giant / partial-sum-unreduced /
+dp-grad-divergence findings, and hand the inferred collective graph
+to the comms estimator.
 """
 
 from __future__ import annotations
@@ -38,13 +44,19 @@ from .cost import (CHIP_SPECS, ChipSpec, OpCost, cost_rule, get_chip,
                    plan_program, roofline)
 from .comms import estimate_comms
 from .recompile import enumerate_buckets
+from .shardprop import (PROP_RULES, PROPAGATION_OPAQUE,
+                        compare_collectives, has_prop_rule,
+                        infer_sharding, prop_rule)
 
 __all__ = ["Diagnostics", "Finding", "ERROR", "WARNING", "INFO",
            "ProgramView", "block_liveness", "live_ops",
            "LEVELS", "analyze_program", "structural_errors",
            "ProgramValidationError", "ChipSpec", "CHIP_SPECS",
            "get_chip", "OpCost", "cost_rule", "plan_program",
-           "roofline", "estimate_comms", "enumerate_buckets"]
+           "roofline", "estimate_comms", "enumerate_buckets",
+           "prop_rule", "has_prop_rule", "PROP_RULES",
+           "PROPAGATION_OPAQUE", "infer_sharding",
+           "compare_collectives"]
 
 LEVELS = {
     "structural": ("structural", "dataflow", "grad_link", "sharding"),
@@ -52,6 +64,11 @@ LEVELS = {
              "shape_check"),
     "cost": ("structural", "dataflow", "grad_link", "sharding",
              "cost", "recompile", "comms"),
+    # sharding inference: structural truths + whole-program SPMD
+    # propagation, with the comms pass pricing the inferred collective
+    # graph (instead of its heuristic scan)
+    "shard": ("structural", "dataflow", "grad_link", "sharding",
+              "shardprop", "comms"),
 }
 
 

@@ -29,7 +29,7 @@ from .cost import get_chip, var_bytes
 from .dataflow import ProgramView
 from .diagnostics import INFO, WARNING, Diagnostics, Finding
 
-__all__ = ["comms_pass", "estimate_comms", "CommsReport"]
+__all__ = ["comms_pass", "estimate_comms", "CommsReport", "WIRE_RULES"]
 
 # mesh axes conventionally used for batch sharding (parallel/mesh.py
 # _dp_axes + the transpiler's dp default)
@@ -43,14 +43,43 @@ def _ring_wire_bytes(payload: float, n: int) -> float:
     return 2.0 * (n - 1) / n * payload
 
 
+def _shuffle_wire_bytes(payload: float, n: int) -> float:
+    """all-gather / reduce-scatter / all-to-all: each participant moves
+    (n-1)/n of the payload once (half a ring all-reduce)."""
+    n = max(2, int(n))
+    return (n - 1) / n * payload
+
+
+# per-HLO-kind wire-byte rules (ring algorithms, per participant)
+WIRE_RULES = {
+    "all-reduce": _ring_wire_bytes,
+    "all-gather": _shuffle_wire_bytes,
+    "reduce-scatter": _shuffle_wire_bytes,
+    "all-to-all": _shuffle_wire_bytes,
+}
+
+
+def _hlo_kind_of(entry: Dict) -> str:
+    k = entry.get("hlo_kind")
+    if k:
+        return str(k)
+    # legacy heuristic entries: "allreduce(partial-sum)" etc.
+    return "all-reduce" if "allreduce" in str(entry.get("kind", "")) \
+        else str(entry.get("kind", "all-reduce"))
+
+
 class CommsReport:
-    __slots__ = ("per_axis", "ici_bytes", "dcn_bytes", "ici_time_s",
-                 "dcn_time_s", "grad_sync_bytes", "collectives",
-                 "axis_sizes", "dcn_axes", "quantized_dcn_bytes")
+    __slots__ = ("per_axis", "per_kind", "ici_bytes", "dcn_bytes",
+                 "ici_time_s", "dcn_time_s", "grad_sync_bytes",
+                 "collectives", "axis_sizes", "dcn_axes",
+                 "quantized_dcn_bytes")
 
     def to_dict(self) -> Dict[str, Any]:
         return {
             "per_axis": {a: dict(d) for a, d in self.per_axis.items()},
+            # per-collective-kind subtotals so a differential rel_err
+            # gate can say *which* kind diverged
+            "per_kind": {k: dict(d) for k, d in self.per_kind.items()},
             "ici_bytes": self.ici_bytes,
             "dcn_bytes": self.dcn_bytes,
             "ici_time_s": self.ici_time_s,
@@ -102,22 +131,55 @@ def estimate_comms(view_or_program, chip=None,
 
     rep = CommsReport.__new__(CommsReport)
     rep.per_axis = {}
+    rep.per_kind = {}
     rep.collectives = []
     rep.axis_sizes = sizes
     rep.dcn_axes = dcn_axes
     rep.grad_sync_bytes = 0.0
 
-    def record(axis: str, kind: str, payload: float, where: str) -> None:
+    def record(axis: str, kind: str, payload: float, where: str,
+               hlo_kind: str = "all-reduce") -> None:
         n = sizes.get(axis, 2)
-        wire = _ring_wire_bytes(payload, n)
+        wire = WIRE_RULES.get(hlo_kind, _ring_wire_bytes)(payload, n)
         d = rep.per_axis.setdefault(
             axis, {"count": 0, "payload_bytes": 0.0, "wire_bytes": 0.0,
                    "tier": "dcn" if axis in dcn_axes else "ici"})
         d["count"] += 1
         d["payload_bytes"] += payload
         d["wire_bytes"] += wire
+        k = rep.per_kind.setdefault(
+            hlo_kind, {"count": 0, "payload_bytes": 0.0,
+                       "wire_bytes": 0.0})
+        k["count"] += 1
+        k["payload_bytes"] += payload
+        k["wire_bytes"] += wire
         rep.collectives.append({"axis": axis, "kind": kind,
+                                "hlo_kind": hlo_kind,
                                 "payload_bytes": payload, "at": where})
+
+    # an inferred collective graph (shardprop) replaces the heuristic
+    # scan below outright: every entry is already placed and sized
+    inferred = opts.get("collectives")
+    if inferred is not None:
+        for e in inferred:
+            hk = _hlo_kind_of(e)
+            payload = float(e.get("payload_bytes", 0.0))
+            record(str(e.get("axis", "")), str(e.get("kind", hk)),
+                   payload, str(e.get("at", "")), hlo_kind=hk)
+            if e.get("grad"):
+                rep.grad_sync_bytes += payload
+        rep.ici_bytes = sum(d["wire_bytes"]
+                            for a, d in rep.per_axis.items()
+                            if a not in dcn_axes)
+        rep.dcn_bytes = sum(d["wire_bytes"]
+                            for a, d in rep.per_axis.items()
+                            if a in dcn_axes)
+        rep.ici_time_s = rep.ici_bytes / chip.ici_bw if chip.ici_bw \
+            else 0.0
+        rep.dcn_time_s = rep.dcn_bytes / chip.dcn_bw if chip.dcn_bw \
+            else 0.0
+        rep.quantized_dcn_bytes = rep.dcn_bytes / 4.0 * (1.0 + 4.0 / 32.0)
+        return rep
 
     def sharded_axes(name: str, block_idx: int, dims) -> List[str]:
         vd = view.visible_var(block_idx, name)
@@ -206,6 +268,13 @@ def comms_pass(ctx, diag: Diagnostics) -> None:
     ``mesh_axes`` ({axis: size}), ``dcn_axes`` (axes that span hosts),
     ``chip``, ``assume_batch``."""
     opts = getattr(ctx, "options", {}) or {}
+    sp = diag.reports.get("shardprop")
+    if sp and "collectives" in sp and "collectives" not in opts:
+        # the shardprop pass ran first (level "shard"): price its
+        # inferred collective graph instead of the heuristic scan
+        opts = dict(opts)
+        opts["collectives"] = sp["collectives"]
+        opts.setdefault("mesh_axes", sp.get("mesh_axes"))
     rep = estimate_comms(ctx.view, options=opts)
     diag.reports["comms"] = rep.to_dict()
     if not rep.per_axis:

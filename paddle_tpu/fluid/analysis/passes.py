@@ -252,6 +252,23 @@ def _fmt_sharding(s) -> str:
 
 def sharding_pass(ctx: AnalysisContext, diag: Diagnostics) -> None:
     view = ctx.view
+
+    def producer_of(bidx: int, name: str, before: Optional[int]):
+        """Last op writing ``name`` in its owner block (before the
+        consumer when both live in the same block)."""
+        owner = view.owner_block(bidx, name)
+        if owner is None:
+            return None, None
+        limit = before if owner == bidx else None
+        found = None
+        for op in view.blocks[owner].ops:
+            if limit is not None and op.idx >= limit:
+                break
+            if name in op.write_names():
+                found = op
+        return owner, found
+
+    seen_pairs = set()
     for b in view.blocks:
         for name, vd in b.desc.vars.items():
             sh = vd.sharding
@@ -289,11 +306,23 @@ def sharding_pass(ctx: AnalysisContext, diag: Diagnostics) -> None:
                     continue
                 if va.sharding is not None and vc.sharding is not None \
                         and list(va.sharding) != list(vc.sharding):
+                    # one finding per var pair, however many blocks the
+                    # pair recurs in (while bodies clone these ops)
+                    if (a, c) in seen_pairs:
+                        continue
+                    seen_pairs.add((a, c))
+                    pb, pop = producer_of(b.idx, a, op.idx)
+                    where_p = (f"block {pb} op#{pop.idx} ({pop.type})"
+                               if pop is not None else
+                               f"block {pb if pb is not None else b.idx}"
+                               f" (no producing op)")
                     diag.add(Finding(
                         ERROR, "sharding", "producer-consumer-conflict",
-                        f"'{a}' sharded {_fmt_sharding(va.sharding)} but "
-                        f"'{c}' sharded {_fmt_sharding(vc.sharding)} — "
-                        f"per-dim mesh axes must agree across "
+                        f"'{a}' sharded {_fmt_sharding(va.sharding)} "
+                        f"(producer {where_p}) but '{c}' sharded "
+                        f"{_fmt_sharding(vc.sharding)} (consumer block "
+                        f"{b.idx} op#{op.idx} ({op.type})) — per-dim "
+                        f"mesh axes must agree across "
                         f"producer/consumer",
                         block=b.idx, op=op.idx, op_type=op.type, var=c))
 
@@ -519,8 +548,11 @@ def shape_check_pass(ctx: AnalysisContext, diag: Diagnostics) -> None:
 from .comms import comms_pass                              # noqa: E402
 from .cost import cost_pass                                # noqa: E402
 from .recompile import recompile_pass                      # noqa: E402
+from .shardprop import shardprop_pass                      # noqa: E402
 
 # ordered registry: cheap structural truths first, tracing last
+# (shardprop before comms: the comms pass prices shardprop's inferred
+# collective graph when both run)
 PASSES = [
     ("structural", structural_pass),
     ("dataflow", dataflow_pass),
@@ -529,5 +561,6 @@ PASSES = [
     ("shape_check", shape_check_pass),
     ("cost", cost_pass),
     ("recompile", recompile_pass),
+    ("shardprop", shardprop_pass),
     ("comms", comms_pass),
 ]

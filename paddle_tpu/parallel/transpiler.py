@@ -22,6 +22,27 @@ from ..fluid.framework import Parameter, Program, default_main_program
 __all__ = ["DistributeTranspiler"]
 
 
+def _verify_sharding(program: Program, mesh_axes: Dict[str, int],
+                     context: str) -> None:
+    """Run the shardprop lint over an emitted program.
+
+    The reference transpiler could emit programs whose send/recv splits
+    disagreed with the optimizer placement and nothing caught it until
+    runtime; here every program the transpiler hands out has been through
+    whole-program sharding inference first, so a plan that would force a
+    resharding or leave a contracted partial un-reduced is refused at
+    plan time with exact op coordinates.
+    """
+    from ..fluid.analysis import ProgramValidationError, analyze_program
+    diag = analyze_program(program, level="shard",
+                           options={"mesh_axes": dict(mesh_axes),
+                                    # plan-time check: giants are the
+                                    # executor's concern, not the plan's
+                                    "replicated_giant_bytes": None})
+    if diag.has_errors:
+        raise ProgramValidationError(diag, context=context)
+
+
 class DistributeTranspiler:
     def __init__(self):
         self._program: Optional[Program] = None
@@ -48,6 +69,7 @@ class DistributeTranspiler:
             self._mesh_axes["dp"] = trainers
         mp = self._mesh_axes.get(shard_params_over)
         if not mp or mp <= 1:
+            _verify_sharding(program, self._mesh_axes, context="transpile")
             return
         annotated = {}
         for p in program.global_block().all_parameters():
@@ -101,18 +123,25 @@ class DistributeTranspiler:
                                         merged[i] = mk
                                         break
                             v.set_sharding(merged)
+        _verify_sharding(program, self._mesh_axes, context="transpile")
 
     @property
     def mesh_axes(self) -> Dict[str, int]:
         return self._mesh_axes
 
     def get_trainer_program(self) -> Program:
+        if self._program is not None:
+            _verify_sharding(self._program, self._mesh_axes,
+                             context="get_trainer_program")
         return self._program
 
     def get_pserver_program(self, endpoint: str = "") -> Program:
         """No servers exist on TPU; returns an empty program so reference
         launcher scripts that exe.run() it are no-ops."""
-        return Program()
+        prog = Program()
+        _verify_sharding(prog, self._mesh_axes,
+                         context="get_pserver_program")
+        return prog
 
     def get_startup_program(self, endpoint: str = "",
                             pserver_program: Optional[Program] = None

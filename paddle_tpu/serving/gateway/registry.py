@@ -47,7 +47,8 @@ from ...utils.sync import (RANK_COLLECTOR_INIT, RANK_MODEL_REGISTRY,
                            OrderedLock)
 from ..engine import DEFAULT_BATCH_BUCKETS, InferenceEngine
 from ..paged_decoder import (PagedTransformerGenerator, _CACHE_MARKERS,
-                             estimate_generator_hbm)
+                             build_manifest_program,
+                             estimate_generator_hbm, model_axis_of)
 from ..scheduler import HBMBudgetError, suggest_model_axis
 from ..speculative import SpeculativeGenerator, estimate_speculative_hbm
 
@@ -307,6 +308,38 @@ class ModelRegistry:
         return cost, {"artifact": cost}
 
     @staticmethod
+    def _shard_preflight(kind: str, config: Dict) -> None:
+        """Refuse a ``mesh_axes`` generator artifact whose manifest-built
+        program fails whole-program sharding inference (ISSUE 18).  The
+        shardprop pass propagates the manifest's param annotations
+        through every op of the unified decode-step desc; a manifest
+        that would force a resharding, leave a contracted partial
+        un-reduced, or drift dp-gradients is rejected HERE — at
+        admission, before any HBM is charged or weights are mounted —
+        with exact block/op coordinates in the error."""
+        if kind != "generator":
+            return
+        mesh_axes = config.get("mesh_axes")
+        if model_axis_of(mesh_axes) is None:
+            return
+        from ...fluid.analysis import (ProgramValidationError,
+                                       analyze_program)
+
+        prog, mesh_axes = build_manifest_program(config,
+                                                 mesh_axes=mesh_axes)
+        diag = analyze_program(
+            prog, level="shard",
+            options={"mesh_axes": dict(mesh_axes),
+                     # replicated-giant is the HBM charge's concern
+                     # (plan_program prices per-shard bytes); admission
+                     # only gates on propagation-correctness findings
+                     "replicated_giant_bytes": None})
+        if diag.has_errors:
+            raise ProgramValidationError(
+                diag, context=f"sharding preflight, "
+                              f"mesh_axes={dict(mesh_axes)}")
+
+    @staticmethod
     def _estimate_cost(kind: str, dirname: Optional[str],
                        config: Dict) -> int:
         cost, _ = ModelRegistry._estimate_cost_detail(kind, dirname,
@@ -343,6 +376,7 @@ class ModelRegistry:
             kind = manifest.get("kind", "engine")
             config = dict(manifest.get("config", {}))
             config.update(overrides)
+            self._shard_preflight(kind, config)
             cost, components = self._estimate_cost_detail(kind, dirname,
                                                           config)
             self._charge(cost, key, components)

@@ -48,8 +48,9 @@ from .paging import (PageAllocator, PoolCapacityError, TRASH_PAGE,
                      chunk_hashes)
 
 __all__ = ["PagedTransformerGenerator", "copy_weights", "kv_page_bytes",
-           "build_unified_program", "estimate_generator_hbm",
-           "default_num_pages", "model_axis_of", "check_shardable"]
+           "build_unified_program", "build_manifest_program",
+           "estimate_generator_hbm", "default_num_pages",
+           "model_axis_of", "check_shardable"]
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -291,6 +292,24 @@ def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
     ``analysis.cost.ProgramMemoryPlan``."""
     from ..fluid.analysis.cost import plan_program
 
+    prog, mesh_axes = build_manifest_program(
+        config, verify_tokens=verify_tokens, logit_masks=logit_masks,
+        mesh_axes=mesh_axes)
+    lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
+        else int(assume_lanes)
+    return plan_program(prog, assume_batch=lanes,
+                        assume_donation=assume_donation,
+                        mesh_axes=mesh_axes)
+
+
+def build_manifest_program(config: Dict, verify_tokens: int = 1,
+                           logit_masks: bool = False,
+                           mesh_axes: Optional[Dict[str, int]] = None):
+    """Build the unified decode-step desc a gateway manifest describes —
+    the shared front half of ``estimate_generator_hbm`` and the
+    registry's sharding preflight.  ``mesh_axes`` defaults to
+    ``config["mesh_axes"]``; params get their column/row annotations
+    when a model axis is present.  Returns ``(program, mesh_axes)``."""
     cfg = _Cfg(int(config["src_vocab_size"]),
                int(config["trg_vocab_size"]),
                int(config.get("n_layer", 6)),
@@ -319,11 +338,7 @@ def estimate_generator_hbm(config: Dict, assume_lanes: int = None,
         kv_dtype=str(config.get("kv_dtype", "float32")),
         verify_tokens=int(verify_tokens), logit_masks=bool(logit_masks),
         shard_axis=shard_axis)
-    lanes = HBM_ESTIMATE_LANES if assume_lanes is None \
-        else int(assume_lanes)
-    return plan_program(prog, assume_batch=lanes,
-                        assume_donation=assume_donation,
-                        mesh_axes=mesh_axes)
+    return prog, mesh_axes
 
 
 class _Lane:

@@ -8,6 +8,8 @@
     python -m paddle_tpu.tools.plint prog.json --cost --budget 16000000000
     python -m paddle_tpu.tools.plint prog.json --cost --batch-bucket 8 \
         --fail-on unregistered-cost-rule --fail-on value-shape-op
+    python -m paddle_tpu.tools.plint prog.json --shard \
+        --mesh-axis model=2 --replicated-giant-bytes 268435456
 
 Programs that arrive via serialization (save_inference_model output,
 checkpoints, transpiled programs shipped between processes) are exactly
@@ -48,13 +50,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("program", help="path to a serialized program "
                     "(canonical JSON, as written by "
                     "ProgramDesc.serialize_to_string / save_inference_model)")
-    ap.add_argument("--level", choices=("structural", "full", "cost"),
+    ap.add_argument("--level",
+                    choices=("structural", "full", "cost", "shard"),
                     default="full",
                     help="structural = desc-only passes; full adds the "
                          "abstract shape/dtype re-check (default); cost "
-                         "runs the static cost family instead")
+                         "runs the static cost family instead; shard "
+                         "runs whole-program SPMD sharding inference")
     ap.add_argument("--cost", action="store_true",
                     help="shorthand for --level cost")
+    ap.add_argument("--shard", action="store_true",
+                    help="shorthand for --level shard (sharding "
+                    "propagation + resharding/partial-sum/dp-drift "
+                    "lint; pair with --mesh-axis AXIS=N)")
+    ap.add_argument("--replicated-giant-bytes", type=int, default=None,
+                    metavar="BYTES",
+                    help="threshold for shard/replicated-giant: a "
+                    "persistable this large left fully replicated on "
+                    "the model axis is an error (default 256 MiB)")
     ap.add_argument("--fetch", action="append", default=None,
                     metavar="VAR", help="var name you intend to fetch "
                     "(liveness root for dead-code findings; repeatable)")
@@ -102,8 +115,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"plint: cannot load {args.program!r}: {e}", file=sys.stderr)
         return 2
 
-    level = "cost" if args.cost else args.level
+    level = "cost" if args.cost else \
+        ("shard" if args.shard else args.level)
     options = {"assume_batch": args.assume_batch}
+    if args.replicated_giant_bytes is not None:
+        options["replicated_giant_bytes"] = args.replicated_giant_bytes
     if args.budget is not None:
         options["budget_bytes"] = args.budget
     if args.chip:

@@ -440,6 +440,117 @@ EOF
   else
     echo "-- plint --cost $name: MISSING"; rc=1
   fi
+
+  # shardprop sweep (ISSUE 18): whole-program sharding inference over
+  # the tensor-parallel decode-step program (model=2) and a dp book
+  # training program — any resharding-hazard / partial-sum-unreduced /
+  # dp-grad-divergence finding fails the gate
+  name=serving_sharded_ragged_step
+  prog="$tmpdir/$name.json"
+  if [ -f "$prog" ]; then
+    fetch_args=""
+    while read -r v; do
+      [ -n "$v" ] && fetch_args="$fetch_args --fetch $v"
+    done < "$tmpdir/$name.fetch"
+    echo "-- plint --shard $name (--mesh-axis model=2)"
+    # shellcheck disable=SC2086
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m paddle_tpu.tools.plint "$prog" --shard --quiet \
+        --mesh-axis model=2 $fetch_args || rc=1
+  else
+    echo "-- plint --shard $name: MISSING"; rc=1
+  fi
+  name=digits_conv
+  prog="$tmpdir/$name.json"
+  if [ -f "$prog" ]; then
+    fetch_args=""
+    while read -r v; do
+      [ -n "$v" ] && fetch_args="$fetch_args --fetch $v"
+    done < "$tmpdir/$name.fetch"
+    echo "-- plint --shard $name (--mesh-axis dp=2)"
+    # shellcheck disable=SC2086
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m paddle_tpu.tools.plint "$prog" --shard --quiet \
+        --mesh-axis dp=2 --assume-batch 8 $fetch_args || rc=1
+  else
+    echo "-- plint --shard $name: MISSING"; rc=1
+  fi
+
+  # HLO-differential check (ISSUE 18): the inferred collective graph
+  # must match what XLA actually emits — Executor.collective_analysis
+  # on a 4-virtual-device CPU mesh, op-for-op (equal counts AND equal
+  # payload bytes per kind, rel_err 0.0) for a sharded decode step and
+  # a dp-sharded training step
+  echo "== shardprop HLO differential (4 virtual devices)"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4 ${XLA_FLAGS:-}" \
+    python - <<'EOF' || rc=1
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid.analysis.shardprop import (compare_collectives,
+                                                 infer_sharding)
+from paddle_tpu.parallel import mesh as pmesh
+from paddle_tpu.parallel.transpiler import DistributeTranspiler
+from paddle_tpu.serving import PagedTransformerGenerator
+
+
+def gate(tag, prog, mesh_axes, feed, fetch_list, exe, scope, mesh,
+         mode, assume_batch):
+    with fluid.scope_guard(scope), pmesh.mesh_guard(mesh):
+        meas = exe.collective_analysis(prog, feed=feed,
+                                       fetch_list=fetch_list, mode=mode)
+    pred = infer_sharding(
+        prog, options={"mesh_axes": mesh_axes,
+                       "assume_batch": assume_batch},
+        fetch=[getattr(v, "name", v) for v in fetch_list])
+    errs = [f.render() for f in pred.findings if f.severity == "error"]
+    assert not errs, f"{tag}: {errs}"
+    cmp = compare_collectives(pred.per_kind(), meas["per_kind"])
+    assert cmp["match"] and cmp["rel_err"] == 0.0, (
+        f"{tag}: rel_err={cmp['rel_err']} predicted={pred.per_kind()} "
+        f"measured={meas['per_kind']}")
+    print(f"{tag}: rel_err 0.0, "
+          + ", ".join(f"{k}x{int(v['count'])}"
+                      for k, v in sorted(pred.per_kind().items())))
+
+
+ma = {"batch": 1, "model": 2}
+g = PagedTransformerGenerator(30, 30, n_layer=2, n_head=2, d_key=4,
+                              d_value=4, d_model=16, d_inner_hid=32,
+                              max_length=64, src_len=8, max_out_len=8,
+                              page_size=4, chunk_size=4, num_pages=32,
+                              param_prefix="tfsh", mesh_axes=ma)
+g.init_params(seed=1)
+g.open_slots(2)
+prog, _, next_ids, _ = g._unified
+feed = g._prefill_arrays()
+feed.update(g._decode_arrays(1))
+gate("decode-step model=2", prog, ma, feed, [next_ids], g.exe,
+     g.scope, g.mesh, "infer", 2)
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup), fluid.unique_name.guard():
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=32, act="relu")
+    p = fluid.layers.fc(input=h, size=4, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=p, label=y))
+    opt_ops, pg = fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+t = DistributeTranspiler()
+t.transpile(optimize_ops=opt_ops, params_grads=pg, trainers=4,
+            program=main, mesh_axes={"dp": 4})
+exe = fluid.Executor(fluid.TPUPlace(0))
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe.run(startup)
+rng = np.random.RandomState(3)
+feed = {"x": rng.rand(8, 16).astype("float32"),
+        "y": rng.randint(0, 4, (8, 1)).astype("int64")}
+gate("training dp=4", t.get_trainer_program(), {"dp": 4}, feed,
+     [loss], exe, scope, pmesh.make_mesh({"dp": 4}), "train", 8)
+EOF
 fi
 
 if [ "$want_aot" = 1 ]; then
