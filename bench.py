@@ -1854,6 +1854,233 @@ def bench_sharded(trials: int) -> dict:
     return json.loads(p.stdout.strip().splitlines()[-1])
 
 
+def bench_multihost_child() -> None:
+    """One subprocess 'host' of the elastic pod (ISSUE 19): a
+    numpy-only data-parallel regression driven by ResilientTrainer's
+    coordinator mode — per-step gradient shards mean-reduced through
+    the agreement barrier, coordinated manifests on the shared ckpt
+    dir.  Re-exec'd by bench_multihost with BENCH_MULTIHOST_CHILD=1."""
+    import numpy as _np
+
+    from paddle_tpu.parallel import PodClient
+    from paddle_tpu.resilience import ResilientTrainer
+
+    addr = os.environ["BENCH_MH_ADDR"]
+    host = os.environ["BENCH_MH_HOST"]
+    ckpt = os.environ["BENCH_MH_CKPT"]
+    steps = int(os.environ["BENCH_MH_CHILD_STEPS"])
+    save_every = int(os.environ.get("BENCH_MH_SAVE_EVERY", "1000000"))
+    batch = int(os.environ.get("BENCH_MH_BATCH", "2048"))
+    dim = int(os.environ.get("BENCH_MH_DIM", "64"))
+
+    w_true = _np.linspace(-1.0, 1.0, dim).astype(_np.float32)[:, None]
+    params = {}
+
+    def read_chunk(step, rank, world):
+        r = _np.random.RandomState(step % 97)   # one global batch/step
+        xs = r.randn(batch, dim).astype(_np.float32)
+        ys = xs @ w_true
+        return xs[rank::world], ys[rank::world]
+
+    def train_step(rec, step):
+        xs, ys = rec
+        g = 2.0 * xs.T @ (xs @ params["w"] - ys) / len(xs)
+        return True, {"w": g.astype(_np.float32)}
+
+    def apply_update(reduced, step):
+        params["w"] = (params["w"]
+                       - 0.01 * reduced["w"]).astype(_np.float32)
+
+    client = PodClient(addr, host, poll_interval=0.002)
+    trainer = ResilientTrainer(
+        ckpt, coordinator=client, read_chunk=read_chunk,
+        apply_update=apply_update,
+        state_get=lambda: dict(params),
+        state_set=lambda items: params.update(items),
+        save_interval_steps=save_every, rendezvous_deadline=120.0,
+        step_deadline=120.0, heartbeat_interval=0.2)
+    final = trainer.run(
+        train_step,
+        init_fn=lambda: params.update(
+            w=_np.zeros((dim, 1), _np.float32)),
+        max_steps=steps)
+    print(json.dumps({"host": host, "final_step": final}))
+
+
+def bench_multihost(trials: int, steps: int = 30) -> dict:
+    """Elastic multi-host training (ISSUE 19), measured on subprocess
+    hosts over the real HTTP control plane:
+
+    * lockstep step time at worlds 1 -> 2 -> 4 with a FIXED global
+      batch, plus scaling efficiency t1/(N*tN) — on CPU subprocesses
+      this prices the agreement barrier, not an accelerator;
+    * chaos host loss at world 3: a seeded ``coord.crash`` SIGKILLs
+      one host mid-run, and the detect / re-rendezvous-at-2 / first
+      committed-manifest-after-resume wall clocks are measured from
+      the kill;
+    * the recovery contract as a metric: replaying the shared guard
+      journal (resyncs rewind the timeline) must show every step
+      applied exactly once — ``lost_steps``/``duplicated_steps`` are
+      gated to 0 like any headline number.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+    import time as _t
+
+    from paddle_tpu.parallel import CoordinatorServer
+    from paddle_tpu.resilience import FaultInjector
+
+    def spawn(addr, host, ckpt, n_steps, extra=None):
+        env = dict(os.environ, BENCH_MULTIHOST_CHILD="1",
+                   JAX_PLATFORMS="cpu", BENCH_MH_ADDR=addr,
+                   BENCH_MH_HOST=host, BENCH_MH_CKPT=ckpt,
+                   BENCH_MH_CHILD_STEPS=str(n_steps))
+        env.update(extra or {})
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+    def timed_run(world):
+        """Wall from pod formation to the final committed manifest,
+        read off the coordinator status (excludes interpreter
+        startup)."""
+        tmp = tempfile.mkdtemp(prefix=f"bench-mh-{world}-")
+        srv = CoordinatorServer(world_min=1, world_target=world,
+                                heartbeat_timeout=10.0)
+        addr = srv.start()
+        procs = []
+        try:
+            procs = [spawn(addr, f"host-{i}",
+                           os.path.join(tmp, "pod"), steps)
+                     for i in range(world)]
+            t_formed = None
+            deadline = _t.monotonic() + 300
+            while _t.monotonic() < deadline:
+                st = srv.status()
+                now = _t.monotonic()
+                if t_formed is None and st["world"] == world:
+                    t_formed = now
+                if t_formed is not None \
+                        and st["last_committed"] >= steps:
+                    break
+                _t.sleep(0.005)
+            else:
+                raise RuntimeError(f"world {world} never finished")
+            wall = now - t_formed
+            for p in procs:
+                err = p.communicate(timeout=60)[1]
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"multihost child failed: {err[-800:]}")
+            return wall / steps
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            srv.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    worlds = {}
+    for world in (1, 2, 4):
+        best = min(timed_run(world) for _ in range(max(1, trials)))
+        worlds[str(world)] = {"step_ms": round(best * 1000.0, 3)}
+    t1 = worlds["1"]["step_ms"]
+    for world in (2, 4):
+        tn = worlds[str(world)]["step_ms"]
+        worlds[str(world)]["scaling_efficiency"] = round(
+            t1 / (world * tn), 3) if tn > 0 else None
+
+    # -- chaos host loss at world 3 ------------------------------------------
+    save_every = 5
+    # seed the crash so it fires between two commit points: first
+    # coord.crash draw below prob in [save_every+2, 3*save_every)
+    prob = 0.1
+    seed = next(
+        s for s in range(1000)
+        if [i for i in range(steps)
+            if FaultInjector.decision(s, "coord.crash", i) < prob
+            ][:1] and save_every + 2 <= [
+                i for i in range(steps)
+                if FaultInjector.decision(s, "coord.crash", i) < prob
+            ][0] < 3 * save_every)
+    tmp = tempfile.mkdtemp(prefix="bench-mh-kill-")
+    ckpt = os.path.join(tmp, "pod")
+    srv = CoordinatorServer(world_min=1, world_target=3,
+                            heartbeat_timeout=2.0, vote_timeout=4.0)
+    addr = srv.start()
+    procs = {}
+    try:
+        for i in range(3):
+            extra = {"BENCH_MH_SAVE_EVERY": str(save_every)}
+            if i == 2:
+                extra.update(PADDLE_TPU_CHAOS=f"coord.crash={prob}",
+                             PADDLE_TPU_CHAOS_SEED=str(seed))
+            procs[i] = spawn(addr, f"host-{i}", ckpt, steps, extra)
+        t_kill = t_detect = t_resume = None
+        committed_at_kill = None
+        deadline = _t.monotonic() + 300
+        while _t.monotonic() < deadline:
+            st = srv.status()
+            now = _t.monotonic()
+            if t_kill is None and procs[2].poll() is not None:
+                t_kill, committed_at_kill = now, st["last_committed"]
+            if t_kill is not None:
+                if t_detect is None and st["world"] == 2:
+                    t_detect = now
+                if t_resume is None \
+                        and st["last_committed"] > committed_at_kill:
+                    t_resume = now
+            if st["last_committed"] >= steps:
+                break
+            _t.sleep(0.005)
+        else:
+            raise RuntimeError("host-kill run never finished")
+        for i in (0, 1):
+            err = procs[i].communicate(timeout=60)[1]
+            if procs[i].returncode != 0:
+                raise RuntimeError(
+                    f"survivor {i} failed: {err[-800:]}")
+        final_status = srv.status()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+
+    # zero lost/duplicated steps, reconstructed from one survivor's
+    # journal: resync/rollback entries rewind the effective timeline
+    line = []
+    for ln in open(os.path.join(ckpt, "guard.journal")):
+        rec = json.loads(ln)
+        if rec.get("host") != "host-0" \
+                or not rec["event"].startswith("pod-"):
+            continue
+        if rec["event"] in ("pod-resync", "pod-rollback-restore"):
+            line = [s for s in line if s <= rec["step"]]
+        else:
+            line.append(rec["step"])
+    lost = len(set(range(1, steps + 1)) - set(line))
+    dup = len(line) - len(set(line))
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "steps": steps,
+        "worlds": worlds,
+        "host_kill": {
+            "world": 3,
+            "detect_s": round(t_detect - t_kill, 3)
+            if t_detect and t_kill else None,
+            "resume_s": round(t_resume - t_kill, 3)
+            if t_resume and t_kill else None,
+            "final_committed": final_status["last_committed"],
+            "host_losses": final_status["host_losses"],
+            "lost_steps": lost,
+            "duplicated_steps": dup,
+        },
+    }
+
+
 def _calibrated_chip():
     """Measured machine model for the roofline gate: achievable matmul
     FLOP/s and achievable copy bandwidth of THIS device (env overrides:
@@ -2432,6 +2659,10 @@ def main() -> None:
         # re-exec'd by bench_cost_model for the shardprop differential
         bench_shardprop_child()
         return
+    if os.environ.get("BENCH_MULTIHOST_CHILD", "") == "1":
+        # re-exec'd by bench_multihost: one subprocess pod host
+        bench_multihost_child()
+        return
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     trials = max(1, int(os.environ.get("BENCH_TRIALS", "2")))
     batches = [int(b) for b in os.environ.get(
@@ -2625,6 +2856,15 @@ def main() -> None:
         except Exception as e:
             print(f"sharded bench failed: {e}", file=sys.stderr)
 
+    multihost_cmp = None
+    if os.environ.get("BENCH_SKIP_MULTIHOST", "") != "1":
+        try:
+            multihost_cmp = retry_transient(
+                bench_multihost, trials,
+                int(os.environ.get("BENCH_MH_STEPS", "30")))
+        except Exception as e:
+            print(f"multihost bench failed: {e}", file=sys.stderr)
+
     cost_model = None
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         try:
@@ -2726,6 +2966,12 @@ def main() -> None:
         # contract measured: zero lost requests, empty victim journal
         # after migration
         "fleet": fleet_cmp,
+        # elastic multi-host training (ISSUE 19): lockstep step time at
+        # 1/2/4 subprocess hosts with scaling efficiency over the
+        # agreement barrier, and the chaos host-kill walls (detect /
+        # re-rendezvous / first post-resume commit) with the
+        # zero-lost-steps recovery contract gated like a perf number
+        "multihost": multihost_cmp,
         # tensor-parallel sharded serving (ISSUE 17): tok/s +
         # max-servable-model-size at 1/2/4 virtual devices, the
         # zero-recompile and token-parity contracts, and predicted-vs-
@@ -2838,6 +3084,15 @@ def main() -> None:
                 # the sharded engine diverged from the single-chip
                 # tokens — a correctness failure, not a perf number
                 missing.append("sharded_parity_contract")
+    if os.environ.get("BENCH_SKIP_MULTIHOST", "") != "1":
+        if multihost_cmp is None:
+            missing.append("multihost")
+        elif (multihost_cmp["host_kill"]["lost_steps"] != 0
+              or multihost_cmp["host_kill"]["duplicated_steps"] != 0):
+            # the whole elastic contract: a SIGKILLed host costs wall
+            # clock, never training steps — a lost or double-applied
+            # step is a failed run, like any perf regression
+            missing.append("multihost_lost_steps")
     if os.environ.get("BENCH_SKIP_COST", "") != "1":
         if cost_model is None:
             missing.append("cost_model")

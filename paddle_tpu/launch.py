@@ -112,7 +112,9 @@ def _terminate(procs, kill_grace: float = 10.0) -> None:
 
 def launch(nprocs: int, argv, coordinator: str | None = None,
            env_extra: dict | None = None, max_restarts: int = 0,
-           kill_grace: float = 10.0, log_dir: str | None = None) -> int:
+           kill_grace: float = 10.0, log_dir: str | None = None,
+           pod_rendezvous: str | None = None, pod_min_world: int = 1,
+           pod_heartbeat: float | None = None) -> int:
     """Spawn ``nprocs`` copies of ``argv``; returns the first fatal
     non-zero exit code (terminating the rest), else 0.
 
@@ -120,11 +122,33 @@ def launch(nprocs: int, argv, coordinator: str | None = None,
     non-zero is respawned (same rank/env) while the shared restart
     budget lasts; only exhaustion of the budget tears the job down.
     Meant for master/data-dispatch workloads — collective (SPMD) jobs
-    should keep the fail-fast default (see module docstring)."""
+    should keep the fail-fast default (see module docstring).
+
+    ``pod_rendezvous`` arms the ISSUE 19 elastic pod control plane:
+    ``"auto"`` starts a PodCoordinator server inside the launcher
+    (world_target=nprocs, world_min=pod_min_world) and hands its
+    address to every rank via ``PADDLE_TPU_POD_COORDINATOR``; an
+    explicit ``host:port`` points ranks at an externally-run
+    coordinator instead.  Each rank also gets a stable pod host id
+    (``PADDLE_TPU_POD_HOST=host-<rank>``, doubling as the
+    ``PADDLE_TPU_METRICS_HOST`` exposition label) so the pod scrapes
+    as one /metrics surface.  Note the pod coordinator is NOT torn
+    down between elastic restarts — a respawned rank re-rendezvouses
+    into the live membership, which is the point."""
     held = None
+    pod_server = None
     if coordinator is None:
         port, held = _hold_port()
         coordinator = f"127.0.0.1:{port}"
+    pod_addr = pod_rendezvous
+    if pod_rendezvous == "auto":
+        from .parallel.coordinator import CoordinatorServer
+
+        hb = 1.0 if pod_heartbeat is None else float(pod_heartbeat)
+        pod_server = CoordinatorServer(
+            world_min=max(1, int(pod_min_world)), world_target=nprocs,
+            heartbeat_timeout=max(10.0, 10.0 * hb))
+        pod_addr = pod_server.start()
     if log_dir is not None:
         os.makedirs(log_dir, exist_ok=True)
     specs = []
@@ -134,6 +158,13 @@ def launch(nprocs: int, argv, coordinator: str | None = None,
         env["PADDLE_TPU_COORDINATOR"] = coordinator
         env["PADDLE_TPU_NPROCS"] = str(nprocs)
         env["PADDLE_TPU_PROC_ID"] = str(rank)
+        if pod_addr is not None:
+            env["PADDLE_TPU_POD_COORDINATOR"] = pod_addr
+            env.setdefault("PADDLE_TPU_POD_HOST", f"host-{rank}")
+            env.setdefault("PADDLE_TPU_METRICS_HOST",
+                           env["PADDLE_TPU_POD_HOST"])
+            if pod_heartbeat is not None:
+                env["PADDLE_TPU_POD_HEARTBEAT"] = str(pod_heartbeat)
         log = (os.path.join(log_dir, f"rank-{rank}.log")
                if log_dir is not None else None)
         specs.append(_RankSpec(rank, [sys.executable] + list(argv), env,
@@ -156,6 +187,8 @@ def launch(nprocs: int, argv, coordinator: str | None = None,
         _terminate(procs, kill_grace)
         raise
     finally:
+        if pod_server is not None:
+            pod_server.stop()
         if held is not None:
             held.close()
 
@@ -295,6 +328,20 @@ def main() -> None:
     ap.add_argument("--log-dir", default=None,
                     help="write each rank's stdout/stderr to "
                          "DIR/rank-<i>.log (appended across restarts)")
+    ap.add_argument("--pod-rendezvous", default=None,
+                    metavar="auto|HOST:PORT",
+                    help="elastic multi-host pod: 'auto' runs the pod "
+                         "coordinator inside the launcher; HOST:PORT "
+                         "points ranks at an external one (exported as "
+                         "PADDLE_TPU_POD_COORDINATOR)")
+    ap.add_argument("--pod-min-world", type=int, default=1,
+                    help="survivors needed for the pod to keep running "
+                         "after a host loss (first rendezvous still "
+                         "waits for all --nprocs ranks)")
+    ap.add_argument("--pod-heartbeat", type=float, default=None,
+                    help="pod heartbeat interval seconds (exported as "
+                         "PADDLE_TPU_POD_HEARTBEAT; eviction timeout is "
+                         "10x this)")
     ap.add_argument("script", help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args()
@@ -306,7 +353,10 @@ def main() -> None:
                               ssh_cmd=ns.ssh, kill_grace=ns.kill_grace))
     sys.exit(launch(ns.nprocs, [ns.script] + ns.args, ns.coordinator,
                     max_restarts=ns.max_restarts,
-                    kill_grace=ns.kill_grace, log_dir=ns.log_dir))
+                    kill_grace=ns.kill_grace, log_dir=ns.log_dir,
+                    pod_rendezvous=ns.pod_rendezvous,
+                    pod_min_world=ns.pod_min_world,
+                    pod_heartbeat=ns.pod_heartbeat))
 
 
 if __name__ == "__main__":

@@ -31,11 +31,24 @@ Two registration styles:
 Timestamps are monotonic (``time.monotonic``): the snapshot records
 *when* relative to process start, never wall-clock, so a clock step
 can't fake a rate.
+
+**Process-level host label** (ISSUE 19): in a multi-host pod every
+process exports the same series names, so scraping the pod as ONE
+/metrics surface needs a distinguishing label without threading
+``host=`` through every instrument call site.  Setting
+``PADDLE_TPU_METRICS_HOST=<id>`` (injected per rank by launch.py; or
+derived as ``host-<PADDLE_TPU_HOST_ID>``) stamps ``host="<id>"`` onto
+every exposed sample — instruments and collectors alike — at
+exposition time only (zero hot-path cost; series that already declare
+their own ``host`` label win).  Unset, exposition is byte-identical to
+before.  ``set_process_labels()`` is the in-process override for
+tests and embedders.
 """
 
 from __future__ import annotations
 
 import math
+import os
 import time
 import weakref
 from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, \
@@ -46,7 +59,44 @@ from ..utils.sync import (RANK_METRICS_CHILD, RANK_METRICS_FAMILY,
                           RANK_METRICS_REGISTRY, OrderedLock, OrderedRLock)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Sample",
-           "registry", "bucket_percentile", "DEFAULT_BUCKETS"]
+           "registry", "bucket_percentile", "DEFAULT_BUCKETS",
+           "set_process_labels", "process_labels"]
+
+
+def _labels_from_env() -> Tuple[Tuple[str, str], ...]:
+    host = os.environ.get("PADDLE_TPU_METRICS_HOST")
+    if not host:
+        hid = os.environ.get("PADDLE_TPU_HOST_ID")
+        if hid:
+            host = f"host-{hid}"
+    return ((("host", host),) if host else ())
+
+
+# stamped onto every exposed sample; () = exposition unchanged
+_process_labels: Tuple[Tuple[str, str], ...] = _labels_from_env()
+
+
+def set_process_labels(**labels) -> None:
+    """Replace the process-level exposition labels (e.g.
+    ``set_process_labels(host="host-3")``; no arguments clears them).
+    Applied at scrape/snapshot time to every sample that does not
+    already carry the label key."""
+    global _process_labels
+    _process_labels = tuple(sorted((_check_name(k), str(v))
+                                   for k, v in labels.items()))
+
+
+def process_labels() -> Tuple[Tuple[str, str], ...]:
+    return _process_labels
+
+
+def _stamp(pairs):
+    """Process labels + the sample's own pairs (own keys win)."""
+    if not _process_labels:
+        return pairs
+    have = {k for k, _ in pairs}
+    extra = [kv for kv in _process_labels if kv[0] not in have]
+    return extra + list(pairs) if extra else pairs
 
 # latency-shaped default buckets (seconds): sub-ms dispatch overheads up
 # through multi-second queue waits
@@ -454,7 +504,7 @@ class MetricsRegistry:
             lines.append(f"# HELP {name} {fam.help}")
             lines.append(f"# TYPE {name} {fam.kind}")
             for vals, child in sorted(fam.children()):
-                pairs = list(zip(fam.label_names, vals))
+                pairs = _stamp(list(zip(fam.label_names, vals)))
                 if fam.kind == "histogram":
                     cum, total, count = child.snapshot()
                     edges = [_fmt_value(b) for b in child._buckets] \
@@ -478,7 +528,7 @@ class MetricsRegistry:
             lines.append(f"# HELP {name} {samples[0].help}")
             lines.append(f"# TYPE {name} {samples[0].kind}")
             for s in sorted(samples, key=lambda s: s.labels):
-                lines.append(f"{name}{labelstr(s.labels)} "
+                lines.append(f"{name}{labelstr(_stamp(s.labels))} "
                              f"{_fmt_value(s.value)}")
         return "\n".join(lines) + "\n"
 
@@ -492,7 +542,8 @@ class MetricsRegistry:
             samples = []
             for vals, child in sorted(fam.children()):
                 entry: Dict[str, object] = {
-                    "labels": dict(zip(fam.label_names, vals)),
+                    "labels": dict(_stamp(list(zip(fam.label_names,
+                                                   vals)))),
                     "updated_at": child.updated_at,
                 }
                 if fam.kind == "histogram":
@@ -514,7 +565,7 @@ class MetricsRegistry:
                 s.name, {"name": s.name, "type": s.kind, "help": s.help,
                          "samples": []})
             fam_entry["samples"].append(
-                {"labels": dict(s.labels), "value": s.value})
+                {"labels": dict(_stamp(s.labels)), "value": s.value})
         out.extend(coll[k] for k in sorted(coll))
         return {"monotonic_now": time.monotonic(), "metrics": out}
 

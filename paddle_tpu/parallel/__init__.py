@@ -28,9 +28,13 @@ from .pipeline import gpipe_call
 from .transpiler import DistributeTranspiler
 from .master import Task, TaskQueue, master_reader
 from .master_service import MasterClient, MasterServer
+from .coordinator import (CoordinatorServer, MembershipView, PodClient,
+                          PodCoordinator, StaleGeneration, agree_verdicts)
 
 __all__ = ["Mesh", "make_mesh", "mesh_guard", "set_mesh", "current_mesh",
            "feed_sharding", "state_sharding", "init_distributed",
            "DistributeTranspiler", "Task", "TaskQueue", "master_reader",
            "MasterClient", "MasterServer", "gpipe_call",
-           "switch_moe_call"]
+           "switch_moe_call", "CoordinatorServer", "MembershipView",
+           "PodClient", "PodCoordinator", "StaleGeneration",
+           "agree_verdicts"]

@@ -40,6 +40,21 @@ through the distributed stack (all no-ops unless configured):
                         checksum must fail and the entry degrade to a
                         compile-and-overwrite MISS — never a crash,
                         never garbage loaded into the device;
+  * ``net.partition`` — client-side: raise a transient ChaosError
+                        instead of sending a pod-coordinator RPC
+                        (parallel/coordinator.py PodClient — exercises
+                        the heartbeat/step retry loops, a simulated
+                        network partition that heals when the draws
+                        stop firing);
+  * ``net.delay``     — client-side: sleep a seeded deterministic
+                        interval before sending a coordinator RPC
+                        (``maybe_delay`` — skewed/laggy links without
+                        losing determinism);
+  * ``coord.crash``   — SIGKILL self at step_sync entry (the
+                        multi-host host-loss scenario: the pod must
+                        detect the silence, evict, re-rendezvous at
+                        N-1, and resume from the last committed pod
+                        snapshot);
   * ``sync.preempt``  — seeded yield/sleep perturbation at lock
                         acquire/release boundaries (armed via
                         ``utils.sync.enable_preemption``): the
@@ -187,6 +202,26 @@ class FaultInjector:
         """Raise a transient ChaosError when `point` fires."""
         if self.should(point):
             raise ChaosError(f"chaos[{point}]: injected fault")
+
+    def maybe_delay(self, point: str = "net.delay",
+                    max_delay: float = 0.05) -> bool:
+        """Sleep a seeded deterministic interval when `point` fires — a
+        laggy link rather than a lost packet (same indexed draw stream
+        as ``should``, so delay and partition schedules never perturb
+        each other); returns True if it slept."""
+        prob = self.probs.get(point, 0.0)
+        if prob <= 0.0:
+            return False
+        with self._lock:
+            index = self._draws.get(point, 0)
+            self._draws[point] = index + 1
+        value = self.decision(self.seed, point, index)
+        fired = value < prob
+        self._log(f"{point} {index} {value:.9f} {int(fired)}")
+        if not fired:
+            return False
+        time.sleep((value / prob) * max_delay)   # uniform [0, max_delay)
+        return True
 
     def maybe_truncate(self, path: str, point: str = "ckpt.truncate") -> bool:
         """Truncate `path` to half its size when `point` fires — a torn

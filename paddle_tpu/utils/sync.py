@@ -74,6 +74,7 @@ RANK_SERVICE = 10          # resilience.service    resilience/service.py
 RANK_LIFECYCLE = 12        # lifecycle.controller  lifecycle/controller.py
 RANK_NATIVE_BUILD = 14     # native.build          native/__init__.py
 RANK_NATIVE = 15           # native.lib            native/__init__.py
+RANK_COORD = 18            # coord.state           parallel/coordinator.py
 RANK_MASTER_SNAP = 20      # master.snapshot       parallel/master_service.py
 RANK_MASTER_QUEUE = 22     # master.queue          parallel/master.py
 RANK_FLEET_ROUTER = 24     # fleet.router          serving/fleet/router.py
@@ -101,6 +102,7 @@ RANK_TABLE: Dict[str, int] = {
     "lifecycle.controller": RANK_LIFECYCLE,
     "native.build": RANK_NATIVE_BUILD,
     "native.lib": RANK_NATIVE,
+    "coord.state": RANK_COORD,
     "master.snapshot": RANK_MASTER_SNAP,
     "master.queue": RANK_MASTER_QUEUE,
     "fleet.router": RANK_FLEET_ROUTER,

@@ -1,0 +1,517 @@
+"""Fast deterministic tests for the elastic multi-host control plane
+(parallel/coordinator.py + the pod snapshot half in fluid/checkpoint.py):
+agreement-protocol vote matrices, generation-numbered rendezvous with a
+fake clock, vote-stall and heartbeat eviction, manifest commit/torn-rank
+recovery, the META-checksum restore bugfix, the new chaos points, the
+process-level metrics host label, and an in-process two-host pod train
+loop (threads, no subprocesses — the slow SIGKILL scenario lives in
+test_coordinator_e2e.py).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.fluid import io as fio
+from paddle_tpu.fluid.checkpoint import (CheckpointManager,
+                                         PodCheckpointManager)
+from paddle_tpu.observability.metrics import (registry,
+                                              set_process_labels)
+from paddle_tpu.parallel.coordinator import (CoordinatorServer,
+                                             PodClient, PodCoordinator,
+                                             StaleGeneration,
+                                             agree_verdicts, pack_arrays,
+                                             unpack_arrays)
+from paddle_tpu.resilience import FaultInjector, install
+from paddle_tpu.resilience.trainer import ResilientTrainer
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- the agreement rule (pure) ------------------------------------------------
+
+@pytest.mark.parametrize("votes,expected,want", [
+    ({"a": "continue", "b": "continue"}, ["a", "b"], "continue"),
+    ({"a": "continue", "b": "skip"}, ["a", "b"], "skip"),
+    ({"a": "skip", "b": "rollback"}, ["a", "b"], "rollback"),
+    ({"a": "rollback", "b": "continue", "c": "continue"},
+     ["a", "b", "c"], "rollback"),
+    # a missing expected voter is a conservative skip — it may have
+    # applied nothing, so nobody else may apply anything
+    ({"a": "continue", "b": "continue"}, ["a", "b", "c"], "skip"),
+    ({}, ["a"], "skip"),
+    # extra votes from hosts outside the expected set are ignored
+    ({"a": "continue", "zombie": "rollback"}, ["a"], "continue"),
+])
+def test_agree_verdicts_matrix(votes, expected, want):
+    assert agree_verdicts(votes, expected) == want
+
+
+def test_agree_verdicts_rejects_unknown_verdict():
+    with pytest.raises(ValueError, match="unknown verdict"):
+        agree_verdicts({"a": "explode"}, ["a"])
+
+
+# -- rendezvous + membership epochs ------------------------------------------
+
+def test_rendezvous_waits_for_world_target_then_forms():
+    c = PodCoordinator(world_min=1, world_target=3)
+    assert c.join("h0")["status"] == "wait"
+    assert c.join("h1")["status"] == "wait"
+    out = c.join("h2")
+    assert out["status"] == "ok" and out["world"] == 3
+    gen = out["generation"]
+    # ranks are sorted-host-id order, deterministic
+    assert [c.join(f"h{i}")["rank"] for i in range(3)] == [0, 1, 2]
+    # idempotent re-join of a member does not bump the generation
+    assert c.join("h1")["generation"] == gen
+
+
+def test_heartbeat_eviction_bumps_generation_and_reranks():
+    clk = FakeClock()
+    c = PodCoordinator(world_min=1, world_target=2,
+                       heartbeat_timeout=5.0, clock=clk)
+    c.join("a")
+    gen = c.join("b")["generation"]
+    clk.advance(3.0)
+    assert c.heartbeat("a", gen) == {"generation": gen, "stale": False,
+                                     "last_committed": 0}
+    clk.advance(3.0)          # b silent for 6s > 5s; a beat at t=3
+    out = c.heartbeat("a", gen)
+    assert out["stale"] and out["generation"] == gen + 1
+    view = c.join("a")
+    assert view["world"] == 1 and view["rank"] == 0
+    # the lost host is a loss in status, and its rejoin regrows the pod
+    assert c.status()["host_losses"] == 1
+    assert c.join("b")["status"] == "ok"
+    assert c.join("a")["generation"] == gen + 2
+    assert c.join("a")["world"] == 2
+
+
+def test_pod_below_world_min_waits_for_rejoin():
+    clk = FakeClock()
+    c = PodCoordinator(world_min=2, world_target=2,
+                       heartbeat_timeout=5.0, clock=clk)
+    c.join("a")
+    gen = c.join("a")["generation"]
+    assert gen == 0            # still gathering: no epoch yet
+    gen = c.join("b")["generation"]
+    clk.advance(6.0)
+    assert c.heartbeat("a", gen)["stale"]
+    assert c.join("a")["status"] == "wait"     # 1 < world_min
+    assert c.join("b")["status"] == "ok"       # rejoin reforms at 2
+    assert c.join("a")["world"] == 2
+
+
+def test_join_refused_beyond_world_max():
+    c = PodCoordinator(world_min=1, world_target=1, world_max=2)
+    c.join("a")
+    c.join("b")
+    assert c.join("c")["status"] == "refused"
+
+
+# -- the step barrier ---------------------------------------------------------
+
+def test_step_sync_reduces_mean_and_serves_identical_bytes():
+    c = PodCoordinator(world_min=1, world_target=2)
+    c.join("a"), c.join("b")
+    ga = {"w": np.array([1.0, 2.0], np.float32)}
+    gb = {"w": np.array([3.0, 6.0], np.float32)}
+    assert c.step_sync("a", 1, 1, "continue",
+                       pack_arrays(ga))["status"] == "wait"
+    out_b = c.step_sync("b", 1, 1, "continue", pack_arrays(gb))
+    out_a = c.step_sync("a", 1, 1, "continue")    # re-poll, no payload
+    assert out_a["verdict"] == out_b["verdict"] == "continue"
+    # identical serialized bytes to every member — bitwise, not just close
+    assert json.dumps(out_a["payload"]) == json.dumps(out_b["payload"])
+    np.testing.assert_array_equal(unpack_arrays(out_a["payload"])["w"],
+                                  np.array([2.0, 4.0], np.float32))
+
+
+def test_step_sync_one_skip_vote_skips_everyone_no_payload():
+    c = PodCoordinator(world_min=1, world_target=2)
+    c.join("a"), c.join("b")
+    c.step_sync("a", 1, 1, "continue",
+                pack_arrays({"w": np.ones(2, np.float32)}))
+    out = c.step_sync("b", 1, 1, "skip")
+    assert out["verdict"] == "skip" and "payload" not in out
+    # the healthy host's re-poll agrees: applied by all or none
+    assert c.step_sync("a", 1, 1, "continue")["verdict"] == "skip"
+
+
+def test_step_sync_rollback_dominates():
+    c = PodCoordinator(world_min=1, world_target=2)
+    c.join("a"), c.join("b")
+    c.step_sync("a", 1, 1, "skip")
+    assert c.step_sync("b", 1, 1, "rollback")["verdict"] == "rollback"
+
+
+def test_vote_stall_times_out_to_skip_and_evicts_the_silent_host():
+    clk = FakeClock()
+    c = PodCoordinator(world_min=1, world_target=3, vote_timeout=10.0,
+                       heartbeat_timeout=1e9, clock=clk)
+    for h in ("a", "b", "c"):
+        c.join(h)
+    gen = c.join("a")["generation"]
+    c.step_sync("a", gen, 1, "continue",
+                pack_arrays({"w": np.ones(1, np.float32)}))
+    out = c.step_sync("b", gen, 1, "continue",
+                      pack_arrays({"w": np.ones(1, np.float32)}))
+    assert out["status"] == "wait"
+    clk.advance(11.0)          # c never votes
+    out = c.step_sync("a", gen, 1, "continue")
+    assert out["status"] == "decided" and out["verdict"] == "skip"
+    assert "payload" not in out
+    # the stalled voter was evicted: generation moved, world shrank
+    st = c.status()
+    assert st["generation"] > gen and st["world"] == 2
+    assert "c" not in st["members"] and st["host_losses"] == 1
+    # survivors' next barrier is stale until they resync
+    assert c.step_sync("a", gen, 2, "continue")["status"] == "stale"
+    assert c.join("a")["world"] == 2
+
+
+def test_step_sync_mismatched_shapes_degrade_to_skip():
+    c = PodCoordinator(world_min=1, world_target=2)
+    c.join("a"), c.join("b")
+    c.step_sync("a", 1, 1, "continue",
+                pack_arrays({"w": np.ones(2, np.float32)}))
+    out = c.step_sync("b", 1, 1, "continue",
+                      pack_arrays({"w": np.ones(3, np.float32)}))
+    assert out["verdict"] == "skip" and "shapes differ" in out["error"]
+
+
+def test_step_sync_stale_generation_rejected():
+    c = PodCoordinator(world_min=1, world_target=1)
+    c.join("a")
+    assert c.step_sync("a", 99, 1, "continue")["status"] == "stale"
+
+
+# -- HTTP surface + PodClient ------------------------------------------------
+
+def test_client_join_step_and_regrow_staleness(tmp_path):
+    srv = CoordinatorServer(world_min=1, world_target=2,
+                            vote_timeout=30.0)
+    addr = srv.start()
+    try:
+        a = PodClient(addr, "a", retry=False, poll_interval=0.01)
+        b = PodClient(addr, "b", retry=False, poll_interval=0.01)
+        assert a.ping()
+        views = {}
+        ta = threading.Thread(
+            target=lambda: views.__setitem__("a", a.join(deadline=10)))
+        ta.start()
+        views["b"] = b.join(deadline=10)
+        ta.join(10)
+        assert views["a"].world == views["b"].world == 2
+        assert {views["a"].rank, views["b"].rank} == {0, 1}
+
+        out = {}
+
+        def step(cl, g):
+            out[cl.host] = cl.step_sync(1, "continue", g, deadline=10)
+
+        t = threading.Thread(target=step, args=(
+            a, {"w": np.array([1.0], np.float32)}))
+        t.start()
+        step(b, {"w": np.array([3.0], np.float32)})
+        t.join(10)
+        va, ra = out["a"]
+        vb, rb = out["b"]
+        assert va == vb == "continue"
+        assert ra["w"].tobytes() == rb["w"].tobytes()
+        np.testing.assert_array_equal(ra["w"],
+                                      np.array([2.0], np.float32))
+
+        # a third host joining regrows the pod: the old generation is
+        # stale, and the client surfaces that as StaleGeneration
+        cthird = PodClient(addr, "c", retry=False, poll_interval=0.01)
+        cthird.join(deadline=10)
+        with pytest.raises(StaleGeneration):
+            a.step_sync(2, "continue",
+                        {"w": np.array([1.0], np.float32)}, deadline=10)
+        assert a.resync(deadline=10).world == 3
+    finally:
+        srv.stop()
+
+
+def test_client_retries_through_injected_partition(tmp_path):
+    srv = CoordinatorServer(world_min=1, world_target=1)
+    addr = srv.start()
+    prev = install(FaultInjector(spec="net.partition=0.5", seed=3))
+    try:
+        cl = PodClient(addr, "solo", poll_interval=0.01)   # default retry
+        view = cl.join(deadline=30)
+        assert view.world == 1
+        verdict, reduced = cl.step_sync(
+            1, "continue", {"w": np.ones(2, np.float32)}, deadline=30)
+        assert verdict == "continue"
+        np.testing.assert_array_equal(reduced["w"],
+                                      np.ones(2, np.float32))
+    finally:
+        install(prev)
+        srv.stop()
+
+
+def test_maybe_delay_is_seeded_and_deterministic(tmp_path):
+    log = str(tmp_path / "chaos.journal")
+    inj = FaultInjector(spec="net.delay=0.5", seed=11, log_path=log)
+    fired = [inj.maybe_delay("net.delay", max_delay=0.001)
+             for _ in range(20)]
+    assert any(fired) and not all(fired)
+    # the journal replays exactly from the seed, draw by draw
+    for ln in open(log):
+        point, index, value, f = ln.split()
+        assert point == "net.delay"
+        want = FaultInjector.decision(11, point, int(index))
+        assert abs(float(value) - want) < 1e-9
+        assert (want < 0.5) == bool(int(f))
+    # a fresh injector with the same seed fires the same schedule
+    inj2 = FaultInjector(spec="net.delay=0.5", seed=11)
+    assert [inj2.maybe_delay("net.delay", max_delay=0.0)
+            for _ in range(20)] == fired
+
+
+# -- pod manifests: stage / commit / torn-rank recovery ----------------------
+
+def _state(v):
+    return {"w": np.full(3, v, np.float32),
+            "b": np.array([v], np.float32)}
+
+
+def test_pod_manifest_commit_requires_all_ranks(tmp_path):
+    pm = PodCheckpointManager(str(tmp_path))
+    pm.stage(4, rank=0, world=2, items=_state(1.0))
+    assert pm.commit(4, world=2) is False        # rank 1 missing: torn
+    assert pm.latest_committed() is None
+    assert pm.restore(0) is None                 # never half-restored
+    pm.stage(4, rank=1, world=2, items=_state(2.0))
+    assert pm.commit(4, world=2) is True
+    assert pm.commit(4, world=2) is True         # idempotent
+    step, items = pm.restore(0)
+    assert step == 4
+    np.testing.assert_array_equal(items["w"], np.full(3, 1.0, np.float32))
+    # any rank id maps onto a committed copy (replicated params)
+    step, items = pm.restore(5)                  # 5 % 2 == 1
+    np.testing.assert_array_equal(items["w"], np.full(3, 2.0, np.float32))
+
+
+def test_pod_restore_skips_torn_newest_manifest(tmp_path):
+    pm = PodCheckpointManager(str(tmp_path))
+    for r in range(2):
+        pm.stage(2, rank=r, world=2, items=_state(1.0))
+    pm.commit(2, world=2)
+    pm.stage(5, rank=0, world=2, items=_state(9.0))   # rank 1 died
+    step, items = pm.restore(0)
+    assert step == 2                             # torn 5 skipped whole
+    np.testing.assert_array_equal(items["w"], np.full(3, 1.0, np.float32))
+
+
+def test_pod_restore_falls_back_on_checksum_mismatch(tmp_path):
+    pm = PodCheckpointManager(str(tmp_path))
+    for step in (2, 4):
+        for r in range(2):
+            pm.stage(step, rank=r, world=2, items=_state(float(step)))
+        pm.commit(step, world=2)
+    # corrupt BOTH copies of step 4 with self-consistent frames (the
+    # framed CRC passes; only the META checksum recorded at save time
+    # can catch it)
+    for r in range(2):
+        path = os.path.join(str(tmp_path), "pod-4", f"rank-{r}", "w")
+        with open(path, "wb") as f:
+            f.write(fio.tensor_to_bytes(np.full(3, 666.0, np.float32)))
+    step, items = pm.restore(0)
+    assert step == 2
+    np.testing.assert_array_equal(items["w"], np.full(3, 2.0, np.float32))
+
+
+def test_pod_prune_keeps_newest_committed_and_gcs_stale_stages(tmp_path):
+    pm = PodCheckpointManager(str(tmp_path), max_to_keep=2)
+    pm.stage(1, rank=0, world=1, items=_state(1.0))   # abandoned stage
+    for step in (2, 4, 6):
+        pm.stage(step, rank=0, world=1, items=_state(float(step)))
+        pm.commit(step, world=1)
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "pod-2" not in names and "pod-1" not in names
+    assert {"pod-4", "pod-6"} <= set(names)
+
+
+# -- the CheckpointManager restore bugfix ------------------------------------
+
+def test_restore_verifies_meta_checksums_and_falls_back(tmp_path):
+    pytest.importorskip("jax")
+    from paddle_tpu import fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [2], "float32")
+        fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+    mgr.save(1, main, scope, force=True)
+    mgr.save(2, main, scope, force=True)
+    meta = json.load(open(os.path.join(str(tmp_path), "ckpt-2",
+                                       "META.json")))
+    assert meta["checksums"]                    # recorded per tensor
+    name = meta["names"][0]
+    # rewrite a tensor of the NEWEST checkpoint with a frame-valid but
+    # wrong payload — before the fix this loaded silently
+    with open(os.path.join(str(tmp_path), "ckpt-2", name), "wb") as f:
+        f.write(fio.tensor_to_bytes(np.full((2, 2), 7.0, np.float32)))
+    with fluid.scope_guard(scope):
+        restored = mgr.restore(main, scope)
+    assert restored == 1                        # fell back, not 2
+    assert not np.allclose(np.asarray(scope.find_var(name)), 7.0)
+
+
+# -- metrics: process-level host label ---------------------------------------
+
+def test_process_host_label_stamped_at_exposition():
+    reg = registry()
+    fam = reg.counter("paddle_test_pod_host_total",
+                      "host label test", labels=("kind",))
+    fam.labels(kind="x").inc()
+    own = reg.counter("paddle_test_pod_own_host_total",
+                      "own host label wins", labels=("host",))
+    own.labels(host="explicit").inc()
+    set_process_labels(host="host-7")
+    try:
+        text = reg.render_prometheus()
+        assert 'paddle_test_pod_host_total{host="host-7",kind="x"}' \
+            in text
+        # a series that declares its own host label is left alone
+        assert 'paddle_test_pod_own_host_total{host="explicit"}' in text
+        snap = reg.snapshot()
+        by_name = {m["name"]: m for m in snap["metrics"]}
+        assert by_name["paddle_test_pod_host_total"]["samples"][0][
+            "labels"] == {"host": "host-7", "kind": "x"}
+    finally:
+        set_process_labels()
+    assert "host-7" not in reg.render_prometheus()
+
+
+def test_process_host_label_from_env(monkeypatch):
+    from paddle_tpu.observability import metrics as m
+
+    monkeypatch.setenv("PADDLE_TPU_METRICS_HOST", "pod-host-3")
+    assert m._labels_from_env() == (("host", "pod-host-3"),)
+    monkeypatch.delenv("PADDLE_TPU_METRICS_HOST")
+    monkeypatch.setenv("PADDLE_TPU_HOST_ID", "2")
+    assert m._labels_from_env() == (("host", "host-2"),)
+    monkeypatch.delenv("PADDLE_TPU_HOST_ID")
+    assert m._labels_from_env() == ()
+
+
+# -- the in-process pod train loop -------------------------------------------
+
+W_TRUE = np.array([1.5, -2.0, 0.5, 3.0], np.float32)
+
+
+def _pod_worker(addr, host, ckpt, max_steps, results, nan_step=None,
+                nan_host=None):
+    params = {}
+    client = PodClient(addr, host, retry=False, poll_interval=0.01)
+
+    def read_chunk(step, rank, world):
+        r = np.random.RandomState(step)       # global batch per step
+        xs = r.randn(8, 4).astype(np.float32)
+        ys = xs @ W_TRUE[:, None]
+        return xs[rank::world], ys[rank::world]     # equal shards
+
+    def train_step(rec, step):
+        xs, ys = rec
+        pred = xs @ params["w"]
+        g = 2.0 * xs.T @ (pred - ys) / len(xs)
+        if step == nan_step and host == nan_host:
+            g = g * np.nan
+        return True, {"w": g.astype(np.float32)}
+
+    def apply_update(reduced, step):
+        params["w"] = (params["w"] - 0.05 * reduced["w"]).astype(
+            np.float32)
+
+    trainer = ResilientTrainer(
+        ckpt, coordinator=client, read_chunk=read_chunk,
+        apply_update=apply_update,
+        state_get=lambda: dict(params),
+        state_set=lambda items: params.update(items),
+        save_interval_steps=2, rendezvous_deadline=60,
+        step_deadline=60, heartbeat_interval=0.2)
+    final = trainer.run(train_step,
+                        init_fn=lambda: params.update(
+                            w=np.zeros((4, 1), np.float32)),
+                        max_steps=max_steps)
+    results[host] = (final, params["w"].copy())
+
+
+def test_two_host_pod_trains_in_lockstep_with_agreed_nan_skip(tmp_path):
+    srv = CoordinatorServer(world_min=1, world_target=2,
+                            vote_timeout=60.0)
+    addr = srv.start()
+    ckpt = str(tmp_path / "pod")
+    results = {}
+    try:
+        threads = [threading.Thread(
+            target=_pod_worker,
+            args=(addr, h, ckpt, 6, results),
+            kwargs={"nan_step": 3, "nan_host": "hb"})
+            for h in ("ha", "hb")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        srv.stop()
+    assert results["ha"][0] == results["hb"][0] == 6
+    # one host's NaN became an agreed pod-wide skip: params stayed
+    # BITWISE identical across hosts through it
+    assert results["ha"][1].tobytes() == results["hb"][1].tobytes()
+    # training still converged toward W_TRUE
+    final_w = results["ha"][1].ravel()
+    assert np.linalg.norm(final_w - W_TRUE) < np.linalg.norm(W_TRUE)
+    # both hosts journaled the SAME agreed verdict per step: skip at
+    # exactly step 3, continue elsewhere
+    verdicts = {}
+    for ln in open(os.path.join(ckpt, "guard.journal")):
+        rec = json.loads(ln)
+        if not rec["event"].startswith("pod-"):
+            continue
+        key = (rec["generation"], rec["step"])
+        verdicts.setdefault(key, set()).add(rec["event"])
+    for (gen, step), events in verdicts.items():
+        assert len(events) == 1, (gen, step, events)
+        assert events == ({"pod-skip"} if step == 3
+                          else {"pod-continue"})
+    # the coordinated snapshot committed the final step, restorable
+    pm = PodCheckpointManager(ckpt)
+    assert pm.latest_committed() == 6
+    step, items = pm.restore(0)
+    assert step == 6
+    assert items["w"].tobytes() == results["ha"][1].tobytes()
+
+
+def test_pod_mode_requires_apply_update(tmp_path):
+    with pytest.raises(ValueError, match="apply_update"):
+        ResilientTrainer(str(tmp_path), coordinator=object())
+
+
+def test_lease_mode_requires_queue(tmp_path):
+    with pytest.raises(ValueError, match="queue"):
+        ResilientTrainer(str(tmp_path))

@@ -1,0 +1,262 @@
+"""Elastic multi-host chaos end-to-end (marked slow; the fast
+deterministic halves live in test_coordinator.py).
+
+The flagship scenario: three subprocess "hosts" rendezvous through one
+PodCoordinator, train a shared fluid regression in lockstep (gradients
+mean-reduced through the per-step agreement barrier), and a seeded
+FaultInjector SIGKILLs one host at a precomputed step_sync entry.  The
+survivors must detect the loss, re-rendezvous at world 2, rewind to the
+last committed pod manifest, and finish with zero lost or duplicated
+steps and bitwise-identical parameters — with an injected single-host
+NaN earlier in the run becoming an agreed pod-wide skip, and a
+pre-seeded torn (uncommitted) manifest never restored.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.fluid.checkpoint import PodCheckpointManager
+from paddle_tpu.parallel import CoordinatorServer
+from paddle_tpu.resilience import FaultInjector
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+# seed 5 @ p=0.12: FaultInjector.decision(5, "coord.crash", i) first
+# fires at draw index 4.  PodClient.step_sync draws once per call, so
+# the victim SIGKILLs itself entering the barrier for step 5 — after
+# the world-3 manifests at steps 2 and 4 committed.
+CRASH_SEED, CRASH_PROB, CRASH_STEP = 5, 0.12, 5
+NAN_STEP = 2          # a SURVIVOR poisons this step -> agreed pod skip
+MAX_STEPS = 8
+
+POD_WORKER = """
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    addr, ckpt_dir, out_dir, host = sys.argv[1:5]
+    max_steps = int(os.environ["POD_MAX_STEPS"])
+    nan_step = int(os.environ.get("POD_NAN_STEP", "0"))
+    nan_host = os.environ.get("POD_NAN_HOST", "")
+
+    from paddle_tpu import fluid
+    from paddle_tpu.parallel import PodClient
+    from paddle_tpu.resilience import ResilientTrainer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11   # identical init pod-wide
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4], "float32")
+        y = fluid.layers.data("y", [1], "float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        pairs = fluid.append_backward(loss)       # fetch grads, apply later
+    exe = fluid.Executor(fluid.CPUPlace())
+    params = [p.name for p, _ in pairs]
+    gvars = [g for _, g in pairs]
+
+    W = np.array([1.5, -2.0, 0.5, 3.0], np.float32)
+
+    def read_chunk(step, rank, world):
+        r = np.random.RandomState(step)           # one global batch per step
+        xs = r.randn(12, 4).astype(np.float32)
+        ys = (xs @ W[:, None]).astype(np.float32)
+        return xs[rank::world], ys[rank::world]   # this host's shard
+
+    losses = open(os.path.join(out_dir, host + ".losses"), "a")
+
+    def train_step(rec, step):
+        xs, ys = rec
+        out = exe.run(main, feed={"x": xs, "y": ys},
+                      fetch_list=[loss] + gvars)
+        losses.write(f"{step} {float(np.asarray(out[0]))}\\n")
+        losses.flush()
+        grads = {n: np.asarray(g) for n, g in zip(params, out[1:])}
+        if step == nan_step and host == nan_host:
+            grads = {k: v * np.nan for k, v in grads.items()}
+        return True, grads
+
+    def apply_update(reduced, step):
+        for name in params:
+            cur = np.asarray(scope.find_var(name))
+            scope.set_var(name,
+                          (cur - 0.05 * reduced[name]).astype(np.float32))
+
+    client = PodClient(addr, host, poll_interval=0.05)
+    trainer = ResilientTrainer(
+        ckpt_dir, coordinator=client, read_chunk=read_chunk,
+        apply_update=apply_update, program=main, scope=scope,
+        save_interval_steps=2, rendezvous_deadline=60.0,
+        step_deadline=60.0, heartbeat_interval=0.2)
+
+    def cold_init():
+        # marker written HERE (not at exit): the chaos victim never
+        # reaches exit, but its cold start must still be observable
+        open(os.path.join(out_dir, host + ".fresh"), "w").close()
+        exe.run(startup)
+
+    with fluid.scope_guard(scope):
+        final = trainer.run(train_step, init_fn=cold_init,
+                            max_steps=max_steps)
+    state = {n: np.asarray(scope.find_var(n)) for n in params}
+    np.savez(os.path.join(out_dir, host + ".final.npz"), **state)
+    print("WORKER-DONE", final, flush=True)
+"""
+
+
+def _clean_env(extra=None):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep
+                + os.environ.get("PYTHONPATH", "")})
+    env.update(extra or {})
+    return env
+
+
+def _effective_timeline(events):
+    """Replay one host's pod-* journal entries: verdicts advance the
+    timeline, resync/rollback-restore rewind it (discarding every
+    later entry — those steps were never durably applied).  Returns the
+    surviving [(step, verdict)] in order."""
+    line = []
+    for rec in events:
+        if rec["event"] in ("pod-resync", "pod-rollback-restore"):
+            line = [(s, v) for s, v in line if s <= rec["step"]]
+        else:
+            line.append((rec["step"], rec["event"]))
+    return line
+
+
+def test_chaos_host_loss_re_rendezvous_and_lockstep_recovery(tmp_path):
+    script = str(tmp_path / "pod_worker.py")
+    open(script, "w").write(textwrap.dedent(POD_WORKER))
+    ckpt = str(tmp_path / "pod")
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    journal = str(tmp_path / "chaos.journal")
+
+    # a torn manifest from "before": one staged rank, no COMMIT marker.
+    # Recovery must never restore it — every host cold-starts instead.
+    torn = PodCheckpointManager(ckpt)
+    torn.stage(999, 0, 3, {"fc_0.w_0": np.full((4, 1), 77.0, np.float32)})
+    assert torn.latest_committed() is None
+
+    srv = CoordinatorServer(world_min=1, world_target=3,
+                            heartbeat_timeout=2.0, vote_timeout=4.0)
+    addr = srv.start()
+    procs = {}
+    try:
+        base = {"POD_MAX_STEPS": str(MAX_STEPS),
+                "POD_NAN_STEP": str(NAN_STEP),
+                "POD_NAN_HOST": "host-a"}
+        victim_extra = {"PADDLE_TPU_CHAOS":
+                        f"coord.crash={CRASH_PROB}",
+                        "PADDLE_TPU_CHAOS_SEED": str(CRASH_SEED),
+                        "PADDLE_TPU_CHAOS_LOG": journal}
+        for host in ("host-a", "host-b", "host-c"):
+            extra = dict(base)
+            if host == "host-c":
+                extra.update(victim_extra)
+            procs[host] = subprocess.Popen(
+                [sys.executable, script, addr, ckpt, out, host],
+                env=_clean_env(extra), cwd=str(tmp_path))
+
+        # the victim dies by its own seeded hand at step 5's barrier
+        assert procs["host-c"].wait(timeout=120) == -9
+
+        # survivors detect the loss and re-rendezvous at world 2
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = srv.status()
+            if st["world"] == 2 and "host-c" not in st["members"]:
+                break
+            time.sleep(0.1)
+        assert st["world"] == 2 and st["host_losses"] == 1, st
+
+        for host in ("host-a", "host-b"):
+            assert procs[host].wait(timeout=180) == 0, host
+        final_status = srv.status()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+
+    # every host cold-started: the torn pod-999 manifest was skipped
+    for host in ("host-a", "host-b", "host-c"):
+        assert os.path.exists(os.path.join(out, host + ".fresh")), host
+
+    # the pod's durable result is the final step, restorable, and the
+    # torn manifest is still not committed
+    pm = PodCheckpointManager(ckpt)
+    assert pm.latest_committed() == MAX_STEPS
+    assert 999 not in pm.committed_steps()
+    assert final_status["last_committed"] == MAX_STEPS
+    step, items = pm.restore(0)
+    assert step == MAX_STEPS
+
+    # bitwise-identical parameters across the survivors, matching the
+    # committed manifest
+    fa = np.load(os.path.join(out, "host-a.final.npz"))
+    fb = np.load(os.path.join(out, "host-b.final.npz"))
+    assert set(fa.files) == set(fb.files) and fa.files
+    for name in fa.files:
+        assert fa[name].tobytes() == fb[name].tobytes(), name
+        assert items[name].tobytes() == fa[name].tobytes(), name
+
+    # training converged through the NaN-skip and the host loss
+    for host in ("host-a", "host-b"):
+        lines = [ln.split() for ln in
+                 open(os.path.join(out, host + ".losses"))]
+        vals = [float(v) for _, v in lines]
+        assert vals[-1] < vals[0], (host, vals[0], vals[-1])
+
+    # journal audit: identical agreed verdicts wherever two hosts saw
+    # the same (generation, step); zero lost or duplicated steps after
+    # rewinds; the only effective skip is the agreed NaN step
+    per_host = {}
+    verdicts = {}
+    for ln in open(os.path.join(ckpt, "guard.journal")):
+        rec = json.loads(ln)
+        if not rec["event"].startswith("pod-"):
+            continue
+        per_host.setdefault(rec["host"], []).append(rec)
+        if rec["event"] not in ("pod-resync", "pod-rollback-restore"):
+            key = (rec["generation"], rec["step"])
+            verdicts.setdefault(key, set()).add(rec["event"])
+    for key, events in verdicts.items():
+        assert len(events) == 1, (key, events)
+    for host in ("host-a", "host-b"):
+        line = _effective_timeline(per_host[host])
+        assert [s for s, _ in line] == list(range(1, MAX_STEPS + 1)), \
+            (host, line)
+        assert {s for s, v in line if v == "pod-skip"} == {NAN_STEP}, \
+            (host, line)
+        # the loss really forced a rewind: a resync below the crash step
+        assert any(r["event"] == "pod-resync"
+                   and r["step"] < CRASH_STEP
+                   for r in per_host[host]), host
+
+    # determinism: every journaled chaos draw replays from the seed,
+    # and the fatal draw is the precomputed one
+    fired = []
+    for ln in open(journal):
+        if ln.startswith("#") or not ln.strip():
+            continue
+        point, index, value, hit = ln.split()
+        assert point == "coord.crash"
+        want = FaultInjector.decision(CRASH_SEED, point, int(index))
+        assert abs(float(value) - want) < 1e-9
+        if hit == "1":
+            fired.append(int(index))
+    assert fired == [CRASH_STEP - 1]      # draw i belongs to step i+1

@@ -46,6 +46,14 @@ if [ "$want_sync" = 1 ]; then
     python -m paddle_tpu.tools.syncheck paddle_tpu/serving/fleet \
       paddle_tpu/tools/fleet.py || rc=1
 
+  # the elastic pod control plane (ISSUE 19) mixes HTTP handlers, a
+  # heartbeat thread and the coordinator state lock — the explicit
+  # sweep makes a raw-primitive or I/O-under-lock regression there
+  # unmissable
+  echo "== syncheck over paddle_tpu/parallel/"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m paddle_tpu.tools.syncheck paddle_tpu/parallel || rc=1
+
   # smoke-run the real scheduler/gateway/journal stack with runtime
   # order checking ON and dump the observed lock-order graph as an
   # artifact (SYNC_GRAPH_OUT overrides the path) — the graph is the
@@ -107,6 +115,85 @@ print(f"sync smoke: {len(g['nodes'])} locks, {len(g['edges'])} edges, "
 sync.disable_checking()
 EOF
   rm -f "$smoke_journal"
+
+  # pod smoke (ISSUE 19): two REAL subprocess hosts rendezvous through
+  # a CoordinatorServer, train 6 lockstep steps with mean-reduced
+  # gradients, and must finish bitwise identical with the coordinated
+  # manifest committed at the final step — the minimal end-to-end pass
+  # over the elastic control plane on every lint run
+  echo "== pod smoke: 2 subprocess hosts through the coordinator"
+  pod_tmp="$(mktemp -d)"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$pod_tmp" <<'EOF' || rc=1
+import os, subprocess, sys
+
+tmpdir = sys.argv[1]
+from paddle_tpu.fluid.checkpoint import PodCheckpointManager
+from paddle_tpu.parallel import CoordinatorServer
+
+WORKER = '''
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from paddle_tpu.parallel import PodClient
+from paddle_tpu.resilience import ResilientTrainer
+
+addr, ckpt, host = sys.argv[1:4]
+params = {}
+w_true = np.arange(4, dtype=np.float32)[:, None]
+
+def read_chunk(step, rank, world):
+    r = np.random.RandomState(step)
+    xs = r.randn(8, 4).astype(np.float32)
+    return xs[rank::world], (xs @ w_true)[rank::world]
+
+def train_step(rec, step):
+    xs, ys = rec
+    g = 2.0 * xs.T @ (xs @ params["w"] - ys) / len(xs)
+    return True, {"w": g.astype(np.float32)}
+
+trainer = ResilientTrainer(
+    ckpt, coordinator=PodClient(addr, host, poll_interval=0.01),
+    read_chunk=read_chunk,
+    apply_update=lambda red, step: params.update(
+        w=(params["w"] - 0.05 * red["w"]).astype(np.float32)),
+    state_get=lambda: dict(params),
+    state_set=lambda items: params.update(items),
+    save_interval_steps=3, rendezvous_deadline=60.0,
+    step_deadline=60.0, heartbeat_interval=0.2)
+final = trainer.run(train_step,
+                    init_fn=lambda: params.update(
+                        w=np.zeros((4, 1), np.float32)),
+                    max_steps=6)
+assert final == 6, final
+print(params["w"].tobytes().hex())
+'''
+script = os.path.join(tmpdir, "pod_worker.py")
+open(script, "w").write(WORKER)
+srv = CoordinatorServer(world_min=1, world_target=2)
+addr = srv.start()
+try:
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PYTHONPATH=os.getcwd() + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, script, addr, os.path.join(tmpdir, "pod"),
+         f"h{i}"], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for i in range(2)]
+    outs = [p.communicate(timeout=180) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-2000:]
+    finals = [out.strip().splitlines()[-1] for out, _ in outs]
+    assert finals[0] == finals[1], "pod hosts diverged"
+    assert srv.status()["last_committed"] == 6, srv.status()
+finally:
+    srv.stop()
+assert PodCheckpointManager(os.path.join(tmpdir, "pod")) \
+    .latest_committed() == 6
+print("pod smoke: 2 hosts, 6 lockstep steps, params bitwise "
+      "identical, manifest committed @6")
+EOF
+  rm -rf "$pod_tmp"
 fi
 
 if [ "$want_ruff" = 1 ]; then
