@@ -774,6 +774,155 @@ def bench_serving(batch: int, trials: int, seq_len: int = 256,
     }
 
 
+def bench_long_context_sessions(trials: int, decode_len: int = 48):
+    """ISSUE 20 measurement: the tiered KV cache as a long-context
+    serving capability.  One pooled-KV transformer with a pinned-host
+    second tier and a session store serves MANY concurrent
+    conversations through two HBM slots; an HBM-only twin with the
+    SAME page pool is the baseline.  Reports (and the driver gates):
+
+    * max concurrent open sessions, tiered vs HBM-only at equal
+      ``num_pages`` — both MEASURED (admit until ``PoolCapacityError``
+      / suspend until the target), never derived from page math;
+    * resume TTFT vs re-prefill TTFT for same-length prompts — the
+      whole point of session suspend/resume is skipping the O(S^2)
+      prefill, so the ratio must be < 1;
+    * page-granular spill (d2h) / prefetch (h2d) bandwidth through the
+      fixed-width copy programs;
+    * executable-cache misses across the whole suspend/resume/demote/
+      promote churn after one warm cycle (contract: 0)."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    from paddle_tpu import fluid
+    from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                    PagedTransformerGenerator,
+                                    PoolCapacityError, SessionStore)
+
+    vocab, src_len, ps = 8192, 96, 8
+    dims = dict(n_layer=2, n_head=4, d_key=32, d_value=32, d_model=128,
+                d_inner_hid=512)
+    # pool sized so only a handful of sessions fit device-resident;
+    # the host tier holds an order of magnitude more pages
+    num_pages = 97
+    kw = dict(max_length=src_len + decode_len + 2, src_len=src_len,
+              max_out_len=decode_len, page_size=ps, chunk_size=16,
+              num_pages=num_pages, **dims)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    sess_dir = tempfile.mkdtemp(prefix="bench_kvs_")
+    store = SessionStore(dirname=sess_dir)
+    gen = PagedTransformerGenerator(vocab, vocab, host_pages=1024,
+                                    session_store=store, scope=scope,
+                                    executor=exe, param_prefix="lcs",
+                                    **kw)
+    gen.init_params(seed=0)
+
+    rng = np.random.RandomState(0)
+
+    # HBM-only ceiling: admission reserves every page a resident
+    # conversation holds, so "admit distinct prompts until the pool
+    # refuses" IS the max-concurrent-sessions measurement.  The twin
+    # never dispatches — admission is host-side bookkeeping — so it
+    # needs no parameters, just the same pool geometry and no tier.
+    hbm = PagedTransformerGenerator(vocab, vocab, scope=fluid.Scope(),
+                                    executor=fluid.Executor(
+                                        fluid.TPUPlace(0)),
+                                    param_prefix="lch", **kw)
+    probe_cap = 64
+    hbm.open_slots(probe_cap)
+    hbm_only = 0
+    try:
+        for i in range(probe_cap):
+            hbm.admit_slot(i, rng.randint(2, vocab, src_len),
+                           max_new=decode_len)
+            hbm_only += 1
+    except PoolCapacityError:
+        pass
+    hbm.open_slots(1)           # release the probe lanes
+
+    n_sessions = min(40, max(2 * hbm_only, hbm_only + 4))
+    prompts = [rng.randint(2, vocab, src_len) for _ in range(n_sessions)]
+    sched = ContinuousBatchingScheduler(gen, n_slots=2,
+                                        max_new_tokens=decode_len)
+
+    def _run(prompt, max_new, session=None):
+        req = sched.submit(prompt, max_new_tokens=max_new,
+                           session=session)
+        sched.run_until_idle()
+        assert req.done and req.error is None, req.error
+        return req
+
+    # warm cycle: fresh prefill+decode+suspend, then resume (upload
+    # program) + re-suspend — every executable the measured phases
+    # touch compiles here, then the miss counter freezes
+    warm_p = rng.randint(2, vocab, src_len)
+    _run(warm_p, 2, session="warm")
+    _run(warm_p, 2, session="warm")
+    sched.run_until_idle()
+    store.delete("warm")
+    c0 = gen.exe.cache_stats()["executable"]["misses"]
+
+    # fan-out: every session decodes a couple of tokens through the TWO
+    # slots, suspends at retire, and stays resumable — the tiered
+    # max-concurrent count is how many are simultaneously open
+    for i in range(n_sessions):
+        _run(prompts[i], 2, session=f"s{i}")
+    sched.run_until_idle()      # drain trailing suspend maintenance
+    tiered = sum(1 for i in range(n_sessions) if store.has(f"s{i}"))
+
+    # resume TTFT vs re-prefill TTFT: same prompt lengths, distinct
+    # prompts per trial both ways (no prefix-cache crosstalk)
+    n_t = max(2, min(int(trials), tiered, 8))
+    resume_ttft = reprefill_ttft = float("inf")
+    for i in range(n_t):
+        req = _run(prompts[i], 4, session=f"s{i}")
+        assert req.resumed, f"session s{i} did not resume"
+        resume_ttft = min(resume_ttft, req.first_token - req.submitted)
+    for i in range(n_t):
+        req = _run(rng.randint(2, vocab, src_len), 4)
+        reprefill_ttft = min(reprefill_ttft,
+                             req.first_token - req.submitted)
+
+    # spill/prefetch bandwidth: drain every evictable chunk to the host
+    # tier, then promote each back, timing the fixed-width copy-program
+    # traffic via the allocator's byte counters
+    a0 = dict(gen.alloc.stats())
+    t0 = _t.time()
+    while gen.alloc.demote_one():
+        pass
+    d2h_s = _t.time() - t0
+    a1 = dict(gen.alloc.stats())
+    t0 = _t.time()
+    for h in list(gen.alloc.host._entries):
+        gen.alloc.promote_chunk(h)
+    h2d_s = _t.time() - t0
+    a2 = dict(gen.alloc.stats())
+    spill_b = a1["spilled_bytes"] - a0["spilled_bytes"]
+    fetch_b = a2["fetched_bytes"] - a1["fetched_bytes"]
+
+    recompiles = gen.exe.cache_stats()["executable"]["misses"] - c0
+    sched.shutdown()
+    shutil.rmtree(sess_dir, ignore_errors=True)
+    return {
+        "mode": "tiered_kv_sessions",
+        "src_len": src_len, "page_size": ps, "num_pages": num_pages,
+        "host_pages": 1024, "n_slots": 2,
+        "max_concurrent_sessions": {"tiered": tiered,
+                                    "hbm_only": hbm_only},
+        "resume_ttft_s": round(resume_ttft, 4),
+        "reprefill_ttft_s": round(reprefill_ttft, 4),
+        "resume_vs_reprefill_ttft_ratio": round(
+            resume_ttft / reprefill_ttft, 4),
+        "spill_mb_per_s": (round(spill_b / 1e6 / d2h_s, 1)
+                           if spill_b and d2h_s > 0 else None),
+        "prefetch_mb_per_s": (round(fetch_b / 1e6 / h2d_s, 1)
+                              if fetch_b and h2d_s > 0 else None),
+        "recompiles_after_warmup": recompiles,
+    }
+
+
 def bench_speculative(trials: int, n_slots: int = 6, decode_len: int = 48,
                       k: int = 4):
     """ISSUE 15 measurement: draft-k-verify-once decoding vs the plain
@@ -2719,6 +2868,14 @@ def main() -> None:
             except Exception as e:
                 print(f"long-context bench s={lc_seq} failed: {e}",
                       file=sys.stderr)
+        # the serving side of long context (ISSUE 20): tiered-KV
+        # session capacity + resume-vs-reprefill TTFT, gated below
+        try:
+            long_ctx.append(retry_transient(
+                bench_long_context_sessions, trials))
+        except Exception as e:
+            print(f"long-context session bench failed: {e}",
+                  file=sys.stderr)
 
     lstm_results = {}
     for hidden in [int(x) for x in os.environ.get(
@@ -3004,8 +3161,27 @@ def main() -> None:
     missing = []
     if out["transformer_tokens_per_sec"] is None:
         missing.append("transformer_tokens_per_sec")
-    if os.environ.get("BENCH_SKIP_LONGCTX", "") != "1" and not long_ctx:
-        missing.append("transformer_long_context")
+    if os.environ.get("BENCH_SKIP_LONGCTX", "") != "1":
+        if not long_ctx:
+            missing.append("transformer_long_context")
+        sess_row = next((r for r in long_ctx
+                         if r.get("mode") == "tiered_kv_sessions"), None)
+        if sess_row is None:
+            missing.append("transformer_long_context_sessions")
+        else:
+            mc = sess_row["max_concurrent_sessions"]
+            if mc["tiered"] <= mc["hbm_only"]:
+                # the tier must BUY session capacity over the same HBM
+                # pool, not just exist — a failed run otherwise
+                missing.append("longctx_capacity_contract")
+            if sess_row["resume_vs_reprefill_ttft_ratio"] >= 1.0:
+                # resuming a suspended session must beat re-prefilling
+                # the same-length prompt, or suspend/resume is pointless
+                missing.append("longctx_resume_ttft_contract")
+            if sess_row["recompiles_after_warmup"] != 0:
+                # tier churn (suspend/resume/demote/promote) compiled
+                # something after warmup — fixed-signature contract broke
+                missing.append("longctx_recompile_contract")
     if os.environ.get("BENCH_SKIP_PIPELINE", "") != "1" \
             and pipeline_cmp is None:
         missing.append("pipeline")

@@ -626,6 +626,22 @@ def _page_copy_cost(od, env):
     return OpCost(0.0, moved, moved)
 
 
+@cost_rule("paged_page_gather", "quantized_paged_page_gather",
+           "paged_page_scatter", "quantized_paged_page_scatter")
+def _page_xfer_cost(od, env):
+    """Tier transfers move W whole pages (all layers, K+V) between the
+    pool and a dense slab — pure bandwidth, zero flops; the int8 pool's
+    fp32 scale sidecar rides the same rows."""
+    h, page, d, item = _pool_geometry(env, od)
+    n_layer = max(1, int(od.attrs.get("n_layer", 1)))
+    pages = env.shape((od.inputs.get("Pages") or [""])[0]) or [1]
+    w = _prod(pages)
+    moved = float(w * 2 * n_layer * page * h * d * item)
+    if od.inputs.get("Scales"):
+        moved += w * 2 * n_layer * page * 4
+    return OpCost(0.0, moved, moved)
+
+
 # ---------------------------------------------------------------------------
 # peak-HBM planner: liveness byte timeline per block
 # ---------------------------------------------------------------------------

@@ -1106,6 +1106,41 @@ def _r_page_copy(ctx: _OpCtx) -> None:
                     slot=f"{slot}#{pos}")
 
 
+@prop_rule("paged_page_gather", "quantized_paged_page_gather")
+def _r_page_gather(ctx: _OpCtx) -> None:
+    """KV-tier download: the slab is pool rows restacked on a page
+    axis — [h, W*2L, ps, d] has the pool's rank and head-leading
+    layout, so Out keeps the pool's sharding and the scale slab
+    mirrors the scales sidecar."""
+    pool = ctx.first("Pool")
+    scales = ctx.first("Scales")
+    for slot, pos, name in ctx.op.writes:
+        src = scales if slot == "ScalesOut" else pool
+        ctx.set_out(name, ctx.spec(src) if src else (),
+                    slot=f"{slot}#{pos}")
+
+
+@prop_rule("paged_page_scatter", "quantized_paged_page_scatter")
+def _r_page_scatter(ctx: _OpCtx) -> None:
+    """KV-tier upload: Out aliases Pool (ScalesOut aliases Scales), so
+    each target keeps its own sharding; a slab whose head dim disagrees
+    with a head-sharded pool would force an all-to-all first."""
+    pool = ctx.first("Pool")
+    ps = ctx.spec(pool) if pool else ()
+    data = ctx.first("Data")
+    if data is not None:
+        ds = ctx.spec(data)
+        if ps and ps[0] and ds and ds[0] and ds[0] != ps[0]:
+            ctx.hazard(ALL_TO_ALL, ps[0], data,
+                       f"upload slab head dim sharded '{ds[0]}' but the "
+                       f"pool's head dim is '{ps[0]}'", slot="Data#0")
+    scales = ctx.first("Scales")
+    for slot, pos, name in ctx.op.writes:
+        src = scales if slot == "ScalesOut" else pool
+        ctx.set_out(name, ctx.spec(src) if src else (),
+                    slot=f"{slot}#{pos}")
+
+
 @prop_rule("fused_vocab_cross_entropy")
 def _r_vocab_ce(ctx: _OpCtx) -> None:
     x = ctx.first("X")

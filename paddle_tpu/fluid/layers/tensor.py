@@ -9,7 +9,7 @@ __all__ = ["create_tensor", "create_global_var", "fill_constant",
            "fill_constant_batch_size_like", "zeros", "ones", "concat",
            "sums", "assign", "cast", "argmax", "isfinite", "cache_write",
            "paged_cache_write", "quantized_paged_cache_write",
-           "paged_page_copy"]
+           "paged_page_copy", "paged_page_gather", "paged_page_scatter"]
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -173,6 +173,60 @@ def paged_page_copy(pool, src, dst, n_layer, out=None, scales=None,
     out.stop_gradient = True
     helper.append_op("paged_page_copy",
                      {"Pool": pool, "Src": src, "Dst": dst},
+                     {"Out": out}, {"n_layer": int(n_layer)})
+    return out
+
+
+def paged_page_gather(pool, pages, n_layer, scales=None):
+    """Gather W whole logical pages out of the paged pool as a dense
+    [H, W*2L, page_size, D] slab — the device side of a KV-tier download
+    (ops/cache_ops.paged_page_gather).  ``pages`` [W] int32 is DATA;
+    short transfers pad with the trash page.  Pass the int8 pool's
+    ``scales`` sidecar to gather the fp32 block scales with the bytes;
+    returns (slab, scale_slab) then."""
+    if scales is not None:
+        helper = LayerHelper("quantized_paged_page_gather")
+        out = helper.create_tmp_variable(pool.dtype, stop_gradient=True)
+        scales_out = helper.create_tmp_variable(scales.dtype,
+                                                stop_gradient=True)
+        helper.append_op("quantized_paged_page_gather",
+                         {"Pool": pool, "Scales": scales, "Pages": pages},
+                         {"Out": out, "ScalesOut": scales_out},
+                         {"n_layer": int(n_layer)})
+        return out, scales_out
+    helper = LayerHelper("paged_page_gather")
+    out = helper.create_tmp_variable(pool.dtype, stop_gradient=True)
+    helper.append_op("paged_page_gather",
+                     {"Pool": pool, "Pages": pages},
+                     {"Out": out}, {"n_layer": int(n_layer)})
+    return out
+
+
+def paged_page_scatter(pool, data, pages, n_layer, out=None, scales=None,
+                       scale_data=None, scales_out=None):
+    """Scatter a gathered slab back into W logical pages — the device
+    side of a KV-tier upload (ops/cache_ops.paged_page_scatter).  Out
+    defaults to the pool variable itself (the ParamOut in-place idiom);
+    trash-page entries absorb padding rows.  Pass ``scales`` +
+    ``scale_data`` for an int8 pool (the fp32 block scales re-install at
+    the same rows); returns (pool, scales) then."""
+    if scales is not None:
+        helper = LayerHelper("quantized_paged_page_scatter")
+        out = out or pool
+        scales_out = scales_out or scales
+        out.stop_gradient = True
+        scales_out.stop_gradient = True
+        helper.append_op("quantized_paged_page_scatter",
+                         {"Pool": pool, "Scales": scales, "Data": data,
+                          "ScaleData": scale_data, "Pages": pages},
+                         {"Out": out, "ScalesOut": scales_out},
+                         {"n_layer": int(n_layer)})
+        return out, scales_out
+    helper = LayerHelper("paged_page_scatter")
+    out = out or pool
+    out.stop_gradient = True
+    helper.append_op("paged_page_scatter",
+                     {"Pool": pool, "Data": data, "Pages": pages},
                      {"Out": out}, {"n_layer": int(n_layer)})
     return out
 

@@ -214,3 +214,71 @@ def quantized_paged_page_copy(ctx, pool, scales, src, dst):
     pool = pool.at[:, dst_rows].set(pool[:, src_rows])
     scales = scales.at[:, dst_rows].set(scales[:, src_rows])
     return pool, scales
+
+
+# ---------------------------------------------------------------------------
+# Tiered-KV transfer ops (ISSUE 20).  The device half of host-RAM page
+# demotion: gather pulls whole logical pages out of the pool as a dense
+# [H, W*2L, page_size, D] slab the host fetches (device->host), scatter
+# writes such a slab back into fresh pages (host->device).  W is FIXED
+# per compiled program (short transfers pad with the trash page), and
+# the page lists are int32 DATA — so the whole tier machinery compiles
+# exactly two extra executables and never recompiles after warmup.
+# ---------------------------------------------------------------------------
+
+
+@primitive("paged_page_gather", inputs=["Pool", "Pages"],
+           outputs=["Out"], no_grad=True)
+def paged_page_gather(ctx, pool, pages):
+    """Gather W whole logical pages (all layers, K and V) into a dense
+    slab [H, W*2L, page_size, D] for host download.  ``pages`` [W] int32
+    logical page ids; trash-page entries gather junk the host side
+    ignores (the fixed-width padding encoding)."""
+    n_layer = int(ctx.attr("n_layer", 1))
+    pages = jnp.asarray(pages).astype(jnp.int32).reshape(-1)
+    span = jnp.arange(2 * n_layer, dtype=jnp.int32)[None, :]
+    rows = (pages[:, None] * (2 * n_layer) + span).reshape(-1)  # [W*2L]
+    return pool[:, rows]
+
+
+@primitive("paged_page_scatter", inputs=["Pool", "Data", "Pages"],
+           outputs=["Out"], no_grad=True)
+def paged_page_scatter(ctx, pool, data, pages):
+    """Scatter a gathered slab [H, W*2L, page_size, D] back into the
+    pool at W logical pages — the host->device upload of a promoted or
+    resumed page.  Out aliases Pool (the cache_write ParamOut idiom);
+    trash-page entries absorb the padding rows harmlessly."""
+    n_layer = int(ctx.attr("n_layer", 1))
+    pages = jnp.asarray(pages).astype(jnp.int32).reshape(-1)
+    span = jnp.arange(2 * n_layer, dtype=jnp.int32)[None, :]
+    rows = (pages[:, None] * (2 * n_layer) + span).reshape(-1)
+    return pool.at[:, rows].set(data.astype(pool.dtype))
+
+
+@primitive("quantized_paged_page_gather", inputs=["Pool", "Scales", "Pages"],
+           outputs=["Out", "ScalesOut"], no_grad=True)
+def quantized_paged_page_gather(ctx, pool, scales, pages):
+    """``paged_page_gather`` for an int8 pool: the fp32 block-scale
+    sidecar rows travel WITH the int8 bytes (same physical rows), so a
+    demoted page carries everything needed to dequantize after resume."""
+    n_layer = int(ctx.attr("n_layer", 1))
+    pages = jnp.asarray(pages).astype(jnp.int32).reshape(-1)
+    span = jnp.arange(2 * n_layer, dtype=jnp.int32)[None, :]
+    rows = (pages[:, None] * (2 * n_layer) + span).reshape(-1)
+    return pool[:, rows], scales[:, rows]
+
+
+@primitive("quantized_paged_page_scatter",
+           inputs=["Pool", "Scales", "Data", "ScaleData", "Pages"],
+           outputs=["Out", "ScalesOut"], no_grad=True)
+def quantized_paged_page_scatter(ctx, pool, scales, data, scale_data, pages):
+    """``paged_page_scatter`` for an int8 pool: re-installs the int8
+    bytes AND their fp32 block scales at the same physical rows —
+    a promoted chunk dequantizes bit-identically to pre-demotion."""
+    n_layer = int(ctx.attr("n_layer", 1))
+    pages = jnp.asarray(pages).astype(jnp.int32).reshape(-1)
+    span = jnp.arange(2 * n_layer, dtype=jnp.int32)[None, :]
+    rows = (pages[:, None] * (2 * n_layer) + span).reshape(-1)
+    pool = pool.at[:, rows].set(data.astype(pool.dtype))
+    scales = scales.at[:, rows].set(scale_data.astype(scales.dtype))
+    return pool, scales

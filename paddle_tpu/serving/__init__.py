@@ -41,6 +41,12 @@ This package is the TPU-native replacement:
   truncation + pre-write copy-on-write, and per-request grammar/JSON
   constrained generation via in-graph token masks fed as data.
   Token-for-token parity with plain greedy at any accept rate.
+* ``SessionStore`` (sessions.py) + the tiered ``PageAllocator`` host
+  pool — the ISSUE-20 tentpole: evicted prefix chunks DEMOTE to pinned
+  host RAM instead of being destroyed (promoted back bitwise-identical
+  on the next hit), and whole lanes suspend/resume through checksummed
+  fingerprint-keyed host/disk artifacts — a session id on
+  ``/v1/generate`` continues a conversation without re-prefill.
 * ``gateway/`` (ISSUE 10) — the production front door: ``ModelRegistry``
   (versioned artifacts, HBM budget, zero-downtime hot swap),
   ``TenantRouter`` (token buckets, SLO-class admission, fair share),
@@ -60,10 +66,12 @@ from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
 from .constraints import (Constraint, DFAConstraint,  # noqa: F401
                           TokenSetConstraint, compile_constraint)
 from .speculative import SpeculativeGenerator  # noqa: F401
+from .sessions import SessionStore  # noqa: F401
 
 __all__ = ["InferenceEngine", "TransformerGenerator", "FullRerunDecoder",
            "PagedTransformerGenerator", "PageAllocator", "copy_weights",
            "kv_page_bytes", "PoolCapacityError",
            "ContinuousBatchingScheduler", "Request", "RequestCancelled",
            "SchedulerShutdown", "SpeculativeGenerator", "Constraint",
-           "TokenSetConstraint", "DFAConstraint", "compile_constraint"]
+           "TokenSetConstraint", "DFAConstraint", "compile_constraint",
+           "SessionStore"]

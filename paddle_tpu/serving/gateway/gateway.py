@@ -492,7 +492,8 @@ class Gateway:
                max_new: Optional[int] = None, on_token=None,
                draft_model: Optional[str] = None, constraint=None,
                speculate: Optional[bool] = None,
-               tag: Optional[str] = None) -> Request:
+               tag: Optional[str] = None,
+               session: Optional[str] = None) -> Request:
         """Rate-limit gate -> journal -> queue.  Returns the scheduler
         ``Request`` (``wait()`` for blocking use).  ``draft_model``
         (must match the group's attached draft), ``constraint`` (a
@@ -538,11 +539,12 @@ class Gateway:
         if self.journal is not None:
             jid = self.journal.new_jid()
             self.journal.record_submit(jid, tenant, model, prompt,
-                                       eff_new, decode=decode, tag=tag)
+                                       eff_new, decode=decode, tag=tag,
+                                       session=session)
         try:
             req = self.sched.submit(
                 prompt, max_new_tokens=eff_new, model=model,
-                tenant=tenant, decode=decode,
+                tenant=tenant, decode=decode, session=session,
                 on_token=self._wrap_on_token(jid, cfg.slo, inst,
                                              on_token))
         except BaseException as e:
@@ -564,11 +566,20 @@ class Gateway:
                  timeout: Optional[float] = 120.0,
                  draft_model: Optional[str] = None, constraint=None,
                  speculate: Optional[bool] = None,
-                 tag: Optional[str] = None) -> Dict[str, object]:
-        """Blocking path: submit, wait, return the full token list."""
+                 tag: Optional[str] = None,
+                 session: Optional[str] = None) -> Dict[str, object]:
+        """Blocking path: submit, wait, return the full token list.
+
+        ``session`` (ISSUE 20) names a tiered-KV conversation: the first
+        call decodes normally and SUSPENDS the lane's KV pages at retire
+        (host/disk artifact keyed by this id); a later call with the same
+        id resumes from the suspended position — the response's tokens
+        are the CONTINUATION only, and ``resumed`` tells which path
+        admission took (False = the artifact was missing/stale and the
+        prompt re-prefilled from scratch)."""
         req = self.submit(model, prompt, tenant=tenant, max_new=max_new,
                           draft_model=draft_model, constraint=constraint,
-                          speculate=speculate, tag=tag)
+                          speculate=speculate, tag=tag, session=session)
         if not req.wait(timeout):
             req.cancel()
             raise TimeoutError(f"generate: rid {req.rid} still running "
@@ -579,16 +590,21 @@ class Gateway:
         # DELIVERED completion from one whose async done record was
         # still queued when the replica died (the dedup input for
         # zero-duplicate journal migration)
-        return {"rid": req.rid, "jid": req.jid, "model": req.model,
-                "version": (req.group or "@?").split("@", 1)[-1],
-                "tenant": tenant, "tokens": list(req.tokens),
-                "latency_s": round(req.total_latency or 0.0, 4)}
+        out = {"rid": req.rid, "jid": req.jid, "model": req.model,
+               "version": (req.group or "@?").split("@", 1)[-1],
+               "tenant": tenant, "tokens": list(req.tokens),
+               "latency_s": round(req.total_latency or 0.0, 4)}
+        if session is not None:
+            out["session"] = session
+            out["resumed"] = bool(req.resumed)
+        return out
 
     def submit_stream(self, model: str, prompt, tenant: str = "default",
                       max_new: Optional[int] = None,
                       timeout: float = 60.0,
                       draft_model: Optional[str] = None, constraint=None,
-                      speculate: Optional[bool] = None) -> TokenStream:
+                      speculate: Optional[bool] = None,
+                      session: Optional[str] = None) -> TokenStream:
         """Streaming path: returns a ``TokenStream`` yielding tokens as
         decode steps retire.  Token-for-token identical to the blocking
         path (same scheduler, same lanes) — the acceptance test asserts
@@ -598,7 +614,8 @@ class Gateway:
         stream = TokenStream(timeout=timeout)
         req = self.submit(model, prompt, tenant=tenant, max_new=max_new,
                           on_token=stream._push, draft_model=draft_model,
-                          constraint=constraint, speculate=speculate)
+                          constraint=constraint, speculate=speculate,
+                          session=session)
         stream.request = req
         return stream
 
@@ -625,6 +642,7 @@ class Gateway:
                     max_new_tokens=entry["max_new"],
                     model=entry["model"], tenant=entry["tenant"],
                     decode=entry.get("decode"),
+                    session=entry.get("session"),
                     on_token=self._wrap_on_token(entry["jid"], cfg.slo,
                                                  inst))
             except Exception as e:

@@ -88,10 +88,16 @@ class RequestJournal:
     def record_submit(self, jid: str, tenant: str, model: str,
                       prompt, max_new: int,
                       decode: Optional[Dict] = None,
-                      tag: Optional[str] = None) -> None:
+                      tag: Optional[str] = None,
+                      session: Optional[str] = None) -> None:
         entry = {"op": "submit", "jid": jid, "tenant": tenant,
                  "model": model, "prompt": [int(t) for t in prompt],
                  "max_new": int(max_new)}
+        if session is not None:
+            # tiered-KV session id (ISSUE 20): replay re-attaches the
+            # request to its suspended KV — resumed when the artifact
+            # survived the restart, a plain re-prefill when it did not
+            entry["session"] = str(session)
         if decode is not None:
             # per-request decode options (ISSUE 15: draft on/off +
             # constraint spec) are plain JSON, so a replayed request

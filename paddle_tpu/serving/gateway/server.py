@@ -5,9 +5,13 @@ Same serving shape as ``observability/server.py`` and the PR 1
 JSON bodies, port 0 = pick-a-port.  Routes:
 
 * ``POST /v1/generate`` — body ``{"model", "prompt": [ids], "tenant",
-  "max_new", "stream", "draft_model", "constraint", "speculate"}``
-  (the last three are the ISSUE 15 speculative/constrained decode
-  options; they 400 unless the model group has a draft attached).
+  "max_new", "stream", "draft_model", "constraint", "speculate",
+  "session"}``
+  (draft/constraint/speculate are the ISSUE 15 speculative/constrained
+  decode options; they 400 unless the model group has a draft attached.
+  ``session`` (ISSUE 20) names a tiered-KV conversation: the lane's KV
+  suspends to host/disk at retire and resumes on the next call with the
+  same id — the blocking response echoes ``session`` + ``resumed``).
   Blocking by default (one JSON response with
   the full token list); ``"stream": true`` switches to chunked
   transfer, one JSON line per token as the decode step retires it, with
@@ -169,13 +173,19 @@ class _Handler(BaseHTTPRequestHandler):
         tag = body.get("tag")
         if tag is not None:
             tag = str(tag)
+        # tiered-KV session id (ISSUE 20): same id across calls =
+        # suspend at retire / resume at admission; the blocking
+        # response echoes it back with a "resumed" flag
+        session = body.get("session")
+        if session is not None:
+            session = str(session)
         if not body.get("stream", False):
             out = gw.generate(model, prompt, tenant=tenant,
                               max_new=max_new,
                               timeout=self.server_ref.request_timeout,
                               draft_model=draft_model,
                               constraint=constraint, speculate=speculate,
-                              tag=tag)
+                              tag=tag, session=session)
             return self._send_json(out)
         # chunked streaming: one JSON line per token, then a done line.
         # BrokenPipe (client went away) cancels the request so the lane
@@ -185,7 +195,7 @@ class _Handler(BaseHTTPRequestHandler):
                                   timeout=self.server_ref.request_timeout,
                                   draft_model=draft_model,
                                   constraint=constraint,
-                                  speculate=speculate)
+                                  speculate=speculate, session=session)
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonl")
         self.send_header("Transfer-Encoding", "chunked")
@@ -198,11 +208,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.flush()
                 n += 1
             req = stream.request
-            self._chunk(json.dumps(
-                {"done": True, "tokens": n, "rid": req.rid,
-                 "jid": req.jid,
-                 "version": (req.group or "@?").split("@", 1)[-1]}
-                ).encode() + b"\n")
+            done_line = {"done": True, "tokens": n, "rid": req.rid,
+                         "jid": req.jid,
+                         "version": (req.group or "@?").split("@", 1)[-1]}
+            if session is not None:
+                done_line["session"] = session
+                done_line["resumed"] = bool(req.resumed)
+            self._chunk(json.dumps(done_line).encode() + b"\n")
             self._chunk(b"")
         except (BrokenPipeError, ConnectionResetError):
             stream.close()

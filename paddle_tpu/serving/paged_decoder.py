@@ -34,6 +34,8 @@ test_paged_serving.py) when both run the causal-encoder feeds.
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -390,7 +392,9 @@ class PagedTransformerGenerator:
                  param_prefix="tf", start_id=0, end_id=1,
                  page_size=8, num_pages=None, chunk_size=8,
                  prefix_sharing=True, topk_size=None,
-                 kv_dtype="float32", mesh=None, mesh_axes=None):
+                 kv_dtype="float32", mesh=None, mesh_axes=None,
+                 host_pages=0, session_store=None, xfer_width=4,
+                 demote_watermark=0):
         if d_key != d_value:
             raise ValueError("paged KV pool requires d_key == d_value "
                              "(one pool row shape serves both)")
@@ -436,7 +440,6 @@ class PagedTransformerGenerator:
             num_pages = default_num_pages(self.src_len, self.max_out_len,
                                           self.page_size)
         self.num_pages = int(num_pages)
-        self.alloc = PageAllocator(self.num_pages, self.page_size)
         self.scope = scope or fluid.Scope()
         self.exe = executor or fluid.Executor(place or fluid.TPUPlace(0))
         self.kv_dtype = kv_dtype
@@ -448,6 +451,24 @@ class PagedTransformerGenerator:
                               self.page_size)
         self.page_bytes = kv_page_bytes(n_layer, n_head, d_key,
                                         self.page_size, kv_dtype)
+        # tiered KV (ISSUE 20): host_pages > 0 attaches a host-RAM
+        # demotion tier behind the allocator; session_store enables
+        # suspend/resume of whole lanes; both are opt-in (defaults keep
+        # the exact pre-tier destroy-on-evict engine).
+        self.host_pages = int(host_pages)
+        self.sessions = session_store
+        self.xfer_width = max(1, int(xfer_width))
+        self.demote_watermark = int(demote_watermark)
+        self.alloc = PageAllocator(self.num_pages, self.page_size,
+                                   host_pages=self.host_pages)
+        self._xfer_progs = None
+        self._pending_suspends: Dict[str, Dict] = {}
+        self._tier_stats = {"suspends": 0, "suspend_drops": 0,
+                            "resumes": 0, "resume_misses": 0,
+                            "prefetches": 0, "eager_demotes": 0}
+        if self.host_pages > 0:
+            self.alloc.set_pager(self._tier_download, self._tier_upload,
+                                 page_bytes=self.page_bytes)
         self._lanes: List[_Lane] = []
         self._slots = 0
         self._steps = 0
@@ -722,6 +743,338 @@ class PagedTransformerGenerator:
         for p in lane.self_table:
             self.alloc.unref(p)
         lane.reset()
+
+    # -- tiered KV & sessions (ISSUE 20) -------------------------------------
+    def _xfer(self):
+        """Lazily build the d2h/h2d copy-program pair: ``download``
+        gathers W whole logical pages into a dense slab the host
+        fetches; ``upload`` scatters such a slab back (Out aliases
+        Pool).  W (``xfer_width``) is FIXED and short transfers pad
+        with the trash page, so each program compiles exactly once —
+        tiering adds two executables and zero recompiles."""
+        if self._xfer_progs is not None:
+            return self._xfer_progs
+        c = self.cfg
+        W = self.xfer_width
+        rows = W * 2 * c.n_layer
+        down, d_start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(down, d_start), \
+                fluid.unique_name.guard():
+            block = down.global_block()
+            pool = self._pool_var(block)
+            kv_scales = self._scales_var(block)
+            pages = layers.data("xfer_pages", [W], "int32",
+                                append_batch_size=False)
+            if kv_scales is not None:
+                slab, sslab = layers.paged_page_gather(
+                    pool, pages, n_layer=c.n_layer, scales=kv_scales)
+                d_fetch = [slab, sslab]
+            else:
+                slab = layers.paged_page_gather(pool, pages,
+                                                n_layer=c.n_layer)
+                d_fetch = [slab]
+        up, u_start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(up, u_start), fluid.unique_name.guard():
+            block = up.global_block()
+            pool = self._pool_var(block)
+            kv_scales = self._scales_var(block)
+            pages = layers.data("xfer_pages", [W], "int32",
+                                append_batch_size=False)
+            data = layers.data("xfer_data",
+                               [c.n_head, rows, self.page_size, c.d_key],
+                               self.kv_dtype, append_batch_size=False)
+            if kv_scales is not None:
+                sdata = layers.data("xfer_scales",
+                                    [1, rows, self.page_size], "float32",
+                                    append_batch_size=False)
+                layers.paged_page_scatter(pool, data, pages,
+                                          n_layer=c.n_layer,
+                                          scales=kv_scales,
+                                          scale_data=sdata)
+            else:
+                layers.paged_page_scatter(pool, data, pages,
+                                          n_layer=c.n_layer)
+        self._xfer_progs = {"down": (down, d_fetch), "up": up}
+        return self._xfer_progs
+
+    def _tier_download(self, pages) -> Dict[str, object]:
+        """Device->host: pull whole logical pages as host numpy.  Groups
+        of ``xfer_width`` ride one fixed-signature dispatch each.
+        Returns ``{"kv": [h, n*2L, ps, d], "scales": [1, n*2L, ps]|None}``
+        with rows in the order of ``pages``."""
+        progs = self._xfer()
+        down, fetches = progs["down"]
+        c = self.cfg
+        W, L2, ps = self.xfer_width, 2 * c.n_layer, self.page_size
+        kv_parts: List[np.ndarray] = []
+        sc_parts: List[np.ndarray] = []
+        pages = [int(p) for p in pages]
+        for i in range(0, len(pages), W):
+            grp = pages[i:i + W]
+            pad = np.full(W, TRASH_PAGE, np.int32)
+            pad[:len(grp)] = grp
+            with fluid.scope_guard(self.scope), self._mesh_ctx():
+                out = self.exe.run(down, feed={"xfer_pages": pad},
+                                   fetch_list=fetches, mode="infer")
+            slab = np.asarray(out[0]).reshape(c.n_head, W * L2, ps,
+                                              c.d_key)
+            kv_parts.append(slab[:, :len(grp) * L2])
+            if len(fetches) > 1:
+                ssl = np.asarray(out[1]).reshape(1, W * L2, ps)
+                sc_parts.append(ssl[:, :len(grp) * L2])
+        kv = np.concatenate(kv_parts, axis=1) if kv_parts else \
+            np.zeros((c.n_head, 0, ps, c.d_key), self.kv_dtype)
+        scales = np.concatenate(sc_parts, axis=1) if sc_parts else None
+        return {"kv": kv, "scales": scales}
+
+    def _tier_upload(self, pages, payload) -> None:
+        """Host->device: scatter a ``_tier_download`` payload back into
+        freshly allocated pages (same fixed-width program discipline;
+        pad rows land on the trash page)."""
+        progs = self._xfer()
+        up = progs["up"]
+        c = self.cfg
+        W, L2, ps = self.xfer_width, 2 * c.n_layer, self.page_size
+        kv = np.asarray(payload["kv"])
+        scales = payload.get("scales")
+        pages = [int(p) for p in pages]
+        if kv.shape[1] != len(pages) * L2:
+            raise ValueError(
+                f"tier upload: payload holds {kv.shape[1] // L2} pages, "
+                f"target list has {len(pages)}")
+        for i in range(0, len(pages), W):
+            grp = pages[i:i + W]
+            pad = np.full(W, TRASH_PAGE, np.int32)
+            pad[:len(grp)] = grp
+            data = np.zeros((c.n_head, W * L2, ps, c.d_key), kv.dtype)
+            data[:, :len(grp) * L2] = kv[:, i * L2:(i + len(grp)) * L2]
+            feed = {"xfer_pages": pad, "xfer_data": data}
+            if self.kv_dtype == "int8":
+                sdata = np.zeros((1, W * L2, ps), np.float32)
+                if scales is not None:
+                    sdata[:, :len(grp) * L2] = \
+                        np.asarray(scales)[:, i * L2:(i + len(grp)) * L2]
+                feed["xfer_scales"] = sdata
+            with fluid.scope_guard(self.scope), self._mesh_ctx():
+                self.exe.run(up, feed=feed, fetch_list=[], mode="infer")
+
+    def session_fingerprint(self) -> str:
+        """The artifact key prefix a suspended lane's KV is only valid
+        under: model geometry + pool dtype/layout + weights identity
+        (the param prefix — two models sharing a scope differ here).
+        A changed fingerprint turns every stored session into a clean
+        miss (degrade to re-prefill), never a wrong-KV resume."""
+        c = self.cfg
+        doc = json.dumps([c.src_vocab_size, c.trg_vocab_size, c.n_layer,
+                          c.n_head, c.d_key, c.d_value, c.d_model,
+                          c.d_inner_hid, c.max_length, self.kv_dtype,
+                          self.page_size, self.src_len, self.max_out_len,
+                          self.prefix], separators=(",", ":"))
+        return hashlib.sha256(doc.encode("utf-8")).hexdigest()[:24]
+
+    def detach_slot(self, slot: int, session_id: str) -> bool:
+        """Suspend a lane WITHOUT device work: the lane's page
+        references (self pages, cross pages, chunk refs) transfer to a
+        pending-suspend record and the slot frees immediately — safe to
+        call under the scheduler lock at retire time.  The d2h copy and
+        artifact store happen later in ``tier_maintenance`` (off the
+        lock).  False when sessions are off or the lane is not in a
+        suspendable phase (the caller falls back to ``clear_slot``)."""
+        if self.sessions is None:
+            return False
+        lane = self._lanes[slot]
+        if lane.phase not in ("decode", "hold") or not lane.self_table:
+            return False
+        old = self._pending_suspends.pop(session_id, None)
+        if old is not None:
+            # same session suspended twice before maintenance ran: the
+            # newer lane state supersedes — drop the stale record's refs
+            self._release_suspend_refs(old)
+        self._pending_suspends[session_id] = {
+            "src": np.array(lane.src), "s_true": lane.s_true,
+            "max_new": lane.max_new, "pos": lane.pos, "cur": lane.cur,
+            "self_table": list(lane.self_table),
+            "cross_table": list(lane.cross_table),
+            "cross_owned": list(lane.cross_owned),
+            "hit_hashes": list(lane.hit_hashes),
+            "inserted_hashes": list(lane.inserted_hashes),
+            # a fully-cached admit reaches decode without _finish_prefill
+            # — it still holds enc-owned refs that must release with the
+            # record, not leak
+            "enc_owned": list(lane.enc_owned),
+        }
+        lane.reset()
+        return True
+
+    def _release_suspend_refs(self, rec: Dict) -> None:
+        for h in rec["hit_hashes"] + rec["inserted_hashes"]:
+            self.alloc.unref_chunk(h)
+        for p in rec["cross_owned"] + rec["enc_owned"]:
+            self.alloc.unref(p)
+        for p in rec["self_table"]:
+            self.alloc.unref(p)
+
+    def _complete_suspend(self, session_id: str) -> bool:
+        """Finish one pending suspend: download the lane's used self
+        pages + cross pages, store the checksummed artifact, release the
+        page references.  Runs on the serve-loop thread OUTSIDE the
+        scheduler lock (the PR 12 discipline — this is device + disk
+        I/O).  The references are released even when the store fails:
+        the session degrades to re-prefill, the pool never leaks."""
+        rec = self._pending_suspends.pop(session_id, None)
+        if rec is None:
+            return False
+        ps = self.page_size
+        n_self_used = _ceil_div(rec["pos"], ps) if rec["pos"] else 0
+        ok = False
+        try:
+            cross = self._tier_download(rec["cross_table"])
+            own = self._tier_download(rec["self_table"][:n_self_used]) \
+                if n_self_used else {"kv": None, "scales": None}
+            arrays = {"cross_kv": cross["kv"]}
+            if cross["scales"] is not None:
+                arrays["cross_scales"] = cross["scales"]
+            if own["kv"] is not None:
+                arrays["self_kv"] = own["kv"]
+                if own["scales"] is not None:
+                    arrays["self_scales"] = own["scales"]
+            meta = {"pos": rec["pos"], "cur": rec["cur"],
+                    "s_true": rec["s_true"], "max_new": rec["max_new"],
+                    "src": [int(t) for t in rec["src"]],
+                    "n_cross": len(rec["cross_table"]),
+                    "n_self": n_self_used}
+            ok = self.sessions.put(session_id, self.session_fingerprint(),
+                                   meta, arrays)
+        except Exception:
+            ok = False
+        finally:
+            self._release_suspend_refs(rec)
+        self._tier_stats["suspends" if ok else "suspend_drops"] += 1
+        self._tracer.instant("session/suspend", cat="serving",
+                             sid=session_id, ok=ok,
+                             pages=len(rec["cross_table"]) + n_self_used)
+        return ok
+
+    def resume_slot(self, slot: int, session_id: str,
+                    max_new: Optional[int] = None):
+        """Resume a suspended session into an idle slot: allocate fresh
+        cross + self pages, upload the artifact's KV (+ int8 scale
+        sidecars), and restore the lane straight to ``decode`` phase at
+        its recorded position — no re-prefill.  Runs OUTSIDE the
+        scheduler lock (device + disk I/O, like ``admit_slot``).
+
+        Returns ``{"s_true", "pos", "max_new"}`` on success or None on
+        any miss — unknown/corrupt/stale artifact, position at the
+        generator's cap, or pool pressure — in which case the caller
+        degrades to a fresh ``admit_slot`` of the recorded prompt
+        (greedy decode is deterministic, so degrading costs prefill
+        latency, never wrong tokens)."""
+        if self.sessions is None:
+            return None
+        if not self._lanes:
+            raise RuntimeError("open_slots() before resume_slot()")
+        lane = self._lanes[slot]
+        if lane.phase != "idle":
+            raise RuntimeError(f"resume_slot: slot {slot} is busy")
+        if session_id in self._pending_suspends:
+            # resumed before maintenance flushed it: complete the spill
+            # now so the resume reads a stored artifact (one code path)
+            self._complete_suspend(session_id)
+        got = self.sessions.get(session_id, self.session_fingerprint())
+        if got is None:
+            self._tier_stats["resume_misses"] += 1
+            return None
+        meta, arrays = got
+        pos = int(meta["pos"])
+        ps = self.page_size
+        # the self_table feed width is fixed at p_out: a resumed lane
+        # continues within the SAME compiled signature, so its total
+        # output (recorded pos + continuation) caps at max_out_len
+        mn = self._resolve_max_new(max_new)
+        mn = min(mn, self.max_out_len - pos)
+        if mn <= 0:
+            self._tier_stats["resume_misses"] += 1
+            return None
+        n_cross = int(meta["n_cross"])
+        n_self_used = int(meta["n_self"])
+        n_self = min(self.p_out, max(n_self_used,
+                                     _ceil_div(pos + mn, ps)))
+        try:
+            pages = self.alloc.alloc(n_cross + n_self)
+        except PoolCapacityError:
+            self._tier_stats["resume_misses"] += 1
+            return None
+        cross_pages = pages[:n_cross]
+        self_pages = pages[n_cross:]
+        try:
+            self._tier_upload(cross_pages,
+                              {"kv": arrays["cross_kv"],
+                               "scales": arrays.get("cross_scales")})
+            if n_self_used:
+                self._tier_upload(self_pages[:n_self_used],
+                                  {"kv": arrays["self_kv"],
+                                   "scales": arrays.get("self_scales")})
+        except Exception:
+            for p in pages:
+                self.alloc.unref(p)
+            self._tier_stats["resume_misses"] += 1
+            return None
+        lane.src = np.asarray(meta["src"], np.int64)
+        lane.s_true = int(meta["s_true"])
+        lane.max_new = mn
+        lane.hashes = []
+        lane.hit_hashes = []
+        lane.inserted_hashes = []
+        lane.enc_table = []
+        lane.enc_owned = []
+        lane.cross_table = cross_pages
+        lane.cross_owned = cross_pages
+        lane.self_table = self_pages
+        lane.enc_done = lane.s_true
+        lane.pending_chunk = 0
+        lane.cur = int(meta["cur"])
+        lane.pos = pos
+        lane.phase = "decode"
+        self._tier_stats["resumes"] += 1
+        self._tracer.instant("session/resume", cat="serving",
+                             sid=session_id, slot=slot, pos=pos,
+                             pages=len(pages))
+        return {"s_true": lane.s_true, "pos": pos, "max_new": mn}
+
+    def tier_maintenance(self, prefetch=None) -> bool:
+        """The serve loop's off-lock tier slice: complete pending
+        suspends (d2h + artifact store), prefetch-promote a queued
+        prompt's demoted chunks during the admission gap, and eager-
+        demote LRU chunks down to the free-page watermark.  Returns
+        True when any device/disk work happened (the scheduler counts
+        that as progress so shutdown drains suspends)."""
+        did = False
+        for sid in list(self._pending_suspends):
+            self._complete_suspend(sid)
+            did = True
+        if prefetch is not None and self.prefix_sharing \
+                and self.alloc.tiered:
+            hashes = chunk_hashes(np.asarray(prefetch).reshape(-1),
+                                  self.page_size)
+            resident = len(self.alloc.lookup_chain(hashes, count=False))
+            for h in hashes[resident:]:
+                if not self.alloc.promote_chunk(h):
+                    break
+                self._tier_stats["prefetches"] += 1
+                did = True
+        if self.demote_watermark and self.alloc.tiered:
+            while self.alloc.free_count() < self.demote_watermark:
+                if not self.alloc.demote_one():
+                    break
+                self._tier_stats["eager_demotes"] += 1
+                did = True
+        if self.sessions is not None \
+                and self.sessions.idle_spill_s is not None:
+            # suspend-on-idle at the host-RAM level: sessions nobody
+            # resumed lately drop their RAM copy (disk keeps them)
+            if self.sessions.spill_idle():
+                did = True
+        return did
 
     def _finish_prefill(self, lane: _Lane) -> None:
         lane.phase = "decode"
@@ -1145,6 +1498,20 @@ class PagedTransformerGenerator:
                 "dense_bytes_per_slot": self.kv_bytes_per_slot_dense(),
             },
             "shard": self.shard_plan(),
+            "tiers": {
+                "host_pages": pages.get("host_pages", 0),
+                "host_pages_used": pages.get("host_pages_used", 0),
+                "host_chunks": pages.get("host_chunks", 0),
+                "demotes": pages.get("demotes", 0),
+                "promotes": pages.get("promotes", 0),
+                "host_evictions": pages.get("host_evictions", 0),
+                "spilled_bytes": pages.get("spilled_bytes", 0),
+                "fetched_bytes": pages.get("fetched_bytes", 0),
+                "pending_suspends": len(self._pending_suspends),
+                **self._tier_stats,
+            },
+            "sessions": self.sessions.stats()
+            if self.sessions is not None else None,
         }
 
     def shard_plan(self) -> Dict[str, object]:

@@ -223,10 +223,17 @@ class Request:
     def __init__(self, src_tokens, max_new_tokens: int,
                  model: str = DEFAULT_MODEL, tenant: Optional[str] = None,
                  on_token: Optional[Callable] = None,
-                 decode: Optional[Dict] = None):
+                 decode: Optional[Dict] = None,
+                 session: Optional[str] = None):
         self.rid = next(Request._next_id)
         self.src = np.asarray(src_tokens)
         self.max_new_tokens = int(max_new_tokens)
+        # tiered-KV session id (ISSUE 20): admission tries resume_slot
+        # first (continue from suspended KV, no re-prefill) and a clean
+        # retire suspends the lane's pages instead of destroying them.
+        # ``resumed`` records which path admission actually took.
+        self.session = session
+        self.resumed = False
         self.model = str(model)          # alias as submitted; resolved
         self.group: Optional[str] = None  # lane-group key at admission
         # per-request decode options (ISSUE 15): a speculative-aware
@@ -548,7 +555,8 @@ class ContinuousBatchingScheduler:
     def submit(self, src_tokens, max_new_tokens: Optional[int] = None,
                model: str = DEFAULT_MODEL, tenant: Optional[str] = None,
                on_token: Optional[Callable] = None,
-               decode: Optional[Dict] = None) -> Request:
+               decode: Optional[Dict] = None,
+               session: Optional[str] = None) -> Request:
         with self._lock:
             group = self._group_for(model)
         if group is None:
@@ -579,10 +587,16 @@ class ContinuousBatchingScheduler:
                     f"per-request decode options (draft/constraint "
                     f"need a speculative lane group)")
         cap = getattr(group.model, "max_out_len", self.default_max_new)
+        if session is not None and not callable(
+                getattr(group.model, "resume_slot", None)):
+            # a sessionless group serves the request fine — it just
+            # cannot suspend/resume; drop the id rather than reject so
+            # journal replay onto an untiered build still decodes
+            session = None
         req = Request(src_tokens,
                       min(max_new_tokens or self.default_max_new, cap),
                       model=model, tenant=tenant, on_token=on_token,
-                      decode=decode)
+                      decode=decode, session=session)
         if group.page_aware and group.model.prompt_infeasible(
                 req.src, req.max_new_tokens):
             # structurally unserveable: the prompt + decode reservation
@@ -754,13 +768,31 @@ class ContinuousBatchingScheduler:
                 self._queue.remove(req)
                 slot = group.free.pop()
             try:
+                resumed_max_new = None
                 if getattr(group.model, "speculative_aware", False):
                     s_true = group.model.admit_slot(
                         slot, req.src, max_new=req.max_new_tokens,
                         decode=req.decode)
                 elif group.page_aware:
-                    s_true = group.model.admit_slot(
-                        slot, req.src, max_new=req.max_new_tokens)
+                    s_true = None
+                    if req.session is not None and callable(
+                            getattr(group.model, "resume_slot", None)):
+                        # session resume first (device h2d upload —
+                        # correctly OUTSIDE the lock, like prefill); any
+                        # miss (unknown/corrupt/stale artifact, pool
+                        # pressure) degrades to a fresh prefill of the
+                        # same prompt — greedy decode is deterministic,
+                        # so degrading costs latency, never wrong tokens
+                        got = group.model.resume_slot(
+                            slot, req.session,
+                            max_new=req.max_new_tokens)
+                        if got is not None:
+                            s_true = got["s_true"]
+                            resumed_max_new = got["max_new"]
+                            req.resumed = True
+                    if s_true is None:
+                        s_true = group.model.admit_slot(
+                            slot, req.src, max_new=req.max_new_tokens)
                 else:
                     s_true = group.model.admit_slot(slot, req.src)
             except BaseException as e:
@@ -799,10 +831,17 @@ class ContinuousBatchingScheduler:
                         except Exception:
                             pass
                     group.free.append(slot)
+                    req.resumed = False
                     self._queue.appendleft(req)
                     continue
                 req.slot = slot
                 req.group = group.key
+                if resumed_max_new is not None:
+                    # the resumed lane's self-KV table is sized for the
+                    # recorded position + this continuation: the retire
+                    # cap must not outrun it
+                    req.max_new_tokens = min(req.max_new_tokens,
+                                             resumed_max_new)
                 req.admitted = time.perf_counter()
                 group.active[slot] = req
                 in_flight = sum(len(g.active)
@@ -815,7 +854,8 @@ class ContinuousBatchingScheduler:
             self._m_requests.labels(event="admitted").inc()
             self._h_queue.observe(req.admitted - req.submitted)
             self._tracer.instant("request/admitted", cat="serving",
-                                 rid=req.rid, slot=slot, model=group.key)
+                                 rid=req.rid, slot=slot, model=group.key,
+                                 resumed=req.resumed)
             admitted += 1
 
     def _retire_locked(self, group: _LaneGroup, slot: int,
@@ -831,10 +871,25 @@ class ContinuousBatchingScheduler:
         req.finished = time.perf_counter()
         del group.active[slot]
         if group.page_aware:
-            try:
-                group.model.clear_slot(slot)
-            except BaseException as e:      # pragma: no cover - belt and
-                req.error = req.error or e  # braces; never lose the slot
+            detached = False
+            if req.session is not None and req.error is None:
+                # session retire SUSPENDS instead of destroys: the
+                # lane's page refs move to a pending-suspend record
+                # (bookkeeping only — legal under this lock); the d2h
+                # spill + artifact store run later in tier_maintenance,
+                # off the lock.  Any failure degrades to the plain
+                # destroy path below.
+                try:
+                    detached = bool(getattr(
+                        group.model, "detach_slot",
+                        lambda *_: False)(slot, req.session))
+                except BaseException:
+                    detached = False
+            if not detached:
+                try:
+                    group.model.clear_slot(slot)
+                except BaseException as e:  # pragma: no cover - belt and
+                    req.error = req.error or e  # braces; keep the slot
         group.tokens[slot] = group.model.start_id
         group.pos[slot] = 0
         group.src_len[slot] = 1
@@ -955,18 +1010,43 @@ class ContinuousBatchingScheduler:
         with self._lock:
             self._reap_cancelled_locked()
             work = []
+            maint = []
             for group in self._groups.values():
+                if group.managed and callable(
+                        getattr(group.model, "tier_maintenance", None)):
+                    # snapshot the next queued prompt bound for this
+                    # group so the maintenance slice (outside the lock)
+                    # can prefetch its demoted prefix chunks back to HBM
+                    # during the admission gap
+                    pre = None
+                    for req in self._queue:
+                        if not req.cancelled and self._group_for(
+                                req.route_to or req.model) is group:
+                            pre = req.src
+                            break
+                    maint.append((group, pre))
                 if not group.active:
                     continue
                 snap = None if group.managed else (
                     group.tokens.copy(), group.pos.copy(),
                     group.src_len.copy())
                 work.append((group, snap))
-            if not work:
+            if not work and not maint:
                 return False
+        busy = bool(work)
         for group, snap in work:
             self._step_group(group, snap)
-        return True
+        # the off-lock tier slice, AFTER stepping: pending suspends
+        # spill to host/disk, queued-prompt chunks prefetch back, free
+        # pages top up to the demote watermark.  Counted as progress so
+        # the loop (and drain) keeps running until suspends complete.
+        for group, pre in maint:
+            try:
+                if group.model.tier_maintenance(prefetch=pre):
+                    busy = True
+            except BaseException:           # pragma: no cover - belt and
+                pass                        # braces; never kill the loop
+        return busy
 
     def _fail_group(self, group: _LaneGroup, exc: BaseException) -> None:
         """A step dispatch failed: fail every in-flight request of that
@@ -1126,11 +1206,40 @@ class ContinuousBatchingScheduler:
                 "kv_dtype": getattr(model, "kv_dtype", "float32"),
                 "page_bytes": model.page_bytes,
                 "pool_bytes": model.page_bytes * model.num_pages,
+                # ALWAYS a float (ISSUE 20 satellite): the dashboard
+                # schema divides by this key unconditionally — a model
+                # without the accessor reports 0.0, never a missing key
+                # or None
                 "kv_bytes_per_token": (
-                    model.kv_bytes_per_token()
+                    float(model.kv_bytes_per_token())
                     if hasattr(model, "kv_bytes_per_token")
-                    else None),
+                    else 0.0),
             }
+            alloc = getattr(model, "alloc", None)
+            if alloc is not None and hasattr(alloc, "stats"):
+                ast = alloc.stats()
+                gts = getattr(model, "_tier_stats", {})
+                out["kv"]["tiers"] = {
+                    "hbm_pages": int(getattr(model, "num_pages", 0)),
+                    "hbm_pages_in_use": int(ast.get("in_use", 0)),
+                    "host_pages": int(ast.get("host_pages", 0)),
+                    "host_pages_used": int(ast.get("host_pages_used",
+                                                   0)),
+                    "host_chunks": int(ast.get("host_chunks", 0)),
+                }
+                out["kv"]["spills"] = {
+                    "demotes": int(ast.get("demotes", 0)),
+                    "promotes": int(ast.get("promotes", 0)),
+                    "host_evictions": int(ast.get("host_evictions", 0)),
+                    "spilled_bytes": int(ast.get("spilled_bytes", 0)),
+                    "fetched_bytes": int(ast.get("fetched_bytes", 0)),
+                    "suspends": int(gts.get("suspends", 0)),
+                    "suspend_drops": int(gts.get("suspend_drops", 0)),
+                    "resumes": int(gts.get("resumes", 0)),
+                    "resume_misses": int(gts.get("resume_misses", 0)),
+                    "prefetches": int(gts.get("prefetches", 0)),
+                    "eager_demotes": int(gts.get("eager_demotes", 0)),
+                }
             if hasattr(model, "shard_plan"):
                 # mesh shape + per-shard pool residency for /statusz
                 out["kv"]["shard"] = model.shard_plan()

@@ -80,6 +80,7 @@ RANK_MASTER_QUEUE = 22     # master.queue          parallel/master.py
 RANK_FLEET_ROUTER = 24     # fleet.router          serving/fleet/router.py
 RANK_GATEWAY_WEDGE = 26    # gateway.wedge         serving/gateway/gateway.py
 RANK_SCHEDULER = 30        # serving.scheduler     serving/scheduler.py
+RANK_SESSIONS = 34         # serving.sessions      serving/sessions.py
 RANK_ROUTER = 40           # gateway.router        serving/gateway/router.py
 RANK_CANARY = 42           # lifecycle.canary      lifecycle/canary.py
 RANK_MODEL_REGISTRY = 44   # gateway.registry      serving/gateway/registry.py
@@ -108,6 +109,7 @@ RANK_TABLE: Dict[str, int] = {
     "fleet.router": RANK_FLEET_ROUTER,
     "gateway.wedge": RANK_GATEWAY_WEDGE,
     "serving.scheduler": RANK_SCHEDULER,
+    "serving.sessions": RANK_SESSIONS,
     "gateway.router": RANK_ROUTER,
     "lifecycle.canary": RANK_CANARY,
     "gateway.registry": RANK_MODEL_REGISTRY,

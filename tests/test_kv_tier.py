@@ -1,0 +1,349 @@
+"""Tiered KV cache & session tests (ISSUE 20): bitwise decode parity
+across evict->spill->reload round-trips for fp32/bf16/int8 pools (scale
+sidecars travel with the pages), session suspend/resume through the
+checksummed host/disk artifact with token-for-token continuation parity,
+the seeded ``kv.spill_corrupt`` chaos point degrading a torn artifact to
+re-prefill (never wrong tokens), zero recompiles with tiering active,
+the scheduler's always-emitted ``kv_bytes_per_token`` + tier/spill stats
+schema, and the gateway session API (journal replay included)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fluid
+from paddle_tpu.resilience.chaos import FaultInjector, install
+from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                PagedTransformerGenerator, SessionStore,
+                                TransformerGenerator)
+
+V, NL, NH, DK, DM, DI = 24, 2, 2, 4, 16, 32
+SRC, OUT, PS, CHUNK = 8, 16, 4, 4
+# probed prompt: greedy decode under seed-7 params emits no end_id for
+# >= 12 steps, so suspend/resume legs never retire early on end tokens
+PROMPT = np.array([14, 17, 23, 2, 5, 5], np.int64)
+
+
+@pytest.fixture(autouse=True)
+def _inert_chaos():
+    prev = install(FaultInjector())
+    yield
+    install(prev)
+
+
+def _mk(kv_dtype, store, prefix, host_pages=16, demote_watermark=0,
+        seed=7):
+    """A tiered paged generator sharing a randomly-initialized scope
+    with the dense decoder (same weight-init recipe as the paged parity
+    suite — dense.init_params seeds both)."""
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    kw = dict(n_layer=NL, n_head=NH, d_key=DK, d_value=DK, d_model=DM,
+              d_inner_hid=DI, max_length=64, src_len=SRC, scope=scope,
+              executor=exe, param_prefix=prefix)
+    dense = TransformerGenerator(V, V, max_out_len=OUT,
+                                 causal_encoder=True, **kw)
+    gen = PagedTransformerGenerator(V, V, max_out_len=OUT, page_size=PS,
+                                    chunk_size=CHUNK, num_pages=64,
+                                    kv_dtype=kv_dtype,
+                                    host_pages=host_pages,
+                                    session_store=store,
+                                    demote_watermark=demote_watermark,
+                                    **kw)
+    dense.init_params(seed=seed)
+    return gen
+
+
+@pytest.fixture(scope="module", params=["float32", "bfloat16", "int8"])
+def tiered(request, tmp_path_factory):
+    """One tiered generator per kv dtype, shared across this module's
+    tests (each test resets lane state via open_slots), plus the
+    uninterrupted greedy reference decode of PROMPT."""
+    kv_dtype = request.param
+    store = SessionStore(
+        dirname=str(tmp_path_factory.mktemp(f"kvs-{kv_dtype}")))
+    gen = _mk(kv_dtype, store, prefix=f"tft{kv_dtype[:3]}")
+    srcp = np.zeros((1, SRC), np.int64)
+    srcp[0, :len(PROMPT)] = PROMPT
+    ref = [int(t) for t in
+           gen.greedy(srcp, [len(PROMPT)], max_new=12,
+                      stop_at_end=False)[0]]
+    assert gen.end_id not in ref[:10], \
+        "probed prompt regressed; pick another"
+    return gen, store, ref
+
+
+def _decode(gen, slot, want, toks):
+    for _ in range(4 * OUT):
+        if len(toks) >= want:
+            return
+        out = gen.lane_step()
+        if slot in out:
+            toks.append(int(out[slot]))
+    raise AssertionError(f"lane never produced {want} tokens: {toks}")
+
+
+# -- suspend / resume ---------------------------------------------------------
+
+def test_suspend_resume_token_parity(tiered):
+    """Decode 4 tokens, suspend the lane to a host/disk artifact, resume
+    into a (different) slot, decode on: the continuation is bitwise the
+    tokens an uninterrupted decode produces — for fp32, bf16 AND int8
+    pools (the int8 artifact carries the fp32 scale sidecar rows)."""
+    gen, store, ref = tiered
+    gen.open_slots(2)
+    gen.admit_slot(0, PROMPT, max_new=10)
+    toks = []
+    _decode(gen, 0, 4, toks)
+    assert toks == ref[:4]
+    assert gen.detach_slot(0, "parity-1"), "detach refused a decode lane"
+    # the spill completes OFF the retire path, in the maintenance slice
+    assert gen.tier_maintenance()
+    assert gen.cache_stats()["tiers"]["suspends"] >= 1
+    got = store.get("parity-1", gen.session_fingerprint())
+    assert got is not None, "artifact unreadable after suspend"
+    meta, arrays = got
+    assert meta["pos"] == 4
+    if gen.kv_dtype == "int8":
+        assert "cross_scales" in arrays and "self_scales" in arrays, \
+            "int8 scale sidecars must travel with the pages"
+    # resume into the OTHER slot: placement must not matter
+    res = gen.resume_slot(1, "parity-1")
+    assert res is not None and res["pos"] == 4
+    _decode(gen, 1, 10, toks)
+    assert toks == ref[:10], (gen.kv_dtype, toks, ref)
+    gen.clear_slot(1)
+    # unknown session: clean miss, counted
+    misses0 = gen._tier_stats["resume_misses"]
+    assert gen.resume_slot(0, "never-stored") is None
+    assert gen._tier_stats["resume_misses"] == misses0 + 1
+
+
+def test_evict_spill_reload_bitwise(tiered):
+    """Cached chunks demoted to host RAM and promoted back land on
+    fresh pages with bitwise-identical bytes (pool rows AND, for int8,
+    the scale sidecar rows)."""
+    gen, _, _ = tiered
+    gen.open_slots(1)
+    gen.admit_slot(0, PROMPT, max_new=2)
+    toks = []
+    _decode(gen, 0, 2, toks)
+    gen.clear_slot(0)           # the prompt chunk goes evictable
+    a = gen.alloc
+    assert len(a._chunks) >= 1
+    h = next(iter(a._chunks))
+    enc, cross, _rc = a._chunks[h]
+    before = gen._tier_download([enc, cross])
+    demoted = 0
+    while a.demote_one():
+        demoted += 1
+    assert demoted >= 1 and h not in a._chunks
+    assert a.stats()["host_chunks"] >= 1
+    assert a.promote_chunk(h), "promote failed with free pages"
+    enc2, cross2, _rc = a._chunks[h]
+    after = gen._tier_download([enc2, cross2])
+    assert before["kv"].tobytes() == after["kv"].tobytes(), \
+        f"{gen.kv_dtype} chunk bytes changed across spill/reload"
+    if before["scales"] is not None:
+        assert before["scales"].tobytes() == after["scales"].tobytes(), \
+            "int8 scale sidecar changed across spill/reload"
+    a.check_invariants()
+
+
+def test_zero_recompiles_with_tiering_active(tiered):
+    """A full admit/decode/suspend/resume/demote/promote cycle after
+    warmup replays compiled executables only — block tables and transfer
+    feeds are int32 DATA, so tiering never widens the signature set."""
+    gen, _, _ = tiered
+    gen.open_slots(1)
+
+    def cycle(sid):
+        gen.admit_slot(0, PROMPT, max_new=6)
+        toks = []
+        _decode(gen, 0, 3, toks)
+        assert gen.detach_slot(0, sid)
+        gen.tier_maintenance()
+        assert gen.resume_slot(0, sid) is not None
+        _decode(gen, 0, 6, toks)
+        gen.clear_slot(0)
+        while gen.alloc.demote_one():
+            pass
+        gen.tier_maintenance(prefetch=PROMPT)
+
+    cycle("warm-1")             # warm every program incl. xfer pair
+    warm = gen.exe.cache_stats()["executable"]["misses"]
+    cycle("warm-2")
+    assert gen.exe.cache_stats()["executable"]["misses"] == warm, \
+        "tiering recompiled after warmup"
+    assert gen._tier_stats["prefetches"] >= 1, \
+        "prefetch never promoted the queued prompt's chunks"
+
+
+# -- scheduler integration ----------------------------------------------------
+
+def test_scheduler_session_lifecycle_and_chaos(tiered):
+    """Scheduler-level session flow: retire SUSPENDS (pages spill via
+    the maintenance slice, not under the lock), a same-session submit
+    RESUMES with exact continuation tokens, a lost artifact degrades to
+    re-prefill with correct tokens, and the seeded ``kv.spill_corrupt``
+    chaos point proves a torn artifact is detected (checksum), dropped,
+    and ALSO degrades to re-prefill — never wrong tokens."""
+    gen, store, ref = tiered
+    sched = ContinuousBatchingScheduler(gen, n_slots=2,
+                                        max_new_tokens=OUT)
+    base = dict(gen._tier_stats)
+    r1 = sched.submit(PROMPT, max_new_tokens=4, session="conv")
+    sched.run_until_idle()
+    assert r1.error is None and not r1.resumed
+    assert r1.tokens == ref[:4]
+    assert gen._tier_stats["suspends"] == base["suspends"] + 1
+    assert not gen._pending_suspends, "run_until_idle left a suspend"
+
+    r2 = sched.submit(PROMPT, max_new_tokens=6, session="conv")
+    sched.run_until_idle()
+    assert r2.error is None and r2.resumed
+    assert r2.tokens == ref[4:10], (gen.kv_dtype, r2.tokens, ref)
+
+    # stats schema (ISSUE 20 satellite): kv_bytes_per_token ALWAYS a
+    # float; tier page counts + spill/suspend counters present
+    st = sched.stats()["kv"]
+    assert isinstance(st["kv_bytes_per_token"], float) \
+        and st["kv_bytes_per_token"] > 0
+    assert st["tiers"]["host_pages"] == 16
+    assert st["spills"]["suspends"] >= 2
+    assert st["spills"]["resumes"] >= 1
+
+    # lost artifact: re-prefill, resumed False, same first tokens
+    store.delete("conv")
+    r3 = sched.submit(PROMPT, max_new_tokens=4, session="conv")
+    sched.run_until_idle()
+    assert r3.error is None and not r3.resumed
+    assert r3.tokens == ref[:4]
+
+    # torn artifact: r3's retire stored a fresh suspend; corrupt every
+    # read — the checksum catches it, the session drops from both store
+    # tiers, and the request decodes from the prompt instead
+    corrupt0 = store.stats()["corrupt"]
+    install(FaultInjector(spec="kv.spill_corrupt=1.0", seed=3))
+    r4 = sched.submit(PROMPT, max_new_tokens=4, session="conv")
+    sched.run_until_idle()
+    install(FaultInjector())
+    assert r4.error is None and not r4.resumed
+    assert r4.tokens == ref[:4], "torn spill artifact produced wrong " \
+        f"tokens: {r4.tokens}"
+    assert store.stats()["corrupt"] == corrupt0 + 1
+
+
+def test_scheduler_stats_kv_bytes_fallback():
+    """A page-aware model WITHOUT the kv_bytes_per_token accessor still
+    reports the key as 0.0 — the pre-fix schema emitted None and broke
+    dashboard division (ISSUE 20 satellite)."""
+
+    class _FakePaged:
+        page_aware = True
+        start_id, end_id = 0, 1
+        page_bytes = 128
+        num_pages = 8
+
+        def open_slots(self, n):
+            pass
+
+        def lane_step(self):
+            return {}
+
+    sched = ContinuousBatchingScheduler(_FakePaged(), n_slots=1,
+                                        max_new_tokens=4)
+    kv = sched.stats()["kv"]
+    assert kv["kv_bytes_per_token"] == 0.0
+    assert isinstance(kv["kv_bytes_per_token"], float)
+    assert "tiers" not in kv          # no allocator -> no tier block
+
+
+# -- session store ------------------------------------------------------------
+
+def test_session_store_integrity_semantics(tmp_path):
+    """Store-level contract: bf16 arrays round-trip bitwise (raw-bytes
+    framing — np.savez has no bf16), a STALE fingerprint is a miss that
+    does NOT delete the artifact (a config rollback can still resume
+    it), a TORN disk artifact is dropped from both tiers, host RAM is
+    LRU-bounded, and idle sessions spill their RAM copy to disk-only."""
+    import ml_dtypes
+
+    store = SessionStore(dirname=str(tmp_path / "a"), host_bytes=1 << 20)
+    kv = np.arange(64, dtype=np.float32).reshape(2, 32)
+    bf = kv.astype(ml_dtypes.bfloat16)
+    assert store.put("s", "fp-A", {"pos": 3},
+                     {"kv": kv, "bf": bf})
+    meta, arrays = store.get("s", "fp-A")
+    assert meta["pos"] == 3
+    assert arrays["kv"].tobytes() == kv.tobytes()
+    assert arrays["bf"].dtype == bf.dtype
+    assert arrays["bf"].tobytes() == bf.tobytes()
+    # stale fingerprint: miss, artifact SURVIVES
+    assert store.get("s", "fp-B") is None
+    assert store.stats()["resume_misses"] == 1
+    assert store.get("s", "fp-A") is not None
+    # torn disk artifact: drop host copy first so get() reads disk
+    store.spill_idle(0.0)
+    path = store._path("s")
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:len(raw) // 2])
+    assert store.get("s", "fp-A") is None
+    assert store.stats()["corrupt"] == 1
+    assert not store.has("s")
+    store.check_invariants()
+    # host LRU: a tiny budget holds one session; disk holds both
+    small = SessionStore(dirname=str(tmp_path / "b"),
+                         host_bytes=kv.nbytes + 512)
+    small.put("one", "fp", {}, {"kv": kv})
+    small.put("two", "fp", {}, {"kv": kv})
+    st = small.stats()
+    assert st["host_sessions"] == 1 and st["disk_sessions"] == 2
+    assert st["host_evictions"] == 1
+    assert small.get("one", "fp") is not None   # promoted back from disk
+    small.check_invariants()
+
+
+# -- gateway ------------------------------------------------------------------
+
+def test_gateway_session_api_and_journal_replay(tmp_path):
+    """`session` rides /v1/generate's surface end to end: the blocking
+    response echoes ``session``/``resumed``, the journal records the id,
+    and recovery resubmits with it (a replayed request re-attaches to
+    its suspended KV when the artifact survived)."""
+    from paddle_tpu.serving.gateway import Gateway
+    from paddle_tpu.serving.gateway.journal import RequestJournal
+
+    store = SessionStore(dirname=str(tmp_path / "kvs"))
+    gen = _mk("float32", store, prefix="tfgw")
+    srcp = np.zeros((1, SRC), np.int64)
+    srcp[0, :len(PROMPT)] = PROMPT
+    ref = [int(t) for t in gen.greedy(srcp, [len(PROMPT)], max_new=12,
+                                      stop_at_end=False)[0]]
+    gw = Gateway(n_slots=2, max_new_tokens=OUT,
+                 journal_path=str(tmp_path / "journal.jsonl"))
+    gw.load_model("chat", "1", instance=gen, warm=False)
+    gw.serve()
+    try:
+        o1 = gw.generate("chat", [int(t) for t in PROMPT], max_new=4,
+                         session="s-1", timeout=60)
+        assert o1["session"] == "s-1" and o1["resumed"] is False
+        assert o1["tokens"] == ref[:4]
+        deadline = time.monotonic() + 10
+        while not store.has("s-1"):    # serve thread finishes the spill
+            assert time.monotonic() < deadline, "suspend never completed"
+            time.sleep(0.01)
+        o2 = gw.generate("chat", [int(t) for t in PROMPT], max_new=6,
+                         session="s-1", timeout=60)
+        assert o2["resumed"] is True and o2["tokens"] == ref[4:10]
+    finally:
+        gw.shutdown()
+    # journal carries the session id; replay hands it back to submit
+    j = RequestJournal(str(tmp_path / "j2.jsonl"))
+    j.record_submit("jid-1", "default", "chat", [1, 2], 4,
+                    session="s-9")
+    j.flush()
+    entry = list(j.pending())[0]
+    assert entry["session"] == "s-9"

@@ -54,6 +54,18 @@ if [ "$want_sync" = 1 ]; then
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m paddle_tpu.tools.syncheck paddle_tpu/parallel || rc=1
 
+  # the KV tier + session store (ISSUE 20) move device pages and disk
+  # artifacts from the serve loop while the scheduler lock guards the
+  # bookkeeping — suspend d2h and artifact fsync MUST stay off that
+  # lock; the explicit sweep makes an I/O-under-lock regression in the
+  # tier path unmissable
+  echo "== syncheck over the tiered-KV serving modules"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m paddle_tpu.tools.syncheck paddle_tpu/serving/paging.py \
+      paddle_tpu/serving/paged_decoder.py \
+      paddle_tpu/serving/sessions.py \
+      paddle_tpu/serving/scheduler.py || rc=1
+
   # smoke-run the real scheduler/gateway/journal stack with runtime
   # order checking ON and dump the observed lock-order graph as an
   # artifact (SYNC_GRAPH_OUT overrides the path) — the graph is the
@@ -367,6 +379,22 @@ with open(os.path.join(tmpdir, "serving_int8_ragged_step.json"), "wb") as f:
 with open(os.path.join(tmpdir, "serving_int8_ragged_step.fetch"), "w") as f:
     f.write(qids.name + "\n")
 
+# tier sweep (ISSUE 20): the fixed-width page d2h/h2d copy-program
+# pair — the ONLY device work KV tiering adds — must stay analyzer-
+# clean and fully priced; the int8 generator's pair carries the fp32
+# scale sidecar, so it covers the quantized gather/scatter ops too
+tprogs = qgen._xfer()
+tdown, tfetch = tprogs["down"]
+with open(os.path.join(tmpdir, "kv_tier_download.json"), "wb") as f:
+    f.write(tdown.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "kv_tier_download.fetch"), "w") as f:
+    f.write("".join(v.name + "\n" for v in tfetch))
+tup = tprogs["up"]
+with open(os.path.join(tmpdir, "kv_tier_upload.json"), "wb") as f:
+    f.write(tup.desc.serialize_to_string())
+with open(os.path.join(tmpdir, "kv_tier_upload.fetch"), "w") as f:
+    f.write(qgen._pool_name + "\n")
+
 # sharded sweep (ISSUE 17): the tensor-parallel unified decode-step
 # program — head-sharded QKV/O + column/row MLP partitions annotated on
 # the descs, the pool partitioned on its head axis — must stay
@@ -489,7 +517,8 @@ EOF
   # with no registered cost rule fails via --fail-on (the analyzer
   # guessing about the flagship programs is a defect)
   for name in digits_conv word2vec resnet_cifar serving_int8_ragged_step \
-              speculative_verify_step speculative_draft_step; do
+              speculative_verify_step speculative_draft_step \
+              kv_tier_download kv_tier_upload; do
     prog="$tmpdir/$name.json"
     [ -f "$prog" ] || { echo "-- plint --cost $name: MISSING"; rc=1; continue; }
     fetch_args=""
